@@ -1,0 +1,100 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework.
+
+Capability surface of DeepSpeed (see SURVEY.md), re-designed for TPU: named-axis
+device meshes + pjit sharding instead of runtime partition hooks, one fused compiled
+train step, Pallas kernels for hot ops, XLA collectives over ICI/DCN.
+
+Public API parity (reference: ``deepspeed/__init__.py``):
+- ``initialize(...)`` (:69) → (engine, optimizer, dataloader, lr_scheduler)
+- ``init_inference(...)`` (:291)
+- ``add_config_arguments(...)`` (:268)
+"""
+
+from typing import Any, Callable, Optional
+
+__version__ = "0.1.0"
+
+from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
+from deepspeed_tpu.comm import mesh as _mesh_lib
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
+from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port: Optional[int] = None,
+               mesh=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn: Optional[Callable] = None,
+               config: Any = None,
+               config_params: Any = None,
+               loss_fn: Optional[Callable] = None,
+               example_batch: Any = None,
+               tensor_rules: Optional[Callable] = None,
+               seed: int = 0):
+    """Build the engine (reference: deepspeed.initialize, deepspeed/__init__.py:69).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)`` like the
+    reference. ``model`` is a flax Module or a callable
+    ``apply_fn(params, batch, rng) -> loss``; ``model_parameters`` is the params
+    pytree (or None to init from ``example_batch``).
+    """
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    ds_config = config if isinstance(config, DeepSpeedTPUConfig) \
+        else DeepSpeedTPUConfig(config)
+
+    if dist_init_required:
+        _mesh_lib.init_distributed()
+
+    engine = DeepSpeedTPUEngine(
+        model=model,
+        config=ds_config,
+        params=model_parameters,
+        loss_fn=loss_fn,
+        mesh=mesh,
+        example_batch=example_batch,
+        tensor_rules=tensor_rules,
+        seed=seed,
+        lr_scheduler=lr_scheduler if callable(lr_scheduler) else None,
+        client_optimizer=optimizer,
+    )
+
+    dataloader = None
+    if training_data is not None:
+        import jax
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
+        dataloader = DeepSpeedTPUDataLoader(
+            training_data,
+            batch_size=engine.micro_batch_size * engine.dp_world_size,
+            collate_fn=collate_fn,
+            process_index=jax.process_index(),
+            process_count=jax.process_count())
+
+    return engine, engine.tx, dataloader, engine.lr_schedule
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """reference: deepspeed.init_inference (deepspeed/__init__.py:291)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import InferenceConfig
+    inf_config = config if isinstance(config, InferenceConfig) \
+        else InferenceConfig(**(config or {}), **kwargs)
+    return InferenceEngine(model, inf_config)
+
+
+def add_config_arguments(parser):
+    """reference: deepspeed.add_config_arguments (deepspeed/__init__.py:268)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for config parsing)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed-TPU json config file")
+    return parser

@@ -1,0 +1,4 @@
+from deepspeed_tpu.accelerator.abstract_accelerator import Accelerator
+from deepspeed_tpu.accelerator.real_accelerator import get_accelerator, set_accelerator
+
+__all__ = ["Accelerator", "get_accelerator", "set_accelerator"]

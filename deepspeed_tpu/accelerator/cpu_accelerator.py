@@ -1,0 +1,28 @@
+"""CPU accelerator — the CI / development backend.
+
+Reference analog: ``accelerator/cpu_accelerator.py:28`` (gloo backend lets the whole
+suite run without GPUs). Here the JAX CPU platform plays that role; with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` it exposes N virtual devices so
+multi-chip sharding is exercised on one host.
+"""
+
+from typing import Any, List
+
+from deepspeed_tpu.accelerator.abstract_accelerator import Accelerator
+
+
+class CPUAccelerator(Accelerator):
+    _name = "cpu"
+
+    def devices(self) -> List[Any]:
+        import jax
+        return [d for d in jax.local_devices() if d.platform == "cpu"] or jax.local_devices()
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def communication_backend_name(self) -> str:
+        return "xla-cpu"
+
+    def memory_stats(self) -> dict:
+        return {}

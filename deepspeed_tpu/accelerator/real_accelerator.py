@@ -1,0 +1,46 @@
+"""Accelerator auto-detection.
+
+Reference analog: ``accelerator/real_accelerator.py:51`` (env override
+``DS_ACCELERATOR`` + probe-based detection). Here detection is by JAX platform;
+override with ``DSTPU_ACCELERATOR=cpu|tpu``.
+"""
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import Accelerator
+
+_accelerator: Optional[Accelerator] = None
+
+
+def _detect() -> Accelerator:
+    from deepspeed_tpu.accelerator.cpu_accelerator import CPUAccelerator
+    from deepspeed_tpu.accelerator.tpu_accelerator import TPUAccelerator
+
+    override = os.environ.get("DSTPU_ACCELERATOR", "").lower()
+    if override == "cpu":
+        return CPUAccelerator()
+    if override == "tpu":
+        return TPUAccelerator()
+
+    try:
+        import jax
+        platform = jax.local_devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    # Treat any non-cpu XLA platform (tpu, experimental tunnels) as the TPU path.
+    if platform != "cpu":
+        return TPUAccelerator()
+    return CPUAccelerator()
+
+
+def get_accelerator() -> Accelerator:
+    global _accelerator
+    if _accelerator is None:
+        _accelerator = _detect()
+    return _accelerator
+
+
+def set_accelerator(acc: Accelerator) -> None:
+    global _accelerator
+    _accelerator = acc

@@ -1,0 +1,48 @@
+"""TPU accelerator (the first-class platform).
+
+Reference analog: ``accelerator/cuda_accelerator.py``. Peak-TFLOPS table is used for
+MFU reporting by the throughput timer / flops profiler.
+"""
+
+from typing import Any, List
+
+from deepspeed_tpu.accelerator.abstract_accelerator import Accelerator
+
+# chip generation -> peak dense TFLOPS (bf16). Public figures.
+_PEAK_TFLOPS_BF16 = {
+    "v4": 275.0,
+    "v5 lite": 197.0,   # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,   # trillium
+    "v6e": 918.0,
+}
+
+
+class TPUAccelerator(Accelerator):
+    _name = "tpu"
+
+    def devices(self) -> List[Any]:
+        import jax
+        return jax.local_devices()
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def communication_backend_name(self) -> str:
+        return "ici+dcn"
+
+    def peak_tflops(self, dtype: str = "bf16") -> float:
+        devs = self.devices()
+        if not devs:
+            return 0.0
+        kind = getattr(devs[0], "device_kind", "").lower()
+        for key, tflops in _PEAK_TFLOPS_BF16.items():
+            if key in kind:
+                scale = 1.0
+                if dtype in ("int8", "fp8"):
+                    scale = 2.0
+                elif dtype == "fp32":
+                    scale = 0.5
+                return tflops * scale
+        return 0.0

@@ -1,0 +1,133 @@
+"""Checkpoint save/load — single logical sharded checkpoint, reshape-on-load.
+
+Reference analogs:
+- ``runtime/engine.py:3109 save_checkpoint`` / ``:2763 load_checkpoint`` (per-rank
+  ``mp_rank_XX_model_states.pt`` + per-dp-rank optim shards, ``latest`` tag file)
+- ``runtime/checkpoint_engine/checkpoint_engine.py`` (pluggable engine ABC)
+- ``deepspeed/checkpoint/ds_to_universal.py`` universal checkpoint (per-parameter
+  atomic files enabling TP/PP/DP reshape on resume)
+
+TPU-native design (SURVEY.md §5.4): orbax/tensorstore OCDBT writes ONE logical
+checkpoint where every array is stored parameter-atomically regardless of its runtime
+sharding — so *every* checkpoint is a "universal checkpoint": loading onto a different
+mesh/world size just reads each array with the new sharding. The offline
+``ds_to_universal`` converter is unnecessary by construction.
+
+The ``latest`` tag-file protocol is kept for API parity.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from deepspeed_tpu.utils.logging import log_dist
+
+LATEST_FILE = "latest"
+
+
+def _ckpt_dir(save_dir: str, tag: str) -> str:
+    return os.path.join(os.path.abspath(save_dir), str(tag))
+
+
+def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                           client_state: Optional[Dict[str, Any]] = None) -> str:
+    tag = tag if tag is not None else f"global_step{engine.global_steps}"
+    path = _ckpt_dir(save_dir, tag)
+    state = engine.state
+    composite = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "scalars": {
+            "step": state.step,
+            "loss_scale": state.loss_scale.scale,
+            "good_steps": state.loss_scale.good_steps,
+            "hysteresis": state.loss_scale.hysteresis,
+            "skipped_steps": state.skipped_steps,
+        },
+    }
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, composite, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+
+    meta = {
+        "global_steps": engine.global_steps,
+        "global_samples": engine.global_samples,
+        "micro_steps": engine.micro_steps,
+        "zero_stage": engine.zero_stage,
+        "mesh_shape": dict(engine.mesh.shape),
+        "client_state": client_state or {},
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "ds_meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
+            f.write(tag)
+    log_dist(f"saved checkpoint {path}", ranks=[0])
+    return path
+
+
+def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                           load_optimizer_states: bool = True):
+    load_dir = os.path.abspath(load_dir)
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest):
+            log_dist(f"no '{LATEST_FILE}' file in {load_dir}; nothing restored", ranks=[0])
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = _ckpt_dir(load_dir, tag)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint not found: {path}")
+
+    state = engine.state
+    # Restore with the *current* engine shardings — a mesh/world-size change between
+    # save and load reshapes automatically (the UCP capability, built in).
+    target = {
+        "params": jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            state.params, engine.param_shardings),
+        "opt_state": jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            state.opt_state, engine.opt_state_shardings),
+        "scalars": jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype),
+            {
+                "step": state.step,
+                "loss_scale": state.loss_scale.scale,
+                "good_steps": state.loss_scale.good_steps,
+                "hysteresis": state.loss_scale.hysteresis,
+                "skipped_steps": state.skipped_steps,
+            }),
+    }
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(path, target)
+    ckptr.close()
+
+    from deepspeed_tpu.runtime.engine import EngineState
+    from deepspeed_tpu.runtime.precision import LossScaleState
+    sc = restored["scalars"]
+    engine.state = EngineState(
+        step=sc["step"],
+        params=restored["params"],
+        opt_state=restored["opt_state"] if load_optimizer_states else state.opt_state,
+        loss_scale=LossScaleState(sc["loss_scale"], sc["good_steps"], sc["hysteresis"]),
+        skipped_steps=sc["skipped_steps"],
+    )
+
+    meta_path = os.path.join(path, "ds_meta.json")
+    client_state: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        engine.global_steps = meta.get("global_steps", 0)
+        engine.global_samples = meta.get("global_samples", 0)
+        engine.micro_steps = meta.get("micro_steps", 0)
+        client_state = meta.get("client_state", {})
+    log_dist(f"loaded checkpoint {path}", ranks=[0])
+    return path, client_state

@@ -1,0 +1,38 @@
+from deepspeed_tpu.comm.comm import (
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast_one_to_all,
+    device_broadcast,
+    ppermute,
+    reduce_scatter,
+)  # noqa: F401
+from deepspeed_tpu.comm.comms_logging import CommsLogger, get_comms_logger
+from deepspeed_tpu.comm.mesh import (
+    BATCH_AXES,
+    MESH_AXES,
+    batch_sharding,
+    create_mesh,
+    get_data_parallel_world_size,
+    get_expert_parallel_world_size,
+    get_global_mesh,
+    get_model_parallel_world_size,
+    get_pipe_parallel_world_size,
+    get_seq_data_parallel_world_size,
+    get_sequence_parallel_world_size,
+    init_distributed,
+    replicated,
+    set_global_mesh,
+)
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
+    "broadcast_one_to_all", "barrier", "device_broadcast", "CommsLogger",
+    "get_comms_logger", "MESH_AXES", "BATCH_AXES", "create_mesh", "batch_sharding",
+    "replicated", "init_distributed", "get_global_mesh", "set_global_mesh",
+    "get_data_parallel_world_size", "get_seq_data_parallel_world_size",
+    "get_model_parallel_world_size", "get_expert_parallel_world_size",
+    "get_sequence_parallel_world_size", "get_pipe_parallel_world_size",
+]

@@ -1,0 +1,106 @@
+"""Communication volume / bandwidth accounting.
+
+Reference analog: ``deepspeed/utils/comms_logging.py:67`` (``CommsLogger``) and
+``calc_bw_log:34`` (alg/bus bandwidth). Two recording modes:
+
+- ``record_traced``: called at **trace time** from the collective facade — per-op
+  message sizes and world sizes are static under XLA, so totals are exact analytic
+  communication volume per compiled step.
+- ``timed``: context manager for eager host-driven ops — wall-clock latency plus
+  alg/bus bandwidth like the reference's ``timed_op``.
+"""
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def calc_bw(op_name: str, size_bytes: int, duration_s: float, world: int):
+    """Algorithm vs bus bandwidth (reference: comms_logging.py:34 calc_bw_log).
+
+    busbw scales algbw by the ring-collective traffic factor: allreduce 2(n-1)/n,
+    allgather/reduce_scatter/all_to_all (n-1)/n.
+    """
+    if duration_s <= 0:
+        return 0.0, 0.0
+    algbw = size_bytes / duration_s
+    n = max(world, 1)
+    if "all_reduce" in op_name:
+        busbw = algbw * (2 * (n - 1) / n)
+    elif any(k in op_name for k in ("all_gather", "reduce_scatter", "all_to_all")):
+        busbw = algbw * ((n - 1) / n)
+    else:
+        busbw = algbw
+    return algbw, busbw
+
+
+class CommsLogger:
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.prof_all = True
+        self.prof_ops = []
+        # op -> {count, total_bytes}
+        self.traced: Dict[str, Dict[str, float]] = defaultdict(lambda: {"count": 0, "bytes": 0})
+        # op -> list of (bytes, seconds, world)
+        self.timed_records: Dict[str, list] = defaultdict(list)
+
+    def configure(self, enabled: bool = True, verbose: bool = False,
+                  prof_all: bool = True, prof_ops=None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+
+    def record_traced(self, op_name: str, nbytes: int, world: int):
+        rec = self.traced[op_name]
+        rec["count"] += 1
+        rec["bytes"] += nbytes
+        if self.verbose:
+            logger.info(f"[comms][trace] {op_name}: {nbytes / 1e6:.2f} MB over {world} members")
+
+    @contextmanager
+    def timed(self, op_name: str, nbytes: int, world: int):
+        if not self.enabled:
+            yield
+            return
+        start = time.time()
+        yield
+        dur = time.time() - start
+        self.timed_records[op_name].append((nbytes, dur, world))
+        if self.verbose:
+            algbw, busbw = calc_bw(op_name, nbytes, dur, world)
+            logger.info(f"[comms] {op_name}: {nbytes / 1e6:.2f} MB in {dur * 1e3:.2f} ms | "
+                        f"algbw {algbw / 1e9:.2f} GB/s busbw {busbw / 1e9:.2f} GB/s")
+
+    def log_summary(self, show_straggler: bool = False):
+        """reference: dist.log_summary (comm/comm.py:422)."""
+        lines = ["Communication summary:"]
+        for op, rec in sorted(self.traced.items()):
+            lines.append(f"  [traced] {op}: {int(rec['count'])} calls, "
+                         f"{rec['bytes'] / 1e9:.3f} GB total")
+        for op, recs in sorted(self.timed_records.items()):
+            tot_b = sum(r[0] for r in recs)
+            tot_t = sum(r[1] for r in recs)
+            algbw, busbw = calc_bw(op, tot_b, tot_t, recs[-1][2] if recs else 1)
+            lines.append(f"  [timed]  {op}: {len(recs)} calls, {tot_b / 1e9:.3f} GB, "
+                         f"{tot_t * 1e3:.1f} ms, algbw {algbw / 1e9:.2f} GB/s")
+        logger.info("\n".join(lines))
+        return lines
+
+    def reset(self):
+        self.traced.clear()
+        self.timed_records.clear()
+
+
+_comms_logger: Optional[CommsLogger] = None
+
+
+def get_comms_logger() -> CommsLogger:
+    global _comms_logger
+    if _comms_logger is None:
+        _comms_logger = CommsLogger()
+    return _comms_logger

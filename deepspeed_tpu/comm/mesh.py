@@ -1,0 +1,165 @@
+"""Device-mesh factory — the TPU-native process-group layer.
+
+Reference analog: ``deepspeed/utils/groups.py`` (dp/mp/ep/sp group factories,
+``_create_expert_and_data_parallel:117``, SP accessors ``:472-524``) and
+``comm.init_distributed`` / ``initialize_mesh_device`` (``deepspeed/comm/comm.py:619,603``).
+
+On TPU, process groups are *named mesh axes* of one ``jax.sharding.Mesh``:
+
+    axes (outer→inner): ('pipe', 'data', 'fsdp', 'expert', 'sequence', 'tensor')
+
+- ``data``     — pure data parallelism (batch sharding, grad all-reduce)
+- ``fsdp``     — ZeRO/FSDP parameter+optimizer sharding (reference ZeRO's dp partition)
+- ``tensor``   — tensor (Megatron-style) model parallelism; innermost so its
+                 collectives ride the fastest ICI links
+- ``sequence`` — Ulysses/context parallelism over the sequence dimension
+- ``expert``   — MoE expert parallelism (all_to_all dispatch axis)
+- ``pipe``     — pipeline stages; outermost so stages map onto distinct ICI
+                 sub-slices (or onto DCN slices in multi-slice)
+
+The combined (data × fsdp × sequence) extent is the "seq-dp" world that the reference's
+ZeRO runs over (``runtime/engine.py:1190 seq_data_parallel_group``).
+
+Multi-slice: axes named in ``MeshConfig.dcn_axes`` are laid out across slices
+(DCN) using ``jax.experimental.mesh_utils.create_hybrid_device_mesh``.
+"""
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+# Canonical axis order, outermost (slowest, DCN-friendly) first.
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "sequence", "tensor")
+
+# Axes over which a replicated batch is split (DP world for batch-size math).
+BATCH_AXES: Tuple[str, ...] = ("data", "fsdp")
+
+_global_mesh: Optional[Mesh] = None
+
+
+def resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> Dict[str, int]:
+    """Fill the single -1 axis with the remaining device count; validate product."""
+    sizes = {
+        "pipe": cfg.pipe, "data": cfg.data, "fsdp": cfg.fsdp,
+        "expert": cfg.expert, "sequence": cfg.sequence, "tensor": cfg.tensor,
+    }
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+    known = int(np.prod([v for v in sizes.values() if v != -1]))
+    if unknown:
+        if n_devices % known != 0:
+            raise ValueError(
+                f"device count {n_devices} not divisible by fixed axes product {known}")
+        sizes[unknown[0]] = n_devices // known
+    total = int(np.prod(list(sizes.values())))
+    if total != n_devices:
+        raise ValueError(
+            f"mesh axes product {total} != device count {n_devices} (sizes={sizes})")
+    return sizes
+
+
+def create_mesh(cfg: Optional[MeshConfig] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build the named-axis mesh. ``devices`` defaults to all global devices."""
+    cfg = cfg or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = resolve_axis_sizes(cfg, len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+
+    dcn_axes = list(cfg.dcn_axes or [])
+    if dcn_axes:
+        from jax.experimental import mesh_utils
+        ici_shape = tuple(1 if a in dcn_axes else sizes[a] for a in MESH_AXES)
+        dcn_shape = tuple(sizes[a] if a in dcn_axes else 1 for a in MESH_AXES)
+        try:
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+        except Exception as e:  # single-slice / CPU: no slice_index attribute
+            logger.warning(f"hybrid mesh unavailable ({e}); falling back to flat mesh")
+            device_array = np.asarray(devices).reshape(shape)
+    else:
+        try:
+            from jax.experimental import mesh_utils
+            device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            device_array = np.asarray(devices).reshape(shape)
+
+    mesh = Mesh(device_array, MESH_AXES)
+    log_dist(f"created mesh {dict(zip(MESH_AXES, shape))} over {len(devices)} devices",
+             ranks=[0])
+    return mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+# --- world-size accessors (reference: utils/groups.py get_*_world_size) -----
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def get_data_parallel_world_size(mesh: Mesh) -> int:
+    """DP world for batch math = data × fsdp (ZeRO shards inside DP)."""
+    return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
+
+
+def get_seq_data_parallel_world_size(mesh: Mesh) -> int:
+    """reference engine.py:1190: ZeRO runs over the seq×dp group under SP."""
+    return get_data_parallel_world_size(mesh) * mesh.shape["sequence"]
+
+
+def get_model_parallel_world_size(mesh: Mesh) -> int:
+    return mesh.shape["tensor"]
+
+def get_expert_parallel_world_size(mesh: Mesh) -> int:
+    return mesh.shape["expert"]
+
+def get_sequence_parallel_world_size(mesh: Mesh) -> int:
+    return mesh.shape["sequence"]
+
+def get_pipe_parallel_world_size(mesh: Mesh) -> int:
+    return mesh.shape["pipe"]
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [batch, ...] array: batch split over the DP axes."""
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (reference: comm.init_distributed env:// rendezvous,
+    comm/comm.py:619). On TPU pods JAX auto-discovers peers from the TPU metadata;
+    explicit args support DCN/CPU clusters. No-op when single-process."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+    if num_processes <= 1 and coordinator_address is None:
+        return
+    kwargs = {}
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    log_dist(f"jax.distributed initialized: {jax.process_count()} processes", ranks=[0])

@@ -1,0 +1,21 @@
+from deepspeed_tpu.config.config import (
+    ActivationCheckpointingConfig,
+    BF16Config,
+    CheckpointConfig,
+    CommsLoggerConfig,
+    DeepSpeedTPUConfig,
+    ElasticityConfig,
+    FP16Config,
+    FlopsProfilerConfig,
+    MeshConfig,
+    OffloadConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    ZeroConfig,
+)
+
+__all__ = [
+    "DeepSpeedTPUConfig", "ZeroConfig", "FP16Config", "BF16Config", "OffloadConfig",
+    "OptimizerConfig", "SchedulerConfig", "MeshConfig", "ActivationCheckpointingConfig",
+    "FlopsProfilerConfig", "CommsLoggerConfig", "CheckpointConfig", "ElasticityConfig",
+]

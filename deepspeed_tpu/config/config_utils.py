@@ -1,0 +1,27 @@
+"""Config model base.
+
+Reference analog: ``deepspeed/runtime/config_utils.py`` (``DeepSpeedConfigModel``):
+pydantic base with "auto" support and deprecated-field aliasing. We keep the "auto"
+sentinel contract — integrations resolve "auto" values before validation.
+"""
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+AUTO = "auto"
+
+
+class DeepSpeedTPUConfigModel(BaseModel):
+    """Base for every sub-config: ignore unknown keys (forward compat), validate on
+    assignment, allow "auto" passthrough for annotated fields."""
+
+    model_config = ConfigDict(extra="ignore", validate_assignment=True,
+                              arbitrary_types_allowed=True, populate_by_name=True)
+
+    def dict_repr(self) -> Dict[str, Any]:
+        return self.model_dump()
+
+
+def get_scalar_param(d: Dict[str, Any], name: str, default: Any) -> Any:
+    return d.get(name, default)

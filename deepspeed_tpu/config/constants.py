@@ -1,0 +1,51 @@
+"""Config keys and defaults.
+
+Reference analog: ``deepspeed/runtime/constants.py`` (457 LoC of key/default pairs).
+Only the keys meaningful on TPU are kept; CUDA-only knobs are accepted (and ignored
+with a warning) for drop-in config compatibility.
+"""
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+OPTIMIZER = "optimizer"
+SCHEDULER = "scheduler"
+
+FP16 = "fp16"
+BF16 = "bf16"
+ZERO_OPTIMIZATION = "zero_optimization"
+GRADIENT_CLIPPING = "gradient_clipping"
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+STEPS_PER_PRINT = "steps_per_print"
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+MESH = "mesh"
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+FLOPS_PROFILER = "flops_profiler"
+MONITOR_TENSORBOARD = "tensorboard"
+MONITOR_CSV = "csv_monitor"
+MONITOR_WANDB = "wandb"
+COMMS_LOGGER = "comms_logger"
+DATA_EFFICIENCY = "data_efficiency"
+CURRICULUM_LEARNING = "curriculum_learning"
+ELASTICITY = "elasticity"
+COMPRESSION_TRAINING = "compression_training"
+AUTOTUNING = "autotuning"
+CHECKPOINT = "checkpoint"
+
+# Defaults (mirroring reference semantics)
+STEPS_PER_PRINT_DEFAULT = 10
+GRADIENT_CLIPPING_DEFAULT = 0.0
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = 1
+
+# Keys from the reference config space that have no TPU meaning; accepted silently.
+IGNORED_CUDA_ONLY_KEYS = frozenset({
+    "amp",
+    "communication_data_type",
+    "sparse_gradients",
+    "fp16_master_weights_and_gradients",
+    "cuda_aware",
+    "use_node_local_storage",
+    "hybrid_engine",
+})

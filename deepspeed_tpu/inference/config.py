@@ -1,0 +1,31 @@
+"""Inference config.
+
+Reference analog: ``deepspeed/inference/config.py`` (``DeepSpeedInferenceConfig``).
+TP degree maps to the mesh ``tensor`` axis; dtype to the compute dtype.
+"""
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.config.config_utils import DeepSpeedTPUConfigModel
+
+
+class QuantizationConfig(DeepSpeedTPUConfigModel):
+    enabled: bool = False
+    bits: int = 8
+
+
+class InferenceConfig(DeepSpeedTPUConfigModel):
+    dtype: str = "bfloat16"
+    tensor_parallel: Dict[str, Any] = Field(default_factory=lambda: {"tp_size": 1})
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = True   # accepted for parity; kernels are XLA/Pallas
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[str] = None
+    enable_cuda_graph: bool = False            # parity no-op: XLA compiles everything
+
+    @property
+    def tp_size(self) -> int:
+        return int(self.tensor_parallel.get("tp_size", 1))

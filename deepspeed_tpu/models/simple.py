@@ -1,0 +1,75 @@
+"""Simple fixture models.
+
+Reference analog: ``tests/unit/simple_model.py`` (``SimpleModel``, random
+dataloaders) — the standard unit-test fixture, kept in the package so examples and
+benchmarks share it.
+"""
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """MLP over dict batches {"x": [B, D], "y": [B]} returning mean cross-entropy.
+
+    Mirrors the reference SimpleModel's role: smallest thing with >1 layer that
+    exercises sharding, precision, and the optimizer.
+    """
+    hidden_dim: int = 64
+    num_layers: int = 2
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, batch):
+        x = batch["x"].astype(jnp.float32) if batch["x"].dtype == jnp.float64 \
+            else batch["x"]
+        for _ in range(self.num_layers):
+            x = nn.Dense(self.hidden_dim)(x)
+            x = nn.relu(x)
+        logits = nn.Dense(self.num_classes)(x)
+        labels = jax.nn.one_hot(batch["y"], self.num_classes, dtype=logits.dtype)
+        loss = -jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        return jnp.mean(loss)
+
+
+class SimpleCNN(nn.Module):
+    """Tiny CNN for the cifar10-style end-to-end slice (BASELINE config 1)."""
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, batch):
+        x = batch["x"]
+        x = nn.Conv(16, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        logits = nn.Dense(self.num_classes)(x)
+        labels = jax.nn.one_hot(batch["y"], self.num_classes, dtype=logits.dtype)
+        return jnp.mean(-jnp.sum(labels * jax.nn.log_softmax(logits, -1), -1))
+
+
+def random_dataset(n: int, input_dim: int = 32, num_classes: int = 10,
+                   seed: int = 0) -> Sequence[Any]:
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, input_dim)).astype(np.float32)
+    ys = rng.integers(0, num_classes, size=(n,)).astype(np.int32)
+    return [{"x": xs[i], "y": ys[i]} for i in range(n)]
+
+
+def random_batch(batch_size: int, input_dim: int = 32, num_classes: int = 10,
+                 seed: int = 0, gas: Optional[int] = None):
+    rng = np.random.default_rng(seed)
+    shape = (gas, batch_size) if gas else (batch_size,)
+    return {
+        "x": rng.normal(size=shape + (input_dim,)).astype(np.float32),
+        "y": rng.integers(0, num_classes, size=shape).astype(np.int32),
+    }

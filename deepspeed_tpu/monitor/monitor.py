@@ -1,0 +1,110 @@
+"""Experiment monitoring fan-out.
+
+Reference analog: ``deepspeed/monitor/monitor.py:13,30`` (``Monitor`` ABC +
+``MonitorMaster`` fanning (tag, value, step) events to TensorBoard / WandB / CSV /
+Comet, rank-0 only). CSV and TensorBoard backends here; wandb gated on import.
+"""
+
+import csv
+import os
+from typing import List, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    """reference: monitor/csv_monitor.py — one csv per tag."""
+
+    def __init__(self, csv_config):
+        self.enabled = csv_config.enabled and jax.process_index() == 0
+        self.output_path = csv_config.output_path or "./csv_monitor"
+        self.job_name = csv_config.job_name
+        self._files = {}
+
+    def _path_for(self, tag: str) -> str:
+        d = os.path.join(self.output_path, self.job_name)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, tag.replace("/", "_") + ".csv")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            p = self._path_for(tag)
+            new = not os.path.exists(p)
+            with open(p, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, tb_config):
+        self.enabled = False
+        if not (tb_config.enabled and jax.process_index() == 0):
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            log_dir = os.path.join(tb_config.output_path or "./runs", tb_config.job_name)
+            self.writer = SummaryWriter(log_dir=log_dir)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"tensorboard unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, wb_config):
+        self.enabled = False
+        if not (wb_config.enabled and jax.process_index() == 0):
+            return
+        try:
+            import wandb
+            wandb.init(project=wb_config.project, group=wb_config.group,
+                       entity=wb_config.team)
+            self._wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"wandb unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self._wandb.log({tag: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """reference: monitor/monitor.py:30."""
+
+    def __init__(self, config):
+        self.backends = [
+            CSVMonitor(config.csv_monitor),
+            TensorBoardMonitor(config.tensorboard),
+            WandbMonitor(config.wandb),
+        ]
+        self.enabled = any(b.enabled for b in self.backends)
+
+    def write_events(self, events: List[Event]):
+        for b in self.backends:
+            if b.enabled:
+                b.write_events(events)

@@ -1,0 +1,90 @@
+"""Data loading.
+
+Reference analog: ``runtime/dataloader.py:41,17`` (``DeepSpeedDataLoader`` with auto
+distributed sampler, ``RepeatingLoader``). On TPU the engine consumes *global*
+batches (every process feeds its shard; single-process feeds the whole batch and the
+engine shards it onto the mesh), so the loader's job is batching + per-process
+sharding + repeat.
+"""
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """reference: runtime/dataloader.py:17 — wrap an iterator to restart on
+    StopIteration."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedTPUDataLoader:
+    """Minimal batching loader over an indexable dataset of pytrees.
+
+    ``process_shard``: with multi-host training each process loads
+    1/process_count of every global batch (the distributed-sampler analog).
+    """
+
+    def __init__(self, dataset: Sequence, batch_size: int,
+                 collate_fn: Optional[Callable] = None, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = True,
+                 process_index: int = 0, process_count: int = 1):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or self._default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.process_index = process_index
+        self.process_count = process_count
+        self.epoch = 0
+        if batch_size % process_count != 0:
+            raise ValueError(f"batch_size {batch_size} not divisible by "
+                             f"process_count {process_count}")
+        self.local_batch = batch_size // process_count
+
+    @staticmethod
+    def _default_collate(samples):
+        import jax
+        return jax.tree.map(lambda *xs: np.stack(xs), *samples)
+
+    def __len__(self):
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self) -> Iterator[Any]:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        self.epoch += 1
+        n_full = len(order) // self.batch_size
+        for b in range(n_full):
+            global_idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            local = global_idx[self.process_index::self.process_count]
+            yield self.collate_fn([self.dataset[int(i)] for i in local])
+        remainder = len(order) % self.batch_size
+        if remainder and not self.drop_last:
+            # final partial batch (note: a different batch shape triggers one extra
+            # XLA compile; prefer drop_last=True for fixed-shape training)
+            tail = order[n_full * self.batch_size:]
+            tail = tail[:len(tail) - (len(tail) % self.process_count)] \
+                if len(tail) >= self.process_count else tail
+            local = tail[self.process_index::self.process_count]
+            if len(local):
+                yield self.collate_fn([self.dataset[int(i)] for i in local])

@@ -1,0 +1,117 @@
+"""Learning-rate schedules.
+
+Reference analog: ``deepspeed/runtime/lr_schedules.py`` — ``LRRangeTest`` (:273),
+``OneCycle`` (:371), ``WarmupLR`` (:633), ``WarmupDecayLR`` (:723),
+``WarmupCosineLR`` (:774). Implemented as optax-compatible schedules
+(``step -> lr``), selected by the same config ``scheduler.type`` strings.
+"""
+
+import math
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]
+
+
+def _warmup(step, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type="log"):
+    warmup_num_steps = max(warmup_num_steps, 1)
+    frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+    if warmup_type == "log":
+        # reference WarmupLR: inverse_log_warm_up * log(step + 1)
+        frac = jnp.log1p(frac * (math.e - 1.0))
+    return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Schedule:
+    """reference: WarmupLR lr_schedules.py:633 — warmup then hold."""
+    def fn(step):
+        return jnp.where(step < warmup_num_steps,
+                         _warmup(step, warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                                 warmup_type),
+                         warmup_max_lr)
+    return fn
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_) -> Schedule:
+    """reference: WarmupDecayLR lr_schedules.py:723 — warmup then linear decay to 0."""
+    def fn(step):
+        w = _warmup(step, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+        decay_frac = jnp.clip(
+            (total_num_steps - step) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, w, warmup_max_lr * decay_frac)
+    return fn
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     lr: float = 0.001, **_) -> Schedule:
+    """reference: WarmupCosineLR lr_schedules.py:774 (ratio-based)."""
+    def fn(step):
+        warm_ratio = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            step / max(warmup_num_steps, 1), 0.0, 1.0)
+        progress = jnp.clip((step - warmup_num_steps) /
+                            max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        cos_ratio = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (
+            1 + jnp.cos(jnp.pi * progress))
+        return lr * jnp.where(step < warmup_num_steps, warm_ratio, cos_ratio)
+    return fn
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float, cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int = None, decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0, **_) -> Schedule:
+    """reference: OneCycle lr_schedules.py:371 (lr triangle then decay; momentum cycle
+    is handled by the optimizer wrapper when enabled)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None \
+        else cycle_first_step_size
+
+    def fn(step):
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.clip(
+            step / max(cycle_first_step_size, 1), 0.0, 1.0)
+        down_progress = jnp.clip((step - cycle_first_step_size) / max(second, 1), 0.0, 1.0)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_progress
+        end_of_cycle = cycle_first_step_size + second
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - end_of_cycle, 0) / decay_step_size
+            decayed = cycle_min_lr / (1.0 + decay_lr_rate * decay_steps)
+        else:
+            decayed = jnp.full_like(jnp.asarray(step, jnp.float32), cycle_min_lr)
+        return jnp.where(step <= cycle_first_step_size, up,
+                         jnp.where(step <= end_of_cycle, down, decayed))
+    return fn
+
+
+def lr_range_test(lr_range_test_min_lr: float = 0.001, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    """reference: LRRangeTest lr_schedules.py:273 (continuous/staircase lr sweep)."""
+    def fn(step):
+        interval = step / max(lr_range_test_step_size, 1)
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+    return fn
+
+
+def constant_lr(lr: float = 0.001, **_) -> Schedule:
+    return lambda step: jnp.full_like(jnp.asarray(step, jnp.float32), lr)
+
+
+SCHEDULES: Dict[str, Callable[..., Schedule]] = {
+    "WarmupLR": warmup_lr,
+    "WarmupDecayLR": warmup_decay_lr,
+    "WarmupCosineLR": warmup_cosine_lr,
+    "OneCycle": one_cycle,
+    "LRRangeTest": lr_range_test,
+    "Constant": constant_lr,
+}
+
+
+def build_schedule(sched_type: str, params: Dict[str, Any]) -> Schedule:
+    if sched_type not in SCHEDULES:
+        raise ValueError(f"unknown scheduler '{sched_type}'; known: {list(SCHEDULES)}")
+    return SCHEDULES[sched_type](**params)

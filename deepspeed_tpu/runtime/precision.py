@@ -1,0 +1,107 @@
+"""Mixed precision: fp32 master weights, bf16/fp16 compute, dynamic loss scaling.
+
+Reference analogs:
+- ``runtime/fp16/loss_scaler.py:91`` ``DynamicLossScaler`` (scale up after
+  ``scale_window`` good steps, scale down on overflow with hysteresis)
+- ``runtime/fp16/fused_optimizer.py:33`` ``FP16_Optimizer`` (fp32 master weights)
+- ``runtime/bf16_optimizer.py:34`` ``BF16_Optimizer`` (fp32 master + fp32 grad accum)
+
+TPU-native shape: master params stay fp32 in the engine state; the forward pass casts
+to the compute dtype at trace time, so XLA keeps matmuls in bf16 on the MXU while the
+optimizer update runs fp32. The loss scaler is a *functional* state threaded through
+the jitted train step (no Python-side branching — overflow handling is ``jnp.where``).
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config.config import FP16Config
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic loss-scale state (all jnp scalars: jit-carriable)."""
+    scale: jnp.ndarray          # current loss scale (fp32)
+    good_steps: jnp.ndarray     # consecutive overflow-free steps (int32)
+    hysteresis: jnp.ndarray     # remaining overflow tolerance (int32)
+
+
+def init_loss_scale(cfg: FP16Config) -> LossScaleState:
+    if not cfg.enabled:
+        return LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(1))
+    scale = cfg.loss_scale if cfg.loss_scale > 0 else float(2 ** cfg.initial_scale_power)
+    return LossScaleState(jnp.float32(scale), jnp.int32(0), jnp.int32(cfg.hysteresis))
+
+
+def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray,
+                      cfg: FP16Config) -> LossScaleState:
+    """One dynamic-loss-scale transition (reference: loss_scaler.py:171 update_scale).
+
+    Static scale (cfg.loss_scale > 0) passes through unchanged.
+    """
+    if not cfg.enabled or not cfg.dynamic:
+        return state
+    scale, good, hyst = state
+
+    def on_overflow():
+        new_hyst = hyst - 1
+        drop = new_hyst <= 0
+        new_scale = jnp.where(drop, jnp.maximum(scale / 2.0, cfg.min_loss_scale), scale)
+        reset_hyst = jnp.where(drop, jnp.int32(cfg.hysteresis), new_hyst)
+        return LossScaleState(new_scale, jnp.int32(0), reset_hyst)
+
+    def on_good():
+        grown = good + 1 >= cfg.loss_scale_window
+        new_scale = jnp.where(grown, scale * 2.0, scale)
+        new_good = jnp.where(grown, jnp.int32(0), good + 1)
+        # reference loss_scaler.py: consecutive_hysteresis=True refills the
+        # tolerance on every overflow-free step; False refills only when the
+        # scale grows at the window boundary.
+        if cfg.consecutive_hysteresis:
+            new_hyst = jnp.int32(cfg.hysteresis)
+        else:
+            new_hyst = jnp.where(grown, jnp.int32(cfg.hysteresis), hyst)
+        return LossScaleState(new_scale, new_good, new_hyst)
+
+    return jax.tree.map(lambda a, b: jnp.where(overflow, a, b), on_overflow(), on_good())
+
+
+def has_inf_or_nan(grads: Any) -> jnp.ndarray:
+    """Global overflow check (reference: stage3.py:2221 _has_inf_or_nan /
+    CheckOverflow runtime/utils.py:181). Under SPMD+jit the result is already
+    globally consistent — no extra allreduce needed."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.bool_(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(l.astype(jnp.float32)))) for l in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def cast_to_compute(params: Any, dtype) -> Any:
+    """Cast fp32 master params to the compute dtype for the forward pass. Integer /
+    bool leaves (embedding tables are float; step counters etc.) pass through."""
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree.map(cast, params)
+
+
+def global_grad_norm(grads: Any) -> jnp.ndarray:
+    """L2 norm over all grad leaves (reference: runtime/utils.py clip_grad_norm_ —
+    but MP-awareness is free here: under jit the grads are global values)."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(grads)]
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def clip_by_global_norm(grads: Any, max_norm: float) -> Tuple[Any, jnp.ndarray]:
+    """Returns (clipped grads, pre-clip global norm)."""
+    norm = global_grad_norm(grads)
+    if max_norm <= 0:
+        return grads, norm
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype),
+                        grads), norm

@@ -1,0 +1,150 @@
+"""ZeRO stages as SPMD sharding policies.
+
+Reference analog: ``deepspeed/runtime/zero/`` — ``stage_1_and_2.py:97``
+(``DeepSpeedZeroOptimizer``: flatten + round-robin partition optimizer states, stage-2
+grad-hook reduce-scatter) and ``stage3.py``/``partition_parameters.py`` (param
+partitioning with allgather/release module hooks and a trace-based prefetcher).
+
+On TPU none of that machinery is runtime code: a ZeRO stage is a **sharding policy** —
+a rule assigning a ``PartitionSpec`` to every parameter / optimizer-state leaf over the
+``fsdp`` mesh axis. XLA then emits exactly the collectives the reference implements by
+hand (allgather params before use ≙ stage-3 fetch; psum_scatter of grads into the
+shard ≙ stage-2 `average_tensor`; sharded optimizer update + allgather ≙ stage-1/2
+step), scheduled and overlapped by the compiler instead of a Python prefetch queue.
+
+  stage 0 — params, grads, optimizer states replicated over (data, fsdp)
+  stage 1 — optimizer states sharded over fsdp
+  stage 2 — + gradients reduce-scattered (same specs; XLA derives reduce-scatter
+            from "grads consumed with sharded layout")
+  stage 3 — + parameters sharded over fsdp (FSDP)
+
+ZeRO++ hpZ (secondary shard within a node, ``partition_parameters.py:1664``) maps to
+sharding over a *sub-axis* of fsdp (see ``hierarchical_axes``); MiCS
+(``runtime/zero/mics.py:64``) is the same idea with replication across DCN slices.
+
+Tensor-parallel sharding composes: a leaf annotated with a logical axis that maps to
+``tensor`` keeps that axis, and fsdp shards a *different* dimension.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.utils.logging import warning_once
+
+# Minimum leaf size worth sharding; tiny leaves (biases, norms) stay replicated —
+# the analog of the reference's persistent-param threshold
+# (stage3 persistence_threshold keeps small params resident).
+DEFAULT_MIN_SHARD_SIZE = 2 ** 11
+
+
+def _choose_fsdp_dim(shape, fsdp_size: int, taken_dims) -> Optional[int]:
+    """Pick the largest dimension divisible by the fsdp axis size, preferring the
+    first (row) dimension to keep matmul layouts MXU-friendly."""
+    candidates = [d for d in range(len(shape))
+                  if d not in taken_dims and shape[d] % fsdp_size == 0 and shape[d] >= fsdp_size]
+    if not candidates:
+        return None
+    return max(candidates, key=lambda d: (shape[d], -d))
+
+
+def param_partition_spec(shape, stage: int, fsdp_size: int,
+                         tensor_spec: Optional[PartitionSpec] = None,
+                         min_shard_size: int = DEFAULT_MIN_SHARD_SIZE) -> PartitionSpec:
+    """PartitionSpec for a parameter leaf under a given ZeRO stage.
+
+    ``tensor_spec`` is an existing (tensor/expert/sequence) sharding from model
+    annotations; fsdp sharding is layered on an unused dimension.
+    """
+    ndim = len(shape)
+    base = list(tensor_spec) if tensor_spec is not None else []
+    base = base + [None] * (ndim - len(base))
+    if stage < 3 or fsdp_size <= 1:
+        return PartitionSpec(*base) if any(a is not None for a in base) else PartitionSpec()
+    if int(np.prod(shape)) < min_shard_size:
+        return PartitionSpec(*base) if any(a is not None for a in base) else PartitionSpec()
+    taken = {i for i, a in enumerate(base) if a is not None}
+    dim = _choose_fsdp_dim(shape, fsdp_size, taken)
+    if dim is None:
+        warning_once(f"param of shape {shape} not divisible by fsdp={fsdp_size}; replicated")
+        return PartitionSpec(*base) if any(a is not None for a in base) else PartitionSpec()
+    base[dim] = "fsdp"
+    return PartitionSpec(*base)
+
+
+def optimizer_state_spec_fn(param_specs, stage: int, fsdp_size: int,
+                            min_shard_size: int = DEFAULT_MIN_SHARD_SIZE):
+    """Build a function mapping an optimizer-state leaf (with a matching param leaf
+    position) to its PartitionSpec. Optimizer moments share the param's shape, so:
+
+      stage >= 1: moments sharded over fsdp like a stage-3 param would be
+      stage 3:    moments follow the (already fsdp-sharded) param spec exactly
+      stage 0:    replicated / follow param's tensor spec
+    """
+    def spec_for(param_spec: PartitionSpec, shape) -> PartitionSpec:
+        if stage == 0 or fsdp_size <= 1:
+            return param_spec
+        if stage >= 3:
+            return param_spec  # param already carries fsdp
+        # stage 1/2: shard moments even though params are replicated
+        return param_partition_spec(shape, stage=3, fsdp_size=fsdp_size,
+                                    tensor_spec=param_spec,
+                                    min_shard_size=min_shard_size)
+    return spec_for
+
+
+def build_param_shardings(params: Any, mesh: Mesh, stage: int,
+                          tensor_rules: Optional[Callable] = None,
+                          min_shard_size: int = DEFAULT_MIN_SHARD_SIZE):
+    """Pytree of NamedShardings for the model params.
+
+    ``tensor_rules(path, leaf) -> PartitionSpec | None`` supplies model-parallel
+    shardings (the AutoTP analog — see deepspeed_tpu.parallel.auto_tp).
+    """
+    fsdp_size = mesh.shape["fsdp"]
+
+    def leaf_spec(path, leaf):
+        tspec = tensor_rules(path, leaf) if tensor_rules else None
+        return param_partition_spec(np.shape(leaf), stage, fsdp_size, tensor_spec=tspec,
+                                    min_shard_size=min_shard_size)
+
+    specs = jax.tree_util.tree_map_with_path(leaf_spec, params)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def build_opt_state_shardings(opt_state: Any, params: Any, param_specs: Any,
+                              mesh: Mesh, stage: int,
+                              min_shard_size: int = DEFAULT_MIN_SHARD_SIZE):
+    """Shardings for an optax state pytree: any leaf whose shape matches a param
+    leaf's shape gets the corresponding (stage-aware) spec; scalars replicated.
+
+    Optax states are pytrees whose array leaves are either param-shaped (moments,
+    master copies) or scalars (step counts); we match by structure where possible and
+    by shape as fallback.
+    """
+    fsdp_size = mesh.shape["fsdp"]
+    spec_of = optimizer_state_spec_fn(param_specs, stage, fsdp_size, min_shard_size)
+
+    flat_params, _ = jax.tree_util.tree_flatten(params)
+    flat_specs, _ = jax.tree_util.tree_flatten(
+        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    shape_to_spec = {}
+    for p, s in zip(flat_params, flat_specs):
+        shape_to_spec.setdefault(np.shape(p), s)
+
+    def state_leaf_spec(leaf):
+        shape = np.shape(leaf)
+        if len(shape) == 0:
+            return PartitionSpec()
+        if shape in shape_to_spec:
+            return spec_of(shape_to_spec[shape], shape)
+        # unmatched non-scalar leaf: auto-shard if big (e.g. flattened buffers)
+        return param_partition_spec(shape, stage=3 if stage >= 1 else 0,
+                                    fsdp_size=fsdp_size, min_shard_size=min_shard_size)
+
+    specs = jax.tree.map(state_leaf_spec, opt_state)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, PartitionSpec))

@@ -1,0 +1,59 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (``log_dist``,
+``logger``): a module-level logger plus rank-filtered helpers. On TPU the "rank" is
+``jax.process_index()`` (one process per host under multi-host SPMD), not a per-device
+rank — devices within a process share the log stream.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stderr)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT))
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = create_logger(
+    level=getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO))
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed process indices (None or [-1] => all).
+
+    Mirrors the reference's ``log_dist`` semantics (deepspeed/utils/logging.py) with
+    ``jax.process_index()`` standing in for the torch.distributed rank.
+    """
+    my_rank = _process_index()
+    if ranks is None or len(list(ranks)) == 0:
+        should = my_rank == 0
+    else:
+        ranks = list(ranks)
+        should = (-1 in ranks) or (my_rank in ranks)
+    if should:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,168 @@
+"""Wall-clock + throughput timers.
+
+TPU-native analog of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` at :44, ``ThroughputTimer`` at :199). CUDA events do not
+exist here; synchronization is ``jax.block_until_ready`` on a token array, which forces
+completion of all previously enqueued XLA work on the device.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_sync():
+    try:
+        import jax
+        # Touching a tiny computation and blocking flushes the async dispatch queue.
+        jax.block_until_ready(jax.numpy.zeros(()))
+    except Exception:
+        pass
+
+
+class Timer:
+    """A single named timer with start/stop/elapsed, mean and total."""
+
+    def __init__(self, name: str, synchronize: bool = True):
+        self.name = name
+        self.synchronize = synchronize
+        self._started = False
+        self._start_time = 0.0
+        self._elapsed = 0.0
+        self._records: List[float] = []
+
+    def start(self):
+        if self._started:
+            return
+        if self.synchronize:
+            _device_sync()
+        self._start_time = time.time()
+        self._started = True
+
+    def stop(self, record: bool = True):
+        if not self._started:
+            return
+        if self.synchronize:
+            _device_sync()
+        delta = time.time() - self._start_time
+        self._elapsed += delta
+        if record:
+            self._records.append(delta)
+        self._started = False
+
+    def reset(self):
+        self._started = False
+        self._elapsed = 0.0
+        self._records = []
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Elapsed seconds since last reset (stops/restarts a running timer)."""
+        was_started = self._started
+        if was_started:
+            self.stop(record=False)
+        value = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+            self._records = []
+        if was_started:
+            self.start()
+        return value
+
+    def mean(self) -> float:
+        return sum(self._records) / len(self._records) if self._records else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Registry of named timers (reference: utils/timer.py:44)."""
+
+    def __init__(self):
+        self.timers: Dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False) -> None:
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        if parts:
+            logger.info("time (ms) | " + " | ".join(parts))
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        out = {}
+        for name in names:
+            if name in self.timers:
+                out[name] = self.timers[name].mean() * 1000.0 / normalizer
+        return out
+
+
+class ThroughputTimer:
+    """samples/sec + TFLOPS reporting (reference: utils/timer.py:199).
+
+    ``flops_per_sample`` may be supplied by the engine (e.g. from the flops profiler /
+    XLA cost analysis) to report model TFLOPS at ``steps_per_print`` boundaries.
+    """
+
+    def __init__(self, batch_size: int, steps_per_output: int = 100,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.steps_per_output = steps_per_output
+        self.logging = logging_fn or logger.info
+        self.started = False
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.start_time = 0.0
+        self.flops_per_sample: Optional[float] = None
+
+    def start(self):
+        self.started = True
+        _device_sync()
+        self.start_time = time.time()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        _device_sync()
+        duration = time.time() - self.start_time
+        self.total_elapsed_time += duration
+        self.step_elapsed_time += duration
+        self.local_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+            if report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
+                msg = (f"epoch step {self.global_step_count}: "
+                       f"{self.avg_samples_per_sec():.1f} samples/s, "
+                       f"batch time {self.step_elapsed_time / self.local_step_count * 1000:.1f} ms")
+                if self.flops_per_sample:
+                    tflops = self.avg_samples_per_sec() * self.flops_per_sample / 1e12
+                    msg += f", {tflops:.2f} TFLOPS"
+                self.logging(msg)
+                self.local_step_count = 0
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > 0 and self.total_elapsed_time > 0:
+            return self.batch_size * self.global_step_count / self.total_elapsed_time
+        return 0.0

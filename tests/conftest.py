@@ -1,0 +1,42 @@
+"""Test harness configuration.
+
+Reference analog: ``tests/unit/common.py`` — the reference spawns world_size real
+processes per test (DistributedTest) so CI needs no GPUs. Here the same effect is a
+virtual 8-device CPU platform (``xla_force_host_platform_device_count=8``): every
+test sees 8 JAX devices and exercises real mesh shardings + collectives in one
+process. Set BEFORE importing jax anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("DSTPU_TEST_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+
+# jax may have been pre-imported at interpreter startup (platform plugins), making
+# the env vars above too late; config updates still apply pre-backend-init.
+if os.environ.get("DSTPU_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """data=2, fsdp=4 mesh over the 8 virtual devices."""
+    from deepspeed_tpu.comm.mesh import create_mesh
+    from deepspeed_tpu.config.config import MeshConfig
+    return create_mesh(MeshConfig(data=2, fsdp=4))
+
+
+@pytest.fixture
+def mesh_dp8():
+    from deepspeed_tpu.comm.mesh import create_mesh
+    from deepspeed_tpu.config.config import MeshConfig
+    return create_mesh(MeshConfig(data=8))

@@ -1,0 +1,86 @@
+"""Config system tests (reference analog: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig, MeshConfig
+
+
+def test_batch_size_reconciliation_all_given():
+    cfg = DeepSpeedTPUConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, dp_world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_size_derive_gas():
+    cfg = DeepSpeedTPUConfig({
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 4,
+    }, dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_size_derive_train_batch():
+    cfg = DeepSpeedTPUConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 3,
+    }, dp_world_size=8)
+    assert cfg.train_batch_size == 48
+
+
+def test_batch_size_mismatch_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedTPUConfig({
+            "train_batch_size": 30,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+        }, dp_world_size=4)
+
+
+def test_zero_config_defaults():
+    cfg = DeepSpeedTPUConfig({"zero_optimization": {"stage": 3}})
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_enabled
+    assert cfg.zero_config.offload_optimizer.device == "none"
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(Exception):
+        DeepSpeedTPUConfig({"zero_optimization": {"stage": 5}})
+
+
+def test_fp16_bf16_precision_dtype():
+    import jax.numpy as jnp
+    assert DeepSpeedTPUConfig({"bf16": {"enabled": True}}).precision_dtype == jnp.bfloat16
+    assert DeepSpeedTPUConfig({"fp16": {"enabled": True}}).precision_dtype == jnp.float16
+    assert DeepSpeedTPUConfig({}).precision_dtype == jnp.float32
+
+
+def test_fp16_dynamic_vs_static():
+    cfg = DeepSpeedTPUConfig({"fp16": {"enabled": True, "loss_scale": 128.0}})
+    assert not cfg.fp16.dynamic
+    cfg = DeepSpeedTPUConfig({"fp16": {"enabled": True}})
+    assert cfg.fp16.dynamic
+
+
+def test_cuda_only_keys_ignored():
+    cfg = DeepSpeedTPUConfig({"amp": {"enabled": True}, "train_batch_size": 8})
+    assert cfg.train_batch_size == 8
+
+
+def test_mesh_config_defaults():
+    m = MeshConfig()
+    assert m.data == -1 and m.fsdp == 1 and m.tensor == 1
+
+
+def test_optimizer_scheduler_parse():
+    cfg = DeepSpeedTPUConfig({
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    })
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.scheduler.type == "WarmupLR"
