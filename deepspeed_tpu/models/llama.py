@@ -1,0 +1,326 @@
+"""Llama-family causal LM — the flagship training model.
+
+Reference analog: the reference has no in-tree Llama *training* model (it wraps HF
+modules), but its inference stack ships per-arch implementations
+(``deepspeed/inference/v2/model_implementations/llama_v2``,
+``module_inject/containers/llama.py``). Here the model is first-class and TPU-native:
+
+- pure flax, bf16-friendly; matmuls land on the MXU
+- Megatron-style tensor parallelism expressed as *sharding rules*
+  (``llama_tensor_rules``), not module surgery — the AutoTP analog
+  (``module_inject/auto_tp.py:189``) for our own model zoo
+- activation sharding constraints on the (batch, sequence, heads) axes so XLA lays
+  collectives on the right mesh axes
+- pluggable attention backend: "xla" (fused by the compiler), "flash" (Pallas),
+  "ulysses" (all-to-all SP, reference ``sequence/layer.py:271``), "ring"
+  (blockwise CP — the reference gap noted in SURVEY.md §2.2)
+- optional ``lax.scan`` over layers (fast compiles at depth) + jax.checkpoint remat
+  policies (reference ``runtime/activation_checkpointing``)
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+# logical activation axes -> mesh axes
+BATCH_AXES = ("data", "fsdp")
+SEQ_AXIS = "sequence"
+HEADS_AXIS = "tensor"
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    attention_backend: str = "xla"     # xla | flash | ulysses | ring
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    scan_layers: bool = False
+    logits_soft_cap: Optional[float] = None
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+
+# Model presets (public architecture configs)
+LLAMA3_8B = LlamaConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                        num_layers=32, num_heads=32, num_kv_heads=8)
+LLAMA3_70B = LlamaConfig(vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+                         num_layers=80, num_heads=64, num_kv_heads=8)
+LLAMA2_7B = LlamaConfig(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                        num_layers=32, num_heads=32, num_kv_heads=32, rope_theta=10000.0)
+TINY_LLAMA = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                         num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256)
+
+
+def shard_activation(x, spec: Tuple):
+    """with_sharding_constraint that degrades to no-op outside a mesh context."""
+    try:
+        return jax.lax.with_sharding_constraint(x, PartitionSpec(*spec))
+    except Exception:
+        return x
+
+
+class RMSNorm(nn.Module):
+    """RMS norm in fp32 accumulation (reference kernel: csrc rms_norm.cu — here a
+    single XLA fusion)."""
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        orig_dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        return (y * scale).astype(orig_dtype)
+
+
+def rope_freqs(head_dim: int, max_len: int, theta: float) -> Tuple[np.ndarray, np.ndarray]:
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    t = np.arange(max_len, dtype=np.float64)
+    freqs = np.outer(t, inv)
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def apply_rope(x, cos, sin, positions):
+    """x: [B, S, H, D]; positions: [B, S] (reference kernel: apply_rotary_pos_emb.cu)."""
+    cos_p = cos[positions][:, :, None, :]   # [B, S, 1, D/2]
+    sin_p = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _xla_attention(q, k, v, causal: bool = True, segment_ids=None):
+    """Plain attention; XLA fuses softmax chain. q,k,v: [B, S, H, D] / kv [B, S, Hkv, D]."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    sk = k.shape[1]
+    if causal:
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)
+        mask = qpos >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(seg_mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _dispatch_attention(backend: str, q, k, v, causal=True, segment_ids=None,
+                        mesh=None):
+    if backend == "xla":
+        return _xla_attention(q, k, v, causal, segment_ids)
+    if backend == "flash":
+        from deepspeed_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if backend == "ulysses":
+        from deepspeed_tpu.sequence.ulysses import ulysses_attention
+        return ulysses_attention(q, k, v, causal=causal)
+    if backend == "ring":
+        from deepspeed_tpu.sequence.ring import ring_attention
+        return ring_attention(q, k, v, causal=causal)
+    raise ValueError(f"unknown attention backend '{backend}'")
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        d = cfg.head_dim_
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(x)
+        k = dense(features=(cfg.num_kv_heads, d), name="wk")(x)
+        v = dense(features=(cfg.num_kv_heads, d), name="wv")(x)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        k = shard_activation(k, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        v = shard_activation(v, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+
+        cos, sin = rope_freqs(d, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        out = _dispatch_attention(cfg.attention_backend, q, k, v, causal=True,
+                                  segment_ids=segment_ids)
+        out = shard_activation(out, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=jnp.float32, name="wo")(out)
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        gate = dense(cfg.intermediate_size, name="w_gate")(x)
+        up = dense(cfg.intermediate_size, name="w_up")(x)
+        h = nn.silu(gate) * up
+        h = shard_activation(h, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS))
+        return dense(cfg.hidden_size, name="w_down")(h)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h = x + LlamaAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            positions, segment_ids)
+        out = h + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(h))
+        return shard_activation(out, (BATCH_AXES, SEQ_AXIS, None))
+
+
+REMAT_POLICIES = {
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+class LlamaModel(nn.Module):
+    """Backbone: embed -> N blocks -> final norm. Call with token ids [B, S]."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
+                                         input_ids.shape)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")
+        x = embed(input_ids)
+        x = shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+
+        block_cls = LlamaBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                LlamaBlock, policy=REMAT_POLICIES[cfg.remat_policy],
+                prevent_cse=not cfg.scan_layers, static_argnums=())
+
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions, segment_ids), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                              param_dtype=jnp.float32, name="lm_head")(x)
+        if cfg.logits_soft_cap:
+            logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
+        return logits
+
+
+class LlamaForCausalLM(nn.Module):
+    """Training entry: batch dict {"input_ids": [B,S]} (+ optional "labels",
+    "segment_ids", "positions", "loss_mask") -> mean next-token cross-entropy."""
+    cfg: LlamaConfig
+
+    def setup(self):
+        self.model = LlamaModel(self.cfg)
+
+    def __call__(self, batch):
+        input_ids = batch["input_ids"]
+        logits = self.model(input_ids,
+                            positions=batch.get("positions"),
+                            segment_ids=batch.get("segment_ids"))
+        labels = batch.get("labels")
+        if labels is None:
+            labels = input_ids[:, 1:]
+            logits = logits[:, :-1]
+            mask = batch.get("loss_mask")
+            mask = mask[:, 1:] if mask is not None else jnp.ones_like(labels)
+        else:
+            mask = batch.get("loss_mask", jnp.ones_like(labels))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        mask = mask.astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def logits(self, batch):
+        return self.model(batch["input_ids"], positions=batch.get("positions"),
+                          segment_ids=batch.get("segment_ids"))
+
+
+def llama_tensor_rules(path, leaf) -> Optional[PartitionSpec]:
+    """Megatron-style TP sharding rules keyed on parameter paths — the AutoTP
+    analog (reference module_inject/auto_tp.py:189: column-shard qkv/up, row-shard
+    o/down, vocab-shard embeddings).
+
+    Returned specs leave dims free for the fsdp axis to occupy (stage 3 layers on
+    a different dim via build_param_shardings).
+    """
+    name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+    ndim = np.ndim(leaf)
+    if "wq/kernel" in name or "wk/kernel" in name or "wv/kernel" in name:
+        # [embed, heads, head_dim] -> shard heads
+        return PartitionSpec(*([None] * (ndim - 2)), "tensor", None)
+    if "wo/kernel" in name:
+        # [heads, head_dim, embed] -> shard heads (input-parallel => psum output)
+        return PartitionSpec("tensor", *([None] * (ndim - 1)))
+    if "w_gate/kernel" in name or "w_up/kernel" in name:
+        return PartitionSpec(*([None] * (ndim - 1)), "tensor")
+    if "w_down/kernel" in name:
+        return PartitionSpec(*([None] * (ndim - 2)), "tensor", None)
+    if "embed/embedding" in name:
+        return PartitionSpec("tensor", *([None] * (ndim - 1)))
+    if "lm_head/kernel" in name:
+        return PartitionSpec(*([None] * (ndim - 1)), "tensor")
+    return None
+
+
+def make_llama(cfg: LlamaConfig = TINY_LLAMA):
+    return LlamaForCausalLM(cfg)
+
+
+def random_tokens(batch_size: int, seq_len: int, vocab_size: int = 512,
+                  seed: int = 0, gas: Optional[int] = None):
+    rng = np.random.default_rng(seed)
+    shape = (gas, batch_size, seq_len) if gas else (batch_size, seq_len)
+    return {"input_ids": rng.integers(0, vocab_size, size=shape).astype(np.int32)}

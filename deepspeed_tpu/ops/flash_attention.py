@@ -1,0 +1,114 @@
+"""Memory-efficient blockwise attention (flash-attention semantics).
+
+Reference analog: the fused attention kernels in
+``csrc/transformer/inference/csrc/softmax.cu`` + the training transformer kernel
+(``csrc/transformer``), and the v2 ``blocked_flash`` ragged kernels.
+
+TPU-native design: an online-softmax blockwise computation expressed in ``lax.scan``
+so XLA tiles the [block_q, block_k] score panels onto the MXU and never materializes
+the full [S, S] score matrix; O(S) memory, autodiff for free (the backward pass
+recomputes per-block under the scan, flash-style). A hand-written Pallas kernel with
+the same interface lives in ``deepspeed_tpu.ops.pallas.flash_attention`` and is used
+when shapes meet its tiling constraints; this module is the portable fallback and
+the numerics reference for kernel tests.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k, v, num_heads: int):
+    hkv = k.shape[2]
+    if hkv != num_heads:
+        rep = num_heads // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+@partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q, k, v, causal: bool = True, segment_ids=None,
+                    block_q: int = 512, block_k: int = 512,
+                    q_offset: int = 0, k_offset: int = 0):
+    """q: [B, Sq, H, D]; k,v: [B, Sk, Hkv, D] -> [B, Sq, H, D].
+
+    ``q_offset``/``k_offset`` shift global positions (used by ring attention where
+    each shard holds a slice of the global sequence).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    k, v = _repeat_kv(k, v, h)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # pad to multiples
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    nq, nk = q.shape[1] // block_q, k.shape[1] // block_k
+
+    scale = 1.0 / np.sqrt(d)
+    q_blocks = q.reshape(b, nq, block_q, h, d).transpose(1, 0, 3, 2, 4)  # [nq,B,H,bq,D]
+    k_blocks = k.reshape(b, nk, block_k, h, d).transpose(1, 0, 3, 2, 4)
+    v_blocks = v.reshape(b, nk, block_k, h, d).transpose(1, 0, 3, 2, 4)
+
+    kv_valid = jnp.arange(nk * block_k) < sk    # mask out k padding
+
+    def per_q_block(qi, q_blk):
+        qpos = q_offset + qi * block_q + jnp.arange(block_q)
+
+        def kv_step(carry, inputs):
+            m, l, o = carry
+            ki, k_blk, v_blk = inputs
+            kpos = k_offset + ki * block_k + jnp.arange(block_k)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk,
+                           preferred_element_type=jnp.float32) * scale
+            mask = kv_valid[ki * block_k + jnp.arange(block_k)][None, None, None, :]
+            if causal:
+                mask = jnp.logical_and(mask,
+                                       (qpos[:, None] >= kpos[None, :])[None, None])
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            o_new = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32)
+            return (m_new, l_new, o_new), None
+
+        m0 = jnp.full((b, h, block_q), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, block_q), jnp.float32)
+        o0 = jnp.zeros((b, h, block_q, d), jnp.float32)
+        (m, l, o), _ = jax.lax.scan(
+            kv_step, (m0, l0, o0),
+            (jnp.arange(nk), k_blocks, v_blocks))
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return out  # [B, H, bq, D]
+
+    outs = jax.lax.map(lambda args: per_q_block(*args), (jnp.arange(nq), q_blocks))
+    out = outs.transpose(1, 0, 3, 2, 4).reshape(b, nq * block_q, h, d)
+    return out[:, :sq].astype(q.dtype)
+
+
+def attention_reference(q, k, v, causal: bool = True):
+    """Naive O(S^2)-memory reference for kernel tests (analog of the torch
+    reference implementations in tests/unit/ops)."""
+    b, sq, h, d = q.shape
+    k, v = _repeat_kv(k, v, h)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    if causal:
+        sk = k.shape[1]
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)
+        s = jnp.where((qpos >= jnp.arange(sk)[None, :])[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)

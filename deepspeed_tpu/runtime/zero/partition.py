@@ -52,15 +52,24 @@ def _choose_fsdp_dim(shape, fsdp_size: int, taken_dims) -> Optional[int]:
 
 def param_partition_spec(shape, stage: int, fsdp_size: int,
                          tensor_spec: Optional[PartitionSpec] = None,
-                         min_shard_size: int = DEFAULT_MIN_SHARD_SIZE) -> PartitionSpec:
+                         min_shard_size: int = DEFAULT_MIN_SHARD_SIZE,
+                         axis_sizes: Optional[dict] = None) -> PartitionSpec:
     """PartitionSpec for a parameter leaf under a given ZeRO stage.
 
     ``tensor_spec`` is an existing (tensor/expert/sequence) sharding from model
-    annotations; fsdp sharding is layered on an unused dimension.
+    annotations; fsdp sharding is layered on an unused dimension. Annotated axes
+    that do not divide the dimension are dropped (e.g. GQA kv heads < tp degree —
+    the reference AutoTP replicates in that case too).
     """
     ndim = len(shape)
     base = list(tensor_spec) if tensor_spec is not None else []
     base = base + [None] * (ndim - len(base))
+    if axis_sizes:
+        for i, ax in enumerate(base):
+            if ax is not None and shape[i] % axis_sizes.get(ax, 1) != 0:
+                warning_once(f"dim {i} of shape {shape} not divisible by "
+                             f"{ax}={axis_sizes.get(ax)}; replicating that dim")
+                base[i] = None
     if stage < 3 or fsdp_size <= 1:
         return PartitionSpec(*base) if any(a is not None for a in base) else PartitionSpec()
     if int(np.prod(shape)) < min_shard_size:
@@ -104,11 +113,13 @@ def build_param_shardings(params: Any, mesh: Mesh, stage: int,
     shardings (the AutoTP analog — see deepspeed_tpu.parallel.auto_tp).
     """
     fsdp_size = mesh.shape["fsdp"]
+    axis_sizes = dict(mesh.shape)
 
     def leaf_spec(path, leaf):
         tspec = tensor_rules(path, leaf) if tensor_rules else None
         return param_partition_spec(np.shape(leaf), stage, fsdp_size, tensor_spec=tspec,
-                                    min_shard_size=min_shard_size)
+                                    min_shard_size=min_shard_size,
+                                    axis_sizes=axis_sizes)
 
     specs = jax.tree_util.tree_map_with_path(leaf_spec, params)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,

@@ -1,0 +1,81 @@
+"""Ring attention — blockwise context parallelism over a ``ppermute`` ring.
+
+The reference has NO ring attention (SURVEY.md §2.2: Ulysses all-to-all is its only
+long-context mechanism); this is the TPU-side improvement called out in the survey:
+KV blocks rotate around the ``sequence`` mesh axis while each device's queries stay
+put, with flash-style online-softmax accumulation — O(S/P) activation memory and
+communication that overlaps with the per-block attention compute (XLA pipelines the
+``ppermute`` with the einsums).
+
+Causality is handled with *global* positions: device i holds queries
+[i*S_l, (i+1)*S_l); at ring step t it holds the KV block originating on device
+(i - t) mod P, masked by qpos >= kpos.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.ops.flash_attention import NEG_INF, _repeat_kv
+
+BATCH = ("data", "fsdp")
+
+
+def ring_attention(q, k, v, causal: bool = True, mesh=None):
+    """q,k,v: [B, S, H(kv), D] global, sequence-sharded. Returns [B, S, H, D]."""
+    mesh = mesh or mesh_lib.get_global_mesh()
+    sp = mesh.shape["sequence"]
+    if sp == 1:
+        from deepspeed_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+
+    h = q.shape[2]
+    spec_q = P(BATCH, "sequence", "tensor", None)
+
+    def body(q_l, k_l, v_l):
+        b, s_l, h_l, d = q_l.shape
+        k_l, v_l = _repeat_kv(k_l, v_l, h_l)
+        idx = jax.lax.axis_index("sequence")
+        qpos = idx * s_l + jnp.arange(s_l)
+        scale = 1.0 / np.sqrt(d)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+        def step(carry, t):
+            k_cur, v_cur, m, l, o = carry
+            src = (idx - t) % sp
+            kpos = src * s_l + jnp.arange(s_l)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q_l, k_cur,
+                           preferred_element_type=jnp.float32) * scale
+            if causal:
+                mask = (qpos[:, None] >= kpos[None, :])[None, None]
+                s = jnp.where(mask, s, NEG_INF)
+            else:
+                mask = jnp.bool_(True)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            if causal:
+                p = jnp.where(mask, p, 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            o_new = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+                preferred_element_type=jnp.float32)
+            # rotate KV one hop around the ring (overlaps with next step's compute)
+            k_next = jax.lax.ppermute(k_cur, "sequence", perm)
+            v_next = jax.lax.ppermute(v_cur, "sequence", perm)
+            return (k_next, v_next, m_new, l_new, o_new), None
+
+        m0 = jnp.full((b, h_l, s_l), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h_l, s_l), jnp.float32)
+        o0 = jnp.zeros((b, h_l, s_l, d), jnp.float32)
+        (_, _, m, l, o), _ = jax.lax.scan(step, (k_l, v_l, m0, l0, o0),
+                                          jnp.arange(sp))
+        out = o / jnp.maximum(l, 1e-30)[..., None]          # [B, H, S_l, D]
+        return out.transpose(0, 2, 1, 3).astype(q_l.dtype)  # [B, S_l, H, D]
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec_q, spec_q, spec_q),
+                         out_specs=spec_q, check_vma=False)(q, k, v)

@@ -1,0 +1,68 @@
+"""DeepSpeed-Ulysses sequence parallelism — head-scatter all-to-all attention.
+
+Reference analog: ``deepspeed/sequence/layer.py:271`` (``DistributedAttention``):
+q/k/v arrive sequence-sharded [B, S/P, H, D]; an all-to-all scatters heads and
+gathers sequence -> [B, S, H/P, D]; local attention runs over the full sequence with
+a slice of heads; an inverse all-to-all restores sequence sharding
+(``_SeqAllToAll`` layer.py:216, ``single_all_to_all`` :153).
+
+TPU-native: one ``shard_map`` over the mesh with ``lax.all_to_all`` on the
+``sequence`` axis — 4 all-to-alls per attention (q,k,v + output), riding ICI.
+Composes with TP: heads are already split over ``tensor``; Ulysses further splits
+the local heads over ``sequence``. Constraint (same as reference default path):
+heads/tp must be divisible by the sequence-parallel degree; the reference's
+uneven-heads fallback (``uneven_heads_all2all`` layer.py:43) is approximated by
+falling back to ring attention when heads don't divide.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.ops.flash_attention import flash_attention
+
+BATCH = ("data", "fsdp")
+
+
+def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
+                      use_flash: bool = True):
+    """q: [B, S, H, D] global (sequence-sharded on the mesh); returns same shape.
+
+    Inside the shard_map each device holds [B, S/sp, H_local, D]; after the
+    all-to-all it holds [B, S, H_local/sp, D] and runs full-sequence attention.
+    """
+    mesh = mesh or mesh_lib.get_global_mesh()
+    sp = mesh.shape["sequence"]
+    if sp == 1:
+        return flash_attention(q, k, v, causal=causal) if use_flash else \
+            _local_attn(q, k, v, causal)
+
+    h_local = q.shape[2] // (mesh.shape["tensor"] * sp) * sp  # sanity below
+    if (q.shape[2] // mesh.shape["tensor"]) % sp != 0 or \
+            (k.shape[2] // max(mesh.shape["tensor"], 1)) % sp != 0:
+        from deepspeed_tpu.sequence.ring import ring_attention
+        return ring_attention(q, k, v, causal=causal, mesh=mesh)
+
+    spec = P(BATCH, "sequence", "tensor", None)
+
+    def body(q_l, k_l, v_l):
+        # [B, S/sp, Hl, D] -> scatter heads / gather sequence -> [B, S, Hl/sp, D]
+        a2a = partial(jax.lax.all_to_all, axis_name="sequence",
+                      split_axis=2, concat_axis=1, tiled=True)
+        qg, kg, vg = a2a(q_l), a2a(k_l), a2a(v_l)
+        out = flash_attention(qg, kg, vg, causal=causal) if use_flash else \
+            _local_attn(qg, kg, vg, causal)
+        # inverse: scatter sequence / gather heads
+        return jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _local_attn(q, k, v, causal):
+    from deepspeed_tpu.ops.flash_attention import attention_reference
+    return attention_reference(q, k, v, causal=causal)
