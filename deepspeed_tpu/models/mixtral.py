@@ -1,0 +1,105 @@
+"""Mixtral-style MoE causal LM.
+
+Reference analog: the MoE model path (``deepspeed/moe/layer.py:17`` MoE wraps a
+dense block's MLP) + inference v2's ``qwen_v2_moe``/mixtral implementations. Here a
+Llama backbone whose MLP is an expert-parallel MOELayer; aux (load-balance + router
+z) losses are threaded functionally through the blocks into the LM loss, as the
+reference accumulates them via MoE param groups.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES,
+    SEQ_AXIS,
+    LlamaAttention,
+    LlamaConfig,
+    RMSNorm,
+    llama_tensor_rules,
+    shard_activation,
+)
+from deepspeed_tpu.moe.sharded_moe import MOELayer, MoEConfig, moe_tensor_rules
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    base: LlamaConfig = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8)
+    moe: MoEConfig = MoEConfig(num_experts=8, top_k=2)
+
+
+TINY_MIXTRAL = MixtralConfig(
+    base=LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                     num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128),
+    moe=MoEConfig(num_experts=4, top_k=2, dtype=jnp.bfloat16))
+
+MIXTRAL_8X7B = MixtralConfig(
+    base=LlamaConfig(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                     num_layers=32, num_heads=32, num_kv_heads=8,
+                     rope_theta=1000000.0),
+    moe=MoEConfig(num_experts=8, top_k=2))
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool = True):
+        base = self.cfg.base
+        h = x + LlamaAttention(base, name="attn")(
+            RMSNorm(base.rms_norm_eps, base.dtype, name="attn_norm")(x), positions)
+        moe_out, aux = MOELayer(self.cfg.moe, base.hidden_size,
+                                base.intermediate_size, name="moe")(
+            RMSNorm(base.rms_norm_eps, base.dtype, name="mlp_norm")(h), train=train)
+        out = h + moe_out
+        return shard_activation(out, (BATCH_AXES, SEQ_AXIS, None)), aux
+
+
+class MixtralForCausalLM(nn.Module):
+    """batch {"input_ids": [B,S]} -> LM loss + weighted MoE aux losses."""
+    cfg: MixtralConfig
+
+    @nn.compact
+    def _backbone(self, input_ids, train: bool = True):
+        base = self.cfg.base
+        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+        embed = nn.Embed(base.vocab_size, base.hidden_size, dtype=base.dtype,
+                         param_dtype=jnp.float32, name="embed")
+        x = embed(input_ids)
+        aux_total = jnp.float32(0.0)
+        for i in range(base.num_layers):
+            x, aux = MixtralBlock(self.cfg, name=f"layer_{i}")(x, positions, train)
+            aux_total = aux_total + aux
+        x = RMSNorm(base.rms_norm_eps, base.dtype, name="final_norm")(x)
+        logits = nn.Dense(base.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits, aux_total
+
+    def __call__(self, batch, train: bool = True):
+        input_ids = batch["input_ids"]
+        logits, aux_total = self._backbone(input_ids, train)
+        labels = input_ids[:, 1:]
+        logits = logits[:, :-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll) + aux_total
+
+    def logits(self, batch):
+        logits, _ = self._backbone(batch["input_ids"], train=False)
+        return logits
+
+
+def mixtral_tensor_rules(path, leaf) -> Optional[PartitionSpec]:
+    """Compose attention TP rules with expert-parallel rules."""
+    spec = moe_tensor_rules(path, leaf)
+    if spec is not None:
+        return spec
+    return llama_tensor_rules(path, leaf)

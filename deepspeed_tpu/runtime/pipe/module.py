@@ -1,0 +1,71 @@
+"""Pipeline partitioning helpers.
+
+Reference analog: ``PipelineModule`` (``runtime/pipe/module.py:86``) with
+``LayerSpec``/``TiedLayerSpec`` (:30,:77) and partition methods
+``parameters|uniform|type:regex``. Here models are flax modules with stacked layer
+params, so "partitioning" reduces to assigning contiguous layer ranges to stages —
+balanced by count (uniform) or by parameter volume (parameters).
+"""
+
+from typing import Any, List
+
+import jax
+import numpy as np
+
+
+def partition_uniform(num_layers: int, num_stages: int) -> List[int]:
+    """Stage boundaries [s_0=0, ..., s_P=L], uniform by layer count
+    (reference: ds_utils.partition_uniform)."""
+    bounds = [0]
+    for s in range(num_stages):
+        bounds.append(bounds[-1] + num_layers // num_stages +
+                      (1 if s < num_layers % num_stages else 0))
+    return bounds
+
+
+def partition_balanced(weights: List[float], num_stages: int) -> List[int]:
+    """Boundaries minimizing the max per-stage weight (reference:
+    partition_method='parameters' — binary search over bottleneck capacity,
+    ds_utils.partition_balanced)."""
+    n = len(weights)
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    def feasible(cap: float) -> bool:
+        stages, start = 0, 0
+        while start < n:
+            end = start
+            while end < n and prefix[end + 1] - prefix[start] <= cap:
+                end += 1
+            if end == start:
+                return False
+            stages += 1
+            start = end
+        return stages <= num_stages
+
+    lo, hi = max(weights), sum(weights)
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        if feasible(mid):
+            hi = mid
+        else:
+            lo = mid
+    # materialize boundaries greedily at capacity hi
+    bounds, start = [0], 0
+    for _ in range(num_stages):
+        end = start
+        while end < n and prefix[end + 1] - prefix[start] <= hi:
+            end += 1
+        bounds.append(end)
+        start = end
+    bounds[-1] = n
+    return bounds
+
+
+def layer_param_counts(stacked_params: Any) -> List[float]:
+    """Per-layer parameter counts from [L, ...]-stacked leaves."""
+    leaves = jax.tree.leaves(stacked_params)
+    if not leaves:
+        return []
+    num_layers = leaves[0].shape[0]
+    per_layer = sum(int(np.prod(l.shape[1:])) for l in leaves)
+    return [float(per_layer)] * num_layers

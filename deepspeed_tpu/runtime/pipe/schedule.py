@@ -1,0 +1,143 @@
+"""Pipeline instruction schedules.
+
+Reference analog: ``deepspeed/runtime/pipe/schedule.py`` — the instruction set
+(:327-475: LoadMicroBatch, ForwardPass, BackwardPass, SendActivation,
+RecvActivation, SendGrad, RecvGrad, ReduceGrads, ReduceTiedGrads, OptimizerStep)
+and the 1F1B ``TrainSchedule`` (:189) / ``InferenceSchedule`` (:135) generators.
+
+On TPU the *executor* is SPMD (see ``spmd.py``): XLA schedules sends/recvs as
+``ppermute`` collectives inside one compiled program, and autodiff derives the
+backward pipeline. The instruction streams remain useful as (a) the analytical
+model of the schedule (bubble accounting, tests), (b) the contract for a future
+host-driven multi-slice executor over DCN. Generators are pure and unit-tested.
+"""
+
+import dataclasses
+from typing import Iterator, List
+
+
+@dataclasses.dataclass(frozen=True)
+class PipeInstruction:
+    micro_batch_id: int = -1
+
+    def __repr__(self):
+        mb = f"(mb={self.micro_batch_id})" if self.micro_batch_id >= 0 else ""
+        return f"{type(self).__name__}{mb}"
+
+
+class LoadMicroBatch(PipeInstruction): pass
+class ForwardPass(PipeInstruction): pass
+class BackwardPass(PipeInstruction): pass
+class SendActivation(PipeInstruction): pass
+class RecvActivation(PipeInstruction): pass
+class SendGrad(PipeInstruction): pass
+class RecvGrad(PipeInstruction): pass
+class ReduceGrads(PipeInstruction): pass
+class ReduceTiedGrads(PipeInstruction): pass
+class OptimizerStep(PipeInstruction): pass
+
+
+class PipeSchedule:
+    """Base generator (reference: schedule.py:9 PipeSchedule)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference: schedule.py:135)."""
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for t in range(total):
+            cmds: List[PipeInstruction] = []
+            mb = t - self.stage_id
+            if 0 <= mb < self.micro_batches:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(mb))
+                else:
+                    cmds.append(RecvActivation(mb))
+                cmds.append(ForwardPass(mb))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(mb))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference: schedule.py:189): warmup forwards, steady-state alternating
+    fwd/bwd, cooldown backwards, then grad reduce + optimizer step."""
+
+    def num_pipe_buffers(self) -> int:
+        # reference :268 — buffers needed = min(stages - stage_id, micro_batches)
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+    def steps(self):
+        m, s, p = self.micro_batches, self.stages, self.stage_id
+        warmup = min(s - p - 1, m)
+        remaining = m - warmup
+        fwd_mb = 0
+        bwd_mb = 0
+
+        # warmup: forwards only
+        for _ in range(warmup):
+            cmds: List[PipeInstruction] = []
+            cmds.append(LoadMicroBatch(fwd_mb) if p == 0 else RecvActivation(fwd_mb))
+            cmds.append(ForwardPass(fwd_mb))
+            if p != s - 1:
+                cmds.append(SendActivation(fwd_mb))
+            yield cmds
+            fwd_mb += 1
+
+        # steady state: 1F1B
+        for i in range(remaining):
+            cmds = []
+            cmds.append(LoadMicroBatch(fwd_mb) if p == 0 else RecvActivation(fwd_mb))
+            cmds.append(ForwardPass(fwd_mb))
+            if p != s - 1:
+                cmds.append(SendActivation(fwd_mb))
+            fwd_mb += 1
+            if p != s - 1:
+                cmds.append(RecvGrad(bwd_mb))
+            cmds.append(BackwardPass(bwd_mb))
+            if p != 0:
+                cmds.append(SendGrad(bwd_mb))
+            yield cmds
+            bwd_mb += 1
+
+        # cooldown: backwards only
+        while bwd_mb < m:
+            cmds = []
+            if p != s - 1:
+                cmds.append(RecvGrad(bwd_mb))
+            cmds.append(BackwardPass(bwd_mb))
+            if p != 0:
+                cmds.append(SendGrad(bwd_mb))
+            yield cmds
+            bwd_mb += 1
+
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Pipeline bubble overhead of GPipe/1F1B: (s-1)/(m+s-1)."""
+    return (stages - 1) / (micro_batches + stages - 1)

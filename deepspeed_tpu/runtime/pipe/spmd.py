@@ -1,0 +1,99 @@
+"""SPMD pipeline executor — GPipe over the ``pipe`` mesh axis inside one jit.
+
+Reference analog: ``PipelineEngine._exec_schedule`` (``runtime/pipe/engine.py:1408``)
++ p2p send/recv (``runtime/pipe/p2p.py``). TPU redesign (SURVEY.md §7 hard-part 2):
+instead of a host-driven instruction loop with point-to-point sends, the whole
+fill-process-drain rotation is a ``lax.scan`` whose per-step stage handoff is a
+``ppermute`` — one compiled program per train step. ``jax.grad`` through the scan
+derives the backward pipeline (reverse ppermutes = SendGrad/RecvGrad) mechanically,
+which is why no BackwardPass instruction executor exists here.
+
+Layout: per-layer params are stacked on a leading layer dim [L, ...], reshaped to
+[P, L/P, ...] and sharded over ``pipe``; each stage scans its local L/P layers.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+
+
+def stack_to_stages(stacked_params: Any, num_stages: int) -> Any:
+    """[L, ...] -> [P, L/P, ...] per leaf (layer-uniform partitioning, the
+    reference's ``partition_method='uniform'``; see module.py for 'parameters')."""
+    def reshape(x):
+        l = x.shape[0]
+        assert l % num_stages == 0, f"{l} layers not divisible by {num_stages} stages"
+        return x.reshape(num_stages, l // num_stages, *x.shape[1:])
+    return jax.tree.map(reshape, stacked_params)
+
+
+def pipeline_apply(block_fn: Callable, stacked_params: Any, x_microbatches,
+                   mesh=None, extra_args: tuple = ()):
+    """Run microbatched activations through a layer pipeline.
+
+    block_fn(layer_params, x, *extra_args) -> x  — one transformer block.
+    stacked_params: leaves [L, ...] (flax nn.scan layout).
+    x_microbatches: [M, B, S, D] activations (replicated across pipe).
+    Returns [M, B, S, D] outputs (replicated).
+    """
+    mesh = mesh or mesh_lib.get_global_mesh()
+    n_stages = mesh.shape["pipe"]
+    if n_stages == 1:
+        def no_pipe(x):
+            def layer_step(carry, lp):
+                return block_fn(lp, carry, *extra_args), None
+            y, _ = jax.lax.scan(layer_step, x, stacked_params)
+            return y
+        return jax.vmap(no_pipe)(x_microbatches) if x_microbatches.ndim > 3 \
+            else no_pipe(x_microbatches)
+
+    staged = stack_to_stages(stacked_params, n_stages)
+    m = x_microbatches.shape[0]
+
+    param_specs = jax.tree.map(lambda x: P("pipe", *([None] * (x.ndim - 1))), staged)
+    x_spec = P()  # microbatches replicated into the pipe shard_map
+
+    def body(local_params, x_mb):
+        # local_params leaves: [1, L/P, ...] (shard of the stage dim) -> squeeze
+        local_params = jax.tree.map(lambda x: x[0], local_params)
+        p = jax.lax.axis_index("pipe")
+        total_steps = m + n_stages - 1
+
+        def apply_stage(x):
+            def layer_step(carry, lp):
+                return block_fn(lp, carry, *extra_args), None
+            y, _ = jax.lax.scan(layer_step, x, local_params)
+            return y
+
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def step(carry, t):
+            cur, outputs = carry
+            # stage 0 loads microbatch t (clipped reload after M is dead compute)
+            inp = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+            cur = jnp.where(p == 0, inp, cur)
+            out = apply_stage(cur)
+            # last stage stores microbatch t-(P-1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            write = jnp.logical_and(p == n_stages - 1, t >= n_stages - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, out, prev), out_idx, 0)
+            nxt = jax.lax.ppermute(out, "pipe", perm)
+            return (nxt, outputs), None
+
+        cur0 = jnp.zeros_like(x_mb[0])
+        outs0 = jnp.zeros_like(x_mb)
+        (_, outputs), _ = jax.lax.scan(step, (cur0, outs0), jnp.arange(total_steps))
+        # replicate the last stage's outputs to every stage
+        outputs = jax.lax.psum(
+            jnp.where(p == n_stages - 1, outputs, jnp.zeros_like(outputs)), "pipe")
+        return outputs
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(param_specs, x_spec),
+                         out_specs=P(), check_vma=False)(staged, x_microbatches)
