@@ -1,0 +1,205 @@
+"""Continuous-batching inference engine (FastGen equivalent).
+
+Reference analog: ``deepspeed/inference/v2/engine_v2.py:30``
+(``InferenceEngineV2``): ``put(batch_uids, batch_tokens)`` schedules a ragged
+forward; ``query``/``can_schedule`` gate admission on free KV blocks; the state
+manager + blocked KV cache hold per-sequence context.
+
+TPU adaptation: per step, the SplitFuse plan becomes (a) one bucketed
+``prefill_chunk`` call per admitted chunk and (b) one padded ``decode_step`` call
+for all running decodes — every shape from a small bucket ladder, so steady-state
+serving runs entirely from compiled programs.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache, KVCacheConfig
+from deepspeed_tpu.inference.v2.llama_decode import decode_step, prefill_chunk
+from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor, StateManager
+from deepspeed_tpu.inference.v2.scheduler import (
+    PrefillChunk,
+    SchedulerConfig,
+    StepPlan,
+    plan_step,
+    snap_bucket,
+)
+from deepspeed_tpu.models.llama import LlamaConfig
+from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass
+class V2EngineConfig:
+    kv_block_size: int = 64
+    kv_num_blocks: int = 512
+    max_tracked_sequences: int = 256
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    decode_batch_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    ctx_block_buckets: Tuple[int, ...] = (4, 8, 16, 32, 64)   # blocks per table
+    eos_token_id: Optional[int] = None
+    greedy: bool = True
+
+
+class InferenceEngineV2:
+    def __init__(self, params, model_config: LlamaConfig,
+                 config: Optional[V2EngineConfig] = None):
+        self.params = params
+        self.model_config = model_config
+        self.config = config or V2EngineConfig()
+        self.kv = BlockedKVCache(KVCacheConfig(
+            num_layers=model_config.num_layers,
+            num_kv_heads=model_config.num_kv_heads,
+            head_dim=model_config.head_dim_,
+            block_size=self.config.kv_block_size,
+            num_blocks=self.config.kv_num_blocks,
+            dtype=model_config.dtype))
+        self.state = StateManager(
+            max_tracked_sequences=self.config.max_tracked_sequences,
+            max_context_length=model_config.max_seq_len)
+        self._pending_logits: Dict[int, np.ndarray] = {}
+
+    # ------------------------------------------------------------------
+    # admission control (reference: engine_v2.py:158 query, :184 can_schedule)
+    # ------------------------------------------------------------------
+    def query(self, uid: int, max_request_length: int) -> Tuple[int, int]:
+        """Returns (max_new_blocks_needed, free_blocks)."""
+        seq = self.state.get(uid)
+        tracked = seq.total_tokens if seq else 0
+        needed = self.kv.blocks_needed(tracked + max_request_length) - \
+            (len(seq.blocks) if seq else 0)
+        return needed, self.kv.free_blocks
+
+    def can_schedule(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> bool:
+        total = 0
+        for uid, n in zip(uids, lengths):
+            needed, _ = self.query(uid, n)
+            total += needed
+        return total <= self.kv.free_blocks and \
+            len(self.state) + len([u for u in uids if u not in self.state]) <= \
+            self.state.max_tracked_sequences
+
+    # ------------------------------------------------------------------
+    # block bookkeeping
+    # ------------------------------------------------------------------
+    def _ensure_blocks(self, seq: SequenceDescriptor, up_to_tokens: int):
+        need = self.kv.blocks_needed(up_to_tokens) - len(seq.blocks)
+        if need > 0:
+            seq.blocks.extend(self.kv.reserve(need))
+
+    def _block_table(self, seq: SequenceDescriptor, bucket_blocks: int) -> np.ndarray:
+        trash = self.kv.cfg.num_blocks - 1
+        table = np.full((bucket_blocks,), trash, dtype=np.int32)
+        n = min(len(seq.blocks), bucket_blocks)
+        table[:n] = seq.blocks[:n]
+        return table
+
+    def _ctx_bucket_blocks(self, tokens: int) -> int:
+        blocks = self.kv.blocks_needed(max(tokens, 1))
+        return snap_bucket(blocks, self.config.ctx_block_buckets)
+
+    # ------------------------------------------------------------------
+    # the step
+    # ------------------------------------------------------------------
+    def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[Sequence[int]],
+            do_checks: bool = True) -> Dict[int, int]:
+        """Admit new/continued sequences and run ONE engine step
+        (reference: engine_v2.put engine_v2.py:107). Returns {uid: next_token}
+        for every sequence that produced a token this step."""
+        if do_checks and not self.can_schedule(
+                batch_uids, [len(t) for t in batch_tokens]):
+            raise RuntimeError("cannot schedule batch: out of KV blocks or slots")
+        for uid, toks in zip(batch_uids, batch_tokens):
+            if uid in self.state:
+                seq = self.state.get(uid)
+                seq.prompt_tokens = np.concatenate(
+                    [seq.prompt_tokens, np.asarray(toks, np.int32)])
+                seq.done = False
+            else:
+                self.state.create(uid, toks)
+        return self.step()
+
+    def step(self) -> Dict[int, int]:
+        plan = plan_step(self.state.decoding(), self.state.prefilling(),
+                         self.config.scheduler)
+        out: Dict[int, int] = {}
+        cache = self.kv.data
+
+        # --- prefill chunks (SplitFuse) ---
+        for chunk in plan.prefill_chunks:
+            seq = chunk.seq
+            end = chunk.start + chunk.length
+            self._ensure_blocks(seq, end)
+            bucket = chunk.bucket
+            tokens = np.zeros((bucket,), np.int32)
+            tokens[:chunk.length] = seq.prompt_tokens[chunk.start:end]
+            mb = self._ctx_bucket_blocks(end)
+            table = self._block_table(seq, mb)
+            logits, cache = prefill_chunk(
+                self.params, cache, jnp.asarray(tokens), chunk.start,
+                jnp.asarray(table), chunk.length,
+                cfg=self.model_config, block_size=self.kv.cfg.block_size)
+            seq.seen_tokens = end
+            if not seq.in_prefill:
+                tok = self._sample(np.asarray(logits))
+                seq.generated.append(int(tok))
+                out[seq.uid] = int(tok)
+
+        # --- decode batch ---
+        if plan.decode_seqs:
+            seqs = plan.decode_seqs
+            b = snap_bucket(len(seqs), self.config.decode_batch_buckets)
+            max_ctx = max(s.total_tokens for s in seqs)
+            mb = self._ctx_bucket_blocks(max_ctx)
+            tokens = np.zeros((b,), np.int32)
+            positions = np.zeros((b,), np.int32)
+            tables = np.full((b, mb), self.kv.cfg.num_blocks - 1, np.int32)
+            valid = np.zeros((b,), bool)
+            for j, seq in enumerate(seqs):
+                self._ensure_blocks(seq, seq.total_tokens)
+                tokens[j] = seq.generated[-1] if seq.generated else \
+                    seq.prompt_tokens[-1]
+                positions[j] = seq.total_tokens - 1
+                tables[j] = self._block_table(seq, mb)
+                valid[j] = True
+            logits, cache = decode_step(
+                self.params, cache, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(valid),
+                cfg=self.model_config, block_size=self.kv.cfg.block_size)
+            logits_np = np.asarray(logits)
+            for j, seq in enumerate(seqs):
+                tok = self._sample(logits_np[j])
+                seq.seen_tokens = seq.total_tokens
+                seq.generated.append(int(tok))
+                out[seq.uid] = int(tok)
+                if self.config.eos_token_id is not None and \
+                        int(tok) == self.config.eos_token_id:
+                    seq.done = True
+
+        self.kv.data = cache
+        return out
+
+    def _sample(self, logits: np.ndarray) -> int:
+        return int(np.argmax(logits))
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference: engine_v2.flush)
+    # ------------------------------------------------------------------
+    def flush(self, uid: int) -> List[int]:
+        """Release a sequence's KV blocks; returns its generated tokens."""
+        seq = self.state.pop(uid)
+        self.kv.release(seq.blocks)
+        return seq.generated
+
+    def generate(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
+                 uid: int = 0) -> List[int]:
+        """Convenience serial generation loop over the continuous-batching step."""
+        self.put([uid], [list(prompt_tokens)])
+        seq = self.state.get(uid)
+        while len(seq.generated) < max_new_tokens and not seq.done:
+            self.step()
+        return self.flush(uid)

@@ -1,0 +1,70 @@
+"""Paged (blocked) KV cache on device.
+
+Reference analog: ``deepspeed/inference/v2/ragged/kv_cache.py:40``
+(``BlockedKVCache``) — a pool of fixed-size KV blocks per layer, reserved through a
+``BlockedAllocator``. TPU layout: one [num_blocks, block_size, kv_heads, head_dim]
+array per (K, V) per layer, sharded over ``tensor`` on the heads dim. Block writes
+are ``.at[].set`` scatters inside the jitted step; reads gather a sequence's block
+table into a contiguous context window.
+"""
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+
+
+@dataclasses.dataclass
+class KVCacheConfig:
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    block_size: int = 64
+    num_blocks: int = 256
+    dtype: any = jnp.bfloat16
+
+
+class BlockedKVCache:
+    def __init__(self, cfg: KVCacheConfig):
+        self.cfg = cfg
+        # last block reserved as the trash target for padding-token writes
+        # (see llama_decode.py); never handed out by the allocator
+        self.allocator = BlockedAllocator(cfg.num_blocks - 1)
+        # [L, 2(kv), num_blocks, block_size, H_kv, D]
+        self.data = jnp.zeros(
+            (cfg.num_layers, 2, cfg.num_blocks, cfg.block_size,
+             cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return int(np.ceil(num_tokens / self.cfg.block_size))
+
+    def reserve(self, num_blocks: int) -> List[int]:
+        """reference: kv_cache.py:144 reserve."""
+        return self.allocator.allocate(num_blocks)
+
+    def release(self, blocks: List[int]) -> None:
+        self.allocator.free(blocks)
+
+
+def write_kv_block_tokens(cache_data, layer: int, k_new, v_new, block_ids,
+                          start_pos: int, block_size: int):
+    """Scatter new K/V tokens into their blocks (jit-friendly building block).
+
+    k_new/v_new: [T, H, D]; block_ids: [T] target block per token;
+    offsets derived from positions. Used by the engine's compiled step via
+    flat (block, offset) indices.
+    """
+    t = k_new.shape[0]
+    positions = start_pos + jnp.arange(t)
+    offsets = positions % block_size
+    cache_data = cache_data.at[layer, 0, block_ids, offsets].set(k_new)
+    cache_data = cache_data.at[layer, 1, block_ids, offsets].set(v_new)
+    return cache_data

@@ -1,0 +1,182 @@
+"""Cache-aware Llama forward for serving: chunked prefill + batched paged decode.
+
+Reference analog: the inference v2 kernel pipeline (``linear_blocked_kv_rotary``,
+``blocked_flash``, ``logits_gather`` in ``inference/v2/kernels/ragged_ops/``) and
+the per-arch model implementations (``inference/v2/model_implementations/llama_v2``).
+
+TPU redesign: pure functions over the *training* model's param pytree
+(``LlamaForCausalLM`` — same weights serve and train, no module surgery), with
+static bucketed shapes so each (bucket, batch) pair compiles once:
+
+- ``prefill_chunk``: one sequence, a [bucket]-padded token chunk; writes K/V into
+  its cache blocks, runs flash attention against the gathered context, returns the
+  last real token's logits (SplitFuse chunks: q_offset = chunk start).
+- ``decode_step``: a [B]-padded batch of sequences, one token each; scatter-writes
+  K/V, attends over gathered paged context.
+
+Padding tokens write into a reserved trash block (the pool's last block), so no
+masking is needed on the write path. Causal masking doubles as padding masking on
+the read path: gathered positions >= context length can never satisfy
+qpos >= kpos.
+"""
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig, rope_freqs
+from deepspeed_tpu.ops.flash_attention import flash_attention
+
+NEG_INF = -1e30
+
+
+def _rms(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def _rope_1d(x, cos, sin, positions):
+    """x: [..., T, H, D]; positions broadcastable to [..., T]."""
+    cos_p = cos[positions][..., None, :]
+    sin_p = sin[positions][..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], -1)
+    return out.astype(x.dtype)
+
+
+def _layer_params(params, i):
+    return params["model"][f"layer_{i}"]
+
+
+def _qkv(lp, x, dtype):
+    """x: [T, D] -> q [T,H,d], k/v [T,Hkv,d] via DenseGeneral kernels."""
+    q = jnp.einsum("td,dhk->thk", x, lp["attn"]["wq"]["kernel"].astype(dtype))
+    k = jnp.einsum("td,dhk->thk", x, lp["attn"]["wk"]["kernel"].astype(dtype))
+    v = jnp.einsum("td,dhk->thk", x, lp["attn"]["wv"]["kernel"].astype(dtype))
+    return q, k, v
+
+
+def _mlp(lp, x, dtype):
+    g = x @ lp["mlp"]["w_gate"]["kernel"].astype(dtype)
+    u = x @ lp["mlp"]["w_up"]["kernel"].astype(dtype)
+    return (jax.nn.silu(g) * u) @ lp["mlp"]["w_down"]["kernel"].astype(dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg", "block_size"))
+def prefill_chunk(params, cache_data, tokens, start, block_table, true_len,
+                  cfg: LlamaConfig, block_size: int):
+    """One sequence, one chunk. tokens: [Tb] (bucket-padded); start: chunk offset;
+    block_table: [MB] block ids (trash-padded); true_len: real chunk tokens.
+    Returns (last-token logits [V], updated cache_data)."""
+    dtype = cfg.dtype
+    tb = tokens.shape[0]
+    mb = block_table.shape[0]
+    d_head = cfg.head_dim_
+    cos, sin = rope_freqs(d_head, cfg.max_seq_len, cfg.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+
+    positions = start + jnp.arange(tb)
+    safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+    # padding tokens (t >= true_len) route to the trash block
+    tok_block = jnp.where(jnp.arange(tb) < true_len,
+                          block_table[jnp.minimum(safe_pos // block_size, mb - 1)],
+                          cache_data.shape[2] - 1)
+    tok_off = safe_pos % block_size
+
+    x = params["model"]["embed"]["embedding"].astype(dtype)[tokens]
+    for i in range(cfg.num_layers):
+        lp = _layer_params(params, i)
+        h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, h, dtype)
+        q = _rope_1d(q, cos, sin, safe_pos)
+        k = _rope_1d(k, cos, sin, safe_pos)
+        cache_data = cache_data.at[i, 0, tok_block, tok_off].set(k)
+        cache_data = cache_data.at[i, 1, tok_block, tok_off].set(v)
+        # gather full context (includes this chunk's freshly written K/V)
+        ctx_k = cache_data[i, 0, block_table].reshape(mb * block_size,
+                                                     cfg.num_kv_heads, d_head)
+        ctx_v = cache_data[i, 1, block_table].reshape(mb * block_size,
+                                                     cfg.num_kv_heads, d_head)
+        attn = flash_attention(q[None], ctx_k[None], ctx_v[None], causal=True,
+                               q_offset=start)[0]
+        attn_out = jnp.einsum("thk,hkd->td", attn,
+                              lp["attn"]["wo"]["kernel"].astype(dtype))
+        x = x + attn_out
+        h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2, dtype)
+
+    x = _rms(x, params["model"]["final_norm"]["scale"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(true_len - 1, 0)]
+    if cfg.tie_embeddings:
+        logits = params["model"]["embed"]["embedding"].astype(jnp.float32) @ \
+            last.astype(jnp.float32)
+    else:
+        logits = last.astype(jnp.float32) @ \
+            params["model"]["lm_head"]["kernel"].astype(jnp.float32)
+    return logits, cache_data
+
+
+@partial(jax.jit, static_argnames=("cfg", "block_size"))
+def decode_step(params, cache_data, tokens, positions, block_tables, valid,
+                cfg: LlamaConfig, block_size: int):
+    """Batched single-token decode. tokens/positions/valid: [B];
+    block_tables: [B, MB]. Returns (logits [B, V], updated cache_data)."""
+    dtype = cfg.dtype
+    b = tokens.shape[0]
+    mb = block_tables.shape[1]
+    d_head = cfg.head_dim_
+    cos, sin = rope_freqs(d_head, cfg.max_seq_len, cfg.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+
+    safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+    blk = jnp.where(valid,
+                    jnp.take_along_axis(
+                        block_tables,
+                        jnp.minimum(safe_pos // block_size, mb - 1)[:, None],
+                        axis=1)[:, 0],
+                    cache_data.shape[2] - 1)
+    off = safe_pos % block_size
+
+    x = params["model"]["embed"]["embedding"].astype(dtype)[tokens]  # [B, D]
+    for i in range(cfg.num_layers):
+        lp = _layer_params(params, i)
+        h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, h, dtype)                     # [B, H(kv), d]
+        q = _rope_1d(q[:, None], cos, sin, safe_pos[:, None])[:, 0]
+        k = _rope_1d(k[:, None], cos, sin, safe_pos[:, None])[:, 0]
+        cache_data = cache_data.at[i, 0, blk, off].set(k)
+        cache_data = cache_data.at[i, 1, blk, off].set(v)
+        # paged context gather: [B, MB*bs, Hkv, d]
+        ctx_k = cache_data[i, 0][block_tables].reshape(b, mb * block_size,
+                                                       cfg.num_kv_heads, d_head)
+        ctx_v = cache_data[i, 1][block_tables].reshape(b, mb * block_size,
+                                                       cfg.num_kv_heads, d_head)
+        rep = cfg.num_heads // cfg.num_kv_heads
+        if rep > 1:
+            ctx_k = jnp.repeat(ctx_k, rep, axis=2)
+            ctx_v = jnp.repeat(ctx_v, rep, axis=2)
+        scores = jnp.einsum("bhd,bkhd->bhk", q, ctx_k,
+                            preferred_element_type=jnp.float32) / np.sqrt(d_head)
+        kpos = jnp.arange(mb * block_size)[None, :]
+        mask = kpos <= safe_pos[:, None]
+        scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        attn = jnp.einsum("bhk,bkhd->bhd", probs, ctx_v)
+        attn_out = jnp.einsum("bhk,hkd->bd", attn,
+                              lp["attn"]["wo"]["kernel"].astype(dtype))
+        x = x + attn_out
+        h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2, dtype)
+
+    x = _rms(x, params["model"]["final_norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_embeddings:
+        logits = x.astype(jnp.float32) @ \
+            params["model"]["embed"]["embedding"].astype(jnp.float32).T
+    else:
+        logits = x.astype(jnp.float32) @ \
+            params["model"]["lm_head"]["kernel"].astype(jnp.float32)
+    return logits, cache_data

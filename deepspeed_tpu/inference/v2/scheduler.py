@@ -1,0 +1,69 @@
+"""Dynamic SplitFuse scheduling.
+
+Reference analog: ``deepspeed/inference/v2/scheduling_utils.py`` + the admission
+logic in ``engine_v2.py:158,184`` (``query``/``can_schedule``): each engine step
+carries a fixed token budget; running decodes get 1 token each, remaining budget is
+filled by *chunks* of pending prefills (long prompts split across steps — SplitFuse).
+
+TPU adaptation: chunk sizes snap to a bucket ladder so every distinct compiled
+shape is reused (XLA static shapes); decodes batch into a padded [max_batch] call.
+"""
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_tokens_per_step: int = 2048      # SplitFuse token budget
+    max_decode_batch: int = 64
+    prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048)
+
+
+@dataclasses.dataclass
+class PrefillChunk:
+    seq: SequenceDescriptor
+    start: int           # token offset into the sequence
+    length: int          # real tokens this chunk
+    bucket: int          # padded compile shape
+
+
+@dataclasses.dataclass
+class StepPlan:
+    decode_seqs: List[SequenceDescriptor]
+    prefill_chunks: List[PrefillChunk]
+
+    @property
+    def empty(self) -> bool:
+        return not self.decode_seqs and not self.prefill_chunks
+
+
+def snap_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def plan_step(decoding: List[SequenceDescriptor],
+              prefilling: List[SequenceDescriptor],
+              cfg: SchedulerConfig) -> StepPlan:
+    """Build one step's work: decodes first (latency), then prefill chunks up to
+    the token budget (reference: SplitFuse composition in engine_v2.put)."""
+    decodes = decoding[:cfg.max_decode_batch]
+    budget = cfg.max_tokens_per_step - len(decodes)
+    chunks: List[PrefillChunk] = []
+    for seq in prefilling:
+        if budget < cfg.prefill_buckets[0] // 2 and chunks:
+            break
+        remaining = len(seq.prompt_tokens) - seq.seen_tokens
+        take = min(remaining, budget, cfg.prefill_buckets[-1])
+        if take <= 0:
+            break
+        bucket = snap_bucket(take, cfg.prefill_buckets)
+        chunks.append(PrefillChunk(seq=seq, start=seq.seen_tokens,
+                                   length=take, bucket=bucket))
+        budget -= take
+    return StepPlan(decode_seqs=decodes, prefill_chunks=chunks)
