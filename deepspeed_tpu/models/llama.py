@@ -136,8 +136,9 @@ def _dispatch_attention(backend: str, q, k, v, causal=True, segment_ids=None,
     if backend == "xla":
         return _xla_attention(q, k, v, causal, segment_ids)
     if backend == "flash":
-        from deepspeed_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        # Pallas kernel on TPU, blockwise lax fallback elsewhere
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_auto
+        return flash_attention_auto(q, k, v, causal=causal)
     if backend == "ulysses":
         from deepspeed_tpu.sequence.ulysses import ulysses_attention
         return ulysses_attention(q, k, v, causal=causal)

@@ -1,0 +1,151 @@
+"""Pallas flash-attention forward kernel.
+
+Reference analog: the fused attention CUDA kernels
+(``csrc/transformer/inference/csrc/softmax.cu``, v2 ``blocked_flash``). TPU design:
+canonical sequential-grid flash — grid (batch*heads, q_blocks, k_blocks) with the
+k dimension innermost (TPU grids execute sequentially, so VMEM scratch accumulators
+carry across k steps): online-softmax max/sum/output accumulators in fp32 scratch,
+[block_q, block_k] score panels on the MXU, GQA handled by index-mapping q heads
+onto shared KV heads (no KV repeat materialized).
+
+Backward: flash-style recompute via the blockwise lax implementation
+(``deepspeed_tpu.ops.flash_attention``) under ``jax.custom_vjp`` — same numerics,
+O(S) memory.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.flash_attention import flash_attention as blockwise_reference
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                       # [block_q, D]
+    k = k_ref[0]                       # [block_k, D]
+    v = v_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+
+    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = kpos < seq_len_k            # kv padding
+    if causal:
+        mask = jnp.logical_and(mask, qpos >= kpos)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[:]                  # [block_q, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+    acc_scr[:] = acc
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
+                           interpret: bool):
+    """q: [B, Sq, H, D]; k,v: [B, Sk, Hkv, D]."""
+    b, sq, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    rep = h // hkv
+    sm_scale = 1.0 / np.sqrt(d)
+
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
+
+    sq_p, sk_p = qp.shape[1], kp.shape[1]
+    # [B*H, S, D] layout: heads fold into the grid's batch dim
+    q2 = qp.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    k2 = kp.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
+    v2 = vp.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
+
+    nq, nk = sq_p // block_q, sk_p // block_k
+    grid = (b * h, nq, nk)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          seq_len_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q2, k2, v2)
+
+    out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)
+    return out[:, :sq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
+                           block_k: int = 256, interpret: bool = False):
+    """Flash attention with a Pallas forward and flash-recompute backward.
+    ``interpret=True`` runs the kernel in interpreter mode (CPU CI)."""
+    return _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # flash-style recompute through the blockwise lax implementation
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: blockwise_reference(
+            q_, k_, v_, causal=causal,
+            block_q=min(block_q, q.shape[1]), block_k=min(block_k, k.shape[1])),
+        q, k, v)
+    return vjp_fn(g)
+
+
+pallas_flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_auto(q, k, v, causal: bool = True):
+    """Dispatch: Pallas kernel on TPU, interpret/blockwise elsewhere."""
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        return pallas_flash_attention(q, k, v, causal)
+    return blockwise_reference(q, k, v, causal=causal)

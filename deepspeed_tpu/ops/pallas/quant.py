@@ -1,0 +1,102 @@
+"""Int8 (de)quantization Pallas kernels.
+
+Reference analog: ``csrc/quantization/{quantize.cu,swizzled_quantize.cu,
+quant_reduce.cu}`` — symmetric per-group int8 used by ZeRO++ quantized-weight
+allgather (qwZ) and quantized-gradient collectives (qgZ), and
+``deepspeed/inference/quantization`` for ZeRO-Inference weight quant.
+
+Layout: per-row (last-dim group) symmetric scales in fp32. The quantize kernel
+fuses absmax + scale + round in one VMEM pass; dequantize fuses scale-multiply.
+These are the building blocks the quantized-collective layer composes around an
+``all_gather``/``psum_scatter`` (int8 on the wire = 4x ICI bandwidth saving vs
+fp32, 2x vs bf16 — cf. ZeRO++'s qwZ).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x / scale), -127, 127)
+    q_ref[:] = q.astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _dequant_kernel(q_ref, s_ref, o_ref):
+    o_ref[:] = (q_ref[:].astype(jnp.float32) * s_ref[:]).astype(o_ref.dtype)
+
+
+def _auto_interpret():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def quantize_int8(x, block_rows: int = 256, interpret: bool = None):
+    """x: [..., D] -> (int8 values [..., D], fp32 scales [..., 1]) per-row."""
+    interpret = _auto_interpret() if interpret is None else interpret
+    shape = x.shape
+    d = shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    qv, sv = pl.pallas_call(
+        _quant_kernel,
+        grid=(x2.shape[0] // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, jnp.int8),
+            jax.ShapeDtypeStruct((x2.shape[0], 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2)
+    return (qv[:n].reshape(shape),
+            sv[:n].reshape(*shape[:-1], 1))
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret", "dtype"))
+def dequantize_int8(q, scales, dtype=jnp.bfloat16, block_rows: int = 256,
+                    interpret: bool = None):
+    interpret = _auto_interpret() if interpret is None else interpret
+    shape = q.shape
+    d = shape[-1]
+    q2 = q.reshape(-1, d)
+    s2 = scales.reshape(-1, 1)
+    n = q2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        q2 = jnp.pad(q2, ((0, pad), (0, 0)))
+        s2 = jnp.pad(s2, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        _dequant_kernel,
+        grid=(q2.shape[0] // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q2.shape, dtype),
+        interpret=interpret,
+    )(q2, s2)
+    return out[:n].reshape(shape)
+
+
+def quantized_all_gather(x, axis_name: str):
+    """qwZ-style collective: quantize locally, all_gather int8 + scales, dequant
+    (reference: quantized weights allgather, partition_parameters.py:1664 +
+    quantizer kernels). Usable inside shard_map."""
+    q, s = quantize_int8(x)
+    qg = jax.lax.all_gather(q, axis_name, axis=0, tiled=True)
+    sg = jax.lax.all_gather(s, axis_name, axis=0, tiled=True)
+    return dequantize_int8(qg, sg, dtype=x.dtype)

@@ -1,0 +1,76 @@
+"""Fused RMSNorm Pallas kernel with analytical backward.
+
+Reference analog: ``csrc/transformer/inference/csrc/rms_norm.cu`` (fused rms_norm
++ residual-add variants). One VMEM pass per row block: fp32 mean-of-squares,
+rsqrt, scale — what the CUDA kernel does with a block reduction, here on the VPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _rms_fwd_kernel(x_ref, scale_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    o_ref[:] = (x * inv * scale_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rms_impl(x, scale, eps, block_rows, interpret):
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=(x2.shape[0] // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        interpret=interpret,
+    )(x2, scale)
+    return out[:n].reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def pallas_rms_norm(x, scale, eps: float = 1e-5, block_rows: int = 256,
+                    interpret: bool = False):
+    return _rms_impl(x, scale, eps, block_rows, interpret)
+
+
+def _fwd(x, scale, eps, block_rows, interpret):
+    return _rms_impl(x, scale, eps, block_rows, interpret), (x, scale)
+
+
+def _bwd(eps, block_rows, interpret, res, g):
+    x, scale = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    s32 = scale.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = x32 * inv
+    d = x.shape[-1]
+    # d/dx of x*inv(x)*s with inv = (mean(x^2)+eps)^-1/2
+    gs = g32 * s32
+    dx = inv * (gs - xhat * jnp.mean(gs * xhat, axis=-1, keepdims=True))
+    dscale = jnp.sum((g32 * xhat).reshape(-1, d), axis=0)
+    return dx.astype(x.dtype), dscale.astype(scale.dtype)
+
+
+pallas_rms_norm.defvjp(_fwd, _bwd)
+
+
+def rms_norm_reference(x, scale, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(x.dtype)
