@@ -1,0 +1,69 @@
+"""Host fused optimizers over the native C++ kernels.
+
+Reference analog: ``deepspeed/ops/adam/cpu_adam.py:13`` (``DeepSpeedCPUAdam`` —
+python wrapper over the AVX kernel, used for ZeRO-Offload optimizer states).
+Numpy fallback keeps CI working without a toolchain.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import warning_once
+
+
+class CPUAdam:
+    """Fused AdamW/Adam over flat fp32 numpy shards (host memory)."""
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self._fn = None
+        try:
+            from deepspeed_tpu.ops.op_builder import get_op
+            lib = get_op("cpu_adam")
+            fn = lib.cpu_adam_step
+            fn.argtypes = [ctypes.POINTER(ctypes.c_float)] * 4 + [
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_int64]
+            self._fn = fn
+        except Exception as e:
+            warning_once(f"cpu_adam native op unavailable ({e}); numpy fallback")
+
+    @staticmethod
+    def _ptr(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+             exp_avg_sq: np.ndarray, lr: Optional[float] = None):
+        """In-place fused update on contiguous fp32 arrays."""
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        if self._fn is not None:
+            grads32 = np.ascontiguousarray(grads, dtype=np.float32)
+            self._fn(self._ptr(params), self._ptr(grads32), self._ptr(exp_avg),
+                     self._ptr(exp_avg_sq), params.size, lr, self.beta1,
+                     self.beta2, self.eps, self.weight_decay,
+                     int(self.adamw_mode), self.step_count)
+            return
+        # numpy fallback (same math)
+        g = grads.astype(np.float32)
+        if not self.adamw_mode and self.weight_decay:
+            g = g + self.weight_decay * params
+        exp_avg *= self.beta1
+        exp_avg += (1 - self.beta1) * g
+        exp_avg_sq *= self.beta2
+        exp_avg_sq += (1 - self.beta2) * g * g
+        bc1 = 1 - self.beta1 ** self.step_count
+        bc2 = 1 - self.beta2 ** self.step_count
+        update = (exp_avg / bc1) / (np.sqrt(exp_avg_sq / bc2) + self.eps)
+        if self.adamw_mode and self.weight_decay:
+            update = update + self.weight_decay * params
+        params -= lr * update

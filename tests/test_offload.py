@@ -1,0 +1,131 @@
+"""Offload tier tests: native C++ ops (cpu_adam, aio) and end-to-end
+ZeRO-Offload / ZeRO-Infinity training.
+
+Reference analog: tests/unit/ops/adam/test_cpu_adam.py, ops/aio tests, and
+runtime/zero offload tests.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+from deepspeed_tpu.ops.async_io import AsyncIOHandle
+from deepspeed_tpu.ops.cpu_adam import CPUAdam
+
+
+def _ref_adamw(params, grads, m, v, lr, b1, b2, eps, wd, step):
+    m = b1 * m + (1 - b1) * grads
+    v = b2 * v + (1 - b2) * grads ** 2
+    mhat = m / (1 - b1 ** step)
+    vhat = v / (1 - b2 ** step)
+    params = params - lr * (mhat / (np.sqrt(vhat) + eps) + wd * params)
+    return params, m, v
+
+
+def test_cpu_adam_matches_reference():
+    rng = np.random.default_rng(0)
+    n = 4097  # odd size exercises vector tail
+    p = rng.normal(size=n).astype(np.float32)
+    p_ref = p.copy()
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    m_ref, v_ref = m.copy(), v.copy()
+    opt = CPUAdam(lr=1e-2, betas=(0.9, 0.99), eps=1e-8, weight_decay=0.01)
+    for step in range(1, 4):
+        g = rng.normal(size=n).astype(np.float32)
+        opt.step(p, g, m, v)
+        p_ref, m_ref, v_ref = _ref_adamw(p_ref, g, m_ref, v_ref,
+                                         1e-2, 0.9, 0.99, 1e-8, 0.01, step)
+    np.testing.assert_allclose(p, p_ref, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(m, m_ref, atol=1e-6)
+
+
+def test_cpu_adam_native_loaded():
+    """The C++ kernel must actually build in this image (g++ present)."""
+    opt = CPUAdam()
+    assert opt._fn is not None, "native cpu_adam failed to build"
+
+
+def test_aio_roundtrip(tmp_path):
+    h = AsyncIOHandle(num_threads=4)
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(1 << 16,)).astype(np.float32)
+    path = str(tmp_path / "swap.bin")
+    wid = h.async_pwrite(data, path)
+    assert h.wait(wid) == 0
+    out = np.empty_like(data)
+    rid = h.async_pread(out, path)
+    assert h.wait(rid) == 0
+    np.testing.assert_array_equal(out, data)
+
+
+def test_aio_many_concurrent(tmp_path):
+    h = AsyncIOHandle(num_threads=8)
+    arrays = [np.full((4096,), i, np.float32) for i in range(16)]
+    reqs = [h.async_pwrite(a, str(tmp_path / f"f{i}.bin"))
+            for i, a in enumerate(arrays)]
+    assert h.drain() == 0
+    outs = [np.empty_like(a) for a in arrays]
+    reqs = [h.async_pread(o, str(tmp_path / f"f{i}.bin"))
+            for i, o in enumerate(outs)]
+    for r in reqs:
+        h.wait(r)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, arrays[i])
+
+
+def _train(config, steps=10, mesh=None, seed=3):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=64), config=config, mesh=mesh,
+        example_batch=random_batch(4), seed=seed)
+    losses = []
+    for i in range(steps):
+        losses.append(float(engine.train_batch(batch=random_batch(8, seed=i % 3))))
+    return engine, losses
+
+
+def test_zero_offload_cpu_training(mesh_dp8):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    engine, losses = _train(cfg, mesh=mesh_dp8)
+    assert losses[-1] < losses[0]
+    assert engine._offload is not None
+
+
+def test_zero_infinity_nvme_training(tmp_path, mesh_dp8):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "nvme",
+                                                    "nvme_path": str(tmp_path)}},
+    }
+    engine, losses = _train(cfg, mesh=mesh_dp8)
+    assert losses[-1] < losses[0]
+    # moment files exist on "nvme"
+    import glob
+    assert glob.glob(str(tmp_path / "proc0" / "exp_avg_*.bin"))
+
+
+def test_offload_matches_in_hbm_adamw(mesh_dp8):
+    """Host CPU-Adam path == in-HBM optax path numerically."""
+    base = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-2, "betas": (0.9, 0.999),
+                                 "eps": 1e-8, "weight_decay": 0.0}},
+    }
+    off = dict(base)
+    off["zero_optimization"] = {"stage": 1, "offload_optimizer": {"device": "cpu"}}
+    e1, _ = _train(base, steps=5, mesh=mesh_dp8, seed=9)
+    e2, _ = _train(off, steps=5, mesh=mesh_dp8, seed=9)
+    import jax
+    p1 = jax.device_get(e1.state.params)
+    p2 = jax.device_get(e2.state.params)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-4)
