@@ -152,6 +152,10 @@ def init_distributed(coordinator_address: Optional[str] = None,
     explicit args support DCN/CPU clusters. No-op when single-process."""
     if num_processes is None:
         num_processes = int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("DSTPU_COORDINATOR_ADDRESS")
+    if process_id is None and "DSTPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DSTPU_PROCESS_ID"])
     if num_processes <= 1 and coordinator_address is None:
         return
     kwargs = {}

@@ -1,0 +1,15 @@
+"""Elasticity: scale-invariant batch configs + worker supervision
+(reference: ``deepspeed/elasticity/``)."""
+
+from deepspeed_tpu.elasticity.agent import ElasticAgent, WorkerSpec
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfig, ElasticityConfigError, ElasticityError,
+    ElasticityIncompatibleWorldSize, compute_elastic_config, elasticity_enabled,
+    get_candidate_batch_sizes, get_valid_devices)
+
+__all__ = [
+    "ElasticAgent", "WorkerSpec", "ElasticityConfig", "ElasticityError",
+    "ElasticityConfigError", "ElasticityIncompatibleWorldSize",
+    "compute_elastic_config", "elasticity_enabled",
+    "get_candidate_batch_sizes", "get_valid_devices",
+]

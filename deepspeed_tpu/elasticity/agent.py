@@ -1,0 +1,122 @@
+"""Elastic supervisor (reference: ``deepspeed/elasticity/elastic_agent.py:32
+DSElasticAgent`` — monitors the worker group and restarts it within the
+rendezvous on failure).
+
+JAX/TPU has no torchelastic, so the supervisor is a real component here: it owns
+the worker processes, detects failures (exit codes) and scale changes (host set
+callback), recomputes a *compatible* world size from the elastic batch config,
+and relaunches workers with fresh DSTPU_* rendezvous env. Checkpoint/resume is
+the state-transfer mechanism (workers are expected to resume from the latest
+checkpoint tag, as with preempted TPU slices).
+"""
+
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityIncompatibleWorldSize, compute_elastic_config)
+from deepspeed_tpu.launcher.constants import (ENV_COORDINATOR, ENV_NUM_PROCESSES,
+                                              ENV_PROCESS_ID)
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class WorkerSpec:
+    """What to run on each alive host (reference: torchelastic WorkerSpec)."""
+    cmd: List[str]
+    max_restarts: int = 100
+    monitor_interval_s: float = 1.0
+    coordinator_port: int = 8476
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+class ElasticAgent:
+    """Run → monitor → (on failure) shrink/regrow → relaunch loop
+    (reference: elastic_agent.py:127 _invoke_run)."""
+
+    def __init__(self, spec: WorkerSpec, ds_config: Dict,
+                 host_provider: Optional[Callable[[], List[str]]] = None,
+                 popen: Callable = subprocess.Popen):
+        self.spec = spec
+        self.ds_config = ds_config
+        # host_provider returns the currently-alive host list; defaults to
+        # localhost-only (single-host elasticity = restart-on-crash).
+        self.host_provider = host_provider or (lambda: ["localhost"])
+        self.popen = popen  # injectable for tests
+        self.restart_count = 0
+        self.procs: List[subprocess.Popen] = []
+
+    def _validate_world(self, world_size: int) -> int:
+        """Check the world size against the elastic config; returns the global
+        batch that training must use at this scale."""
+        final_batch, valid = compute_elastic_config(
+            self.ds_config, world_size=world_size)
+        return final_batch
+
+    def _launch(self, hosts: List[str]) -> None:
+        world = len(hosts)
+        final_batch = self._validate_world(world)
+        coordinator = f"{hosts[0]}:{self.spec.coordinator_port}"
+        logger.info(f"elastic launch: world={world} batch={final_batch} "
+                    f"coordinator={coordinator} (restart #{self.restart_count})")
+        self.procs = []
+        for pid, host in enumerate(hosts):
+            env = dict(os.environ)
+            env.update(self.spec.env)
+            env[ENV_COORDINATOR] = coordinator
+            env[ENV_NUM_PROCESSES] = str(world)
+            env[ENV_PROCESS_ID] = str(pid)
+            env["DSTPU_ELASTIC_RESTART"] = str(self.restart_count)
+            env["DSTPU_ELASTIC_BATCH"] = str(final_batch)
+            self.procs.append(self.popen(self.spec.cmd, env=env))
+
+    def _poll(self) -> Optional[int]:
+        """None while all healthy; first non-zero exit code on failure; 0 done."""
+        codes = [p.poll() for p in self.procs]
+        if any(c not in (None, 0) for c in codes):
+            return next(c for c in codes if c not in (None, 0))
+        if all(c == 0 for c in codes):
+            return 0
+        return None
+
+    def _terminate_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def run(self) -> int:
+        """Supervise until success or restart budget exhausted."""
+        hosts = self.host_provider()
+        self._launch(hosts)
+        while True:
+            time.sleep(self.spec.monitor_interval_s)
+            status = self._poll()
+            current_hosts = self.host_provider()
+            scale_change = set(current_hosts) != set(hosts)
+            if status is None and not scale_change:
+                continue
+            if status == 0 and not scale_change:
+                logger.info("elastic agent: all workers finished")
+                return 0
+            # failure or membership change → restart the group at new scale
+            self._terminate_all()
+            self.restart_count += 1
+            if self.restart_count > self.spec.max_restarts:
+                logger.error("elastic agent: restart budget exhausted")
+                return status or 1
+            hosts = current_hosts
+            try:
+                self._launch(hosts)
+            except ElasticityIncompatibleWorldSize as e:
+                logger.error(f"elastic agent: no compatible config at "
+                             f"world={len(hosts)}: {e}")
+                return 1

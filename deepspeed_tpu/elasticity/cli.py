@@ -1,0 +1,37 @@
+"""``dstpu_elastic`` CLI (reference: ``bin/ds_elastic`` — inspect a config's
+elastic batch/world-size compatibility table)."""
+
+import argparse
+import json
+import sys
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser(
+        description="elastic batch-size compatibility explorer")
+    parser.add_argument("-c", "--config", type=str, required=True,
+                        help="DeepSpeed-TPU json config with an 'elasticity' block")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="validate a specific world size")
+    args = parser.parse_args(args)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+
+    if args.world_size:
+        batch, valid, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size, return_microbatch=True)
+        gas = batch // (micro * args.world_size)
+        print(f"world size {args.world_size} OK: train_batch_size={batch}, "
+              f"micro_batch={micro}, gradient_accumulation_steps={gas}")
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(f"train_batch_size: {batch}")
+        print(f"compatible world sizes ({len(valid)}): {valid}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

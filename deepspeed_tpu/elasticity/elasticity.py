@@ -1,0 +1,245 @@
+"""Elastic batch configuration (reference: ``deepspeed/elasticity/elasticity.py:233
+compute_elastic_config``, ``_get_compatible_gpus_v01:83``, v2 model-parallel-aware
+``:126``).
+
+The contract: pick a global ``train_batch_size`` (or a set of acceptable ones)
+such that for EVERY world size in an allowed range there exists a
+(micro_batch, gradient_accumulation_steps) pair with
+``micro_batch × gas × dp_world == train_batch_size``. A preempted TPU job can
+then restart at a different slice size with an identical global batch — loss
+curves stay comparable across scale changes.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Parsed 'elasticity' config block (reference: elasticity/config.py).
+
+    Fields mirror the reference JSON schema::
+
+        "elasticity": {
+          "enabled": true,
+          "max_train_batch_size": 2000,
+          "micro_batch_sizes": [2, 4, 6],
+          "min_gpus": 1, "max_gpus": 10000,
+          "min_time": 20,
+          "prefer_larger_batch": true,
+          "ignore_non_elastic_batch_info": false,
+          "version": 0.2,
+          "model_parallel_size": 1,
+          "num_gpus_per_node": 4
+        }
+    """
+
+    def __init__(self, param_dict: Dict):
+        self.enabled = param_dict.get("enabled", False)
+        if "max_train_batch_size" not in param_dict and self.enabled:
+            raise ElasticityConfigError(
+                "elasticity config missing 'max_train_batch_size'")
+        self.max_acceptable_batch_size = param_dict.get("max_train_batch_size", 0)
+        self.micro_batches = param_dict.get("micro_batch_sizes", [2, 4, 6])
+        if not isinstance(self.micro_batches, list) or \
+                any(m <= 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got {self.micro_batches}")
+        self.min_devices = param_dict.get("min_gpus",
+                                          param_dict.get("min_devices", 1))
+        self.max_devices = param_dict.get("max_gpus",
+                                          param_dict.get("max_devices", 10000))
+        if self.min_devices < 1 or self.max_devices < self.min_devices:
+            raise ElasticityConfigError(
+                f"invalid device range [{self.min_devices}, {self.max_devices}]")
+        self.model_parallel_size = param_dict.get("model_parallel_size", 1)
+        self.num_devices_per_node = param_dict.get(
+            "num_gpus_per_node", param_dict.get("num_devices_per_node", 1))
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = param_dict.get("version", LATEST_ELASTICITY_VERSION)
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+
+
+def _highly_composite_numbers(limit: int) -> List[int]:
+    """Numbers ≤ limit with strictly more divisors than any smaller number.
+    A batch of micro×HCN divides evenly at the most world sizes — the core
+    trick behind the reference's candidate table (elasticity.py HCN_LIST)."""
+    hcns, best = [], 0
+    counts = [0] * (limit + 1)
+    for d in range(1, limit + 1):          # sieve divisor counts
+        for m in range(d, limit + 1, d):
+            counts[m] += 1
+    for n in range(1, limit + 1):
+        if counts[n] > best:
+            best = counts[n]
+            hcns.append(n)
+    return hcns
+
+
+def get_candidate_batch_sizes(base_list: List[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """For each micro batch, the largest micro×HCN ≤ max — the batch sizes that
+    maximize divisor coverage (reference: elasticity.py:40
+    get_candidate_batch_sizes over its HCN table)."""
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+            continue
+        budget = max_acceptable_batch_size // base
+        hcns = _highly_composite_numbers(budget)
+        candidates.add(base * hcns[-1])
+    return sorted(candidates)
+
+
+def get_valid_devices(batch_size: int, micro_batches: List[int],
+                      min_valid_devices: int, max_valid_devices: int) -> List[int]:
+    """World sizes at which ``batch_size`` divides evenly for some micro batch
+    (reference: elasticity.py:63 get_valid_gpus)."""
+    valid = set()
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch != 0:
+            continue
+        max_devices = batch_size // micro_batch
+        for i in range(1, max_devices + 1):
+            if batch_size % (micro_batch * i) == 0:
+                if min_valid_devices <= i <= max_valid_devices:
+                    valid.add(i)
+    return sorted(valid)
+
+
+def _get_compatible_devices_v01(
+        micro_batches: List[int], max_acceptable_batch_size: int,
+        min_devices: int, max_devices: int,
+        prefer_larger: bool) -> Tuple[int, List[int]]:
+    """v0.1 search: the candidate batch with the most valid world sizes
+    (tie-break toward larger batch if prefer_larger). Reference elasticity.py:83."""
+    final_batch_size, valid_devices = 0, []
+    for batch_size in get_candidate_batch_sizes(
+            micro_batches, max_acceptable_batch_size):
+        devices = get_valid_devices(batch_size, micro_batches,
+                                    min_devices, max_devices)
+        better = (len(devices) > len(valid_devices)
+                  or (len(devices) == len(valid_devices)
+                      and prefer_larger and batch_size > final_batch_size))
+        if devices and better:
+            valid_devices = devices
+            final_batch_size = batch_size
+    if not valid_devices:
+        raise ElasticityConfigError(
+            f"no valid batch size found for micro batches {micro_batches} with "
+            f"max batch {max_acceptable_batch_size} over device range "
+            f"[{min_devices}, {max_devices}]")
+    return final_batch_size, valid_devices
+
+
+def _get_compatible_devices_v02(
+        micro_batches, max_acceptable_batch_size, current_num_devices,
+        min_devices, max_devices, prefer_larger, num_devices_per_node,
+        model_parallel_size) -> Tuple[int, List[int], int]:
+    """v0.2 adds model parallelism: the data-parallel world is
+    world // mp, and mp ranks must pack within nodes (reference elasticity.py:126)."""
+    if model_parallel_size > 1 and current_num_devices % num_devices_per_node != 0:
+        raise ElasticityConfigError(
+            "model-parallel elasticity requires whole nodes: "
+            f"{current_num_devices} devices with {num_devices_per_node}/node")
+    if model_parallel_size > num_devices_per_node and \
+            model_parallel_size % num_devices_per_node != 0:
+        raise ElasticityConfigError(
+            f"model_parallel_size {model_parallel_size} must divide into nodes "
+            f"of {num_devices_per_node}")
+    dp_size_per_node = max(1, num_devices_per_node // model_parallel_size)
+    final_batch_size, valid_world_sizes = _get_compatible_devices_v01(
+        micro_batches,
+        max_acceptable_batch_size,
+        min_devices=max(1, min_devices // model_parallel_size),
+        max_devices=max(1, max_devices // model_parallel_size),
+        prefer_larger=prefer_larger)
+    current_dp = current_num_devices // model_parallel_size
+    if current_dp not in valid_world_sizes:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_devices} (dp={current_dp} at "
+            f"mp={model_parallel_size}) is not in the compatible set "
+            f"{[w * model_parallel_size for w in valid_world_sizes]}")
+    return final_batch_size, valid_world_sizes, current_dp * dp_size_per_node
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Main entry (reference: elasticity.py:233 compute_elastic_config).
+
+    Returns ``(final_batch_size, valid_world_sizes[, micro_batch])``; when
+    ``world_size`` > 0 also validates it and computes the per-rank micro batch +
+    gradient accumulation steps.
+    """
+    elastic_config = ElasticityConfig(ds_config.get("elasticity", {}))
+    if not elastic_config.enabled:
+        raise ElasticityConfigError("elasticity is not enabled in config")
+
+    if elastic_config.version >= 0.2 and elastic_config.model_parallel_size > 1:
+        final_batch_size, valid_world_sizes, _ = _get_compatible_devices_v02(
+            elastic_config.micro_batches,
+            elastic_config.max_acceptable_batch_size,
+            current_num_devices=world_size or elastic_config.min_devices *
+            elastic_config.model_parallel_size,
+            min_devices=elastic_config.min_devices,
+            max_devices=elastic_config.max_devices,
+            prefer_larger=elastic_config.prefer_larger_batch_size,
+            num_devices_per_node=elastic_config.num_devices_per_node,
+            model_parallel_size=elastic_config.model_parallel_size)
+        dp_world = (world_size // elastic_config.model_parallel_size
+                    if world_size else 0)
+    else:
+        final_batch_size, valid_world_sizes = _get_compatible_devices_v01(
+            elastic_config.micro_batches,
+            elastic_config.max_acceptable_batch_size,
+            elastic_config.min_devices, elastic_config.max_devices,
+            elastic_config.prefer_larger_batch_size)
+        dp_world = world_size
+
+    if world_size > 0:
+        if dp_world not in valid_world_sizes:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not compatible; valid: "
+                f"{valid_world_sizes}")
+        micro, gas = _compute_micro_and_gas(
+            final_batch_size, dp_world, elastic_config.micro_batches,
+            elastic_config.prefer_larger_batch_size)
+        if return_microbatch:
+            return final_batch_size, valid_world_sizes, micro
+        return final_batch_size, valid_world_sizes
+    if return_microbatch:
+        raise ElasticityConfigError("return_microbatch requires world_size > 0")
+    return final_batch_size, valid_world_sizes
+
+
+def _compute_micro_and_gas(batch_size: int, dp_world: int,
+                           micro_batches: List[int],
+                           prefer_larger: bool) -> Tuple[int, int]:
+    per_rank = batch_size // dp_world
+    options = [m for m in sorted(micro_batches, reverse=prefer_larger)
+               if per_rank % m == 0]
+    if not options:
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro batch in {micro_batches} divides per-rank batch {per_rank}")
+    micro = options[0]
+    return micro, per_rank // micro
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return ds_config.get("elasticity", {}).get("enabled", False)

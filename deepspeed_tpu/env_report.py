@@ -1,0 +1,85 @@
+"""``dstpu_report`` — environment/compatibility report (reference: ``bin/ds_report``
+→ ``deepspeed/env_report.py``: op compatibility table + version/platform dump).
+"""
+
+import importlib
+import platform
+import shutil
+import subprocess
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{RED}[NO]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+
+
+def _try_version(mod_name):
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def op_report():
+    """Native/Pallas op compatibility table (reference env_report.py:op_report)."""
+    from deepspeed_tpu.ops.op_builder import OPS, OpBuilder
+    rows = []
+    for name, builder in OPS.items():
+        try:
+            compatible = builder.is_compatible()
+        except Exception:
+            compatible = False
+        rows.append((name, OKAY if compatible else NO))
+    return rows
+
+
+def debug_report():
+    import jax
+    rows = [
+        ("python", platform.python_version()),
+        ("platform", platform.platform()),
+        ("jax", jax.__version__),
+        ("jaxlib", _try_version("jaxlib") or "unknown"),
+        ("flax", _try_version("flax")),
+        ("optax", _try_version("optax")),
+        ("orbax", _try_version("orbax.checkpoint")),
+        ("numpy", _try_version("numpy")),
+        ("deepspeed_tpu", _try_version("deepspeed_tpu")),
+        ("g++", shutil.which("g++") or "not found"),
+    ]
+    try:
+        devices = jax.devices()
+        rows.append(("jax backend", devices[0].platform))
+        rows.append(("device count", str(len(devices))))
+        rows.append(("device kind", devices[0].device_kind))
+    except Exception as e:  # no devices available
+        rows.append(("jax backend", f"unavailable ({e})"))
+    return rows
+
+
+def main(hide_operator_status=False, hide_errors_and_warnings=False):
+    print("-" * 60)
+    print("DeepSpeed-TPU C++/Pallas op report")
+    print("-" * 60)
+    if not hide_operator_status:
+        for name, status in op_report():
+            print(f"{name:.<40} {status}")
+    print("-" * 60)
+    print("DeepSpeed-TPU general environment info:")
+    print("-" * 60)
+    for key, val in debug_report():
+        print(f"{key:.<30} {val}")
+    return 0
+
+
+def cli_main():
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    cli_main()

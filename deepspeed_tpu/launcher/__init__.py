@@ -1,0 +1,6 @@
+"""Launcher / CLI layer (reference L7: ``deepspeed/launcher/``, ``bin/``)."""
+
+from deepspeed_tpu.launcher.runner import (encode_world_info, fetch_hostfile,
+                                           parse_resource_filter)
+
+__all__ = ["fetch_hostfile", "parse_resource_filter", "encode_world_info"]

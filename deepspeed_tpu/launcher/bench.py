@@ -1,0 +1,94 @@
+"""``dstpu_bench`` — collective micro-benchmark sweep (reference: ``bin/ds_bench``
+feeding ``deepspeed/utils/comms_logging.py`` algbw/busbw reporting).
+
+Sweeps message sizes for one collective over a chosen mesh axis and prints
+latency, algorithm bandwidth, and bus bandwidth per size (calc_bw_log parity,
+``utils/comms_logging.py:34``).
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(description="collective micro-benchmark sweep")
+    p.add_argument("--op", default="all_reduce",
+                   choices=["all_reduce", "all_gather", "reduce_scatter",
+                            "all_to_all", "ppermute"])
+    p.add_argument("--axis", default="data", help="mesh axis to benchmark over")
+    p.add_argument("--minsize", type=int, default=1 << 12, help="min bytes")
+    p.add_argument("--maxsize", type=int, default=1 << 26, help="max bytes")
+    p.add_argument("--trials", type=int, default=20)
+    p.add_argument("--warmups", type=int, default=5)
+    p.add_argument("--dtype", default="bfloat16")
+    return p.parse_args(args)
+
+
+def run_sweep(op: str, axis: str, minsize: int, maxsize: int, trials: int,
+              warmups: int, dtype: str = "bfloat16"):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.comm.comms_logging import calc_bw
+
+    devices = np.array(jax.devices())
+    world = len(devices)
+    mesh = Mesh(devices.reshape(world), (axis,))
+    jdtype = jnp.dtype(dtype)
+
+    fns = {
+        "all_reduce": lambda x: comm.all_reduce(x, axis),
+        "all_gather": lambda x: comm.all_gather(x, axis),
+        "reduce_scatter": lambda x: comm.reduce_scatter(x, axis),
+        "all_to_all": lambda x: comm.all_to_all(x, axis, 0, 0),
+        "ppermute": lambda x: comm.ppermute(
+            x, axis, [(i, (i + 1) % world) for i in range(world)]),
+    }
+    body = fns[op]
+
+    @jax.jit
+    def step(x):
+        # out_specs is P(axis) for every op: all_gather's per-shard output is the
+        # full gathered array, so its global result is simply world× larger.
+        return jax.shard_map(
+            lambda v: body(v), mesh=mesh, in_specs=P(axis), out_specs=P(axis))(x)
+
+    results = []
+    size = minsize
+    while size <= maxsize:
+        n_elem = max(world, size // jdtype.itemsize)
+        n_elem -= n_elem % world
+        x = jnp.ones((n_elem,), jdtype)
+        for _ in range(warmups):
+            step(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            step(x).block_until_ready()
+        dt = (time.perf_counter() - t0) / trials
+        algbw, busbw = calc_bw(op, n_elem * jdtype.itemsize, dt, world)
+        results.append({"op": op, "bytes": n_elem * jdtype.itemsize,
+                        "latency_us": dt * 1e6,
+                        "algbw_gbps": algbw * 8 / 1e9,
+                        "busbw_gbps": busbw * 8 / 1e9})
+        size *= 4
+    return results
+
+
+def main(args=None):
+    args = parse_args(args)
+    rows = run_sweep(args.op, args.axis, args.minsize, args.maxsize,
+                     args.trials, args.warmups, args.dtype)
+    print(f"{'bytes':>14} {'latency(us)':>14} {'algbw(Gbps)':>12} {'busbw(Gbps)':>12}")
+    for r in rows:
+        print(f"{r['bytes']:>14} {r['latency_us']:>14.1f} "
+              f"{r['algbw_gbps']:>12.2f} {r['busbw_gbps']:>12.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

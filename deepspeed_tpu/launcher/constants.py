@@ -1,0 +1,26 @@
+"""Launcher constants (reference: deepspeed/launcher/constants.py)."""
+
+PDSH_LAUNCHER = "pdsh"
+SSH_LAUNCHER = "ssh"
+GCLOUD_LAUNCHER = "gcloud"
+SLURM_LAUNCHER = "slurm"
+MPICH_LAUNCHER = "mpich"
+OPENMPI_LAUNCHER = "openmpi"
+
+PDSH_MAX_FAN_OUT = 1024
+
+# Env vars every launched rank receives (consumed by comm.mesh.init_distributed).
+ENV_COORDINATOR = "DSTPU_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "DSTPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "DSTPU_PROCESS_ID"
+ENV_LOCAL_RANK = "DSTPU_LOCAL_RANK"
+ENV_HOSTNAME = "DSTPU_HOSTNAME"
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+# Env vars forwarded from the runner's environment to every node (reference
+# forwards NCCL_*/PYTHON* etc, launcher/runner.py EXPORT_ENVS).
+EXPORT_ENVS = [
+    "JAX_", "XLA_", "LIBTPU_", "TPU_", "PYTHON", "PATH", "LD_LIBRARY",
+    "DSTPU_", "HF_", "TRANSFORMERS_",
+]

@@ -1,0 +1,81 @@
+"""``dstpu_io`` — NVMe/SSD async-I/O benchmark (reference: ``bin/ds_io`` →
+``deepspeed/nvme/perf_run_sweep.py`` sweeping the csrc/aio engine).
+
+Measures read/write GB/s of the C++ async I/O engine
+(``deepspeed_tpu/ops/csrc/aio.cpp``) against a target directory, sweeping
+block size and queue depth; prints the best config like ``ds_nvme_tune``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(description="async I/O throughput sweep")
+    p.add_argument("--path", default=None, help="target dir (default: tmp)")
+    p.add_argument("--size_mb", type=int, default=256, help="file size per trial")
+    p.add_argument("--threads", type=int, nargs="+", default=[1, 4, 8])
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--read_only", action="store_true")
+    p.add_argument("--write_only", action="store_true")
+    return p.parse_args(args)
+
+
+def bench_config(path: str, size_mb: int, threads: int, trials: int,
+                 do_read=True, do_write=True):
+    from deepspeed_tpu.ops.async_io import AsyncIOHandle
+    handle = AsyncIOHandle(num_threads=threads)
+    nbytes = size_mb << 20
+    data = np.random.randint(0, 255, size=nbytes, dtype=np.uint8)
+    out = {"threads": threads, "size_mb": size_mb}
+    fname = os.path.join(path, f"dstpu_io_{os.getpid()}.bin")
+    try:
+        if do_write:
+            rates = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                rid = handle.async_pwrite(data, fname)
+                handle.wait(rid)
+                rates.append(nbytes / (time.perf_counter() - t0))
+            out["write_gbps"] = max(rates) / 1e9
+        if do_read:
+            if not os.path.exists(fname):
+                with open(fname, "wb") as f:
+                    f.write(data.tobytes())
+            dst = np.empty(nbytes, dtype=np.uint8)
+            rates = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                rid = handle.async_pread(dst, fname)
+                handle.wait(rid)
+                rates.append(nbytes / (time.perf_counter() - t0))
+            out["read_gbps"] = max(rates) / 1e9
+    finally:
+        if os.path.exists(fname):
+            os.unlink(fname)
+    return out
+
+
+def main(args=None):
+    args = parse_args(args)
+    path = args.path or tempfile.gettempdir()
+    results = []
+    for t in args.threads:
+        r = bench_config(path, args.size_mb, t, args.trials,
+                         do_read=not args.write_only,
+                         do_write=not args.read_only)
+        results.append(r)
+        print(json.dumps(r))
+    best = max(results, key=lambda r: r.get("read_gbps", 0) + r.get("write_gbps", 0))
+    print(f"best config: {json.dumps(best)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""Per-node process spawner (reference: ``deepspeed/launcher/launch.py:133 main()``).
+
+Spawns one Python process per local worker with the DSTPU_* rendezvous env
+(consumed by ``deepspeed_tpu.comm.mesh.init_distributed``), fans SIGINT/SIGTERM
+out to children, and kills all local workers if any one dies (reference
+``terminate_process_tree:119`` + the sig handlers around ``launch.py:160``).
+
+On a TPU host the default is ONE process per node (JAX owns all local chips);
+``--nproc_per_node`` overrides for CPU simulation
+(with ``JAX_PLATFORMS=cpu`` + ``--xla_force_host_platform_device_count``).
+"""
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+from deepspeed_tpu.launcher.constants import (ENV_COORDINATOR, ENV_HOSTNAME,
+                                              ENV_LOCAL_RANK,
+                                              ENV_NUM_PROCESSES,
+                                              ENV_PROCESS_ID)
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="per-node launcher (internal; invoked by the dstpu runner)")
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64-encoded {hostname: [worker ids]} dict")
+    parser.add_argument("--node_rank", type=str, default="0",
+                        help="this node's index (int, or %%n/$SLURM_NODEID "
+                        "substituted by the fan-out tool)")
+    parser.add_argument("--coordinator_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--coordinator_port", type=int, default=8476)
+    parser.add_argument("--nproc_per_node", type=int, default=None,
+                        help="processes on this node (default: from world_info)")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(world_info_b64: str) -> dict:
+    return json.loads(base64.urlsafe_b64decode(world_info_b64.encode()).decode())
+
+
+def build_rank_env(world_info: dict, node_rank: int, local_rank: int,
+                   coordinator_addr: str, coordinator_port: int) -> dict:
+    """Compute the global process id + rendezvous env for one local worker."""
+    hosts = list(world_info.keys())
+    procs_before = sum(len(world_info[h]) for h in hosts[:node_rank])
+    total = sum(len(v) for v in world_info.values())
+    env = dict(os.environ)
+    env[ENV_COORDINATOR] = f"{coordinator_addr}:{coordinator_port}"
+    env[ENV_NUM_PROCESSES] = str(total)
+    env[ENV_PROCESS_ID] = str(procs_before + local_rank)
+    env[ENV_LOCAL_RANK] = str(local_rank)
+    env[ENV_HOSTNAME] = hosts[node_rank] if node_rank < len(hosts) else "localhost"
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    node_rank = int(str(args.node_rank).lstrip("%n").lstrip("$") or "0") \
+        if not str(args.node_rank).isdigit() else int(args.node_rank)
+    hosts = list(world_info.keys())
+    if node_rank >= len(hosts):
+        raise ValueError(f"node_rank {node_rank} out of range for {len(hosts)} hosts")
+    local_workers = world_info[hosts[node_rank]]
+    nproc = args.nproc_per_node or len(local_workers)
+    if nproc != len(local_workers):
+        # --nproc_per_node override: homogeneous re-slotting so global ids and
+        # the world size stay consistent
+        world_info = {h: list(range(nproc)) for h in hosts}
+
+    processes: List[subprocess.Popen] = []
+    for local_rank in range(nproc):
+        env = build_rank_env(world_info, node_rank, local_rank,
+                             args.coordinator_addr, args.coordinator_port)
+        cmd = [sys.executable, "-u", args.user_script] + args.user_args
+        logger.info(f"launching local rank {local_rank}: {' '.join(cmd)}")
+        processes.append(subprocess.Popen(cmd, env=env))
+
+    def sig_handler(signum, frame):
+        for p in processes:
+            if p.poll() is None:
+                p.send_signal(signum)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+
+    # Monitor: if any child exits non-zero, kill the rest (reference launch.py
+    # main-loop + terminate_process_tree).
+    exit_code = 0
+    alive = list(processes)
+    while alive:
+        for p in list(alive):
+            rc = p.poll()
+            if rc is None:
+                continue
+            alive.remove(p)
+            if rc != 0:
+                logger.error(f"child {p.pid} failed with code {rc}; "
+                             "terminating remaining workers")
+                exit_code = rc
+                for q in alive:
+                    if q.poll() is None:
+                        q.terminate()
+                for q in alive:
+                    try:
+                        q.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        q.kill()
+                alive = []
+                break
+        time.sleep(0.5)
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

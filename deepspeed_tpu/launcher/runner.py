@@ -1,0 +1,209 @@
+"""``dstpu`` CLI — multi-node dispatch (reference: ``deepspeed/launcher/runner.py:419``).
+
+Flow (mirrors the reference):
+hostfile → parse/filter resources (--include/--exclude/--num_nodes) →
+base64 world-info → pick a MultiNodeRunner (pdsh/ssh/gcloud/slurm/mpi) →
+exec the fan-out command, which runs ``launcher.launch`` on each node.
+
+Single-node (no hostfile, no --tpu_name) short-circuits straight into
+``launcher.launch`` locally, like the reference does for world_size==1.
+"""
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.launcher import multinode_runner as mnr
+from deepspeed_tpu.launcher.constants import (DEFAULT_COORDINATOR_PORT,
+                                              GCLOUD_LAUNCHER, MPICH_LAUNCHER,
+                                              OPENMPI_LAUNCHER, PDSH_LAUNCHER,
+                                              SLURM_LAUNCHER, SSH_LAUNCHER)
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="dstpu distributed launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile: lines of '<hostname> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="nodes/workers to include, e.g. 'host1,host2@0,1'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="nodes/workers to exclude")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_workers", type=int, default=-1,
+                        help="processes per node (-1 = all slots)")
+    parser.add_argument("--coordinator_addr", type=str, default=None,
+                        help="JAX coordinator address (default: first node)")
+    parser.add_argument("--coordinator_port", type=int,
+                        default=DEFAULT_COORDINATOR_PORT)
+    parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
+                        choices=[PDSH_LAUNCHER, SSH_LAUNCHER, GCLOUD_LAUNCHER,
+                                 SLURM_LAUNCHER, OPENMPI_LAUNCHER, MPICH_LAUNCHER])
+    parser.add_argument("--tpu_name", type=str, default=None,
+                        help="TPU-VM pod name (switches to the gcloud runner)")
+    parser.add_argument("--tpu_zone", type=str, default=None)
+    parser.add_argument("--nproc_per_node", type=int, default=None,
+                        help="override local processes per node (CPU simulation)")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Dict[str, int]:
+    """Parse '<hostname> slots=<n>' lines (reference runner.py:213 fetch_hostfile)."""
+    if not os.path.isfile(hostfile_path):
+        return {}
+    resource_pool: Dict[str, int] = {}
+    with open(hostfile_path) as fd:
+        for line in fd:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(f"Hostfile is not formatted correctly: {line!r}")
+            if hostname in resource_pool:
+                raise ValueError(f"Hostfile contains duplicate hosts: {hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_inclusion_exclusion(resource_pool: Dict[str, int], inclusion: str,
+                               exclusion: str) -> Dict[str, List[int]]:
+    active: Dict[str, List[int]] = {
+        h: list(range(n)) for h, n in resource_pool.items()}
+    return parse_resource_filter(active, include_str=inclusion,
+                                 exclude_str=exclusion)
+
+
+def parse_resource_filter(host_info: Dict[str, List[int]], include_str: str = "",
+                          exclude_str: str = "") -> Dict[str, List[int]]:
+    """Apply --include/--exclude filters of the form
+    'host1@0,2;host2' (reference runner.py:293 parse_resource_filter)."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+    if not include_str and not exclude_str:
+        return host_info
+
+    filtered: Dict[str, List[int]] = {}
+    spec = include_str or exclude_str
+    parsed: Dict[str, Optional[List[int]]] = {}
+    for term in spec.split(";"):
+        term = term.strip()
+        if not term:
+            continue
+        if "@" in term:
+            host, slots = term.split("@")
+            parsed[host.strip()] = [int(s) for s in slots.split(",")]
+        else:
+            parsed[term] = None
+
+    for host, slots in parsed.items():
+        if host not in host_info:
+            raise ValueError(f"Hostname '{host}' not found in hostfile")
+        for s in slots or []:
+            if s not in host_info[host]:
+                raise ValueError(f"No slot '{s}' specified on host '{host}'")
+
+    if include_str:
+        for host, slots in parsed.items():
+            filtered[host] = slots if slots is not None else host_info[host]
+    else:
+        for host, avail in host_info.items():
+            if host not in parsed:
+                filtered[host] = avail
+            elif parsed[host] is not None:
+                keep = [s for s in avail if s not in parsed[host]]
+                if keep:
+                    filtered[host] = keep
+    return filtered
+
+
+def encode_world_info(world_info: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    if args.tpu_name:
+        args.launcher = GCLOUD_LAUNCHER
+
+    resource_pool = fetch_hostfile(args.hostfile)
+    if not resource_pool and args.launcher != GCLOUD_LAUNCHER:
+        # Single-node: run launch.py locally, one process (JAX owns local chips).
+        world_info = {"localhost": [0]}
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={encode_world_info(world_info)}",
+               "--node_rank=0",
+               f"--coordinator_addr=127.0.0.1",
+               f"--coordinator_port={args.coordinator_port}"]
+        if args.nproc_per_node is not None:
+            cmd.append(f"--nproc_per_node={args.nproc_per_node}")
+        cmd += [args.user_script] + args.user_args
+        logger.info(f"single-node launch: {' '.join(cmd)}")
+        result = subprocess.Popen(cmd)
+        result.wait()
+        sys.exit(result.returncode)
+
+    active_resources = _parse_inclusion_exclusion(
+        resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active_resources = dict(list(active_resources.items())[:args.num_nodes])
+    if args.num_workers > 0:
+        active_resources = {h: w[:args.num_workers]
+                            for h, w in active_resources.items()}
+
+    if args.coordinator_addr is None and active_resources:
+        args.coordinator_addr = list(active_resources.keys())[0]
+
+    world_info_b64 = encode_world_info(active_resources)
+
+    runner_cls = {
+        PDSH_LAUNCHER: mnr.PDSHRunner,
+        SSH_LAUNCHER: mnr.SSHRunner,
+        GCLOUD_LAUNCHER: mnr.GcloudTPURunner,
+        SLURM_LAUNCHER: mnr.SlurmRunner,
+        OPENMPI_LAUNCHER: mnr.MPIRunner,
+        MPICH_LAUNCHER: mnr.MPIRunner,
+    }[args.launcher]
+    runner = runner_cls(args, world_info_b64)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{args.launcher}' not available "
+                           f"(binary missing on PATH)")
+
+    env = dict(os.environ)
+    if isinstance(runner, mnr.SSHRunner):
+        procs = []
+        for rank, host in enumerate(active_resources):
+            procs.append(subprocess.Popen(
+                runner.get_node_cmd(host, rank, env)))
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        sys.exit(rc)
+
+    cmd = runner.get_cmd(env, active_resources)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ via atomic rename.
 import ctypes
 import hashlib
 import os
+import platform
 import subprocess
 import tempfile
 from typing import List, Optional
@@ -22,6 +23,18 @@ CACHE_DIR = os.environ.get(
     "DSTPU_OP_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu"))
 
 DEFAULT_FLAGS = ["-O3", "-march=native", "-fopenmp", "-fPIC", "-shared", "-std=c++17"]
+
+
+def _cpu_identity() -> str:
+    """Model name + flags line from /proc/cpuinfo (best effort)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags")):
+                    return line.strip()
+    except OSError:
+        pass
+    return platform.processor() or "unknown-cpu"
 
 
 class OpBuilder:
@@ -41,6 +54,11 @@ class OpBuilder:
             with open(s, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.flags).encode())
+        # -march=native binaries are host-specific: key on the CPU identity so a
+        # shared (NFS) cache dir across heterogeneous hosts never serves a .so
+        # built for another microarchitecture (SIGILL otherwise).
+        h.update(platform.machine().encode())
+        h.update(_cpu_identity().encode())
         return h.hexdigest()[:16]
 
     def is_compatible(self) -> bool:
@@ -71,16 +89,18 @@ class OpBuilder:
         return self._lib
 
 
-_builders = {}
+def _make_ops():
+    return {
+        "cpu_adam": OpBuilder("cpu_adam", ["cpu_adam.cpp"]),
+        "aio": OpBuilder("aio", ["aio.cpp"], extra_flags=["-pthread"]),
+    }
+
+
+# Registry of known native ops (reference: op_builder/all_ops.py).
+OPS = _make_ops()
 
 
 def get_op(name: str) -> ctypes.CDLL:
-    """Registry of known native ops (reference: op_builder/all_ops.py)."""
-    if name not in _builders:
-        if name == "cpu_adam":
-            _builders[name] = OpBuilder("cpu_adam", ["cpu_adam.cpp"])
-        elif name == "aio":
-            _builders[name] = OpBuilder("aio", ["aio.cpp"], extra_flags=["-pthread"])
-        else:
-            raise ValueError(f"unknown native op '{name}'")
-    return _builders[name].load()
+    if name not in OPS:
+        raise ValueError(f"unknown native op '{name}'")
+    return OPS[name].load()

@@ -1,0 +1,154 @@
+"""Elasticity tests (reference shape: tests/unit/elasticity/test_elastic.py)."""
+
+import subprocess
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticAgent, ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      WorkerSpec, compute_elastic_config,
+                                      get_candidate_batch_sizes,
+                                      get_valid_devices)
+
+BASE_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_candidate_batch_sizes():
+    # each base → base × largest highly-composite number fitting under max
+    # base 2: HCNs ≤ 6 are [1,2,4,6] → 12; base 3: HCNs ≤ 4 are [1,2,4] → 12
+    assert get_candidate_batch_sizes([2, 3], 12) == [12]
+
+
+def test_valid_devices():
+    devices = get_valid_devices(batch_size=24, micro_batches=[4, 6],
+                                min_valid_devices=1, max_valid_devices=24)
+    # micro=4 → dp in divisors of 6; micro=6 → dp in divisors of 4
+    assert set(devices) == {1, 2, 3, 4, 6}
+
+
+def test_compute_elastic_config_basic():
+    batch, valid = compute_elastic_config(BASE_CONFIG)
+    assert batch <= 10000
+    assert all(32 <= w <= 1500 for w in valid)
+    assert len(valid) > 10  # highly-composite batch ⇒ many valid world sizes
+
+
+def test_world_size_validation():
+    _, valid = compute_elastic_config(BASE_CONFIG)
+    w = valid[0]
+    batch, valid2 = compute_elastic_config(BASE_CONFIG, world_size=w)
+    assert w in valid2
+    # a world size outside [min,max] or non-divisible should raise
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(BASE_CONFIG, world_size=1531)
+
+
+def test_micro_batch_resolution():
+    _, valid = compute_elastic_config(BASE_CONFIG)
+    w = valid[-1]
+    batch, _, micro = compute_elastic_config(
+        BASE_CONFIG, world_size=w, return_microbatch=True)
+    per_rank = batch // w
+    assert per_rank % micro == 0
+    assert micro in BASE_CONFIG["elasticity"]["micro_batch_sizes"]
+
+
+def test_same_global_batch_across_scales():
+    """The elastic invariant: global batch identical at different world sizes."""
+    _, valid = compute_elastic_config(BASE_CONFIG)
+    w_a, w_b = valid[0], valid[len(valid) // 2]
+    assert w_a != w_b
+    b_a, _ = compute_elastic_config(BASE_CONFIG, world_size=w_a)
+    b_b, _ = compute_elastic_config(BASE_CONFIG, world_size=w_b)
+    assert b_a == b_b
+
+
+def test_disabled_raises():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_model_parallel_v2():
+    cfg = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 4096,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1, "max_gpus": 512,
+            "version": 0.2,
+            "model_parallel_size": 4,
+            "num_gpus_per_node": 8,
+        }
+    }
+    batch, valid = compute_elastic_config(cfg, world_size=32)
+    assert batch <= 4096
+    # dp world = 32/4 = 8 must be in the valid dp set
+    assert 8 in valid
+
+
+class _FakeProc:
+    """Deterministic fake Popen: exits with a scripted code after n polls."""
+
+    def __init__(self, codes):
+        self.codes = list(codes)
+        self.terminated = False
+
+    def poll(self):
+        return self.codes.pop(0) if self.codes else 0
+
+    def terminate(self):
+        self.terminated = True
+
+    def wait(self, timeout=None):
+        return 0
+
+    def kill(self):
+        pass
+
+
+def test_elastic_agent_restarts_on_failure():
+    launches = []
+
+    def fake_popen(cmd, env=None):
+        launches.append(env)
+        # first group: rank0 fails once; second group: both succeed
+        if len(launches) <= 2:
+            return _FakeProc([None, 1])
+        return _FakeProc([0])
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 8, "version": 0.1}}
+    spec = WorkerSpec(cmd=["python", "train.py"], max_restarts=3,
+                      monitor_interval_s=0.01)
+    agent = ElasticAgent(spec, cfg,
+                         host_provider=lambda: ["h0", "h1"], popen=fake_popen)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+    assert len(launches) == 4  # 2 hosts × 2 generations
+    # rendezvous env regenerated each generation
+    assert launches[-1]["DSTPU_ELASTIC_RESTART"] == "1"
+    assert launches[-1]["DSTPU_NUM_PROCESSES"] == "2"
+
+
+def test_elastic_agent_budget_exhausted():
+    def always_fail(cmd, env=None):
+        return _FakeProc([2])
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2], "min_gpus": 1,
+                          "max_gpus": 8, "version": 0.1}}
+    spec = WorkerSpec(cmd=["x"], max_restarts=2, monitor_interval_s=0.01)
+    agent = ElasticAgent(spec, cfg, popen=always_fail)
+    assert agent.run() == 2
+    assert agent.restart_count == 3  # budget (2) + the final attempt
