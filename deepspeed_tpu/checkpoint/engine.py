@@ -38,8 +38,16 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     tag = tag if tag is not None else f"global_step{engine.global_steps}"
     path = _ckpt_dir(save_dir, tag)
     state = engine.state
+    offload = getattr(engine, "_offload", None)
+    params_to_save = state.params
+    if offload is not None:
+        # Under offload the authoritative weights are the fp32 host masters
+        # (device params are compute-dtype shadows) — save those so the
+        # checkpoint stays fp32 regardless of offload config.
+        params_to_save = jax.tree_util.tree_unflatten(
+            engine._params_treedef, offload.masters())
     composite = {
-        "params": state.params,
+        "params": params_to_save,
         "opt_state": state.opt_state,
         "scalars": {
             "step": state.step,
@@ -53,6 +61,15 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     ckptr.save(path, composite, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
+
+    if offload is not None:
+        # host optimizer moments, one file per process (process-local shards)
+        sd = offload.state_dict()
+        np.savez(
+            os.path.join(path, f"offload_state_proc{jax.process_index()}.npz"),
+            step_count=np.int64(sd["step_count"]),
+            **{f"s_{i}_{j}": s for i, states in enumerate(sd["states"])
+               for j, s in enumerate(states)})
 
     meta = {
         "global_steps": engine.global_steps,
@@ -86,11 +103,16 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         raise FileNotFoundError(f"checkpoint not found: {path}")
 
     state = engine.state
+    offload = getattr(engine, "_offload", None)
     # Restore with the *current* engine shardings — a mesh/world-size change between
     # save and load reshapes automatically (the UCP capability, built in).
+    # Checkpointed params are always fp32 (masters); under offload the live
+    # device params are compute-dtype, so the target dtype is forced to fp32.
     target = {
         "params": jax.tree.map(
-            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            lambda x, s: jax.ShapeDtypeStruct(
+                x.shape, np.float32 if offload is not None else x.dtype,
+                sharding=s),
             state.params, engine.param_shardings),
         "opt_state": jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
@@ -112,9 +134,37 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     from deepspeed_tpu.runtime.engine import EngineState
     from deepspeed_tpu.runtime.precision import LossScaleState
     sc = restored["scalars"]
+    restored_params = restored["params"]
+
+    if offload is not None:
+        # Resync the host tier: masters take the restored weights; moments come
+        # from the per-process state file (reset if the checkpoint has none, e.g.
+        # saved by a non-offload config). Device params become fresh shadows —
+        # without this resync the next step would revert to stale masters.
+        masters = [np.asarray(jax.device_get(p), np.float32)
+                   for p in jax.tree.leaves(restored_params)]
+        npz_path = os.path.join(
+            path, f"offload_state_proc{jax.process_index()}.npz")
+        if load_optimizer_states and os.path.exists(npz_path):
+            data = np.load(npz_path)
+            n_states = offload.n_states
+            states = [[data[f"s_{i}_{j}"] for j in range(n_states)]
+                      for i in range(len(masters))]
+            offload.load_state_dict({"step_count": int(data["step_count"]),
+                                     "masters": masters, "states": states})
+        else:
+            if load_optimizer_states:
+                log_dist("offload: checkpoint has no host optimizer state; "
+                         "moments reset to zero", ranks=[0])
+            offload.set_masters(masters, reset_moments=True)
+        shadow = offload.shadows(np.dtype(engine.compute_dtype).name)
+        restored_params = jax.device_put(
+            jax.tree_util.tree_unflatten(engine._params_treedef, shadow),
+            engine.param_shardings)
+
     engine.state = EngineState(
         step=sc["step"],
-        params=restored["params"],
+        params=restored_params,
         opt_state=restored["opt_state"] if load_optimizer_states else state.opt_state,
         loss_scale=LossScaleState(sc["loss_scale"], sc["good_steps"], sc["hysteresis"]),
         skipped_steps=sc["skipped_steps"],

@@ -86,11 +86,14 @@ class AsyncIOHandle:
         return rid
 
     def wait(self, request_id: int) -> int:
-        """Block until the request completes; returns accumulated error count."""
+        """Block until the request completes; 0 = success, 1 = THIS request failed."""
         if self._lib is not None:
             return self._lib.aio_wait(self._h, request_id)
         fut = self._futures.pop(request_id)
-        fut.result()
+        try:
+            fut.result()
+        except Exception:
+            return 1
         return 0
 
     def is_done(self, request_id: int) -> bool:
@@ -100,8 +103,11 @@ class AsyncIOHandle:
         return fut is None or fut.done()
 
     def drain(self) -> int:
+        """Block until all outstanding requests complete; returns the number of
+        failures among requests not individually waited (counter resets)."""
         if self._lib is not None:
             return self._lib.aio_drain(self._h)
+        failures = 0
         for rid in list(self._futures):
-            self.wait(rid)
-        return 0
+            failures += self.wait(rid)
+        return failures

@@ -1,8 +1,9 @@
 """Host fused optimizers over the native C++ kernels.
 
-Reference analog: ``deepspeed/ops/adam/cpu_adam.py:13`` (``DeepSpeedCPUAdam`` —
-python wrapper over the AVX kernel, used for ZeRO-Offload optimizer states).
-Numpy fallback keeps CI working without a toolchain.
+Reference analogs: ``deepspeed/ops/adam/cpu_adam.py:13`` (``DeepSpeedCPUAdam``),
+``ops/adagrad/cpu_adagrad.py`` and ``ops/lion/cpu_lion.py`` — python wrappers
+over the AVX kernels used for ZeRO-Offload optimizer states. Numpy fallback
+keeps CI working without a toolchain.
 """
 
 import ctypes
@@ -13,11 +14,39 @@ import numpy as np
 from deepspeed_tpu.utils.logging import warning_once
 
 
+def _load_sym(name, argtypes):
+    from deepspeed_tpu.ops.op_builder import get_op
+    lib = get_op("cpu_adam")
+    fn = getattr(lib, name)
+    fn.argtypes = argtypes
+    return fn
+
+
+def to_bf16(src: np.ndarray) -> np.ndarray:
+    """Round-to-nearest-even bf16 shadow of an fp32 array (reference: the fp16
+    param-shard update after the CPU step). Uses the C++ kernel when available;
+    halves host→device transfer bytes for the offload tier."""
+    import ml_dtypes
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    try:
+        fn = _load_sym("fp32_to_bf16", [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint16),
+            ctypes.c_int64])
+    except Exception:
+        return src.astype(ml_dtypes.bfloat16)
+    out = np.empty(src.shape, dtype=np.uint16)
+    fn(src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), src.size)
+    return out.view(ml_dtypes.bfloat16)
+
+
 class CPUAdam:
     """Fused AdamW/Adam over flat fp32 numpy shards (host memory)."""
 
+    num_states = 2  # exp_avg, exp_avg_sq
+
     def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
-                 weight_decay: float = 0.0, adamw_mode: bool = True):
+                 weight_decay: float = 0.0, adamw_mode: bool = True, **_ignored):
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -67,3 +96,82 @@ class CPUAdam:
         if self.adamw_mode and self.weight_decay:
             update = update + self.weight_decay * params
         params -= lr * update
+
+
+class CPUAdagrad:
+    """Fused Adagrad over flat fp32 numpy shards (reference:
+    csrc/adagrad/cpu_adagrad.cpp via ops/adagrad/cpu_adagrad.py)."""
+
+    num_states = 1  # state_sum
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, **_ignored):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self._fn = None
+        try:
+            self._fn = _load_sym("cpu_adagrad_step", [
+                ctypes.POINTER(ctypes.c_float)] * 3 + [
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float])
+        except Exception as e:
+            warning_once(f"cpu_adagrad native op unavailable ({e}); numpy fallback")
+
+    def step(self, params: np.ndarray, grads: np.ndarray, state_sum: np.ndarray,
+             lr: Optional[float] = None):
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        if self._fn is not None:
+            g32 = np.ascontiguousarray(grads, dtype=np.float32)
+            self._fn(params.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                     g32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                     state_sum.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                     params.size, lr, self.eps, self.weight_decay)
+            return
+        g = grads.astype(np.float32)
+        if self.weight_decay:
+            g = g + self.weight_decay * params
+        state_sum += g * g
+        params -= lr * g / (np.sqrt(state_sum) + self.eps)
+
+
+class CPULion:
+    """Fused Lion over flat fp32 numpy shards (reference:
+    csrc/lion/cpu_lion_impl.cpp via ops/lion/cpu_lion.py)."""
+
+    num_states = 1  # exp_avg
+
+    def __init__(self, lr: float = 1e-4, betas=(0.9, 0.99),
+                 weight_decay: float = 0.0, **_ignored):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self._fn = None
+        try:
+            self._fn = _load_sym("cpu_lion_step", [
+                ctypes.POINTER(ctypes.c_float)] * 3 + [
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float])
+        except Exception as e:
+            warning_once(f"cpu_lion native op unavailable ({e}); numpy fallback")
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+             lr: Optional[float] = None):
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        if self._fn is not None:
+            g32 = np.ascontiguousarray(grads, dtype=np.float32)
+            self._fn(params.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                     g32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                     exp_avg.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                     params.size, lr, self.beta1, self.beta2, self.weight_decay)
+            return
+        g = grads.astype(np.float32)
+        c = self.beta1 * exp_avg + (1 - self.beta1) * g
+        params -= lr * (np.sign(c) + self.weight_decay * params)
+        exp_avg *= self.beta2
+        exp_avg += (1 - self.beta2) * g

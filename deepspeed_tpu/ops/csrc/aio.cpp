@@ -18,6 +18,7 @@
 #include <string>
 #include <thread>
 #include <unistd.h>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -38,9 +39,10 @@ struct Engine {
     std::condition_variable cv_work;
     std::condition_variable cv_done;
     std::atomic<int64_t> next_id{1};
-    std::vector<int64_t> done_ids;        // completed, not-yet-waited ids
+    // completed, not-yet-waited requests: id -> ok. Per-request status (not a
+    // global sticky counter) so one failed swap never poisons later waits.
+    std::unordered_map<int64_t, bool> done;
     int64_t outstanding = 0;              // submitted but not completed
-    std::atomic<int> errors{0};
     bool shutdown = false;
     int block_size = 1 << 20;             // 1 MiB pread/pwrite chunks
 
@@ -71,8 +73,7 @@ struct Engine {
             bool ok = execute(req);
             {
                 std::lock_guard<std::mutex> l(mu);
-                if (!ok) errors.fetch_add(1);
-                done_ids.push_back(req.id);
+                done[req.id] = ok;
                 outstanding--;
             }
             cv_done.notify_all();
@@ -112,27 +113,28 @@ struct Engine {
 
     bool is_done(int64_t id) {
         std::lock_guard<std::mutex> l(mu);
-        for (int64_t d : done_ids) if (d == id) return true;
-        return false;
+        return done.count(id) != 0;
     }
 
+    // 0 = success, 1 = this request failed (entry reclaimed either way so the
+    // table stays bounded over long runs).
     int wait(int64_t id) {
         std::unique_lock<std::mutex> l(mu);
-        cv_done.wait(l, [&] {
-            for (int64_t d : done_ids) if (d == id) return true;
-            return false;
-        });
-        // reclaim the slot so done_ids stays bounded over long runs
-        for (size_t i = 0; i < done_ids.size(); ++i)
-            if (done_ids[i] == id) { done_ids.erase(done_ids.begin() + i); break; }
-        return errors.load();
+        cv_done.wait(l, [&] { return done.count(id) != 0; });
+        bool ok = done[id];
+        done.erase(id);
+        return ok ? 0 : 1;
     }
 
+    // Waits for all outstanding requests; returns how many of the completed,
+    // not-individually-waited requests failed, then clears the table.
     int drain() {
         std::unique_lock<std::mutex> l(mu);
         cv_done.wait(l, [&] { return outstanding == 0; });
-        done_ids.clear();
-        return errors.load();
+        int failures = 0;
+        for (auto& kv : done) if (!kv.second) failures++;
+        done.clear();
+        return failures;
     }
 };
 

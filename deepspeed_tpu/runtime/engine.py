@@ -171,14 +171,39 @@ class DeepSpeedTPUEngine:
             lambda x: x.astype(jnp.float32)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
 
-        param_specs = jax.tree.map(lambda s: s.spec, self.param_shardings,
-                                   is_leaf=lambda x: isinstance(x, NamedSharding))
-        opt_state_shape = jax.eval_shape(self.tx.init, params)
-        self.opt_state_shardings = build_opt_state_shardings(
-            opt_state_shape, params, param_specs, self.mesh,
-            max(self.zero_stage, 0))
-        opt_state = jax.jit(self.tx.init,
-                            out_shardings=self.opt_state_shardings)(params)
+        # --- optimizer-state offload tier (ZeRO-Offload / Infinity) ----------
+        # Constructed BEFORE device state: under offload the device holds only
+        # compute-dtype param shadows — no fp32 masters, no optimizer moments in
+        # HBM (that is the point of the tier; reference keeps fp16 shards on
+        # device and fp32 masters + moments on host).
+        self._offload = None
+        self._offload_grad_fn = None
+        self._offload_apply_fn = None
+        offload_cfg = config.zero_config.offload_optimizer
+        if offload_cfg.device in ("cpu", "nvme"):
+            from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
+            host_leaves = [np.asarray(jax.device_get(p), np.float32)
+                           for p in jax.tree.leaves(params)]
+            opt_type = config.optimizer.type if config.optimizer else "adamw"
+            self._offload = HostOffloadOptimizer(
+                host_leaves, opt_type,
+                dict(config.optimizer.params) if config.optimizer else {},
+                offload_cfg)
+            self._params_treedef = jax.tree_util.tree_structure(params)
+            params = jax.jit(
+                lambda p: precision.cast_to_compute(p, self.compute_dtype),
+                out_shardings=self.param_shardings)(params)
+            self.opt_state_shardings = ()
+            opt_state = ()
+        else:
+            param_specs = jax.tree.map(lambda s: s.spec, self.param_shardings,
+                                       is_leaf=lambda x: isinstance(x, NamedSharding))
+            opt_state_shape = jax.eval_shape(self.tx.init, params)
+            self.opt_state_shardings = build_opt_state_shardings(
+                opt_state_shape, params, param_specs, self.mesh,
+                max(self.zero_stage, 0))
+            opt_state = jax.jit(self.tx.init,
+                                out_shardings=self.opt_state_shardings)(params)
 
         scalar_sharding = NamedSharding(self.mesh, PartitionSpec())
         self.state = EngineState(
@@ -207,20 +232,6 @@ class DeepSpeedTPUEngine:
         self._micro_fwd_bwd_fn = None   # compat path: per-microbatch grads
         self._apply_update_fn = None    # compat path: update at boundary
         self._eval_fn = None
-
-        # --- optimizer-state offload tier (ZeRO-Offload / Infinity) ----------
-        self._offload = None
-        self._offload_grad_fn = None
-        offload_cfg = config.zero_config.offload_optimizer
-        if offload_cfg.device in ("cpu", "nvme"):
-            from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
-            host_leaves = [np.asarray(jax.device_get(p), np.float32)
-                           for p in jax.tree.leaves(self.state.params)]
-            self._offload = HostOffloadOptimizer(
-                host_leaves,
-                dict(config.optimizer.params) if config.optimizer else {},
-                offload_cfg)
-            self._params_treedef = jax.tree_util.tree_structure(self.state.params)
 
         # --- compat-shim bookkeeping ----------------------------------------
         self._grad_buffer = None
@@ -392,57 +403,80 @@ class DeepSpeedTPUEngine:
         return out.loss
 
     def _train_batch_offloaded(self, batch) -> jnp.ndarray:
-        """ZeRO-Offload step: device grads under jit, fused C++ CPU-Adam on host
-        masters, bf16/fp32 shadow back to device (reference: CPU optimizer step
-        stage3.py:964 with offload). The device<->host round trip is the cost the
-        reference pays too; overlap comes from the async swapper inside."""
+        """ZeRO-Offload step: device grads under jit, fused C++ host optimizer on
+        fp32 masters, compute-dtype shadow back to device (reference: CPU
+        optimizer step stage3.py:964 with offload). The device<->host round trip
+        is the cost the reference pays too; overlap comes from the async swapper
+        inside. fp16 loss scaling + overflow step-skip match the in-HBM path."""
         cfg = self.config
         if self._offload_grad_fn is None:
             gas = self.gradient_accumulation_steps
+            fp16 = cfg.fp16
 
-            def grad_step(params, stacked_batch, rng):
+            def grad_step(params, stacked_batch, rng, scale):
                 rngs = jax.random.split(rng, gas)
 
                 def micro(carry, xs):
                     grad_acc, loss_acc = carry
                     b, r = xs
-                    loss, grads = self._grads_one_micro(params, b, r, jnp.float32(1.0))
+                    loss, grads = self._grads_one_micro(params, b, r, scale)
+                    grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                     return (jax.tree.map(jnp.add, grad_acc, grads),
                             loss_acc + loss), None
 
                 zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
                 (grads, loss_sum), _ = jax.lax.scan(
                     micro, (zero, jnp.float32(0.0)), (stacked_batch, rngs))
-                grads = jax.tree.map(lambda g: g / gas, grads)
+                grads = jax.tree.map(lambda g: g / (scale * gas), grads)
+                overflow = precision.has_inf_or_nan(grads) if fp16.enabled \
+                    else jnp.bool_(False)
                 if cfg.gradient_clipping > 0:
                     grads, norm = precision.clip_by_global_norm(
                         grads, cfg.gradient_clipping)
                 else:
                     norm = precision.global_grad_norm(grads)
-                return loss_sum / gas, grads, norm
+                return loss_sum / gas, grads, norm, overflow
 
             self._offload_grad_fn = jax.jit(grad_step)
 
         device_batch = self._shard_batch(batch, stacked=True)
         self._rng, r = jax.random.split(self._rng)
         self.tput_timer.start()
-        loss, grads, norm = self._offload_grad_fn(self.state.params, device_batch, r)
-        grads_host = [np.asarray(jax.device_get(g)) for g in jax.tree.leaves(grads)]
-        lr = float(jax.device_get(self.lr_schedule(self.state.step)))
-        self._offload.step(grads_host, lr=lr)
-        new_params = jax.tree_util.tree_unflatten(
-            self._params_treedef, self._offload.masters())
-        self.state = self.state._replace(
-            params=jax.device_put(new_params, self.param_shardings),
-            step=self.state.step + 1)
+        loss, grads, norm, overflow = self._offload_grad_fn(
+            self.state.params, device_batch, r, self.state.loss_scale.scale)
+        self._offload_host_update(loss, grads, norm, overflow)
         self.tput_timer.stop(global_step=True)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         self.global_samples += self.train_batch_size
-        self._record_metrics(StepOutput(loss=loss, grad_norm=norm,
-                                        lr=jnp.float32(lr),
-                                        overflow=jnp.bool_(False)))
         return loss
+
+    def _offload_host_update(self, loss, grads, norm, overflow):
+        """Host half of an offloaded step: on overflow skip the update and shrink
+        the loss scale (parity with _update's keep_old/skip); otherwise run the
+        fused CPU kernel on the masters and push a compute-dtype shadow back."""
+        cfg = self.config
+        overflow_host = bool(jax.device_get(overflow))
+        lr = float(jax.device_get(self.lr_schedule(self.state.step)))
+        new_scale = precision.update_loss_scale(
+            self.state.loss_scale, overflow, cfg.fp16) if cfg.fp16.enabled \
+            else self.state.loss_scale
+        if overflow_host:
+            self.state = self.state._replace(
+                loss_scale=new_scale,
+                skipped_steps=self.state.skipped_steps + 1)
+        else:
+            grads_host = [np.asarray(jax.device_get(g))
+                          for g in jax.tree.leaves(grads)]
+            self._offload.step(grads_host, lr=lr)
+            shadow = self._offload.shadows(np.dtype(self.compute_dtype).name)
+            new_params = jax.tree_util.tree_unflatten(self._params_treedef, shadow)
+            self.state = self.state._replace(
+                params=jax.device_put(new_params, self.param_shardings),
+                step=self.state.step + 1,
+                loss_scale=new_scale)
+        self._record_metrics(StepOutput(loss=loss, grad_norm=norm,
+                                        lr=jnp.float32(lr), overflow=overflow))
 
     def _record_metrics(self, out: StepOutput):
         self._last_metrics = {"lr": out.lr, "grad_norm": out.grad_norm,
@@ -469,7 +503,10 @@ class DeepSpeedTPUEngine:
         grad_shardings = self.param_shardings
 
         def fwd_bwd(params, batch, rng, scale):
-            return self._grads_one_micro(params, batch, rng, scale)
+            loss, grads = self._grads_one_micro(params, batch, rng, scale)
+            # fp32 accumulation even when params are compute-dtype shadows
+            # (offload mode); no-op when params are fp32 masters
+            return loss, jax.tree.map(lambda g: g.astype(jnp.float32), grads)
 
         self._micro_fwd_bwd_fn = jax.jit(
             fwd_bwd, out_shardings=(None, grad_shardings))
@@ -525,18 +562,41 @@ class DeepSpeedTPUEngine:
 
     def step(self):
         """Compat shim (reference engine.step:2176): applies the update at the
-        gradient-accumulation boundary; otherwise a no-op."""
+        gradient-accumulation boundary; otherwise a no-op. Routes through the
+        host offload optimizer when configured (same path as train_batch)."""
         if not self.is_gradient_accumulation_boundary():
             return
-        if self._apply_update_fn is None:
-            self._build_micro_fns()
         self.timers(STEP_GLOBAL_TIMER).start()
-        self.state, out = self._apply_update_fn(self.state, self._grad_buffer)
+        if self._offload is not None:
+            if self._offload_apply_fn is None:
+                cfg = self.config
+                gas = self.gradient_accumulation_steps
+
+                def finalize(grad_sum, scale):
+                    grads = jax.tree.map(
+                        lambda g: g.astype(jnp.float32) / (scale * gas), grad_sum)
+                    overflow = precision.has_inf_or_nan(grads) \
+                        if cfg.fp16.enabled else jnp.bool_(False)
+                    if cfg.gradient_clipping > 0:
+                        grads, norm = precision.clip_by_global_norm(
+                            grads, cfg.gradient_clipping)
+                    else:
+                        norm = precision.global_grad_norm(grads)
+                    return grads, norm, overflow
+
+                self._offload_apply_fn = jax.jit(finalize)
+            grads, norm, overflow = self._offload_apply_fn(
+                self._grad_buffer, self.state.loss_scale.scale)
+            self._offload_host_update(jnp.float32(0.0), grads, norm, overflow)
+        else:
+            if self._apply_update_fn is None:
+                self._build_micro_fns()
+            self.state, out = self._apply_update_fn(self.state, self._grad_buffer)
+            self._record_metrics(out)
         self._grad_buffer = None
         self._accum_count = 0
         self.global_steps += 1
         self.global_samples += self.train_batch_size
-        self._record_metrics(out)
         self.timers(STEP_GLOBAL_TIMER).stop()
 
     # ------------------------------------------------------------------

@@ -8,60 +8,81 @@ Reference analogs:
   double-buffered ``pipelined_optimizer_swapper.py``), over the aio engine
 
 TPU-native shape: the device keeps compute-dtype (bf16) params and produces grads
-under jit; the host keeps fp32 master params + Adam moments as numpy arrays and
-runs the fused C++ CPU-Adam kernel; updated masters stream back as a bf16 shadow.
-With NVMe enabled, moments live in per-leaf files; sub-groups are prefetched with
-the async engine while the previous sub-group updates (Infinity's pipelined
-swapper). Twin-Flow (``ratio`` < 1, reference ZeRO-Offload++ engine.py:757) keeps
-the first ``1-ratio`` fraction of sub-groups permanently in host RAM.
+under jit; the host keeps fp32 master params + optimizer moments as numpy arrays
+and runs the fused C++ kernel (Adam/AdamW, Adagrad, or Lion — reference supports
+exactly these CPU optimizers); updated masters stream back as a bf16 shadow
+(half the H2D bytes). With NVMe enabled, moments live in per-leaf files;
+sub-groups are prefetched with the async engine while the previous sub-group
+updates (Infinity's pipelined swapper). Twin-Flow (``ratio`` < 1, reference
+ZeRO-Offload++ engine.py:757) keeps the first ``1-ratio`` fraction of sub-groups
+permanently in host RAM.
 """
 
 import os
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.config.config import OffloadConfig
 from deepspeed_tpu.ops.async_io import AsyncIOHandle
-from deepspeed_tpu.ops.cpu_adam import CPUAdam
+from deepspeed_tpu.ops.cpu_adam import CPUAdagrad, CPUAdam, CPULion, to_bf16
 from deepspeed_tpu.utils.logging import log_dist
+
+# optimizer-type → host kernel (reference: cpu_adam/cpu_adagrad/cpu_lion builders)
+_HOST_OPTIMIZERS = {
+    "adam": CPUAdam, "adamw": CPUAdam, "cpu_adam": CPUAdam,
+    "adagrad": CPUAdagrad, "cpu_adagrad": CPUAdagrad,
+    "lion": CPULion, "cpu_lion": CPULion,
+}
 
 
 class _LeafState:
-    """Host state for one parameter leaf."""
+    """Host state for one parameter leaf: fp32 master + n_states moment buffers."""
 
-    def __init__(self, idx: int, master: np.ndarray, nvme_dir: Optional[str]):
+    def __init__(self, idx: int, master: np.ndarray, n_states: int,
+                 nvme_dir: Optional[str]):
         self.idx = idx
         self.master = master                       # fp32, host-resident always
-        self.nvme_dir = nvme_dir
         self.nvme = nvme_dir is not None
         if self.nvme:
-            self.m_path = os.path.join(nvme_dir, f"exp_avg_{idx}.bin")
-            self.v_path = os.path.join(nvme_dir, f"exp_avg_sq_{idx}.bin")
-            self.m: Optional[np.ndarray] = None    # swapped in on demand
-            self.v: Optional[np.ndarray] = None
+            self.paths = [os.path.join(nvme_dir, f"state{s}_{idx}.bin")
+                          for s in range(n_states)]
+            self.states: List[Optional[np.ndarray]] = [None] * n_states
         else:
-            self.m = np.zeros_like(master)
-            self.v = np.zeros_like(master)
+            self.states = [np.zeros_like(master) for _ in range(n_states)]
+        self._pending_drop = False
+
+
+class UnsupportedOffloadOptimizer(ValueError):
+    pass
 
 
 class HostOffloadOptimizer:
-    """Fused host Adam over offloaded states, with optional NVMe sub-group swap.
+    """Fused host optimizer over offloaded states, with optional NVMe swap.
 
     Single-controller / per-process shard semantics: each process updates the
     params it addresses (multi-host runs shard leaves over processes upstream).
     """
 
-    def __init__(self, params_host: List[np.ndarray], opt_params: Dict[str, Any],
-                 offload: OffloadConfig, sub_group_size: int = 4):
-        self.adam = CPUAdam(
-            lr=opt_params.get("lr", 1e-3),
-            betas=tuple(opt_params.get("betas", (0.9, 0.999))),
-            eps=opt_params.get("eps", 1e-8),
-            weight_decay=opt_params.get("weight_decay", 0.0),
-            adamw_mode=opt_params.get("adam_w_mode", True))
+    def __init__(self, params_host: List[np.ndarray], opt_type: str,
+                 opt_params: Dict[str, Any], offload: OffloadConfig,
+                 sub_group_size: int = 4):
+        key = (opt_type or "adamw").lower()
+        if key not in _HOST_OPTIMIZERS:
+            raise UnsupportedOffloadOptimizer(
+                f"optimizer '{opt_type}' has no fused host kernel; offload "
+                f"supports {sorted(set(_HOST_OPTIMIZERS))} (reference: CPU "
+                "Adam/Adagrad/Lion only)")
+        kernel_cls = _HOST_OPTIMIZERS[key]
+        kwargs = dict(opt_params)
+        kwargs.setdefault("adamw_mode", key != "adam")
+        if "betas" in kwargs:
+            kwargs["betas"] = tuple(kwargs["betas"])
+        self.kernel = kernel_cls(**{k: v for k, v in kwargs.items()
+                                    if k in ("lr", "betas", "eps", "weight_decay",
+                                             "adamw_mode")})
+        self.n_states = kernel_cls.num_states
         self.offload = offload
         nvme_dir = None
         if offload.device == "nvme":
@@ -70,7 +91,8 @@ class HostOffloadOptimizer:
             os.makedirs(nvme_dir, exist_ok=True)
             self.aio = AsyncIOHandle(num_threads=offload.buffer_count * 2)
         self.leaves = [
-            _LeafState(i, np.ascontiguousarray(p, dtype=np.float32),
+            # np.array(copy=True): device_get arrays can be read-only views
+            _LeafState(i, np.array(p, dtype=np.float32, copy=True), self.n_states,
                        # Twin-Flow partial offload: first (1-ratio) leaves pinned in RAM
                        nvme_dir if (nvme_dir and i >= (1.0 - offload.ratio) *
                                     len(params_host)) else None)
@@ -82,33 +104,33 @@ class HostOffloadOptimizer:
                 if leaf.nvme:
                     zeros = np.zeros_like(leaf.master)
                     keepalive.append(zeros)
-                    self.aio.async_pwrite(zeros, leaf.m_path)
-                    self.aio.async_pwrite(zeros, leaf.v_path)
+                    for path in leaf.paths:
+                        self.aio.async_pwrite(zeros, path)
             errors = self.aio.drain()
             if errors:
                 raise RuntimeError(f"nvme moment-file init failed ({errors} errors)")
             del keepalive
         self.sub_group_size = max(1, sub_group_size)
-        log_dist(f"host offload optimizer: device={offload.device} "
-                 f"leaves={len(self.leaves)} ratio={offload.ratio}", ranks=[0])
+        log_dist(f"host offload optimizer: kernel={kernel_cls.__name__} "
+                 f"device={offload.device} leaves={len(self.leaves)} "
+                 f"ratio={offload.ratio}", ranks=[0])
 
     # --- NVMe swap (reference: _prepare_sub_group / _release_sub_group) -----
     def _swap_in(self, group: List[_LeafState]) -> List[int]:
         reqs = []
         for leaf in group:
-            if leaf.nvme and leaf.m is None:
-                leaf.m = np.empty_like(leaf.master)
-                leaf.v = np.empty_like(leaf.master)
-                reqs.append(self.aio.async_pread(leaf.m, leaf.m_path))
-                reqs.append(self.aio.async_pread(leaf.v, leaf.v_path))
+            if leaf.nvme and leaf.states[0] is None:
+                for s in range(self.n_states):
+                    leaf.states[s] = np.empty_like(leaf.master)
+                    reqs.append(self.aio.async_pread(leaf.states[s], leaf.paths[s]))
         return reqs
 
     def _swap_out(self, group: List[_LeafState]):
         for leaf in group:
             if leaf.nvme:
-                self.aio.async_pwrite(leaf.m, leaf.m_path)
-                self.aio.async_pwrite(leaf.v, leaf.v_path)
-                # buffers dropped after writes drain (see step barrier)
+                for s in range(self.n_states):
+                    self.aio.async_pwrite(leaf.states[s], leaf.paths[s])
+                # buffers dropped only after the writes drain WITHOUT error
                 leaf._pending_drop = True
 
     def step(self, grads_host: List[np.ndarray], lr: Optional[float] = None):
@@ -118,7 +140,7 @@ class HostOffloadOptimizer:
                   for i in range(0, len(self.leaves), self.sub_group_size)]
         grad_groups = [grads_host[i:i + self.sub_group_size]
                        for i in range(0, len(grads_host), self.sub_group_size)]
-        step_shared = self.adam.step_count + 1
+        step_shared = self.kernel.step_count + 1
 
         pending: List[int] = self._swap_in(groups[0]) if groups else []
         for gi, (group, ggrads) in enumerate(zip(groups, grad_groups)):
@@ -128,19 +150,82 @@ class HostOffloadOptimizer:
             # prefetch next sub-group while this one updates
             pending = self._swap_in(groups[gi + 1]) if gi + 1 < len(groups) else []
             for leaf, g in zip(group, ggrads):
-                self.adam.step_count = step_shared - 1
-                self.adam.step(leaf.master.ravel(),
-                               np.ascontiguousarray(g, np.float32).ravel(),
-                               leaf.m.ravel(), leaf.v.ravel(), lr=lr)
+                self.kernel.step_count = step_shared - 1
+                self.kernel.step(leaf.master.ravel(),
+                                 np.ascontiguousarray(g, np.float32).ravel(),
+                                 *[s.ravel() for s in leaf.states], lr=lr)
             self._swap_out(group)
         if hasattr(self, "aio"):
-            self.aio.drain()
-            for leaf in self.leaves:
-                if getattr(leaf, "_pending_drop", False):
-                    leaf.m = None
-                    leaf.v = None
+            failures = self.aio.drain()
+            if failures:
+                # keep the in-RAM copies: the files may be truncated/stale
+                for leaf in self.leaves:
                     leaf._pending_drop = False
-        self.adam.step_count = step_shared
+                raise RuntimeError(
+                    f"nvme optimizer-state swap-out failed ({failures} writes); "
+                    "in-RAM moments retained")
+            for leaf in self.leaves:
+                if leaf._pending_drop:
+                    leaf.states = [None] * self.n_states
+                    leaf._pending_drop = False
+        self.kernel.step_count = step_shared
 
+    # --- views ---------------------------------------------------------------
     def masters(self) -> List[np.ndarray]:
         return [l.master for l in self.leaves]
+
+    def shadows(self, dtype: str = "bfloat16") -> List[np.ndarray]:
+        """Compute-dtype shadow copies for the host→device transfer."""
+        if dtype in ("bfloat16", "bf16"):
+            return [to_bf16(l.master) for l in self.leaves]
+        return [l.master.astype(dtype) for l in self.leaves]
+
+    # --- persistence (consumed by checkpoint/engine.py) ----------------------
+    def _materialized_states(self, leaf: _LeafState) -> List[np.ndarray]:
+        if leaf.nvme and leaf.states[0] is None:
+            reqs = self._swap_in([leaf])
+            for r in reqs:
+                if self.aio.wait(r):
+                    raise RuntimeError("nvme swap-in failed during state export")
+        return [np.asarray(s) for s in leaf.states]
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "step_count": int(self.kernel.step_count),
+            "masters": [l.master for l in self.leaves],
+            "states": [self._materialized_states(l) for l in self.leaves],
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.kernel.step_count = int(sd["step_count"])
+        for leaf, master, states in zip(self.leaves, sd["masters"], sd["states"]):
+            np.copyto(leaf.master, np.asarray(master, np.float32).reshape(
+                leaf.master.shape))
+            buffers = [np.ascontiguousarray(s, np.float32).reshape(leaf.master.shape)
+                       for s in states]
+            if leaf.nvme:
+                for s, buf in enumerate(buffers):
+                    self.aio.async_pwrite(buf, leaf.paths[s])
+                if self.aio.drain():
+                    raise RuntimeError("nvme state restore failed")
+                leaf.states = [None] * self.n_states
+            else:
+                leaf.states = buffers
+
+    def set_masters(self, new_masters: List[np.ndarray], reset_moments: bool = False):
+        """Overwrite masters (checkpoint-load resync). ``reset_moments`` zeroes
+        the moments when the checkpoint carried none."""
+        for leaf, m in zip(self.leaves, new_masters):
+            np.copyto(leaf.master, np.asarray(m, np.float32).reshape(
+                leaf.master.shape))
+            if reset_moments:
+                if leaf.nvme:
+                    zeros = np.zeros_like(leaf.master)
+                    for path in leaf.paths:
+                        self.aio.async_pwrite(zeros, path)
+                    if self.aio.drain():
+                        raise RuntimeError("nvme moment reset failed")
+                    leaf.states = [None] * self.n_states
+                else:
+                    leaf.states = [np.zeros_like(leaf.master)
+                                   for _ in range(self.n_states)]

@@ -109,7 +109,157 @@ def test_zero_infinity_nvme_training(tmp_path, mesh_dp8):
     assert losses[-1] < losses[0]
     # moment files exist on "nvme"
     import glob
-    assert glob.glob(str(tmp_path / "proc0" / "exp_avg_*.bin"))
+    assert glob.glob(str(tmp_path / "proc0" / "state0_*.bin"))
+
+
+def test_offload_unsupported_optimizer_raises(mesh_dp8):
+    """sgd has no fused host kernel — must fail loudly, not silently run Adam."""
+    from deepspeed_tpu.runtime.offload import UnsupportedOffloadOptimizer
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "sgd", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    with pytest.raises(UnsupportedOffloadOptimizer):
+        _train(cfg, steps=0, mesh=mesh_dp8)
+
+
+def test_offload_lion_and_adagrad_train(mesh_dp8):
+    for opt in ("lion", "adagrad"):
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": opt, "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1,
+                                  "offload_optimizer": {"device": "cpu"}},
+        }
+        engine, losses = _train(cfg, mesh=mesh_dp8)
+        assert losses[-1] < losses[0], f"{opt} loss did not decrease: {losses}"
+
+
+def test_offload_device_holds_no_optimizer_state(mesh_dp8):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    engine, _ = _train(cfg, steps=1, mesh=mesh_dp8)
+    import jax
+    assert jax.tree.leaves(engine.state.opt_state) == []  # nothing in HBM
+
+
+def test_offload_checkpoint_roundtrip(tmp_path, mesh_dp8):
+    """save → load restores masters AND host moments; training continues from
+    the restored weights (not stale masters)."""
+    import jax
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    e1, _ = _train(cfg, steps=4, mesh=mesh_dp8, seed=11)
+    moments_before = [s.copy() for leaf in e1._offload.leaves for s in leaf.states]
+    e1.save_checkpoint(str(tmp_path), tag="t0")
+
+    e2, _ = _train(cfg, steps=0, mesh=mesh_dp8, seed=99)  # different init
+    e2.load_checkpoint(str(tmp_path), tag="t0")
+    # masters resynced to the checkpoint
+    for a, b in zip(e1._offload.masters(), e2._offload.masters()):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    # host moments restored
+    moments_after = [s for leaf in e2._offload.leaves for s in leaf.states]
+    for a, b in zip(moments_before, moments_after):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    assert e2._offload.kernel.step_count == e1._offload.kernel.step_count
+    # one more step trains FROM the restored weights (regression: stale masters
+    # used to silently revert the load)
+    p_loaded = [x.copy() for x in e2._offload.masters()]
+    e2.train_batch(batch=random_batch(8, seed=0))
+    drift = sum(float(np.abs(a - b).max())
+                for a, b in zip(p_loaded, e2._offload.masters()))
+    ref_drift = sum(float(np.abs(a - b).max())
+                    for a, b in zip(p_loaded, e1._offload.masters()))
+    assert drift > 0 and drift < 1.0  # moved, but from the loaded point
+
+
+def test_offload_fp16_overflow_skips_step(mesh_dp8):
+    """A non-finite grad must skip the host update and shrink the loss scale —
+    never write NaN into masters/moments."""
+    import jax
+
+    def exploding_model(params, batch, rng):
+        return (params["w"] * np.float32("inf")).sum()
+
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "fp16": {"enabled": True, "initial_scale_power": 4, "hysteresis": 1},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=exploding_model, config=cfg, mesh=mesh_dp8,
+        model_parameters={"w": np.ones((4,), np.float32)})
+    scale_before = engine.cur_scale()
+    engine.train_batch(batch=np.zeros((8, 1), np.float32))
+    assert engine.skipped_steps == 1
+    assert engine.cur_scale() < scale_before
+    for m in engine._offload.masters():
+        assert np.isfinite(m).all()
+    for leaf in engine._offload.leaves:
+        for s in leaf.states:
+            assert np.isfinite(s).all()
+
+
+def test_offload_compat_fwd_bwd_step(mesh_dp8):
+    """forward/backward/step protocol must use the host optimizer too."""
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=64), config=cfg, mesh=mesh_dp8,
+        example_batch=random_batch(4), seed=3)
+    masters_before = [x.copy() for x in engine._offload.masters()]
+    losses = []
+    for i in range(5):
+        loss = engine.forward(random_batch(8, seed=i % 3))
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # masters moved (the host optimizer ran), device params track them
+    moved = sum(float(np.abs(a - b).max())
+                for a, b in zip(masters_before, engine._offload.masters()))
+    assert moved > 0
+    import jax
+    for dev, host in zip(jax.tree.leaves(jax.device_get(engine.state.params)),
+                         engine._offload.masters()):
+        np.testing.assert_allclose(np.asarray(dev, np.float32), host,
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_offload_bf16_shadows_on_device(mesh_dp8):
+    """With bf16 compute, device params are bf16 shadows (half the H2D bytes)."""
+    import jax
+    import jax.numpy as jnp
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    engine, losses = _train(cfg, steps=3, mesh=mesh_dp8)
+    for p in jax.tree.leaves(engine.state.params):
+        assert p.dtype == jnp.bfloat16
+    assert losses[-1] < losses[0]
+    for m in engine._offload.masters():  # masters stay fp32
+        assert m.dtype == np.float32
 
 
 def test_offload_matches_in_hbm_adamw(mesh_dp8):
