@@ -1,0 +1,13 @@
+"""Profiling subsystem (reference: ``deepspeed/profiling/flops_profiler``)."""
+
+from deepspeed_tpu.profiling.flops_profiler import (  # noqa: F401
+    FlopsProfiler,
+    count_flops,
+    duration_to_string,
+    flops_to_string,
+    get_model_profile,
+    macs_to_string,
+    number_to_string,
+    params_to_string,
+    xla_cost_analysis,
+)

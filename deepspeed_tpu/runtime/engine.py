@@ -383,6 +383,9 @@ class DeepSpeedTPUEngine:
             # deterministic rule (no shape-guessing): gas==1 batches are unstacked
             # unless the caller says otherwise
             batch = jax.tree.map(lambda x: np.asarray(x)[None], batch)
+        if (self.config.flops_profiler.enabled
+                and self.global_steps == self.config.flops_profiler.profile_step):
+            self._run_flops_profile(batch)
         if self._offload is not None:
             return self._train_batch_offloaded(batch)
         if self._train_batch_fn is None:
@@ -477,6 +480,21 @@ class DeepSpeedTPUEngine:
                 loss_scale=new_scale)
         self._record_metrics(StepOutput(loss=loss, grad_norm=norm,
                                         lr=jnp.float32(lr), overflow=overflow))
+
+    def _run_flops_profile(self, stacked_batch):
+        """Profile the forward pass at ``profile_step`` (reference: engine.py:1850
+        auto-invokes FlopsProfiler). Abstract trace only — no extra device work."""
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+        fcfg = self.config.flops_profiler
+        micro = jax.tree.map(lambda x: np.asarray(x)[0], stacked_batch)
+        prof = FlopsProfiler(self._compute_loss, params=self.state.params)
+        prof.stop_profile(self.state.params, micro, self._rng)  # abstract trace only
+        prof.print_model_profile(profile_step=self.global_steps,
+                                 module_depth=fcfg.module_depth,
+                                 top_modules=fcfg.top_modules,
+                                 detailed=fcfg.detailed,
+                                 output_file=fcfg.output_file)
+        self.flops_profiler = prof
 
     def _record_metrics(self, out: StepOutput):
         self._last_metrics = {"lr": out.lr, "grad_norm": out.grad_norm,

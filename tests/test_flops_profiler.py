@@ -1,0 +1,131 @@
+"""Flops profiler tests.
+
+Reference analog: ``tests/unit/profiling/flops_profiler/test_flops_profiler.py`` —
+checks counted flops/params on small known models (within tolerance) and that the
+engine auto-profiles at ``profile_step``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    count_flops,
+    flops_to_string,
+    get_model_profile,
+    params_to_string,
+)
+
+
+def test_count_matmul_exact():
+    a = jnp.zeros((8, 64))
+    b = jnp.zeros((64, 32))
+    flops, macs, per_mod = count_flops(lambda x, y: x @ y, a, b)
+    assert macs == 8 * 64 * 32
+    assert flops == 2 * 8 * 64 * 32
+
+
+def test_elementwise_and_reduction():
+    x = jnp.zeros((128,))
+    flops, _, _ = count_flops(lambda v: jnp.sum(v * v), x)
+    assert flops == 128 + 128  # mul + reduce_sum
+
+
+def test_scan_multiplies_body_cost():
+    x = jnp.zeros((16, 16))
+
+    def step(c, _):
+        return c @ x, None
+
+    def fn(v):
+        out, _ = jax.lax.scan(step, v, None, length=5)
+        return out
+
+    flops, macs, _ = count_flops(fn, jnp.zeros((16, 16)))
+    assert macs == 5 * 16 * 16 * 16
+
+
+def test_dense_model_attribution():
+    model = SimpleModel(hidden_dim=32)
+    batch = random_batch(4)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+
+    def fwd(p, b):
+        return model.apply({"params": p}, b)
+
+    flops, macs, per_mod = count_flops(fwd, params, batch)
+    assert macs > 0
+    # flax named_scope attribution: at least one scope mentions a Dense layer
+    assert any(per_mod.values())
+
+
+def test_profiler_api_and_strings():
+    model = SimpleModel(hidden_dim=16)
+    batch = random_batch(2)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+
+    def fwd(p, b):
+        return model.apply({"params": p}, b)
+
+    prof = FlopsProfiler(fwd, params=params)
+    prof.start_profile()
+    fwd(params, batch)
+    prof.stop_profile(params, batch)
+    assert prof.get_total_flops() > 0
+    assert prof.get_total_params() == sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    text = prof.print_model_profile(top_modules=3)
+    assert "Flops Profiler" in text
+    assert "FLOPS" in prof.get_total_flops(as_string=True)
+    prof.end_profile()
+    assert prof.get_total_flops() == 0
+    assert flops_to_string(2.5e12) == "2.5 TFLOPS"
+    assert params_to_string(125e6) == "125.0 M"
+
+
+def test_xla_cost_analysis_close_to_analytic():
+    # pure matmul: analytic == XLA (no fusion to shrink it)
+    a = jnp.zeros((32, 128), jnp.float32)
+    b = jnp.zeros((128, 64), jnp.float32)
+
+    def fn(x, y):
+        return x @ y
+
+    prof = FlopsProfiler(fn)
+    prof.start_profile()
+    prof.stop_profile(a, b)
+    xla = prof.get_xla_flops()
+    if xla:  # cost analysis availability is backend-dependent
+        assert xla == pytest.approx(prof.get_total_flops(), rel=0.01)
+
+
+def test_get_model_profile_oneshot():
+    model = SimpleModel(hidden_dim=16)
+    batch = random_batch(2)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+
+    def fwd(p, b):
+        return model.apply({"params": p}, b)
+
+    flops, macs, n_params = get_model_profile(
+        fwd, args=(params, batch), params=params, print_profile=False)
+    assert flops > 0 and macs > 0 and n_params > 0
+
+
+def test_engine_profiles_at_step():
+    model = SimpleModel(hidden_dim=16)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "flops_profiler": {"enabled": True, "profile_step": 1, "top_modules": 3},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=config, example_batch=random_batch(8))
+    engine.train_batch(batch=random_batch(8))  # step 0 -> 1
+    engine.train_batch(batch=random_batch(8))  # profiles at step 1
+    assert hasattr(engine, "flops_profiler")
+    assert engine.flops_profiler.get_total_flops() > 0
