@@ -1,0 +1,12 @@
+"""Autotuning subsystem (reference: ``deepspeed/autotuning/``)."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, estimate_state_bytes  # noqa: F401
+from deepspeed_tpu.autotuning.scheduler import ExperimentRunner, merge_config  # noqa: F401
+from deepspeed_tpu.autotuning.tuner import (  # noqa: F401
+    BaseTuner,
+    CostModel,
+    Experiment,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+)

@@ -1,0 +1,199 @@
+"""Autotuner orchestration.
+
+Reference analog: ``deepspeed/autotuning/autotuner.py:42`` — explores (ZeRO stage,
+micro-batch size, offload/bucket knobs) to maximize throughput: estimates per-stage
+memory feasibility (``_get_gpu_memory_per_stage``), probes the max micro-batch size,
+then hands candidate configs to a tuner strategy and launches experiments.
+
+TPU redesign: the knob space is (zero stage, micro-batch, remat) — bucket sizes,
+overlap flags, and fetch thresholds don't exist because XLA schedules the collectives.
+Memory feasibility uses an analytic HBM model (params/grads/optimizer-state bytes per
+sharding stage) plus XLA's ``memory_analysis`` when a candidate compiles. Experiments
+run in-process (see scheduler.py).
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.autotuning.scheduler import ExperimentRunner, merge_config
+from deepspeed_tpu.autotuning.tuner import (
+    BaseTuner,
+    Experiment,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+)
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MIN_MBS = 1
+TUNER_CLASSES = {
+    "gridsearch": GridSearchTuner,
+    "random": RandomTuner,
+    "model_based": ModelBasedTuner,
+}
+
+
+def estimate_state_bytes(n_params: int, stage: int, fsdp_size: int,
+                         compute_bytes: int = 2) -> int:
+    """Analytic per-device bytes for params + grads + Adam states under a ZeRO stage
+    (reference: autotuner.py get_instantiation_memory_required_per_gpu).
+
+    stage 0: everything replicated; 1: optimizer states sharded; 2: +grads sharded;
+    3: +params sharded. Optimizer master+moments = 3 x fp32.
+    """
+    opt = 12 * n_params  # fp32 master + m + v
+    grads = 4 * n_params  # fp32 grad accumulation
+    params = compute_bytes * n_params
+    if stage >= 1:
+        opt //= fsdp_size
+    if stage >= 2:
+        grads //= fsdp_size
+    if stage >= 3:
+        params //= fsdp_size
+    return params + grads + opt
+
+
+class Autotuner:
+    """Find the best (zero stage, micro batch) config for a model on this mesh.
+
+    Usage::
+
+        tuner = Autotuner(model, base_config, batch_fn=random_batch)
+        best_config, best_metrics = tuner.tune()
+    """
+
+    def __init__(self, model, base_config: Dict[str, Any],
+                 batch_fn: Callable[[int], Any], mesh=None,
+                 loss_fn: Optional[Callable] = None,
+                 example_batch: Any = None,
+                 metric: str = "throughput",
+                 tuner_type: str = "model_based",
+                 zero_stages: Optional[List[int]] = None,
+                 max_micro_batch: int = 64,
+                 num_micro_batches: int = 4,
+                 try_remat: bool = False,
+                 warmup_steps: int = 1, measure_steps: int = 3,
+                 n_trials: int = 50, early_stopping: int = 0,
+                 results_dir: Optional[str] = None,
+                 hbm_bytes: Optional[int] = None):
+        self.model = model
+        self.base_config = dict(base_config)
+        if metric not in ExperimentRunner.METRICS:
+            raise ValueError(f"unknown autotuning metric {metric!r}; "
+                             f"one of {ExperimentRunner.METRICS}")
+        self.metric = metric
+        self.tuner_type = tuner_type
+        self.zero_stages = zero_stages if zero_stages is not None else [0, 1, 2, 3]
+        self.max_micro_batch = max_micro_batch
+        self.num_micro_batches = num_micro_batches
+        self.try_remat = try_remat
+        self.n_trials = n_trials
+        self.early_stopping = early_stopping
+        self.results_dir = results_dir
+        self.hbm_bytes = hbm_bytes
+        self.runner = ExperimentRunner(
+            model, batch_fn, self.base_config, mesh=mesh, loss_fn=loss_fn,
+            warmup_steps=warmup_steps, measure_steps=measure_steps)
+        self._example_batch = example_batch if example_batch is not None else batch_fn(1)
+        self.records: List[Experiment] = []
+
+    # ------------------------------------------------------------------
+    def model_info(self) -> Dict[str, Any]:
+        """Param count without materializing weights (reference: autotuner
+        ``_generate_experiments`` model info probe)."""
+        if not hasattr(self.model, "init"):
+            return {"num_params": 0}
+        shapes = jax.eval_shape(
+            lambda r: self.model.init(r, self._example_batch),
+            jax.random.PRNGKey(0))
+        n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
+        return {"num_params": n}
+
+    def feasible_stages(self, fsdp_size: int) -> List[int]:
+        """Prune stages whose *static* state already exceeds HBM (analytic)."""
+        if not self.hbm_bytes:
+            return list(self.zero_stages)
+        n = self.model_info()["num_params"]
+        keep = [s for s in self.zero_stages
+                if estimate_state_bytes(n, s, fsdp_size) < self.hbm_bytes]
+        return keep or [max(self.zero_stages)]
+
+    def _mbs_candidates(self) -> List[int]:
+        """Log-spaced micro-batch sizes up to max (reference:
+        _get_min_micro_batch_size/_get_max_micro_batch_size probe then interpolate)."""
+        cands = []
+        m = DEFAULT_MIN_MBS
+        while m <= self.max_micro_batch:
+            cands.append(m)
+            m *= 2
+        if len(cands) > self.num_micro_batches:
+            idx = np.linspace(0, len(cands) - 1, self.num_micro_batches)
+            cands = [cands[int(round(i))] for i in idx]
+        return sorted(set(cands))
+
+    def generate_experiments(self, stages: List[int]) -> List[Experiment]:
+        exps = []
+        for stage in stages:
+            for mbs in self._mbs_candidates():
+                variants = [False, True] if self.try_remat else [False]
+                for remat in variants:
+                    name = f"z{stage}_mbs{mbs}" + ("_remat" if remat else "")
+                    ov: Dict[str, Any] = {
+                        "zero_optimization": {"stage": stage},
+                        "train_micro_batch_size_per_gpu": mbs,
+                        "gradient_accumulation_steps":
+                            self.base_config.get("gradient_accumulation_steps", 1),
+                    }
+                    if remat:
+                        ov["activation_checkpointing"] = {"policy": "nothing_saveable"}
+                    exps.append(Experiment(name, ov))
+        return exps
+
+    # ------------------------------------------------------------------
+    def tune(self) -> Tuple[Optional[Dict[str, Any]], Dict[str, float]]:
+        fsdp = 1
+        mesh = self.runner.mesh
+        if mesh is not None:
+            fsdp = int(np.prod([mesh.shape.get(a, 1) for a in ("fsdp", "data")]))
+        stages = self.feasible_stages(fsdp)
+        exps = self.generate_experiments(stages)
+        logger.info(f"autotuning: {len(exps)} candidates over stages {stages}, "
+                    f"metric={self.metric}, tuner={self.tuner_type}")
+        tuner_cls = TUNER_CLASSES.get(self.tuner_type)
+        if tuner_cls is None:
+            raise ValueError(f"unknown tuner {self.tuner_type!r}; "
+                             f"one of {sorted(TUNER_CLASSES)}")
+        higher = self.metric != "latency"
+        tuner: BaseTuner = tuner_cls(exps, self.runner, metric=self.metric,
+                                     higher_is_better=higher)
+        best = tuner.tune(n_trials=self.n_trials,
+                          early_stopping=self.early_stopping)
+        self.records = tuner.records
+        self._write_results(best)
+        if best is None:
+            return None, {}
+        best_config = merge_config(self.base_config, best.overrides)
+        return best_config, dict(best.metrics)
+
+    def _write_results(self, best: Optional[Experiment]):
+        if not self.results_dir or jax.process_index() != 0:
+            return
+        os.makedirs(self.results_dir, exist_ok=True)
+        out = {
+            "metric": self.metric,
+            "best": None if best is None else
+                {"name": best.name, "overrides": best.overrides,
+                 "metrics": best.metrics},
+            "experiments": [
+                {"name": e.name, "status": e.status, "metrics": e.metrics,
+                 "overrides": e.overrides, "error": e.error}
+                for e in self.records],
+        }
+        path = os.path.join(self.results_dir, "autotuning_results.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        logger.info(f"autotuning results written to {path}")

@@ -1,0 +1,93 @@
+"""In-process experiment runner.
+
+Reference analog: ``deepspeed/autotuning/scheduler.py`` — ``ResourceManager`` launches
+each candidate config as a separate multi-node job via the launcher and scrapes metric
+files the exit hook writes.
+
+TPU redesign: an experiment is a fresh engine built from (base config ⊕ overrides) and
+timed in-process — SPMD means one process sees the whole mesh, so there is no job
+launch / ssh layer to orchestrate. OOM (RESOURCE_EXHAUSTED) and compile failures are
+caught per-experiment and recorded, mirroring the reference's failed-experiment
+bookkeeping, so a failing candidate never kills the sweep.
+"""
+
+import copy
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from deepspeed_tpu.autotuning.tuner import Experiment
+from deepspeed_tpu.utils.logging import logger
+
+
+def merge_config(base: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive dict merge, overrides win (reference: autotuner replaces whole
+    sections; nested merge lets overrides stay minimal)."""
+    out = copy.deepcopy(base)
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = merge_config(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class ExperimentRunner:
+    """Builds an engine per experiment and measures step time.
+
+    ``batch_fn(global_batch_size) -> batch`` supplies data shaped for the candidate's
+    batch size. Metrics recorded: ``latency`` (s/step) and ``throughput``
+    (samples/s).
+    """
+
+    METRICS = ("latency", "throughput")
+
+    def __init__(self, model, batch_fn: Callable[[int], Any],
+                 base_config: Dict[str, Any], mesh=None,
+                 loss_fn: Optional[Callable] = None,
+                 warmup_steps: int = 1, measure_steps: int = 3):
+        self.model = model
+        self.batch_fn = batch_fn
+        self.base_config = base_config
+        self.mesh = mesh
+        self.loss_fn = loss_fn
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+
+    def __call__(self, exp: Experiment) -> Experiment:
+        import deepspeed_tpu  # late import: avoid cycle at package init
+
+        exp.status = "running"
+        cfg = merge_config(self.base_config, exp.overrides)
+        # autotuner owns the batch triple: derive train_batch from mbs x gas x dp
+        cfg.pop("train_batch_size", None)
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model, config=cfg, mesh=self.mesh,
+                loss_fn=self.loss_fn,
+                example_batch=self.batch_fn(1))
+            batch = self.batch_fn(engine.train_batch_size)
+            for _ in range(self.warmup_steps):
+                engine.train_batch(batch=batch)
+            jax.block_until_ready(engine.state.params)
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                loss = engine.train_batch(batch=batch)
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / self.measure_steps
+            exp.metrics = {
+                "latency": dt,
+                "throughput": engine.train_batch_size / dt,
+                "train_batch_size": float(engine.train_batch_size),
+            }
+            exp.status = "done"
+        except Exception as e:  # noqa: BLE001 — any candidate may legally fail
+            msg = str(e)
+            exp.error = msg
+            oom = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                   or "out of memory" in msg)
+            exp.status = "oom" if oom else "failed"
+            logger.warning(f"autotuning experiment {exp.name} {exp.status}: "
+                           f"{msg.splitlines()[0] if msg else e!r}")
+        return exp

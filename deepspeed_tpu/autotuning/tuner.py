@@ -1,0 +1,145 @@
+"""Tuner strategies + cost model.
+
+Reference analog: ``deepspeed/autotuning/tuner/{base_tuner.py,index_based_tuner.py,
+model_based_tuner.py,cost_model.py}`` — grid/random tuners plus an XGBoost cost model
+that predicts experiment metrics from config features to order the search.
+
+TPU redesign: same strategy split, but the cost model is a closed-form least-squares
+fit (polynomial in log micro-batch + one-hot ZeRO stage) — no heavyweight ML dep, and
+the search space here is small because sharding layouts replace most of the
+reference's offload/bucket knobs.
+"""
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Experiment:
+    """One candidate config + its measured result."""
+
+    def __init__(self, name: str, overrides: Dict[str, Any]):
+        self.name = name
+        self.overrides = overrides  # config-dict fragment merged over the base
+        self.status = "pending"    # pending | running | done | failed | oom
+        self.metrics: Dict[str, float] = {}
+        self.error: Optional[str] = None
+
+    def metric(self, key: str) -> Optional[float]:
+        return self.metrics.get(key)
+
+    def __repr__(self):
+        return (f"Experiment({self.name}, status={self.status}, "
+                f"metrics={self.metrics})")
+
+
+def _features(exp: Experiment) -> List[float]:
+    mbs = float(exp.overrides.get("train_micro_batch_size_per_gpu", 1))
+    stage = int(exp.overrides.get("zero_optimization", {}).get("stage", 0))
+    onehot = [1.0 if stage == s else 0.0 for s in range(4)]
+    return [1.0, np.log2(max(mbs, 1.0)), np.log2(max(mbs, 1.0)) ** 2] + onehot
+
+
+class CostModel:
+    """Least-squares regression metric ~ features (reference: cost_model.py
+    XGBoostCostModel.fit/predict)."""
+
+    def __init__(self):
+        self._w: Optional[np.ndarray] = None
+
+    def fit(self, exps: Sequence[Experiment], metric: str):
+        pts = [(e, e.metrics[metric]) for e in exps
+               if e.status == "done" and metric in e.metrics]
+        if len(pts) < 2:
+            self._w = None
+            return
+        X = np.array([_features(e) for e, _ in pts])
+        y = np.array([v for _, v in pts])
+        self._w, *_ = np.linalg.lstsq(X, y, rcond=None)
+
+    def predict(self, exp: Experiment) -> float:
+        if self._w is None:
+            return 0.0
+        return float(np.array(_features(exp)) @ self._w)
+
+
+class BaseTuner:
+    """Pulls experiments, runs them via ``runner``, tracks the best (reference:
+    base_tuner.py ``BaseTuner.tune`` with early stopping)."""
+
+    def __init__(self, exps: List[Experiment], runner: Callable[[Experiment], None],
+                 metric: str = "throughput", higher_is_better: bool = True):
+        self.all_exps = list(exps)
+        self.runner = runner
+        self.metric = metric
+        self.higher_is_better = higher_is_better
+        self.best_exp: Optional[Experiment] = None
+        self.records: List[Experiment] = []
+
+    def next_batch(self, n: int) -> List[Experiment]:
+        batch, self.all_exps = self.all_exps[:n], self.all_exps[n:]
+        return batch
+
+    def has_next(self) -> bool:
+        return bool(self.all_exps)
+
+    def _better(self, a: float, b: float) -> bool:
+        return a > b if self.higher_is_better else a < b
+
+    def update_best(self, exp: Experiment):
+        v = exp.metric(self.metric)
+        if v is None:
+            return
+        if self.best_exp is None or self._better(v, self.best_exp.metrics[self.metric]):
+            self.best_exp = exp
+
+    def tune(self, sample_size: int = 1, n_trials: int = 50,
+             early_stopping: int = 0) -> Optional[Experiment]:
+        trials = 0
+        since_best = 0
+        while self.has_next() and trials < n_trials:
+            for exp in self.next_batch(sample_size):
+                self.runner(exp)
+                self.records.append(exp)
+                prev_best = self.best_exp
+                self.update_best(exp)
+                trials += 1
+                since_best = 0 if self.best_exp is not prev_best else since_best + 1
+            if early_stopping and since_best >= early_stopping:
+                break
+        return self.best_exp
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive, in given order (reference: index_based_tuner.py)."""
+
+
+class RandomTuner(BaseTuner):
+    """Uniform random order (reference: index_based_tuner.py RandomTuner)."""
+
+    def __init__(self, *args, seed: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        random.Random(seed).shuffle(self.all_exps)
+
+
+class ModelBasedTuner(BaseTuner):
+    """Seed with a few measured points, then repeatedly re-fit the cost model and
+    run the unexplored candidate with the best predicted metric (reference:
+    model_based_tuner.py ``find_estimated_top_configs``)."""
+
+    def __init__(self, *args, seed_trials: int = 3, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.seed_trials = seed_trials
+        self.cost_model = CostModel()
+
+    def next_batch(self, n: int) -> List[Experiment]:
+        if len(self.records) < self.seed_trials or not self.all_exps:
+            return super().next_batch(n)
+        self.cost_model.fit(self.records, self.metric)
+        scored = sorted(self.all_exps, key=self.cost_model.predict,
+                        reverse=self.higher_is_better)
+        batch = scored[:n]
+        for b in batch:
+            self.all_exps.remove(b)
+        return batch

@@ -1,0 +1,136 @@
+"""Autotuning tests.
+
+Reference analog: ``tests/unit/autotuning/test_autotuning.py`` — tuner strategy
+behavior and experiment bookkeeping on tiny search spaces, no real cluster runs.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (
+    Autotuner,
+    CostModel,
+    Experiment,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+    estimate_state_bytes,
+    merge_config,
+)
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+BASE = {"optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "gradient_accumulation_steps": 1}
+
+
+def _mk_exps(mbs_list, stage=1):
+    return [Experiment(f"z{stage}_mbs{m}",
+                       {"zero_optimization": {"stage": stage},
+                        "train_micro_batch_size_per_gpu": m})
+            for m in mbs_list]
+
+
+def _synthetic_runner(peak_mbs=8):
+    """Throughput rises then falls around peak_mbs; deterministic."""
+    def run(exp):
+        mbs = exp.overrides["train_micro_batch_size_per_gpu"]
+        exp.metrics = {"throughput": 100.0 - (np.log2(mbs) - np.log2(peak_mbs)) ** 2,
+                       "latency": 1.0 + abs(mbs - peak_mbs)}
+        exp.status = "done"
+    return run
+
+
+def test_merge_config_nested():
+    out = merge_config({"a": {"x": 1, "y": 2}, "b": 3}, {"a": {"y": 9}, "c": 4})
+    assert out == {"a": {"x": 1, "y": 9}, "b": 3, "c": 4}
+
+
+def test_grid_search_finds_best():
+    exps = _mk_exps([1, 2, 4, 8, 16, 32])
+    t = GridSearchTuner(exps, _synthetic_runner(), metric="throughput")
+    best = t.tune()
+    assert best.overrides["train_micro_batch_size_per_gpu"] == 8
+    assert len(t.records) == 6
+
+
+def test_random_tuner_explores_all():
+    exps = _mk_exps([1, 2, 4, 8])
+    t = RandomTuner(exps, _synthetic_runner(), metric="latency",
+                    higher_is_better=False, seed=3)
+    best = t.tune()
+    assert best.overrides["train_micro_batch_size_per_gpu"] == 8
+    assert len(t.records) == 4
+
+
+def test_early_stopping_limits_trials():
+    exps = _mk_exps([8, 16, 32, 1, 2, 4])  # best first -> stops early
+    t = GridSearchTuner(exps, _synthetic_runner(), metric="throughput")
+    t.tune(early_stopping=2)
+    assert len(t.records) < 6
+
+
+def test_cost_model_orders_candidates():
+    train = _mk_exps([1, 2, 32])
+    run = _synthetic_runner()
+    for e in train:
+        run(e)
+    cm = CostModel()
+    cm.fit(train, "throughput")
+    lo, hi = _mk_exps([1])[0], _mk_exps([4])[0]
+    assert cm.predict(hi) > cm.predict(lo)
+
+
+def test_model_based_tuner_converges_with_budget():
+    exps = _mk_exps([1, 2, 4, 8, 16, 32, 64, 128])
+    t = ModelBasedTuner(exps, _synthetic_runner(), metric="throughput",
+                        seed_trials=3)
+    best = t.tune(n_trials=6)
+    assert best.overrides["train_micro_batch_size_per_gpu"] == 8
+
+
+def test_estimate_state_bytes_monotone_in_stage():
+    n = 1_000_000
+    vals = [estimate_state_bytes(n, s, fsdp_size=8) for s in range(4)]
+    assert vals[0] > vals[1] > vals[2] > vals[3]
+    assert vals[0] == (2 + 4 + 12) * n
+
+
+def test_autotuner_end_to_end(tmp_path, mesh_dp8):
+    model = SimpleModel(hidden_dim=16)
+    tuner = Autotuner(
+        model, BASE, batch_fn=random_batch, mesh=mesh_dp8,
+        zero_stages=[0, 1], max_micro_batch=2, num_micro_batches=2,
+        tuner_type="gridsearch", warmup_steps=1, measure_steps=1,
+        results_dir=str(tmp_path))
+    info = tuner.model_info()
+    assert info["num_params"] > 0
+    best_config, metrics = tuner.tune()
+    assert best_config is not None
+    assert metrics["throughput"] > 0
+    assert best_config["zero_optimization"]["stage"] in (0, 1)
+    results = json.loads((tmp_path / "autotuning_results.json").read_text())
+    assert results["best"] is not None
+    assert len(results["experiments"]) == 4  # 2 stages x 2 mbs
+    assert all(e["status"] == "done" for e in results["experiments"])
+
+
+def test_autotuner_survives_failing_candidate(mesh_dp8):
+    model = SimpleModel(hidden_dim=16)
+    tuner = Autotuner(model, {**BASE, "optimizer": {"type": "nope", "params": {}}},
+                      batch_fn=random_batch, mesh=mesh_dp8,
+                      zero_stages=[0], max_micro_batch=1, num_micro_batches=1,
+                      tuner_type="gridsearch")
+    best_config, metrics = tuner.tune()
+    assert best_config is None
+    assert tuner.records[0].status in ("failed", "oom")
+
+
+def test_feasible_stages_pruned_by_hbm():
+    model = SimpleModel(hidden_dim=64)
+    tuner = Autotuner(model, BASE, batch_fn=random_batch,
+                      zero_stages=[0, 1, 2, 3], hbm_bytes=1)  # nothing fits
+    stages = tuner.feasible_stages(fsdp_size=8)
+    assert stages == [3]  # falls back to the most-sharded stage
