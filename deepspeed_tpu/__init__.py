@@ -81,13 +81,23 @@ def initialize(args=None,
     return engine, engine.tx, dataloader, engine.lr_schedule
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """reference: deepspeed.init_inference (deepspeed/__init__.py:291)."""
+def init_inference(model=None, config=None, params=None, mesh=None,
+                   tensor_rules=None, **kwargs):
+    """reference: deepspeed.init_inference (deepspeed/__init__.py:291).
+
+    When ``tensor_rules`` is not given and tp_size > 1, AutoTP resolves a policy
+    from the model's architecture (reference: auto-injection via
+    ``replace_transformer_layer``/``AutoTP``, module_inject/replace_module.py:183).
+    """
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import InferenceConfig
     inf_config = config if isinstance(config, InferenceConfig) \
         else InferenceConfig(**(config or {}), **kwargs)
-    return InferenceEngine(model, inf_config)
+    if tensor_rules is None and inf_config.tp_size > 1:
+        from deepspeed_tpu.module_inject.auto_tp import AutoTP
+        tensor_rules = AutoTP.infer_rules(model, params=params)
+    return InferenceEngine(model, inf_config, params=params, mesh=mesh,
+                           tensor_rules=tensor_rules)
 
 
 def add_config_arguments(parser):

@@ -37,8 +37,10 @@ class Experiment:
 def _features(exp: Experiment) -> List[float]:
     mbs = float(exp.overrides.get("train_micro_batch_size_per_gpu", 1))
     stage = int(exp.overrides.get("zero_optimization", {}).get("stage", 0))
+    remat = 1.0 if exp.overrides.get("activation_checkpointing") else 0.0
     onehot = [1.0 if stage == s else 0.0 for s in range(4)]
-    return [1.0, np.log2(max(mbs, 1.0)), np.log2(max(mbs, 1.0)) ** 2] + onehot
+    return ([1.0, np.log2(max(mbs, 1.0)), np.log2(max(mbs, 1.0)) ** 2, remat]
+            + onehot)
 
 
 class CostModel:

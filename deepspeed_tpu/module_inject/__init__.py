@@ -1,0 +1,19 @@
+"""Module injection / automatic tensor parallelism
+(reference: ``deepspeed/module_inject/``)."""
+
+from deepspeed_tpu.module_inject.auto_tp import GENERIC_POLICY, AutoTP  # noqa: F401
+from deepspeed_tpu.module_inject.fusedqkv_utils import (  # noqa: F401
+    shard_qkv_param,
+    split_fused_qkv,
+    unfuse_qkv,
+)
+from deepspeed_tpu.module_inject.layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from deepspeed_tpu.module_inject.policies import (  # noqa: F401
+    POLICIES,
+    TPPolicy,
+    get_policy,
+)

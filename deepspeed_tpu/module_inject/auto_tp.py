@@ -1,0 +1,77 @@
+"""Automatic tensor parallelism.
+
+Reference analog: ``deepspeed/module_inject/auto_tp.py:189`` (``AutoTP``) — for models
+without a hand-written policy it walks the module graph, classifies every ``Linear``
+as all-reduce (row) or partitioned (column) by name heuristics
+(``tp_parser``/``update_policy_list``), and swaps in ``LinearAllreduce``/
+``LinearLayer`` shards.
+
+TPU redesign: the classifier runs over the *parameter pytree* (there is no module
+graph to mutate — sharding specs do the work). ``AutoTP.infer_rules`` first tries the
+per-arch policy registry, then falls back to generic name heuristics covering the
+common transformer vocabulary; anything unmatched stays replicated, which is always
+correct (just not sharded).
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.module_inject.policies import (
+    POLICIES,
+    TENSOR_AXIS,
+    TPPolicy,
+    get_policy,
+)
+from deepspeed_tpu.utils.logging import logger
+
+# Generic fallback vocabulary (reference: auto_tp.py tp_parser's allreduce-name list,
+# e.g. 'o_proj', 'out_proj', 'down_proj', 'dense_4h_to_h', 'attention.dense' ...)
+GENERIC_POLICY = TPPolicy(
+    "generic",
+    column=("q_proj", "k_proj", "v_proj", "query", "key", "value",
+            "gate_proj", "up_proj", "fc1", "fc_in", "dense_h_to_4h",
+            "wq/", "wk/", "wv/", "w_gate", "w_up", "wi/", "col_"),
+    row=("o_proj", "out_proj", "down_proj", "fc2", "fc_out", "dense_4h_to_h",
+         "attention/dense", "self_attention/dense", "wo/", "w_down", "row_"),
+    fused_qkv=("query_key_value", "qkv_proj", "c_attn", "W_pack"),
+)
+
+
+class AutoTP:
+    """Policy resolution + generic fallback (reference: AutoTP auto_tp.py:189)."""
+
+    @staticmethod
+    def get_policy(model_or_arch) -> Optional[TPPolicy]:
+        if isinstance(model_or_arch, str):
+            return get_policy(model_or_arch)
+        # flax module / any object: try class name, then HF-style config.model_type
+        pol = get_policy(type(model_or_arch).__name__)
+        if pol is None:
+            mt = getattr(getattr(model_or_arch, "config", None), "model_type", None)
+            if isinstance(mt, str):
+                pol = get_policy(mt)
+        return pol
+
+    @staticmethod
+    def infer_rules(model_or_arch=None, params: Any = None) -> Callable:
+        """Return a ``tensor_rules`` callable: the arch policy when known, else the
+        generic heuristic. With ``params`` given, logs how much matched (the
+        reference prints the resolved policy list the same way)."""
+        policy = None
+        if model_or_arch is not None:
+            policy = AutoTP.get_policy(model_or_arch)
+        if policy is None:
+            policy = GENERIC_POLICY
+        rules = policy.tensor_rules()
+        if params is not None:
+            leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+            matched = sum(1 for path, leaf in leaves
+                          if rules(path, leaf) is not None)
+            logger.info(f"AutoTP[{policy.arch}]: sharding {matched}/{len(leaves)} "
+                        f"parameter tensors over the '{TENSOR_AXIS}' axis")
+        return rules
+
+    @staticmethod
+    def supported_archs() -> list:
+        return sorted(POLICIES)

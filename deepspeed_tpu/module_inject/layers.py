@@ -1,0 +1,92 @@
+"""Megatron-style parallel layers.
+
+Reference analog: ``deepspeed/module_inject/layers.py`` (``LinearLayer``,
+``LinearAllreduce``, ``EmbeddingLayer``) — the building blocks AutoTP swaps in, with
+hand-written all-reduces after row-parallel matmuls.
+
+TPU redesign: the same blocks as flax modules whose parameter names carry the
+``col_``/``row_`` markers the generic AutoTP policy recognizes, plus activation
+sharding constraints; under jit, XLA inserts the reduce (psum) a row-parallel matmul
+needs — there is no explicit ``dist.all_reduce`` call to write.
+"""
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.comm.mesh import get_global_mesh
+
+TENSOR_AXIS = "tensor"
+
+
+def _constrain(x, spec: Tuple):
+    mesh = get_global_mesh()
+    if mesh is None or mesh.shape.get(TENSOR_AXIS, 1) == 1:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+class ColumnParallelLinear(nn.Module):
+    """Output-dim sharded linear (reference: LinearLayer). The kernel parameter is
+    named ``col_kernel`` so AutoTP's generic rules shard its last dim on the
+    ``tensor`` axis; the activation constraint keeps the output sharded (the
+    following RowParallelLinear consumes it without a gather)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("col_kernel", self.kernel_init,
+                            (x.shape[-1], self.features))
+        y = jnp.dot(x.astype(self.dtype or x.dtype),
+                    kernel.astype(self.dtype or kernel.dtype))
+        if self.use_bias:
+            bias = self.param("col_bias", nn.initializers.zeros, (self.features,))
+            y = y + bias.astype(y.dtype)
+        return _constrain(y, (None,) * (y.ndim - 1) + (TENSOR_AXIS,))
+
+
+class RowParallelLinear(nn.Module):
+    """Input-dim sharded linear (reference: LinearAllreduce). The contraction over
+    the sharded input dim makes XLA emit the psum the reference writes as
+    ``dist.inference_all_reduce``; bias is added after the reduce (replicated)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("row_kernel", self.kernel_init,
+                            (x.shape[-1], self.features))
+        y = jnp.dot(x.astype(self.dtype or x.dtype),
+                    kernel.astype(self.dtype or kernel.dtype))
+        y = _constrain(y, (None,) * y.ndim)  # post-reduce: replicated
+        if self.use_bias:
+            bias = self.param("row_bias", nn.initializers.zeros, (self.features,))
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Vocab-dim sharded embedding table (reference: EmbeddingLayer sharded by
+    AutoTP's vocab rule). Lookup over a sharded table is a gather XLA handles."""
+
+    num_embeddings: int
+    features: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param("embedding", nn.initializers.normal(stddev=0.02),
+                           (self.num_embeddings, self.features))
+        out = jnp.take(table.astype(self.dtype or table.dtype), ids, axis=0)
+        return out

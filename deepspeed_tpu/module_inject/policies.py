@@ -1,0 +1,162 @@
+"""Tensor-parallel injection policies.
+
+Reference analog: ``deepspeed/module_inject/replace_policy.py`` + the per-arch
+containers (``module_inject/containers/{llama,bloom,gptneox,opt,...}.py``) — each
+policy tells the injector which sub-layers are column-parallel (qkv/up projections),
+which are row-parallel (output/down projections), and how fused-QKV weights split.
+
+TPU redesign: a policy compiles down to a ``tensor_rules(path, leaf) -> PartitionSpec``
+function (the contract consumed by ``runtime/zero/partition.py build_param_shardings``
+and the engines) instead of swapping ``nn.Module`` objects — XLA inserts the
+all-gather/all-reduce collectives that ``LinearLayer``/``LinearAllreduce`` hand-code
+in the reference (``module_inject/layers.py``).
+"""
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec
+
+TENSOR_AXIS = "tensor"
+
+
+@dataclasses.dataclass(frozen=True)
+class TPPolicy:
+    """Name-pattern driven TP sharding policy for one model architecture.
+
+    Patterns are substrings matched against the '/'-joined parameter path.
+    ``column``: shard the output (last) dim; ``row``: shard the input (first) dim;
+    ``vocab_in``: embedding tables [vocab, embed] sharded on dim 0;
+    ``vocab_out``: lm-head kernels [embed, vocab] sharded on the last dim;
+    ``fused_qkv``: column-parallel fused QKV weights — need
+    ``fusedqkv_utils.split_fused_qkv`` at weight-load time when head counts differ
+    (GQA), sharded on the last dim like any column layer.
+    """
+
+    arch: str
+    column: Tuple[str, ...] = ()
+    row: Tuple[str, ...] = ()
+    vocab_in: Tuple[str, ...] = ("embed_tokens", "word_embeddings", "wte", "embed/embedding")
+    vocab_out: Tuple[str, ...] = ("lm_head", "embed_out")
+    fused_qkv: Tuple[str, ...] = ()
+
+    def tensor_rules(self) -> Callable:
+        """Compile to the ``tensor_rules(path, leaf)`` contract."""
+
+        def rules(path, leaf) -> Optional[PartitionSpec]:
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+            ndim = np.ndim(leaf)
+            if ndim == 0:
+                return None
+            if any(p in name for p in self.fused_qkv) or \
+                    any(p in name for p in self.column):
+                if ndim == 1:  # bias of a column layer: sharded with the outputs
+                    return PartitionSpec(TENSOR_AXIS)
+                return PartitionSpec(*([None] * (ndim - 1)), TENSOR_AXIS)
+            if any(p in name for p in self.row):
+                if ndim == 1:  # bias of a row layer: added post-reduce, replicated
+                    return None
+                return PartitionSpec(TENSOR_AXIS, *([None] * (ndim - 1)))
+            if any(p in name for p in self.vocab_in) and ndim >= 2:
+                return PartitionSpec(TENSOR_AXIS, *([None] * (ndim - 1)))
+            if any(p in name for p in self.vocab_out) and ndim >= 2:
+                return PartitionSpec(*([None] * (ndim - 1)), TENSOR_AXIS)
+            return None
+
+        return rules
+
+
+# ---------------------------------------------------------------------------
+# Registry (reference: replace_policy.py replace_policies list + containers/)
+# Patterns include both HF module names and our native model zoo's names.
+# ---------------------------------------------------------------------------
+
+_LLAMA_LIKE = dict(
+    column=("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj",
+            "wq/", "wk/", "wv/", "w_gate", "w_up"),
+    row=("o_proj", "down_proj", "wo/", "w_down"),
+)
+
+POLICIES = {
+    "llama": TPPolicy("llama", **_LLAMA_LIKE),
+    "mistral": TPPolicy("mistral", **_LLAMA_LIKE),
+    "internlm": TPPolicy("internlm", **_LLAMA_LIKE),
+    "baichuan": TPPolicy("baichuan", fused_qkv=("W_pack",), **_LLAMA_LIKE),
+    "qwen2": TPPolicy("qwen2", **_LLAMA_LIKE),
+    "mixtral": TPPolicy(
+        "mixtral",
+        column=_LLAMA_LIKE["column"] + ("w1/", "w3/", "experts/wi"),
+        row=_LLAMA_LIKE["row"] + ("w2/", "experts/wo")),
+    "qwen2_moe": TPPolicy(
+        "qwen2_moe",
+        column=_LLAMA_LIKE["column"] + ("w1/", "w3/", "experts/wi", "shared_expert"),
+        row=_LLAMA_LIKE["row"] + ("w2/", "experts/wo")),
+    "phi": TPPolicy(
+        "phi",
+        column=("q_proj", "k_proj", "v_proj", "fc1"),
+        row=("dense", "fc2")),
+    "phi3": TPPolicy(
+        "phi3",
+        column=("gate_up_proj",),
+        row=("o_proj", "down_proj"),
+        fused_qkv=("qkv_proj",)),
+    "falcon": TPPolicy(
+        "falcon",
+        column=("dense_h_to_4h",),
+        row=("self_attention/dense", "dense_4h_to_h"),
+        fused_qkv=("query_key_value",)),
+    "gpt_neox": TPPolicy(
+        "gpt_neox",
+        column=("dense_h_to_4h",),
+        row=("attention/dense", "dense_4h_to_h"),
+        fused_qkv=("query_key_value",)),
+    "bloom": TPPolicy(
+        "bloom",
+        column=("dense_h_to_4h",),
+        row=("self_attention/dense", "dense_4h_to_h"),
+        fused_qkv=("query_key_value",)),
+    "gpt2": TPPolicy(
+        "gpt2",
+        column=("c_fc",),
+        row=("attn/c_proj", "mlp/c_proj"),
+        fused_qkv=("c_attn",)),
+    "gptj": TPPolicy(
+        "gptj",
+        column=("q_proj", "k_proj", "v_proj", "fc_in"),
+        row=("out_proj", "fc_out")),
+    "opt": TPPolicy(
+        "opt",
+        column=("q_proj", "k_proj", "v_proj", "fc1"),
+        row=("out_proj", "fc2")),
+    "bert": TPPolicy(
+        "bert",
+        column=("query", "key", "value", "intermediate/dense"),
+        row=("attention/output/dense", "output/dense")),
+}
+
+# aliases: HF model_type / class-name spellings -> canonical key
+_ALIASES = {
+    "llamaforcausallm": "llama", "llamamodel": "llama",
+    "mistralforcausallm": "mistral",
+    "mixtralforcausallm": "mixtral",
+    "qwen2forcausallm": "qwen2",
+    "qwen2moeforcausallm": "qwen2_moe",
+    "phiforcausallm": "phi", "phi3forcausallm": "phi3",
+    "falconforcausallm": "falcon", "rwforcausallm": "falcon",
+    "gptneoxforcausallm": "gpt_neox",
+    "bloomforcausallm": "bloom",
+    "gpt2lmheadmodel": "gpt2",
+    "gptjforcausallm": "gptj",
+    "optforcausallm": "opt",
+    "bertmodel": "bert", "bertforsequenceclassification": "bert",
+}
+
+
+def get_policy(arch: str) -> Optional[TPPolicy]:
+    """Look up by canonical name, HF ``model_type``, or model class name."""
+    key = arch.lower().replace("-", "_")
+    if key in POLICIES:
+        return POLICIES[key]
+    return POLICIES.get(_ALIASES.get(key.replace("_", ""), ""))

@@ -1,0 +1,186 @@
+"""AutoTP / module injection tests.
+
+Reference analog: ``tests/unit/model_parallelism/test_autotp_training.py`` and
+``tests/unit/inference`` AutoTP cases — policy resolution per arch, fused-qkv
+splitting vs per-matrix reference, and TP-sharded forward == unsharded forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import create_mesh, set_global_mesh
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.module_inject import (
+    AutoTP,
+    ColumnParallelLinear,
+    RowParallelLinear,
+    TPPolicy,
+    get_policy,
+    shard_qkv_param,
+    split_fused_qkv,
+    unfuse_qkv,
+)
+
+
+class _Key:
+    def __init__(self, key):
+        self.key = key
+
+
+def _path(s):
+    return tuple(_Key(p) for p in s.split("/"))
+
+
+def test_policy_registry_covers_major_archs():
+    for arch in ["llama", "mistral", "mixtral", "qwen2", "phi", "phi3",
+                 "falcon", "gpt_neox", "bloom", "gpt2", "gptj", "opt", "bert"]:
+        assert get_policy(arch) is not None, arch
+    assert get_policy("LlamaForCausalLM").arch == "llama"
+    assert get_policy("MixtralForCausalLM").arch == "mixtral"
+    assert get_policy("no_such_arch") is None
+
+
+@pytest.mark.parametrize("arch,col_path,row_path", [
+    ("llama", "model/layers_0/self_attn/q_proj/kernel",
+     "model/layers_0/self_attn/o_proj/kernel"),
+    ("opt", "model/decoder/layers_0/fc1/kernel",
+     "model/decoder/layers_0/fc2/kernel"),
+    ("falcon", "transformer/h_0/mlp/dense_h_to_4h/kernel",
+     "transformer/h_0/mlp/dense_4h_to_h/kernel"),
+    ("bert", "encoder/layer_0/attention/self/query/kernel",
+     "encoder/layer_0/attention/output/dense/kernel"),
+])
+def test_policy_rules_col_row(arch, col_path, row_path):
+    rules = get_policy(arch).tensor_rules()
+    w = np.zeros((8, 8))
+    assert rules(_path(col_path), w) == PartitionSpec(None, "tensor")
+    assert rules(_path(row_path), w) == PartitionSpec("tensor", None)
+
+
+def test_policy_rules_vocab_and_bias():
+    rules = get_policy("llama").tensor_rules()
+    emb = np.zeros((100, 16))
+    assert rules(_path("model/embed_tokens/embedding"), emb) == \
+        PartitionSpec("tensor", None)
+    assert rules(_path("lm_head/kernel"), np.zeros((16, 100))) == \
+        PartitionSpec(None, "tensor")
+    # column bias sharded, row bias replicated
+    assert rules(_path("model/layers_0/self_attn/q_proj/bias"),
+                 np.zeros((8,))) == PartitionSpec("tensor")
+    assert rules(_path("model/layers_0/self_attn/o_proj/bias"),
+                 np.zeros((8,))) is None
+    # norms stay replicated
+    assert rules(_path("model/norm/scale"), np.zeros((8,))) is None
+
+
+def test_autotp_generic_fallback_matches_our_model_zoo():
+    from deepspeed_tpu.models.llama import TINY_LLAMA, LlamaForCausalLM, random_tokens
+    model = LlamaForCausalLM(TINY_LLAMA)
+    params = jax.eval_shape(
+        lambda r: model.init(r, random_tokens(1, 8, TINY_LLAMA.vocab_size)),
+        jax.random.PRNGKey(0))["params"]
+    rules = AutoTP.infer_rules(model, params=params)
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    matched = [p for p, leaf in leaves if rules(p, leaf) is not None]
+    assert len(matched) >= 7 * TINY_LLAMA.num_layers  # qkv,o,gate,up,down per layer
+
+
+def test_unfuse_and_split_fused_qkv_concat():
+    n_heads, n_kv, hd, d_in = 8, 4, 4, 16
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(d_in, n_heads * hd))
+    k = rng.normal(size=(d_in, n_kv * hd))
+    v = rng.normal(size=(d_in, n_kv * hd))
+    fused = np.concatenate([q, k, v], axis=-1)
+    uq, uk, uv = unfuse_qkv(fused, n_heads, n_kv, hd)
+    np.testing.assert_array_equal(uq, q)
+    np.testing.assert_array_equal(uv, v)
+    tp = 2
+    for r in range(tp):
+        shard = split_fused_qkv(fused, n_heads, n_kv, hd, tp, r)
+        expect = np.concatenate([
+            np.split(q, tp, -1)[r], np.split(k, tp, -1)[r],
+            np.split(v, tp, -1)[r]], axis=-1)
+        np.testing.assert_array_equal(shard, expect)
+    stacked = shard_qkv_param(fused, n_heads, n_kv, hd, tp)
+    assert stacked.shape == (tp, d_in, (n_heads + 2 * n_kv) * hd // tp)
+
+
+def test_split_fused_qkv_interleaved_roundtrip():
+    n_heads, hd, d_in = 4, 8, 16
+    rng = np.random.default_rng(1)
+    per_head = rng.normal(size=(d_in, n_heads, 3, hd))
+    fused = per_head.reshape(d_in, n_heads * 3 * hd)
+    q, k, v = unfuse_qkv(fused, n_heads, n_heads, hd, layout="interleaved")
+    np.testing.assert_array_equal(
+        q.reshape(d_in, n_heads, hd), per_head[:, :, 0, :])
+    # sharding must PRESERVE the interleaved layout: rank r's shard is exactly
+    # the per-head chunk of heads [r*heads/tp, (r+1)*heads/tp)
+    tp = 2
+    for r in range(tp):
+        shard = split_fused_qkv(fused, n_heads, n_heads, hd, tp, r,
+                                layout="interleaved")
+        expect = per_head[:, r * n_heads // tp:(r + 1) * n_heads // tp] \
+            .reshape(d_in, n_heads // tp * 3 * hd)
+        np.testing.assert_array_equal(shard, expect)
+    with pytest.raises(ValueError):
+        unfuse_qkv(fused, n_heads, n_heads // 2, hd, layout="interleaved")
+
+
+def test_split_fused_qkv_rejects_indivisible_heads():
+    with pytest.raises(ValueError):
+        split_fused_qkv(np.zeros((4, 3 * 8)), 2, 1, 4, tp_size=4, rank=0)
+
+
+def test_parallel_layers_match_unsharded():
+    mesh = create_mesh(MeshConfig(data=4, tensor=2))
+    set_global_mesh(mesh)
+
+    import flax.linen as nn
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = ColumnParallelLinear(64, name="up")(x)
+            h = nn.relu(h)
+            return RowParallelLinear(16, name="down")(h)
+
+    model = Block()
+    x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    dense_out = model.apply({"params": params}, x)
+
+    # shard params over the tensor axis via generic AutoTP rules and re-run
+    from deepspeed_tpu.runtime.zero.partition import build_param_shardings
+    rules = AutoTP.infer_rules(params=params)
+    shardings = build_param_shardings(params, mesh, stage=0, tensor_rules=rules)
+    sharded = jax.device_put(params, shardings)
+    spec = shardings["up"]["col_kernel"].spec
+    assert spec[-1] == "tensor"
+    with mesh:
+        tp_out = jax.jit(lambda p, b: model.apply({"params": p}, b))(sharded, x)
+    np.testing.assert_allclose(np.asarray(tp_out), np.asarray(dense_out),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_init_inference_autotp_llama():
+    from deepspeed_tpu.models.llama import TINY_LLAMA, LlamaForCausalLM, random_tokens
+    model = LlamaForCausalLM(TINY_LLAMA)
+    batch = random_tokens(2, 16, TINY_LLAMA.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    ref_logits = model.apply({"params": params}, batch)
+
+    mesh = create_mesh(MeshConfig(data=4, tensor=2))
+    set_global_mesh(mesh)
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": "fp32", "tensor_parallel": {"tp_size": 2}},
+        params=params, mesh=mesh)
+    out = engine.forward(batch)
+    # TP reduction reordering drifts the sum slightly (same as the reference's
+    # NCCL allreduce vs single-GPU)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_logits),
+                               rtol=2e-3, atol=2e-3)
