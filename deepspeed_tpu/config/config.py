@@ -172,6 +172,45 @@ class CheckpointConfig(DeepSpeedTPUConfigModel):
     async_save: bool = False
 
 
+class CurriculumLegacyConfig(DeepSpeedTPUConfigModel):
+    """Legacy top-level "curriculum_learning" key (reference: runtime/config.py
+    curriculum_params_legacy) — seqlen curriculum driven by the engine."""
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 1
+    max_difficulty: int = 1
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataEfficiencyConfig(DeepSpeedTPUConfigModel):
+    """reference: runtime/data_pipeline/config.py (get_data_efficiency_config).
+    ``data_sampling.curriculum_learning.curriculum_metrics`` maps metric name →
+    scheduler config; ``data_routing.random_ltd`` configures token dropping."""
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+    @property
+    def curriculum_enabled(self) -> bool:
+        return (self.enabled and self.data_sampling.get("enabled", False)
+                and self.data_sampling.get("curriculum_learning", {}).get("enabled", False))
+
+    @property
+    def curriculum_metrics(self) -> Dict[str, Any]:
+        return self.data_sampling.get("curriculum_learning", {}).get("curriculum_metrics", {})
+
+    @property
+    def random_ltd_enabled(self) -> bool:
+        return (self.enabled and self.data_routing.get("enabled", False)
+                and self.data_routing.get("random_ltd", {}).get("enabled", False))
+
+    @property
+    def random_ltd(self) -> Dict[str, Any]:
+        return self.data_routing.get("random_ltd", {})
+
+
 class ElasticityConfig(DeepSpeedTPUConfigModel):
     """reference: deepspeed/elasticity/config.py."""
     enabled: bool = False
@@ -221,6 +260,10 @@ class DeepSpeedTPUConfig:
         self.wandb = WandbConfig(**self._raw.get(C.MONITOR_WANDB, {}))
         self.checkpoint_config = CheckpointConfig(**self._raw.get(C.CHECKPOINT, {}))
         self.elasticity = ElasticityConfig(**self._raw.get(C.ELASTICITY, {}))
+        self.curriculum_learning_legacy = CurriculumLegacyConfig(
+            **self._raw.get(C.CURRICULUM_LEARNING, {}))
+        self.data_efficiency = DataEfficiencyConfig(
+            **self._raw.get(C.DATA_EFFICIENCY, {}))
 
         self.gradient_clipping: float = float(
             self._raw.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -1,0 +1,25 @@
+"""Data-efficiency suite (reference: ``deepspeed/runtime/data_pipeline/``).
+
+Two halves, mirroring the reference split:
+- **data sampling** — curriculum learning: a difficulty scheduler
+  (``curriculum_scheduler.py``) driving a difficulty-aware batch sampler
+  (``data_sampler.py``), plus the offline metric analyzer (``data_analyzer.py``)
+  and an mmap token dataset (``indexed_dataset.py``).
+- **data routing** — random layerwise token dropping (random-LTD,
+  ``random_ltd.py``): per-layer token subsampling with a token-budget schedule.
+"""
+
+from deepspeed_tpu.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.data_pipeline.data_sampler import CurriculumDataSampler
+from deepspeed_tpu.data_pipeline.data_analyzer import DataAnalyzer
+from deepspeed_tpu.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+from deepspeed_tpu.data_pipeline.random_ltd import (
+    RandomLTDScheduler, gather_tokens, sample_token_indices, scatter_tokens,
+    random_ltd_layer)
+
+__all__ = [
+    "CurriculumScheduler", "CurriculumDataSampler", "DataAnalyzer",
+    "MMapIndexedDataset", "MMapIndexedDatasetBuilder", "RandomLTDScheduler",
+    "gather_tokens", "scatter_tokens", "sample_token_indices", "random_ltd_layer",
+]

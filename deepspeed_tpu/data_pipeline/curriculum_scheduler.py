@@ -1,0 +1,91 @@
+"""Curriculum-learning difficulty scheduler.
+
+Reference analog: ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:11``
+(``CurriculumScheduler``). Same JSON schema and schedule families:
+
+- ``fixed_linear``   — difficulty grows linearly from min to max over
+  ``total_curriculum_step`` steps, quantized to ``difficulty_step``.
+- ``fixed_root``     — grows as ``(step/total)^(1/root_degree)``.
+- ``fixed_discrete`` — explicit ``difficulty`` list with ``max_step`` boundaries.
+- ``custom``         — user-supplied ``fn(global_step) -> difficulty``.
+
+On TPU, ``difficulty_step`` quantization matters for a different reason than the
+reference's tensor-core alignment: when difficulty is a sequence length, every
+distinct value is a distinct XLA program — coarse steps bound recompilation.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    """Stateful difficulty schedule keyed by global step."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.schedule_type: str = config["schedule_type"]
+        self.min_difficulty: int = int(config.get("min_difficulty", 1))
+        self.max_difficulty: int = int(config.get("max_difficulty", self.min_difficulty))
+        self.current_difficulty: int = self.min_difficulty
+        self.schedule_config: Dict[str, Any] = dict(config.get("schedule_config", {}))
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+
+        if self.schedule_type == FIXED_DISCRETE:
+            diffs = self.schedule_config["difficulty"]
+            steps = self.schedule_config["max_step"]
+            if len(diffs) != len(steps) + 1:
+                raise ValueError(
+                    "fixed_discrete needs len(difficulty) == len(max_step)+1 "
+                    f"(got {len(diffs)} vs {len(steps)})")
+        elif self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            if "total_curriculum_step" not in self.schedule_config:
+                raise ValueError(f"{self.schedule_type} needs 'total_curriculum_step'")
+            self.schedule_config.setdefault("difficulty_step", 8)
+            if self.schedule_type == FIXED_ROOT:
+                self.schedule_config.setdefault("root_degree", 2)
+        elif self.schedule_type != CUSTOM:
+            raise ValueError(f"unknown curriculum schedule_type {self.schedule_type!r}")
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def _root_difficulty(self, global_step: int, degree: float) -> int:
+        sc = self.schedule_config
+        frac = min(1.0, max(0.0, global_step / sc["total_curriculum_step"]))
+        raw = self.min_difficulty + (self.max_difficulty - self.min_difficulty) * \
+            (frac ** (1.0 / degree))
+        dstep = sc["difficulty_step"]
+        quantized = int(math.floor(raw / dstep)) * dstep
+        return min(self.max_difficulty, max(self.min_difficulty, quantized))
+
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == FIXED_LINEAR:
+            return self._root_difficulty(global_step, 1.0)
+        if self.schedule_type == FIXED_ROOT:
+            return self._root_difficulty(global_step, self.schedule_config["root_degree"])
+        if self.schedule_type == FIXED_DISCRETE:
+            diffs = self.schedule_config["difficulty"]
+            for d, boundary in zip(diffs, self.schedule_config["max_step"]):
+                if global_step < boundary:
+                    return d
+            return diffs[-1]
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom schedule requires set_custom_get_difficulty()")
+        return self.custom_get_difficulty(global_step)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = state["current_difficulty"]

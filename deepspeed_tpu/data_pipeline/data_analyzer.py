@@ -1,0 +1,79 @@
+"""Offline dataset difficulty analyzer.
+
+Reference analog: ``deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py``
+(``DataAnalyzer`` — maps metric functions over the dataset in worker shards,
+writes per-sample metric files, then merges). The reference persists into its
+custom mmap indexed-dataset format; we persist plain ``.npy`` arrays per metric
+(hosts have plenty of RAM for index arrays; the token data itself stays in
+``indexed_dataset.py`` files).
+
+Output layout per metric under ``save_path``::
+
+    <metric>/sample_values.npy        float64[num_samples] difficulty per sample
+    <metric>/index_to_sample.npy      int64[num_samples] argsort by value
+    <metric>/worker_<i>_<n>.npy       partial shards before merge
+"""
+
+import os
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+
+class DataAnalyzer:
+
+    def __init__(self,
+                 dataset: Sequence,
+                 metric_functions: Dict[str, Callable],
+                 save_path: str,
+                 worker_id: int = 0,
+                 num_workers: int = 1,
+                 batch_size: int = 1024):
+        self.dataset = dataset
+        self.metric_functions = dict(metric_functions)
+        self.save_path = save_path
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        self.batch_size = batch_size
+
+    def _worker_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        lo = self.worker_id * per
+        return lo, min(n, lo + per)
+
+    def run_map(self) -> None:
+        """Compute this worker's shard of every metric and persist it."""
+        lo, hi = self._worker_range()
+        results = {name: [] for name in self.metric_functions}
+        for start in range(lo, hi, self.batch_size):
+            chunk = [self.dataset[i] for i in range(start, min(hi, start + self.batch_size))]
+            for name, fn in self.metric_functions.items():
+                vals = np.asarray([fn(sample) for sample in chunk], dtype=np.float64)
+                results[name].append(vals)
+        for name, parts in results.items():
+            mdir = os.path.join(self.save_path, name)
+            os.makedirs(mdir, exist_ok=True)
+            shard = np.concatenate(parts) if parts else np.empty(0, dtype=np.float64)
+            np.save(os.path.join(
+                mdir, f"worker_{self.worker_id}_{self.num_workers}.npy"), shard)
+
+    def run_reduce(self) -> None:
+        """Merge all worker shards into sample_values + index_to_sample."""
+        for name in self.metric_functions:
+            mdir = os.path.join(self.save_path, name)
+            parts = []
+            for w in range(self.num_workers):
+                path = os.path.join(mdir, f"worker_{w}_{self.num_workers}.npy")
+                if not os.path.exists(path):
+                    raise FileNotFoundError(
+                        f"metric '{name}': missing shard from worker {w} ({path})")
+                parts.append(np.load(path))
+            values = np.concatenate(parts)
+            np.save(os.path.join(mdir, "sample_values.npy"), values)
+            np.save(os.path.join(mdir, "index_to_sample.npy"),
+                    np.argsort(values, kind="stable").astype(np.int64))
+
+    @staticmethod
+    def load_metric(save_path: str, metric_name: str) -> np.ndarray:
+        return np.load(os.path.join(save_path, metric_name, "sample_values.npy"))

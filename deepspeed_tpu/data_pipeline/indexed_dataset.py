@@ -1,0 +1,123 @@
+"""Memory-mapped indexed token dataset.
+
+Reference analog: ``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py``
+(the Megatron-style ``MMapIndexedDataset``). Same capability — a two-file format
+(``.bin`` raw token stream + ``.idx`` sizes/offsets) read through ``np.memmap``
+so billion-token corpora load lazily — with a simplified index layout:
+
+``<prefix>.idx`` (little-endian)::
+
+    magic     8 bytes  b"DSTPUIDX"
+    version   u32      1
+    dtype     u32      numpy type code (see _DTYPES)
+    count     u64      number of sequences
+    sizes     u32[count]
+    offsets   u64[count]   element (not byte) offset of each sequence
+
+``<prefix>.bin``: the concatenated token sequences, dtype as recorded.
+"""
+
+import os
+import struct
+from typing import Sequence, Union
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer: ``add_item`` sequences, then ``finalize``."""
+
+    def __init__(self, prefix: str, dtype: Union[type, np.dtype] = np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise TypeError(f"unsupported dtype {dtype}")
+        self._data = open(data_file_path(prefix), "wb")
+        self._sizes = []
+        self._offsets = []
+        self._elements = 0
+
+    def add_item(self, tokens: Sequence) -> None:
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._data.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+        self._offsets.append(self._elements)
+        self._elements += arr.size
+
+    def merge_file(self, other_prefix: str) -> None:
+        """Append another dataset with the same dtype (reference builder's
+        ``merge_file_``): block-copy the raw ``.bin`` and shift the index — no
+        per-sequence Python loop."""
+        other = MMapIndexedDataset(other_prefix)
+        if other.dtype != self.dtype:
+            raise TypeError(f"dtype mismatch: {other.dtype} vs {self.dtype}")
+        base = self._elements
+        self._sizes.extend(int(s) for s in other.sizes)
+        self._offsets.extend(base + int(o) for o in other.offsets)
+        self._elements += int(other._bin.size)
+        del other  # close the memmap before streaming the raw bytes
+        with open(data_file_path(other_prefix), "rb") as src:
+            while True:
+                chunk = src.read(1 << 24)
+                if not chunk:
+                    break
+                self._data.write(chunk)
+
+    def finalize(self) -> None:
+        self._data.close()
+        with open(index_file_path(self.prefix), "wb") as idx:
+            idx.write(_MAGIC)
+            idx.write(struct.pack("<II", _VERSION, _DTYPE_CODES[self.dtype]))
+            idx.write(struct.pack("<Q", len(self._sizes)))
+            idx.write(np.asarray(self._sizes, dtype=np.uint32).tobytes())
+            idx.write(np.asarray(self._offsets, dtype=np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Lazy reader; ``ds[i]`` returns sequence i as a numpy view."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            if f.read(8) != _MAGIC:
+                raise ValueError(f"{index_file_path(prefix)}: bad magic")
+            version, dtype_code = struct.unpack("<II", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self.dtype = np.dtype(_DTYPES[dtype_code])
+            (count,) = struct.unpack("<Q", f.read(8))
+            self.sizes = np.frombuffer(f.read(4 * count), dtype=np.uint32)
+            self.offsets = np.frombuffer(f.read(8 * count), dtype=np.uint64)
+        self._bin = np.memmap(data_file_path(prefix), dtype=self.dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        off, size = int(self.offsets[i]), int(self.sizes[i])
+        return self._bin[off:off + size]
+
+    def get(self, i: int, offset: int = 0, length: int = None) -> np.ndarray:
+        seq = self[i]
+        return seq[offset:offset + length if length is not None else None]
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(index_file_path(prefix))
+                and os.path.exists(data_file_path(prefix)))

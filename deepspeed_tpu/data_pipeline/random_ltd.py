@@ -1,0 +1,138 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference analog: ``deepspeed/runtime/data_pipeline/data_routing/``
+(``RandomLayerTokenDrop`` basic_layer.py:14, ``RandomLTDScheduler`` scheduler.py:38,
+token gather/scatter CUDA kernels ``csrc/random_ltd/``). Capability: during
+training, each wrapped transformer layer processes only a random subset of
+``reserved_length`` tokens; the rest skip the layer (identity). The reserved
+length anneals from ``min_value`` to ``max_value``, cutting layer FLOPs early in
+training.
+
+TPU-native design: instead of CUDA gather/scatter kernels + autograd Functions,
+the drop is expressed functionally (``jnp.take_along_axis`` gather, ``.at[].set``
+scatter) inside the jitted step — XLA fuses these into cheap dynamic-slice ops.
+``reserved_length`` is a *static* shape under jit, so each distinct value compiles
+once; the scheduler quantizes to ``difficulty_step`` (via the shared fixed-linear /
+fixed-root schedule math) to bound the number of compiles. For decoder models the
+sampled indices are sorted to preserve causal order (reference
+``gpt_sample_tokens``).
+"""
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+
+class RandomLTDScheduler:
+    """Anneals reserved token count; tracks consumed layer-tokens.
+
+    Reference: ``data_routing/scheduler.py:38``. The schedule reuses the
+    curriculum fixed_linear/fixed_root math (the reference duplicates it in
+    ``BaseScheduler``).
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.model_layer_num = int(config["total_layer_num"])
+        self.random_ltd_layer_num = int(config["random_ltd_layer_num"])
+        self.global_batch_size = int(config.get("global_batch_size", 1))
+        sched = config["random_ltd_schedule"]
+        self._curriculum = CurriculumScheduler({
+            "schedule_type": sched.get("schedule_type", "fixed_linear"),
+            "min_difficulty": sched["min_value"],
+            "max_difficulty": sched["max_value"],
+            "schedule_config": sched.get("schedule_config", {}),
+        })
+        self.min_value = int(sched["min_value"])
+        self.max_value = int(sched["max_value"])
+        self.current_value = self.min_value
+        self.consumed_layer_tokens = 0
+        self.curr_step = -1
+
+    def get_current_seq(self) -> int:
+        return self.current_value
+
+    def update_seq(self, global_step: int) -> int:
+        if self.current_value < self.max_value:
+            self.current_value = self._curriculum.update_difficulty(global_step)
+        if global_step != self.curr_step:
+            # layer-token accounting (reference scheduler.py:85): dropped layers see
+            # current_value tokens, the rest see the full sequence
+            self.consumed_layer_tokens += self.global_batch_size * (
+                self.current_value * self.random_ltd_layer_num
+                + self.max_value * (self.model_layer_num - self.random_ltd_layer_num))
+            self.curr_step = global_step
+        return self.current_value
+
+    def get_total_layer_tokens(self, train_iters: int) -> int:
+        """Projection of layer-tokens over ``train_iters`` steps; pure query (the
+        live schedule state is untouched)."""
+        total, value = 0, self.min_value
+        for step in range(train_iters):
+            if value < self.max_value:
+                value = self._curriculum.get_difficulty(step)
+            total += self.global_batch_size * (
+                value * self.random_ltd_layer_num
+                + self.max_value * (self.model_layer_num - self.random_ltd_layer_num))
+        return total
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_value": self.current_value, "curr_step": self.curr_step,
+                "consumed_layer_tokens": self.consumed_layer_tokens,
+                "min_value": self.min_value, "max_value": self.max_value}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.current_value = state["current_value"]
+        self.curr_step = state["curr_step"]
+        self.consumed_layer_tokens = state["consumed_layer_tokens"]
+        self.min_value = state["min_value"]
+        self.max_value = state["max_value"]
+
+
+def sample_token_indices(rng: jax.Array, batch: int, seq_len: int,
+                         reserved: int, decoder: bool = True) -> jnp.ndarray:
+    """Per-example random subset of ``reserved`` token positions.
+
+    Reference: ``data_routing/helper.py`` ``gpt_sample_tokens``/``bert_sample_tokens``
+    (backed by ``csrc/random_ltd/token_sort.cu``). Decoder models sort indices so
+    relative causal order is preserved.
+    """
+    keys = jax.random.split(rng, batch)
+    idx = jax.vmap(
+        lambda k: jax.random.permutation(k, seq_len)[:reserved])(keys)
+    if decoder:
+        idx = jnp.sort(idx, axis=-1)
+    return idx  # [batch, reserved] int32
+
+
+def gather_tokens(hidden: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """[B,S,H] x [B,R] -> [B,R,H] (reference GatherTokens / gather_scatter.cu)."""
+    return jnp.take_along_axis(hidden, indices[..., None], axis=1)
+
+
+def scatter_tokens(hidden: jnp.ndarray, part: jnp.ndarray,
+                   indices: jnp.ndarray) -> jnp.ndarray:
+    """Write [B,R,H] back into [B,S,H] at ``indices`` (reference ScatterTokens)."""
+    batch = jnp.arange(hidden.shape[0])[:, None]
+    return hidden.at[batch, indices].set(part)
+
+
+def random_ltd_layer(layer_fn: Callable, hidden: jnp.ndarray, rng: jax.Array,
+                     reserved: int, decoder: bool = True,
+                     indices: jnp.ndarray = None) -> jnp.ndarray:
+    """Run ``layer_fn`` on a random token subset; other tokens pass through.
+
+    ``reserved`` must be a Python int (static under jit). When ``indices`` is
+    given, reuse it (the reference samples once at layer 0 and shares indices
+    across all LTD layers).
+    """
+    b, s, _ = hidden.shape
+    if reserved >= s:
+        return layer_fn(hidden)
+    if indices is None:
+        indices = sample_token_indices(rng, b, s, reserved, decoder=decoder)
+    part = gather_tokens(hidden, indices)
+    out = layer_fn(part)
+    return scatter_tokens(hidden, out, indices)

@@ -253,6 +253,26 @@ class DeepSpeedTPUEngine:
             from deepspeed_tpu.monitor.monitor import MonitorMaster
             self.monitor = MonitorMaster(config)
 
+        # --- data efficiency (curriculum learning + random-LTD) --------------
+        # reference: engine.py curriculum hooks + runtime/data_pipeline/
+        self.curriculum_scheduler = None
+        self.random_ltd_scheduler = None
+        if config.curriculum_learning_legacy.enabled:
+            from deepspeed_tpu.data_pipeline import CurriculumScheduler
+            c = config.curriculum_learning_legacy
+            self.curriculum_scheduler = CurriculumScheduler({
+                "schedule_type": c.schedule_type,
+                "min_difficulty": c.min_difficulty,
+                "max_difficulty": c.max_difficulty,
+                "schedule_config": c.schedule_config})
+        # per-metric curriculum sampling lives in CurriculumDataSampler (which owns
+        # its schedulers); the engine only drives the legacy seqlen curriculum + LTD
+        if config.data_efficiency.random_ltd_enabled:
+            from deepspeed_tpu.data_pipeline import RandomLTDScheduler
+            ltd = dict(config.data_efficiency.random_ltd)
+            ltd.setdefault("global_batch_size", self.train_batch_size)
+            self.random_ltd_scheduler = RandomLTDScheduler(ltd)
+
     # ------------------------------------------------------------------
     # loss computation
     # ------------------------------------------------------------------
@@ -402,6 +422,7 @@ class DeepSpeedTPUEngine:
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size
+        self._advance_data_schedules()
         self._record_metrics(out)
         return out.loss
 
@@ -452,6 +473,7 @@ class DeepSpeedTPUEngine:
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         self.global_samples += self.train_batch_size
+        self._advance_data_schedules()
         return loss
 
     def _offload_host_update(self, loss, grads, norm, overflow):
@@ -495,6 +517,31 @@ class DeepSpeedTPUEngine:
                                  detailed=fcfg.detailed,
                                  output_file=fcfg.output_file)
         self.flops_profiler = prof
+
+    def _advance_data_schedules(self):
+        """Advance curriculum/random-LTD schedules at each global step (reference:
+        engine curriculum updates + data_pipeline schedulers)."""
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
+        if self.random_ltd_scheduler is not None:
+            self.random_ltd_scheduler.update_seq(self.global_steps)
+
+    def set_custom_curriculum_learning_schedule(self, schedule_fn):
+        """reference: engine.set_custom_curriculum_learning_schedule — install a
+        user difficulty function for 'custom' schedule_type."""
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.set_custom_get_difficulty(schedule_fn)
+
+    def curriculum_seqlen(self) -> int:
+        """Current legacy-curriculum difficulty (seqlen); full seq when disabled."""
+        if self.curriculum_scheduler is None:
+            raise RuntimeError("curriculum_learning not enabled in config")
+        return self.curriculum_scheduler.get_current_difficulty()
+
+    def random_ltd_reserved_length(self) -> int:
+        if self.random_ltd_scheduler is None:
+            raise RuntimeError("random_ltd not enabled in config")
+        return self.random_ltd_scheduler.get_current_seq()
 
     def _record_metrics(self, out: StepOutput):
         self._last_metrics = {"lr": out.lr, "grad_norm": out.grad_norm,
@@ -615,6 +662,7 @@ class DeepSpeedTPUEngine:
         self._accum_count = 0
         self.global_steps += 1
         self.global_samples += self.train_batch_size
+        self._advance_data_schedules()
         self.timers(STEP_GLOBAL_TIMER).stop()
 
     # ------------------------------------------------------------------
@@ -678,5 +726,14 @@ class DeepSpeedTPUEngine:
         """reference: engine.load_checkpoint:2763 (+_get_all_zero_checkpoints
         world-size-change handling — free here: the checkpoint is topology-free)."""
         from deepspeed_tpu.checkpoint.engine import load_engine_checkpoint
-        return load_engine_checkpoint(self, load_dir, tag=tag,
-                                      load_optimizer_states=load_optimizer_states)
+        out = load_engine_checkpoint(self, load_dir, tag=tag,
+                                     load_optimizer_states=load_optimizer_states)
+        # resync data-efficiency schedules to the restored global step; replay the
+        # random-LTD token accounting so consumed_layer_tokens survives resume
+        if self.random_ltd_scheduler is not None:
+            # live training updates at steps 1..N (after each increment); replay
+            # 1..N-1 here, _advance_data_schedules covers N
+            for step in range(1, self.global_steps):
+                self.random_ltd_scheduler.update_seq(step)
+        self._advance_data_schedules()
+        return out

@@ -1,0 +1,305 @@
+"""Data-efficiency tests: curriculum scheduler/sampler, analyzer, indexed dataset,
+random-LTD.
+
+Reference analog: tests/unit/runtime/test_data_efficiency.py +
+data_pipeline behavior (curriculum_scheduler.py, data_sampler.py,
+data_routing/).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.data_pipeline import (
+    CurriculumDataSampler, CurriculumScheduler, DataAnalyzer, MMapIndexedDataset,
+    MMapIndexedDatasetBuilder, RandomLTDScheduler, gather_tokens,
+    random_ltd_layer, sample_token_indices, scatter_tokens)
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+
+# ---------------------------------------------------------------- curriculum
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({
+        "schedule_type": "fixed_linear", "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert s.update_difficulty(0) == 8
+    mid = s.update_difficulty(50)
+    assert 8 < mid < 64 and mid % 8 == 0
+    assert s.update_difficulty(100) == 64
+    assert s.update_difficulty(1000) == 64  # clamped past total
+
+
+def test_fixed_root_schedule_monotone():
+    s = CurriculumScheduler({
+        "schedule_type": "fixed_root", "min_difficulty": 8, "max_difficulty": 128,
+        "schedule_config": {"total_curriculum_step": 200, "difficulty_step": 8,
+                            "root_degree": 2}})
+    vals = [s.update_difficulty(t) for t in range(0, 201, 10)]
+    assert vals == sorted(vals)
+    assert vals[0] == 8 and vals[-1] == 128
+    # sqrt schedule reaches half-way difficulty well before half the steps
+    assert s.get_difficulty(50) > 8 + (128 - 8) * 50 / 200
+
+
+def test_fixed_discrete_schedule():
+    s = CurriculumScheduler({
+        "schedule_type": "fixed_discrete", "min_difficulty": 1, "max_difficulty": 3,
+        "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]}})
+    assert s.get_difficulty(0) == 1
+    assert s.get_difficulty(5) == 2
+    assert s.get_difficulty(9) == 2
+    assert s.get_difficulty(10) == 3
+    assert s.get_difficulty(99) == 3
+
+
+def test_custom_schedule_and_state_roundtrip():
+    s = CurriculumScheduler({"schedule_type": "custom", "min_difficulty": 1,
+                             "max_difficulty": 10})
+    s.set_custom_get_difficulty(lambda step: min(10, 1 + step))
+    assert s.update_difficulty(3) == 4
+    state = s.state_dict()
+    s2 = CurriculumScheduler({"schedule_type": "custom", "min_difficulty": 1,
+                              "max_difficulty": 10})
+    s2.load_state_dict(state)
+    assert s2.get_current_difficulty() == 4
+
+
+# ---------------------------------------------------------------- sampler
+def _sampler(n=256, gbs=16, difficulty_type="value"):
+    seqlens = np.arange(n) % 64 + 1  # difficulty 1..64
+    cfg = {"seqlen": {
+        "schedule_type": "fixed_linear", "min_difficulty": 8, "max_difficulty": 64,
+        "difficulty_type": difficulty_type,
+        "schedule_config": {"total_curriculum_step": 20, "difficulty_step": 8}}}
+    return seqlens, CurriculumDataSampler(
+        metric_values={"seqlen": seqlens}, metric_configs=cfg,
+        total_samples=n, global_batch_size=gbs, seed=7)
+
+
+def test_sampler_honors_difficulty():
+    seqlens, sampler = _sampler()
+    first = sampler.get_next_global_batch()
+    assert len(first) == 16
+    assert (seqlens[first] <= 8).all()  # step 0: only easy samples
+    for _ in range(30):
+        batch = sampler.get_next_global_batch()
+    assert (seqlens[batch] <= 64).all()
+    # after the schedule completes, hard samples do appear
+    assert (seqlens[batch] > 8).any()
+
+
+def test_sampler_percentile_mode():
+    n = 100
+    vals = np.linspace(0, 1000, n)
+    sampler = CurriculumDataSampler(
+        metric_values={"m": vals},
+        metric_configs={"m": {
+            "schedule_type": "fixed_discrete", "difficulty_type": "percentile",
+            "min_difficulty": 10, "max_difficulty": 100,
+            "schedule_config": {"difficulty": [10, 100], "max_step": [5]}}},
+        total_samples=n, global_batch_size=5, seed=0)
+    batch = sampler.get_next_global_batch()
+    # 10th percentile → only the 10 smallest values are admitted
+    assert (vals[batch] <= vals[9]).all()
+
+
+def test_sampler_deterministic_and_resumable():
+    _, a = _sampler()
+    _, b = _sampler()
+    for _ in range(3):
+        assert (a.get_next_global_batch() == b.get_next_global_batch()).all()
+    state = a.state_dict()
+    next_a = a.get_next_global_batch()
+    b.load_state_dict(state)
+    assert (next_a == b.get_next_global_batch()).all()
+
+
+def test_sampler_epoch_reset_covers_pool():
+    n, gbs = 32, 16
+    vals = np.ones(n)
+    sampler = CurriculumDataSampler(
+        metric_values={"m": vals},
+        metric_configs={"m": {"schedule_type": "fixed_discrete",
+                              "min_difficulty": 1, "max_difficulty": 1,
+                              "schedule_config": {"difficulty": [1], "max_step": []}}},
+        total_samples=n, global_batch_size=gbs, seed=3)
+    seen = np.concatenate([sampler.get_next_global_batch() for _ in range(2)])
+    assert len(np.unique(seen)) == n  # one full epoch, no repeats
+
+
+# ---------------------------------------------------------------- analyzer
+def test_data_analyzer_map_reduce(tmp_path):
+    data = [np.arange(i % 7 + 1) for i in range(50)]
+    for w in range(2):
+        DataAnalyzer(data, {"seqlen": len}, str(tmp_path), worker_id=w,
+                     num_workers=2, batch_size=8).run_map()
+    DataAnalyzer(data, {"seqlen": len}, str(tmp_path), num_workers=2).run_reduce()
+    vals = DataAnalyzer.load_metric(str(tmp_path), "seqlen")
+    assert vals.shape == (50,)
+    assert (vals == np.array([len(d) for d in data])).all()
+
+
+# ---------------------------------------------------------------- indexed dataset
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "tokens")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    seqs = [np.arange(n, dtype=np.int32) * 3 for n in (5, 1, 9, 4)]
+    for s in seqs:
+        builder.add_item(s)
+    builder.finalize()
+
+    assert MMapIndexedDataset.exists(prefix)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    for got, want in zip(list(ds[:4]), seqs):
+        assert (np.asarray(got) == want).all()
+    assert (ds.get(2, offset=2, length=3) == np.array([6, 9, 12])).all()
+
+
+def test_indexed_dataset_merge(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    for prefix, vals in ((a, [1, 2]), (b, [3],)):
+        builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int64)
+        for v in vals:
+            builder.add_item(np.full(v, v, dtype=np.int64))
+        builder.finalize()
+    merged = MMapIndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.int64)
+    merged.merge_file(a)
+    merged.merge_file(b)
+    merged.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 3 and (np.asarray(ds[2]) == 3).all()
+
+
+# ---------------------------------------------------------------- random-LTD
+def test_random_ltd_scheduler_annealing():
+    sched = RandomLTDScheduler({
+        "total_layer_num": 12, "random_ltd_layer_num": 10, "global_batch_size": 4,
+        "random_ltd_schedule": {
+            "min_value": 128, "max_value": 512, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 16}}})
+    assert sched.get_current_seq() == 128
+    sched.update_seq(50)
+    assert 128 < sched.get_current_seq() < 512
+    sched.update_seq(100)
+    assert sched.get_current_seq() == 512
+    # token accounting grows monotonically and counts non-LTD layers at full seq
+    total = sched.get_total_layer_tokens(10)
+    assert total > 0
+    state = sched.state_dict()
+    sched2 = RandomLTDScheduler({
+        "total_layer_num": 12, "random_ltd_layer_num": 10,
+        "random_ltd_schedule": {"min_value": 128, "max_value": 512,
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {"total_curriculum_step": 100}}})
+    sched2.load_state_dict(state)
+    assert sched2.get_current_seq() == sched.get_current_seq()
+
+
+def test_gather_scatter_inverse():
+    rng = jax.random.PRNGKey(0)
+    h = jax.random.normal(rng, (2, 16, 8))
+    idx = sample_token_indices(rng, 2, 16, 6, decoder=True)
+    assert idx.shape == (2, 6)
+    # decoder indices sorted → causal order preserved
+    assert (jnp.diff(idx, axis=-1) > 0).all()
+    part = gather_tokens(h, idx)
+    assert part.shape == (2, 6, 8)
+    back = scatter_tokens(h, part, idx)
+    np.testing.assert_allclose(back, h, rtol=1e-6)
+
+
+def test_random_ltd_layer_identity_outside_subset():
+    rng = jax.random.PRNGKey(1)
+    h = jax.random.normal(rng, (2, 12, 4))
+    out = random_ltd_layer(lambda x: x + 100.0, h, rng, reserved=5)
+    changed = np.abs(np.asarray(out - h)).sum(axis=-1) > 1.0
+    assert changed.sum() == 2 * 5  # exactly `reserved` tokens per example touched
+    # reserved >= seq → layer applied to everything
+    out_full = random_ltd_layer(lambda x: x + 100.0, h, rng, reserved=12)
+    np.testing.assert_allclose(out_full, h + 100.0)
+
+
+def test_random_ltd_layer_jit_and_grad():
+    rng = jax.random.PRNGKey(2)
+    h = jax.random.normal(rng, (2, 8, 4))
+    w = jnp.ones((4, 4)) * 0.5
+
+    @jax.jit
+    def loss(w, h):
+        out = random_ltd_layer(lambda x: x @ w, h, rng, reserved=3)
+        return (out ** 2).sum()
+
+    g = jax.grad(loss)(w, h)
+    assert jnp.isfinite(g).all() and (jnp.abs(g) > 0).any()
+
+
+# ---------------------------------------------------------------- engine wiring
+def test_engine_curriculum_integration():
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 2, "max_difficulty": 8,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 2}},
+        "data_efficiency": {
+            "enabled": True,
+            "data_routing": {"enabled": True, "random_ltd": {
+                "enabled": True, "total_layer_num": 2, "random_ltd_layer_num": 1,
+                "random_ltd_schedule": {
+                    "min_value": 4, "max_value": 16, "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4,
+                                        "difficulty_step": 4}}}}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=config,
+        example_batch=random_batch(4))
+    assert engine.curriculum_seqlen() == 2
+    assert engine.random_ltd_reserved_length() == 4
+    for i in range(5):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    assert engine.curriculum_seqlen() == 8
+    assert engine.random_ltd_reserved_length() == 16
+
+
+def test_curriculum_state_resyncs_on_checkpoint_load(tmp_path):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "curriculum_learning": {
+            "enabled": True, "min_difficulty": 2, "max_difficulty": 8,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 2}},
+        "data_efficiency": {
+            "enabled": True,
+            "data_routing": {"enabled": True, "random_ltd": {
+                "enabled": True, "total_layer_num": 2, "random_ltd_layer_num": 1,
+                "random_ltd_schedule": {
+                    "min_value": 4, "max_value": 16, "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4,
+                                        "difficulty_step": 4}}}}},
+    }
+
+    def build():
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16), config=config,
+            example_batch=random_batch(4))
+        return engine
+
+    engine = build()
+    for i in range(5):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    engine.save_checkpoint(str(tmp_path))
+    consumed = engine.random_ltd_scheduler.consumed_layer_tokens
+
+    fresh = build()
+    assert fresh.curriculum_seqlen() == 2  # pre-load: schedules at min
+    fresh.load_checkpoint(str(tmp_path))
+    assert fresh.global_steps == 5
+    assert fresh.curriculum_seqlen() == 8
+    assert fresh.random_ltd_reserved_length() == 16
+    assert fresh.random_ltd_scheduler.consumed_layer_tokens == consumed
