@@ -71,6 +71,18 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             **{f"s_{i}_{j}": s for i, states in enumerate(sd["states"])
                for j, s in enumerate(states)})
 
+    compressor = getattr(engine, "compressor", None)
+    if compressor is not None and jax.process_index() == 0:
+        # pruning masks must survive resume: refreezing from restored (or fresh
+        # random) weights would silently change the sparsity pattern
+        sd = compressor.state_dict()
+        arrays = {f"mask::{m}::{name}": arr
+                  for m, d in sd["masks"].items() for name, arr in d.items()}
+        np.savez(os.path.join(path, "compression_state.npz"),
+                 training_steps=np.int64(sd["training_steps"]),
+                 mask_frozen=np.array(json.dumps(sd["mask_frozen"])),
+                 **arrays)
+
     meta = {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
@@ -169,6 +181,24 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         loss_scale=LossScaleState(sc["loss_scale"], sc["good_steps"], sc["hysteresis"]),
         skipped_steps=sc["skipped_steps"],
     )
+
+    compressor = getattr(engine, "compressor", None)
+    comp_path = os.path.join(path, "compression_state.npz")
+    if compressor is not None and os.path.exists(comp_path):
+        data = np.load(comp_path)
+        masks: Dict[str, Dict[str, np.ndarray]] = {}
+        for key in data.files:
+            if key.startswith("mask::"):
+                _, method, name = key.split("::", 2)
+                masks.setdefault(method, {})[name] = data[key]
+        # methods with no saved masks still need their dict entries
+        for method in compressor._masks:
+            masks.setdefault(method, {})
+        compressor.load_state_dict({
+            "training_steps": int(data["training_steps"]),
+            "mask_frozen": json.loads(str(data["mask_frozen"])),
+            "masks": masks,
+        })
 
     meta_path = os.path.join(path, "ds_meta.json")
     client_state: Dict[str, Any] = {}

@@ -1,0 +1,23 @@
+"""Compression suite (reference: ``deepspeed/compression/``).
+
+Capability parity with the reference's QAT + pruning + layer-reduction stack,
+re-designed functionally for TPU/XLA: the reference swaps ``nn.Linear`` for
+mask-carrying ``LinearLayer_Compress`` modules; here compression is a *pure
+transform over the params pytree* applied inside the jitted step —
+``compressor.transform(params, step)`` fake-quantizes and masks the matched
+leaves with straight-through gradients, and ``redundancy_clean`` bakes the
+compression in at export time (reference ``fix_compression``).
+"""
+
+from deepspeed_tpu.compression.compress import (
+    Compressor, init_compression, redundancy_clean, student_initialization)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+from deepspeed_tpu.compression.ops import (
+    quantize_weight, quantize_activation, sparse_mask, row_mask, head_mask,
+    channel_mask)
+
+__all__ = [
+    "Compressor", "init_compression", "redundancy_clean", "student_initialization",
+    "CompressionScheduler", "quantize_weight", "quantize_activation",
+    "sparse_mask", "row_mask", "head_mask", "channel_mask",
+]

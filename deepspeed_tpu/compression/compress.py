@@ -1,0 +1,247 @@
+"""Compression entry points.
+
+Reference analog: ``deepspeed/compression/compress.py:100`` (``init_compression``
+— regex-matches module names per technique group and swaps in compression-aware
+layers; ``redundancy_clean`` bakes masks in; ``student_initialization`` copies
+teacher layers for layer reduction/distillation).
+
+TPU-native shape: ``init_compression(params, config)`` returns a ``Compressor``
+holding (a) the per-leaf technique assignment resolved from the same
+``compression_training`` JSON schema, and (b) a ``CompressionScheduler``. Inside
+the jitted loss, call ``compressor.transform(params)`` — a pure function of the
+matched leaves under the *current* host-side schedule snapshot; the engine keys
+its compiled step on ``compressor.schedule_key()`` so schedule transitions
+recompile exactly once. Pruning masks are frozen from the weights the first time
+a pruning technique activates (reference: masks computed at enable time and kept).
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import ops
+from deepspeed_tpu.compression.scheduler import (
+    CompressionScheduler, PRUNE_METHODS, QUANT_METHODS)
+from deepspeed_tpu.utils.logging import logger
+
+COMPRESSION_KEY = "compression_training"
+LAYER_REDUCTION_KEY = "layer_reduction"
+
+
+def _path_name(path) -> str:
+    """Canonical 'a/b/kernel' name for a tree_util key path."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def _leaf_paths(params) -> List[Tuple[str, Any]]:
+    """Flatten a params pytree to ('a/b/kernel', leaf) pairs."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return [(_path_name(path), leaf) for path, leaf in flat]
+
+
+class Compressor:
+
+    def __init__(self, params, config: Dict[str, Any],
+                 num_heads: Optional[int] = None):
+        self.config = config.get(COMPRESSION_KEY, config) or {}
+        self.scheduler = CompressionScheduler(self.config)
+        self.num_heads = num_heads
+        self._masks: Dict[str, Dict[str, jnp.ndarray]] = {m: {} for m in PRUNE_METHODS}
+        self._mask_frozen: Dict[str, bool] = {m: False for m in PRUNE_METHODS}
+        # technique -> list of (leaf_path, group_params) resolved once at init
+        self.assignments: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+        names = [n for n, leaf in _leaf_paths(params)
+                 if hasattr(leaf, "ndim") and leaf.ndim >= 2]
+        for method in QUANT_METHODS + PRUNE_METHODS:
+            mcfg = self.config.get(method)
+            if not mcfg or not mcfg.get("shared_parameters", {}).get("enabled", False):
+                continue
+            taken = set()
+            matched: List[Tuple[str, Dict[str, Any]]] = []
+            for gname, group in sorted(mcfg.get("different_groups", {}).items()):
+                gparams = dict(group.get("params", {}))
+                gparams.update({k: v for k, v in mcfg.get("shared_parameters", {}).items()
+                                if k not in gparams})
+                for pattern in group.get("modules", [".*"]):
+                    for name in names:
+                        if re.search(pattern, name) and name not in taken:
+                            taken.add(name)
+                            matched.append((name, gparams))
+            if matched:
+                self.assignments[method] = matched
+                logger.info(f"compression: {method} on {len(matched)} tensors")
+
+    # -- host-side schedule ------------------------------------------------
+    def set_step(self, step: int) -> None:
+        self.scheduler.training_steps = step
+        # freeze pruning masks from current weights the first time each
+        # pruning technique becomes active (requires caller to pass params then)
+
+    def schedule_key(self) -> Tuple:
+        """Hashable snapshot of the static compression structure: active methods
+        + per-tensor bits from the *merged* (shared + group) params — the same
+        values transform() traces with. Changes ⇒ the engine recompiles."""
+        snap = []
+        for method in QUANT_METHODS + PRUNE_METHODS:
+            if method not in self.assignments or not self.scheduler._method_active(method):
+                continue
+            gsnap = []
+            for name, gparams in self.assignments[method]:
+                bits = self.scheduler.current_bits(gparams) \
+                    if method == "weight_quantization" else int(gparams.get("bits", 8))
+                gsnap.append((name, bits))
+            snap.append((method, tuple(gsnap)))
+        return tuple(snap)
+
+    def maybe_freeze_masks(self, params) -> None:
+        """Compute pruning masks once when each pruning method first activates
+        (reference: enable_*_pruning computes the mask from live weights)."""
+        pending = [m for m in PRUNE_METHODS
+                   if not self._mask_frozen[m] and m in self.assignments
+                   and self.scheduler._method_active(m)]
+        if not pending:
+            return
+        leaves = dict(_leaf_paths(params))
+        for method in pending:
+            for name, gparams in self.assignments[method]:
+                w = leaves[name]
+                ratio = float(gparams.get("dense_ratio", 0.5))
+                mth = gparams.get("method", "l1")
+                if method == "sparse_pruning":
+                    m = ops.sparse_mask(w, ratio, mth)
+                elif method == "row_pruning":
+                    m = ops.row_mask(w, ratio, mth)
+                elif method == "head_pruning":
+                    heads = int(gparams.get("num_heads", self.num_heads or 0))
+                    if heads <= 0:
+                        raise ValueError("head_pruning requires num_heads")
+                    m = ops.head_mask(w, ratio, heads, mth)
+                else:
+                    m = ops.channel_mask(w, ratio, mth)
+                self._masks[method][name] = jax.device_get(m)
+            self._mask_frozen[method] = True
+            logger.info(f"compression: froze {method} masks at step "
+                        f"{self.scheduler.training_steps}")
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Persistable compression state: frozen pruning masks + schedule step.
+        Masks MUST survive resume — refreezing from restored (or worse, fresh
+        random) weights would change the sparsity pattern mid-training."""
+        return {
+            "training_steps": self.scheduler.training_steps,
+            "mask_frozen": dict(self._mask_frozen),
+            "masks": {m: {k: jax.device_get(v) for k, v in d.items()}
+                      for m, d in self._masks.items()},
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.scheduler.training_steps = int(state["training_steps"])
+        self._mask_frozen = dict(state["mask_frozen"])
+        self._masks = {m: dict(d) for m, d in state["masks"].items()}
+
+    # -- traced transform --------------------------------------------------
+    def transform(self, params):
+        """Pure function applied to params inside the jitted loss. Uses the
+        host-side schedule snapshot as static structure."""
+        active = dict(self.schedule_key())
+        if not active:
+            return params
+        leaves = dict(_leaf_paths(params))
+        replaced: Dict[str, jnp.ndarray] = {}
+
+        if "weight_quantization" in active:
+            shared = self.config["weight_quantization"].get("shared_parameters", {})
+            sym = shared.get("quantization_type", "symmetric") == "symmetric"
+            for name, gparams in self.assignments.get("weight_quantization", []):
+                bits = self.scheduler.current_bits(gparams)
+                groups = int(gparams.get("quantize_groups", 1))
+                w = replaced.get(name, leaves[name])
+                replaced[name] = ops.quantize_weight(w, bits, symmetric=sym,
+                                                     num_groups=groups)
+        for method in PRUNE_METHODS:
+            if method not in active:
+                continue
+            for name, _ in self.assignments.get(method, []):
+                mask = self._masks[method].get(name)
+                if mask is None:
+                    continue  # activates on the step maybe_freeze_masks runs
+                w = replaced.get(name, leaves[name])
+                replaced[name] = w * jnp.asarray(mask, dtype=w.dtype)
+
+        if not replaced:
+            return params
+
+        def sub(path, leaf):
+            return replaced.get(_path_name(path), leaf)
+        return jax.tree_util.tree_map_with_path(sub, params)
+
+    def quantize_activations(self, x: jnp.ndarray, layer_name: str = "") -> jnp.ndarray:
+        """For models that opt in per-layer (reference QuantAct usage)."""
+        active = dict(self.schedule_key())
+        if "activation_quantization" not in active:
+            return x
+        shared = self.config["activation_quantization"].get("shared_parameters", {})
+        sym = shared.get("quantization_type", "symmetric") == "symmetric"
+        for name, gparams in self.assignments.get("activation_quantization", []):
+            if not layer_name or re.search(name.rsplit("/", 1)[0], layer_name):
+                return ops.quantize_activation(x, int(gparams.get("bits", 8)),
+                                               symmetric=sym)
+        return x
+
+
+def init_compression(params, config: Dict[str, Any],
+                     teacher_params=None, num_heads: Optional[int] = None,
+                     layer_map: Optional[Dict[int, int]] = None) -> "Compressor":
+    """Build a Compressor (reference compress.py:100 init_compression). When the
+    config enables layer_reduction, ``teacher_params`` + the layer mapping seed
+    the student (reference student_initialization)."""
+    comp_cfg = config.get(COMPRESSION_KEY, config) or {}
+    lr_cfg = comp_cfg.get(LAYER_REDUCTION_KEY, {})
+    if lr_cfg.get("enabled", False):
+        if teacher_params is None:
+            raise ValueError("layer_reduction requires teacher_params")
+        params = student_initialization(params, teacher_params, lr_cfg,
+                                        layer_map=layer_map)
+    c = Compressor(params, comp_cfg, num_heads=num_heads)
+    c.initialized_params = params
+    return c
+
+
+def student_initialization(student_params, teacher_params, lr_cfg: Dict[str, Any],
+                           layer_map: Optional[Dict[int, int]] = None):
+    """Copy selected teacher layers into the student (reference
+    ``compress.py student_initialization``): ``teacher_layer[i]`` is the teacher
+    layer index whose weights initialize student layer i. Layer indices are
+    rewritten in leaf paths under ``module_name_prefix`` (e.g. 'layers/3/...').
+    """
+    prefix = lr_cfg.get("module_name_prefix", "layers")
+    teacher_layers = lr_cfg.get("teacher_layer", [])
+    mapping = layer_map or {i: int(t) for i, t in enumerate(teacher_layers)}
+    teacher_leaves = dict(_leaf_paths(teacher_params))
+    pat = re.compile(rf"(^|/){re.escape(prefix)}[_/](\d+)(/|$)")
+
+    def pick(path, leaf):
+        name = _path_name(path)
+        m = pat.search(name)
+        if m:
+            student_idx = int(m.group(2))
+            if student_idx in mapping:
+                tname = name[:m.start(2)] + str(mapping[student_idx]) + name[m.end(2):]
+                if tname in teacher_leaves:
+                    return jnp.asarray(teacher_leaves[tname], dtype=leaf.dtype)
+            return leaf
+        # non-layer leaves (embeddings, final norm, head) copy straight across
+        return jnp.asarray(teacher_leaves[name], dtype=leaf.dtype) \
+            if name in teacher_leaves and teacher_leaves[name].shape == leaf.shape else leaf
+
+    return jax.tree_util.tree_map_with_path(pick, student_params)
+
+
+def redundancy_clean(params, compressor: "Compressor"):
+    """Bake compression into the weights for export (reference
+    ``compress.py redundancy_clean`` / per-layer ``fix_compression``): apply the
+    final quantization + masks once, outside any STE."""
+    baked = compressor.transform(params)
+    return jax.tree.map(jax.lax.stop_gradient, baked)

@@ -1,0 +1,130 @@
+"""Pure-JAX compression primitives: STE fake quantization + pruning masks.
+
+Reference analog: ``deepspeed/compression/utils.py`` (SymQuantizer, AsymQuantizer,
+TernaryQuantizer, BinaryQuantizer — autograd Functions with straight-through
+backward) and the mask helpers inside ``basic_layer.py``. Here each quantizer is a
+pure function; the straight-through estimator is ``w + stop_gradient(q(w) - w)``,
+which XLA folds into the surrounding computation (no custom VJP needed).
+
+Convention: weights are flax-style ``[in_features, out_features]`` — the *output*
+feature axis is the last one, so "row pruning" (reference: torch weight rows =
+output neurons) masks the last axis here, and head pruning groups the last axis
+into ``num_heads`` blocks.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(w: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through estimator: forward q, gradient of identity."""
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def _grouped(w: jnp.ndarray, num_groups: int):
+    """Reshape to (num_groups, -1) for per-group scales (reference quantizers
+    view(num_groups, -1))."""
+    return w.reshape(num_groups, -1)
+
+
+def quantize_weight(w: jnp.ndarray, bits: int, symmetric: bool = True,
+                    num_groups: int = 1) -> jnp.ndarray:
+    """Fake-quantize with STE. bits>=3 → uniform sym/asym; 2 → ternary; 1 → binary
+    (reference utils.py quantizer dispatch in basic_layer.py:319)."""
+    orig_shape = w.shape
+    g = _grouped(w, num_groups)
+    if bits == 1:
+        # binary: sign(w) * E|w| per group (XNOR-style scaling)
+        scale = jnp.mean(jnp.abs(g), axis=1, keepdims=True)
+        q = jnp.sign(g) * scale
+    elif bits == 2:
+        # ternary: threshold 0.7*E|w|; kept values get the mean magnitude of kept
+        thresh = 0.7 * jnp.mean(jnp.abs(g), axis=1, keepdims=True)
+        mask = (jnp.abs(g) > thresh).astype(g.dtype)
+        alpha = jnp.sum(jnp.abs(g) * mask, axis=1, keepdims=True) / \
+            jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+        q = jnp.sign(g) * alpha * mask
+    elif symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-10)
+        q = jnp.round(g / scale).clip(-qmax - 1, qmax) * scale
+    else:
+        levels = 2.0 ** bits - 1
+        lo = jnp.min(g, axis=1, keepdims=True)
+        hi = jnp.max(g, axis=1, keepdims=True)
+        scale = jnp.maximum(hi - lo, 1e-10) / levels
+        q = jnp.round((g - lo) / scale).clip(0, levels) * scale + lo
+    return _ste(w, q.reshape(orig_shape).astype(w.dtype))
+
+
+def quantize_activation(x: jnp.ndarray, bits: int, symmetric: bool = True,
+                        static_range: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Activation fake-quant (reference QuantAct basic_layer.py:17). Dynamic range
+    by default (per-tensor max of the current batch); ``static_range`` supplies a
+    calibrated max instead."""
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        amax = jnp.max(jnp.abs(x)) if static_range is None else static_range
+        scale = jnp.maximum(amax, 1e-10) / qmax
+        q = jnp.round(x / scale).clip(-qmax - 1, qmax) * scale
+    else:
+        levels = 2.0 ** bits - 1
+        lo = jnp.min(x) if static_range is None else -static_range
+        hi = jnp.max(x) if static_range is None else static_range
+        scale = jnp.maximum(hi - lo, 1e-10) / levels
+        q = jnp.round((x - lo) / scale).clip(0, levels) * scale + lo
+    return _ste(x, q.astype(x.dtype))
+
+
+def sparse_mask(w: jnp.ndarray, dense_ratio: float, method: str = "l1") -> jnp.ndarray:
+    """Unstructured magnitude mask keeping the top ``dense_ratio`` fraction
+    (reference enable_sparse_pruning l1/topk)."""
+    k = max(1, int(round(dense_ratio * w.size)))
+    flat = jnp.abs(w).ravel()
+    if method not in ("l1", "topk"):
+        raise ValueError(f"unknown sparse pruning method {method!r}")
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_mask(w: jnp.ndarray, dense_ratio: float, method: str = "l1") -> jnp.ndarray:
+    """Structured mask over output features (last axis), scored by L1 norm
+    (reference enable_row_pruning). Returns shape [..., out] broadcastable mask."""
+    if method != "l1":
+        raise ValueError(f"unknown row pruning method {method!r}")
+    scores = jnp.sum(jnp.abs(w).reshape(-1, w.shape[-1]), axis=0)
+    k = max(1, int(round(dense_ratio * w.shape[-1])))
+    thresh = jnp.sort(scores)[-k]
+    return (scores >= thresh).astype(w.dtype)
+
+
+def head_mask(w: jnp.ndarray, dense_ratio: float, num_heads: int,
+              method: str = "l1") -> jnp.ndarray:
+    """Per-head mask over the output axis grouped into ``num_heads`` blocks
+    (reference enable_head_pruning on attention output projections)."""
+    if method != "l1":
+        raise ValueError(f"unknown head pruning method {method!r}")
+    out = w.shape[-1]
+    if out % num_heads:
+        raise ValueError(f"output dim {out} not divisible by num_heads {num_heads}")
+    head_dim = out // num_heads
+    scores = jnp.sum(jnp.abs(w).reshape(-1, num_heads, head_dim), axis=(0, 2))
+    k = max(1, int(round(dense_ratio * num_heads)))
+    thresh = jnp.sort(scores)[-k]
+    keep = (scores >= thresh).astype(w.dtype)
+    return jnp.repeat(keep, head_dim)
+
+
+def channel_mask(w: jnp.ndarray, dense_ratio: float, method: str = "l1") -> jnp.ndarray:
+    """Conv channel mask (reference enable_channel_pruning): scores over all axes
+    but the output-channel axis (last, HWIO convention)."""
+    if method != "l1":
+        raise ValueError(f"unknown channel pruning method {method!r}")
+    axes = tuple(range(w.ndim - 1))
+    scores = jnp.sum(jnp.abs(w), axis=axes)
+    k = max(1, int(round(dense_ratio * w.shape[-1])))
+    thresh = jnp.sort(scores)[-k]
+    return (scores >= thresh).astype(w.dtype)

@@ -1,0 +1,72 @@
+"""Compression scheduling.
+
+Reference analog: ``deepspeed/compression/scheduler.py`` (``compression_scheduler``
+— flips per-module enable flags once ``training_steps`` passes each technique's
+``schedule_offset``) plus the MoQ-style bit annealing (``start_bits`` →
+``target_bits`` stepped every ``quantization_period`` steps).
+
+Because the train step is a compiled XLA program, the schedule lives on the host:
+``state(step)`` returns a hashable snapshot (which techniques are active + current
+bits per group). The engine keys its compiled-step cache on that snapshot, so a
+schedule transition triggers exactly one recompile — annealing bits one at a time
+bounds the number of programs to ``start_bits - target_bits + 1`` per group.
+"""
+
+from typing import Any, Dict, Tuple
+
+QUANT_METHODS = ("weight_quantization", "activation_quantization")
+PRUNE_METHODS = ("sparse_pruning", "row_pruning", "head_pruning", "channel_pruning")
+
+
+class CompressionScheduler:
+
+    def __init__(self, compression_config: Dict[str, Any]):
+        self.config = compression_config
+        self.training_steps = 0
+
+    def step(self, increment: int = 1) -> None:
+        self.training_steps += increment
+
+    def _method_active(self, method: str) -> bool:
+        mcfg = self.config.get(method)
+        if not mcfg:
+            return False
+        shared = mcfg.get("shared_parameters", {})
+        if not shared.get("enabled", False):
+            return False
+        offset = shared.get("schedule_offset", 0)
+        end = shared.get("schedule_offset_end", None)
+        if self.training_steps < offset:
+            return False
+        if end is not None and self.training_steps > end:
+            return False
+        return True
+
+    def current_bits(self, group_params: Dict[str, Any]) -> int:
+        """Annealed bit width for a weight-quantization group: start_bits drops by
+        one every ``quantization_period`` steps until target_bits."""
+        start = int(group_params.get("start_bits", group_params.get("bits", 8)))
+        target = int(group_params.get("target_bits", start))
+        period = int(group_params.get("quantization_period", 0))
+        if period <= 0 or start <= target:
+            return target
+        return max(target, start - self.training_steps // period)
+
+    def state(self, step: int = None) -> Tuple:
+        """Hashable snapshot of everything *static* about compression at ``step``
+        (active methods + per-group bits). Changes ⇒ the engine recompiles."""
+        if step is not None:
+            self.training_steps = step
+        snap = []
+        for method in QUANT_METHODS + PRUNE_METHODS:
+            if not self._method_active(method):
+                continue
+            groups = self.config.get(method, {}).get("different_groups", {})
+            gsnap = []
+            for gname, g in sorted(groups.items()):
+                params = g.get("params", {})
+                bits = self.current_bits(params) if method == "weight_quantization" \
+                    else int(params.get("bits", 8))
+                gsnap.append((gname, bits))
+            snap.append((method, tuple(gsnap)))
+        return tuple(snap)

@@ -262,6 +262,10 @@ class DeepSpeedTPUConfig:
         self.elasticity = ElasticityConfig(**self._raw.get(C.ELASTICITY, {}))
         self.curriculum_learning_legacy = CurriculumLegacyConfig(
             **self._raw.get(C.CURRICULUM_LEARNING, {}))
+        # compression_training keeps the reference's nested-dict schema verbatim
+        # (deepspeed/compression/config.py); parsed lazily by the Compressor
+        self.compression_config: Dict[str, Any] = dict(
+            self._raw.get(C.COMPRESSION_TRAINING, {}))
         self.data_efficiency = DataEfficiencyConfig(
             **self._raw.get(C.DATA_EFFICIENCY, {}))
 

@@ -56,6 +56,9 @@ class CurriculumScheduler:
     def _root_difficulty(self, global_step: int, degree: float) -> int:
         sc = self.schedule_config
         frac = min(1.0, max(0.0, global_step / sc["total_curriculum_step"]))
+        if frac >= 1.0:
+            # exact max at completion even when it isn't a multiple of the step
+            return self.max_difficulty
         raw = self.min_difficulty + (self.max_difficulty - self.min_difficulty) * \
             (frac ** (1.0 / degree))
         dstep = sc["difficulty_step"]

@@ -101,9 +101,19 @@ class CurriculumDataSampler:
         need = self.global_batch_size
         while need > 0:
             if pool.size == 0:
-                # difficulty-epoch boundary: everything admitted becomes fresh again
+                # difficulty-epoch boundary: everything admitted becomes fresh
+                # again — except indices already drawn into THIS batch, so a
+                # global batch never contains duplicates
                 self.consumed[admitted] = False
                 pool = np.flatnonzero(admitted)
+                if batch:
+                    drawn = np.concatenate(batch)
+                    self.consumed[drawn] = True
+                    pool = np.setdiff1d(pool, drawn, assume_unique=False)
+                    if pool.size == 0:
+                        # batch larger than the admitted pool: duplicates are
+                        # unavoidable, fall back to the full pool
+                        pool = np.flatnonzero(admitted)
             take = min(need, pool.size)
             chosen = rng.choice(pool, size=take, replace=False)
             self.consumed[chosen] = True

@@ -228,10 +228,7 @@ class DeepSpeedTPUEngine:
         self.batch_sharding = NamedSharding(self.mesh, self.batch_spec)
 
         # --- compiled functions ----------------------------------------------
-        self._train_batch_fn = None     # gas microbatches fused via scan
-        self._micro_fwd_bwd_fn = None   # compat path: per-microbatch grads
-        self._apply_update_fn = None    # compat path: update at boundary
-        self._eval_fn = None
+        self._reset_compiled_fns()
 
         # --- compat-shim bookkeeping ----------------------------------------
         self._grad_buffer = None
@@ -273,11 +270,37 @@ class DeepSpeedTPUEngine:
             ltd.setdefault("global_batch_size", self.train_batch_size)
             self.random_ltd_scheduler = RandomLTDScheduler(ltd)
 
+        # --- compression (QAT / pruning; reference deepspeed/compression) -----
+        self.compressor = None
+        self._compression_key = None
+        if config.compression_config:
+            from deepspeed_tpu.compression import init_compression
+            self.compressor = init_compression(
+                self.state.params,
+                {"compression_training": config.compression_config})
+            self.compressor.maybe_freeze_masks(self.state.params)
+            self._compression_key = self.compressor.schedule_key()
+
+    def _reset_compiled_fns(self):
+        """Drop every cached compiled step fn. The single authority for the set of
+        jitted-fn caches — used at init and whenever static trace structure
+        changes (e.g. a compression-schedule transition)."""
+        self._train_batch_fn = None     # gas microbatches fused via scan
+        self._micro_fwd_bwd_fn = None   # compat path: per-microbatch grads
+        self._apply_update_fn = None    # compat path: update at boundary
+        self._eval_fn = None
+        self._offload_grad_fn = None
+        self._offload_apply_fn = None
+
     # ------------------------------------------------------------------
     # loss computation
     # ------------------------------------------------------------------
     def _compute_loss(self, params, batch, rng):
         compute_params = precision.cast_to_compute(params, self.compute_dtype)
+        if self.compressor is not None:
+            # fake-quant + pruning masks with straight-through grads, traced into
+            # the step under the current host-side schedule snapshot
+            compute_params = self.compressor.transform(compute_params)
         out = self._apply_fn(compute_params, batch, rng)
         if self.loss_fn is not None:
             out = self.loss_fn(out, batch)
@@ -525,6 +548,16 @@ class DeepSpeedTPUEngine:
             self.curriculum_scheduler.update_difficulty(self.global_steps)
         if self.random_ltd_scheduler is not None:
             self.random_ltd_scheduler.update_seq(self.global_steps)
+        if self.compressor is not None:
+            self.compressor.set_step(self.global_steps)
+            self.compressor.maybe_freeze_masks(self.state.params)
+            key = self.compressor.schedule_key()
+            if key != self._compression_key:
+                # schedule transition (technique activated / bits annealed):
+                # drop every compiled step so the next call re-traces with the
+                # new static compression structure
+                self._compression_key = key
+                self._reset_compiled_fns()
 
     def set_custom_curriculum_learning_schedule(self, schedule_fn):
         """reference: engine.set_custom_curriculum_learning_schedule — install a
