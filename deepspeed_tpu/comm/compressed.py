@@ -1,0 +1,105 @@
+"""Error-feedback sign-compressed collectives (1-bit compression).
+
+Reference analog: ``deepspeed/runtime/comm/compressed.py`` (``CompressedBackend.
+compressed_allreduce`` — the NCCL/MPI variants in ``runtime/comm/{nccl,mpi}.py``
+implement the same two-phase algorithm with cupy/mpi4py packbits). Algorithm
+(1-bit Adam, arXiv:2102.02888):
+
+1. worker compensates its tensor with its error buffer, compresses to
+   ``sign × scale`` (scale = ‖x‖₂/√n), records the new compression error;
+2. signs are exchanged chunk-wise (all-to-all) + scales allgathered; each worker
+   averages its chunk across workers ("server" role), compensates with the
+   server error buffer, compresses again;
+3. compressed server chunks are allgathered so every worker ends with the full
+   averaged tensor.
+
+TPU-native shape: a pure function over a named mesh axis usable inside
+``shard_map`` — ``lax.all_to_all``/``all_gather`` ride ICI/DCN, signs travel as
+packed uint8 bitmaps (32× smaller than f32, matching the reference's cupy
+packbits wire format).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pack_signs(bits: jnp.ndarray) -> jnp.ndarray:
+    """{0,1} int array [m] (m % 8 == 0) -> uint8 [m/8] bitmap (LSB-first)."""
+    b = bits.reshape(-1, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    return (b * weights).sum(-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray, m: int) -> jnp.ndarray:
+    """uint8 bitmap -> ±1 float32 [m]."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & 1
+    return bits.reshape(-1)[:m].astype(jnp.float32) * 2.0 - 1.0
+
+
+def _compress(x: jnp.ndarray, error: jnp.ndarray
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Error-feedback 1-bit compression: returns (scale, sign_bits, new_error).
+    sign convention matches the reference (x >= 0 → +1)."""
+    comp = x + error
+    n = comp.size
+    scale = jnp.linalg.norm(comp) / jnp.sqrt(jnp.float32(n))
+    signs = (comp >= 0).astype(jnp.float32) * 2.0 - 1.0
+    new_error = comp - scale * signs
+    return scale, (comp >= 0).astype(jnp.uint8), new_error
+
+
+def compress_local(x: jnp.ndarray, error: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-party compression (the degenerate world-size-1 path): returns the
+    decompressed value and the new error buffer."""
+    scale, bits, new_error = _compress(x, error)
+    return scale * (bits.astype(jnp.float32) * 2.0 - 1.0), new_error
+
+
+def compressed_allreduce(x: jnp.ndarray,
+                         worker_error: jnp.ndarray,
+                         server_error: jnp.ndarray,
+                         axis_name: Optional[str]
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """1-bit-compressed mean-allreduce over ``axis_name`` (call inside shard_map).
+
+    ``x``/``worker_error``: flat [n], n divisible by 8·W;
+    ``server_error``: flat [n/W]. Returns (mean_estimate [n], new_worker_error,
+    new_server_error).
+    """
+    if axis_name is None:
+        out, new_we = compress_local(x, worker_error)
+        return out, new_we, server_error
+    w = lax.psum(1, axis_name)
+    n = x.size
+    # phase 1: compress locally, exchange sign chunks + scales
+    scale, bits, new_worker_error = _compress(x, worker_error)
+    packed = pack_signs(bits).reshape(w, -1)          # [W, n/W/8] uint8
+    recv = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0)
+    scales = lax.all_gather(scale, axis_name)         # [W]
+    chunk = n // w
+    peer_signs = jax.vmap(lambda p: unpack_signs(p, chunk))(recv)  # [W, n/W]
+    # "server" reduce: mean of peers' compressed chunks + error feedback
+    server_chunk = (peer_signs * scales[:, None]).mean(0) + server_error
+    s_scale = jnp.linalg.norm(server_chunk) / jnp.sqrt(jnp.float32(chunk))
+    s_bits = (server_chunk >= 0).astype(jnp.uint8)
+    s_signs = s_bits.astype(jnp.float32) * 2.0 - 1.0
+    new_server_error = server_chunk - s_scale * s_signs
+    # phase 2: allgather compressed server chunks
+    packed_s = pack_signs(s_bits)
+    all_packed = lax.all_gather(packed_s, axis_name)  # [W, n/W/8]
+    all_scales = lax.all_gather(s_scale, axis_name)   # [W]
+    all_signs = jax.vmap(lambda p: unpack_signs(p, chunk))(all_packed)
+    out = (all_signs * all_scales[:, None]).reshape(n)
+    return out, new_worker_error, new_server_error
+
+
+def error_buffer_shapes(n: int, world_size: int) -> Tuple[int, int]:
+    """(padded_n, server_chunk) for a flat tensor of ``n`` elements: padded so
+    chunks are byte-aligned per worker."""
+    align = 8 * world_size
+    padded = ((n + align - 1) // align) * align
+    return padded, padded // world_size

@@ -177,17 +177,22 @@ class Compressor:
             return replaced.get(_path_name(path), leaf)
         return jax.tree_util.tree_map_with_path(sub, params)
 
-    def quantize_activations(self, x: jnp.ndarray, layer_name: str = "") -> jnp.ndarray:
-        """For models that opt in per-layer (reference QuantAct usage)."""
+    def quantize_activations(self, x: jnp.ndarray, layer_name: str) -> jnp.ndarray:
+        """For models that opt in per-layer (reference QuantAct usage): quantize
+        iff ``layer_name`` matches a configured activation-quantization group's
+        module patterns. No match (including an empty name) → unchanged."""
         active = dict(self.schedule_key())
         if "activation_quantization" not in active:
             return x
         shared = self.config["activation_quantization"].get("shared_parameters", {})
         sym = shared.get("quantization_type", "symmetric") == "symmetric"
-        for name, gparams in self.assignments.get("activation_quantization", []):
-            if not layer_name or re.search(name.rsplit("/", 1)[0], layer_name):
-                return ops.quantize_activation(x, int(gparams.get("bits", 8)),
-                                               symmetric=sym)
+        groups = self.config["activation_quantization"].get("different_groups", {})
+        for _, group in sorted(groups.items()):
+            for pattern in group.get("modules", [".*"]):
+                if layer_name and re.search(pattern, layer_name):
+                    gparams = {**shared, **group.get("params", {})}
+                    return ops.quantize_activation(x, int(gparams.get("bits", 8)),
+                                                   symmetric=sym)
         return x
 
 

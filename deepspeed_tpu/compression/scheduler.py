@@ -44,13 +44,17 @@ class CompressionScheduler:
 
     def current_bits(self, group_params: Dict[str, Any]) -> int:
         """Annealed bit width for a weight-quantization group: start_bits drops by
-        one every ``quantization_period`` steps until target_bits."""
+        one every ``quantization_period`` steps until target_bits. The anneal
+        clock starts at the technique's ``schedule_offset`` (activation step),
+        so the first quantized steps really run at start_bits."""
         start = int(group_params.get("start_bits", group_params.get("bits", 8)))
         target = int(group_params.get("target_bits", start))
         period = int(group_params.get("quantization_period", 0))
         if period <= 0 or start <= target:
             return target
-        return max(target, start - self.training_steps // period)
+        offset = int(group_params.get("schedule_offset", 0))
+        active_steps = max(0, self.training_steps - offset)
+        return max(target, start - active_steps // period)
 
     def state(self, step: int = None) -> Tuple:
         """Hashable snapshot of everything *static* about compression at ``step``
@@ -61,10 +65,11 @@ class CompressionScheduler:
         for method in QUANT_METHODS + PRUNE_METHODS:
             if not self._method_active(method):
                 continue
-            groups = self.config.get(method, {}).get("different_groups", {})
+            mcfg = self.config.get(method, {})
+            shared = mcfg.get("shared_parameters", {})
             gsnap = []
-            for gname, g in sorted(groups.items()):
-                params = g.get("params", {})
+            for gname, g in sorted(mcfg.get("different_groups", {}).items()):
+                params = {**shared, **g.get("params", {})}
                 bits = self.current_bits(params) if method == "weight_quantization" \
                     else int(params.get("bits", 8))
                 gsnap.append((gname, bits))

@@ -1,0 +1,306 @@
+"""1-bit / 0-1 communication-compressed optimizers.
+
+Reference analogs: ``deepspeed/runtime/fp16/onebit/adam.py:14`` (OnebitAdam),
+``lamb.py`` (OnebitLamb), ``zoadam.py`` (ZeroOneAdam). Semantics preserved:
+
+- **OneBitAdam** — two stages. Warmup (step < ``freeze_step``): vanilla Adam
+  with bias correction. Compressed stage: the *variance is frozen*; the momentum
+  is updated with fresh grads and then passed through the error-feedback 1-bit
+  compressor (``comm/compressed.py``) — that compressed momentum (not the grads)
+  is what crosses the wire; the update is ``m / (√v_frozen + eps)`` with no bias
+  correction (reference adam.py:230).
+- **ZeroOneAdam** — removes the hard freeze: the variance refreshes at
+  exponentially growing intervals (``var_update_scaler``) until
+  ``var_freeze_step``; momentum is always sign-compressed with error feedback
+  (reference zoadam.py learning-rate/variance freeze policies; the local-step
+  policy collapses under SPMD where every step is synchronous).
+- **OneBitLamb** — warmup runs vanilla LAMB while recording per-tensor trust
+  ratios; the compressed stage reuses the *frozen* trust ratio with 1-bit
+  momentum (reference lamb.py scaling-coefficient freezing).
+
+TPU-native shape: optax ``GradientTransformation``s. Under SPMD the engine's
+grads arrive already averaged, so the compressor's distributed path
+(``axis_name``) matters when the transform runs inside ``shard_map`` over the
+data axis (multi-slice DCN, where 32× momentum compression pays); otherwise the
+local error-feedback compressor preserves the exact update semantics.
+"""
+
+from typing import Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.comm.compressed import (
+    compress_local, compressed_allreduce, error_buffer_shapes)
+
+ScheduleOrFloat = Union[float, Callable]
+
+
+def _lr_at(lr: ScheduleOrFloat, count):
+    return lr(count) if callable(lr) else lr
+
+
+def _compress_leaf(m, we, se, axis_name):
+    """Flatten + pad a momentum leaf, run the (distributed) compressor, restore."""
+    flat = m.astype(jnp.float32).ravel()
+    pad = we.size - flat.size
+    flat = jnp.pad(flat, (0, pad))
+    if axis_name is None:
+        out, new_we = compress_local(flat, we)
+        new_se = se
+    else:
+        out, new_we, new_se = compressed_allreduce(flat, we, se, axis_name)
+    return out[:m.size].reshape(m.shape).astype(m.dtype), new_we, new_se
+
+
+class OneBitAdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    worker_error: optax.Updates
+    server_error: optax.Updates
+
+
+def _error_buffers(params, world_size: int):
+    def we(p):
+        padded, _ = error_buffer_shapes(p.size, world_size)
+        return jnp.zeros((padded,), jnp.float32)
+
+    def se(p):
+        _, chunk = error_buffer_shapes(p.size, world_size)
+        return jnp.zeros((chunk,), jnp.float32)
+    return jax.tree.map(we, params), jax.tree.map(se, params)
+
+
+def onebit_adam(learning_rate: ScheduleOrFloat,
+                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                weight_decay: float = 0.0,
+                freeze_step: int = 100000,
+                world_size: int = 1,
+                axis_name: Optional[str] = None,
+                update_clip: float = 10.0) -> optax.GradientTransformation:
+    """reference: runtime/fp16/onebit/adam.py:14 (OnebitAdam).
+
+    ``update_clip`` is a TPU-side stabilization absent in the reference: in the
+    compressed stage each coordinate's raw update ``m/(sqrt(v_frozen)+eps)`` is
+    clipped elementwise to ±update_clip. Healthy coordinates sit at O(1); only
+    near-zero-variance coordinates (which the reference handles with a
+    hand-written ``exp_avg_mask``) are affected."""
+
+    def init(params):
+        we, se = _error_buffers(params, world_size)
+        return OneBitAdamState(
+            count=jnp.zeros([], jnp.int32),
+            exp_avg=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            exp_avg_sq=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            worker_error=we, server_error=se)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        frozen = count > freeze_step
+
+        def warmup(_):
+            m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                             state.exp_avg, grads)
+            v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(
+                g.astype(jnp.float32)), state.exp_avg_sq, grads)
+            bc1 = 1 - b1 ** count.astype(jnp.float32)
+            bc2 = 1 - b2 ** count.astype(jnp.float32)
+            upd = jax.tree.map(
+                lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), m, v)
+            return upd, m, v, state.worker_error, state.server_error
+
+        def compressed(_):
+            m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                             state.exp_avg, grads)
+            flat_m, tree = jax.tree.flatten(m)
+            flat_we = jax.tree.leaves(state.worker_error)
+            flat_se = jax.tree.leaves(state.server_error)
+            outs = [_compress_leaf(mm, we, se, axis_name)
+                    for mm, we, se in zip(flat_m, flat_we, flat_se)]
+            # automatic exp_avg_mask (reference adam.py:218-227): coordinates
+            # whose frozen variance is exactly zero saw no gradient during
+            # warmup — sign-compression noise there would divide by eps and
+            # explode, so mask both the momentum and the update
+            mask = jax.tree.map(lambda v: (v > 0).astype(jnp.float32),
+                                state.exp_avg_sq)
+            m_c = jax.tree.unflatten(tree, [o[0] for o in outs])
+            m_c = jax.tree.map(jnp.multiply, m_c, mask)
+            new_we = jax.tree.unflatten(tree, [o[1] for o in outs])
+            new_se = jax.tree.unflatten(tree, [o[2] for o in outs])
+            # frozen variance, no bias correction (reference adam.py:230);
+            # elementwise trust clip guards tiny-variance coordinates
+            upd = jax.tree.map(
+                lambda m, v: jnp.clip(m / (jnp.sqrt(v) + eps),
+                                      -update_clip, update_clip),
+                m_c, state.exp_avg_sq)
+            return upd, m_c, state.exp_avg_sq, new_we, new_se
+
+        upd, m, v, we, se = jax.lax.cond(frozen, compressed, warmup, None)
+        lr = _lr_at(learning_rate, state.count)
+        if weight_decay and params is not None:
+            upd = jax.tree.map(lambda u, p: u + weight_decay * p.astype(jnp.float32),
+                               upd, params)
+        updates = jax.tree.map(lambda u, g: (-lr * u).astype(g.dtype), upd, grads)
+        return updates, OneBitAdamState(count, m, v, we, se)
+
+    return optax.GradientTransformation(init, update)
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    worker_error: optax.Updates
+    server_error: optax.Updates
+    var_interval: jnp.ndarray   # current variance-refresh interval
+    var_counter: jnp.ndarray    # refreshes done at this interval
+
+
+def zero_one_adam(learning_rate: ScheduleOrFloat,
+                  b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                  weight_decay: float = 0.0,
+                  var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16,
+                  world_size: int = 1,
+                  axis_name: Optional[str] = None,
+                  update_clip: float = 10.0) -> optax.GradientTransformation:
+    """reference: runtime/fp16/onebit/zoadam.py (ZeroOneAdam). Variance updates
+    happen when ``count % var_interval == 0``; after ``var_update_scaler``
+    refreshes the interval doubles (exponential policy, zoadam.py:269-277);
+    past ``var_freeze_step`` the variance never refreshes again. Momentum is
+    1-bit-compressed with error feedback from step one."""
+
+    def init(params):
+        we, se = _error_buffers(params, world_size)
+        return ZeroOneAdamState(
+            count=jnp.zeros([], jnp.int32),
+            exp_avg=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            exp_avg_sq=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            worker_error=we, server_error=se,
+            var_interval=jnp.ones([], jnp.int32),
+            var_counter=jnp.zeros([], jnp.int32))
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                         state.exp_avg, grads)
+        flat_m, tree = jax.tree.flatten(m)
+        outs = [_compress_leaf(mm, we, se, axis_name)
+                for mm, we, se in zip(flat_m, jax.tree.leaves(state.worker_error),
+                                      jax.tree.leaves(state.server_error))]
+        m_c = jax.tree.unflatten(tree, [o[0] for o in outs])
+        new_we = jax.tree.unflatten(tree, [o[1] for o in outs])
+        new_se = jax.tree.unflatten(tree, [o[2] for o in outs])
+
+        refresh = jnp.logical_and(count % state.var_interval == 0,
+                                  count <= var_freeze_step)
+        v = jax.tree.map(
+            lambda v, g: jnp.where(refresh,
+                                   b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+                                   v),
+            state.exp_avg_sq, grads)
+        # mask zero-variance coordinates (no gradient signal yet) — same guard
+        # as onebit_adam's automatic exp_avg_mask
+        m_c = jax.tree.map(lambda m, v: m * (v > 0).astype(jnp.float32), m_c, v)
+        var_counter = jnp.where(refresh, state.var_counter + 1, state.var_counter)
+        grow = var_counter >= var_update_scaler
+        var_interval = jnp.where(grow, state.var_interval * 2, state.var_interval)
+        var_counter = jnp.where(grow, 0, var_counter)
+
+        upd = jax.tree.map(
+            lambda m, v: jnp.clip(m / (jnp.sqrt(v) + eps), -update_clip, update_clip),
+            m_c, v)
+        lr = _lr_at(learning_rate, state.count)
+        if weight_decay and params is not None:
+            upd = jax.tree.map(lambda u, p: u + weight_decay * p.astype(jnp.float32),
+                               upd, params)
+        updates = jax.tree.map(lambda u, g: (-lr * u).astype(g.dtype), upd, grads)
+        return updates, ZeroOneAdamState(count, m_c, v, new_we, new_se,
+                                         var_interval, var_counter)
+
+    return optax.GradientTransformation(init, update)
+
+
+class OneBitLambState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    worker_error: optax.Updates
+    server_error: optax.Updates
+    frozen_ratio: optax.Updates  # per-tensor trust ratio recorded during warmup
+
+
+def onebit_lamb(learning_rate: ScheduleOrFloat,
+                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-6,
+                weight_decay: float = 0.0,
+                freeze_step: int = 100000,
+                max_coeff: float = 10.0, min_coeff: float = 0.01,
+                world_size: int = 1,
+                axis_name: Optional[str] = None,
+                update_clip: float = 10.0) -> optax.GradientTransformation:
+    """reference: runtime/fp16/onebit/lamb.py (OnebitLamb). Warmup = LAMB with
+    live trust ratios (clipped to [min_coeff, max_coeff]), recorded per tensor;
+    compressed stage reuses the frozen ratios with 1-bit momentum."""
+
+    def trust_ratio(p, u):
+        pn = jnp.linalg.norm(p.astype(jnp.float32))
+        un = jnp.linalg.norm(u)
+        raw = jnp.where(un > 0, pn / jnp.maximum(un, 1e-12), 1.0)
+        return jnp.clip(jnp.where(pn > 0, raw, 1.0), min_coeff, max_coeff)
+
+    def init(params):
+        we, se = _error_buffers(params, world_size)
+        return OneBitLambState(
+            count=jnp.zeros([], jnp.int32),
+            exp_avg=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            exp_avg_sq=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            worker_error=we, server_error=se,
+            frozen_ratio=jax.tree.map(lambda p: jnp.ones([], jnp.float32), params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("onebit_lamb requires params (trust ratio)")
+        count = state.count + 1
+        frozen = count > freeze_step
+
+        def warmup(_):
+            m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                             state.exp_avg, grads)
+            v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(
+                g.astype(jnp.float32)), state.exp_avg_sq, grads)
+            bc1 = 1 - b1 ** count.astype(jnp.float32)
+            bc2 = 1 - b2 ** count.astype(jnp.float32)
+            upd = jax.tree.map(
+                lambda m, v, p: (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+                + weight_decay * p.astype(jnp.float32), m, v, params)
+            ratios = jax.tree.map(trust_ratio, params, upd)
+            return upd, m, v, state.worker_error, state.server_error, ratios
+
+        def compressed(_):
+            m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                             state.exp_avg, grads)
+            flat_m, tree = jax.tree.flatten(m)
+            outs = [_compress_leaf(mm, we, se, axis_name)
+                    for mm, we, se in zip(flat_m, jax.tree.leaves(state.worker_error),
+                                          jax.tree.leaves(state.server_error))]
+            mask = jax.tree.map(lambda v: (v > 0).astype(jnp.float32),
+                                state.exp_avg_sq)
+            m_c = jax.tree.unflatten(tree, [o[0] for o in outs])
+            m_c = jax.tree.map(jnp.multiply, m_c, mask)
+            new_we = jax.tree.unflatten(tree, [o[1] for o in outs])
+            new_se = jax.tree.unflatten(tree, [o[2] for o in outs])
+            upd = jax.tree.map(
+                lambda m, v, p: jnp.clip(m / (jnp.sqrt(v) + eps),
+                                         -update_clip, update_clip)
+                + weight_decay * p.astype(jnp.float32), m_c, state.exp_avg_sq, params)
+            return upd, m_c, state.exp_avg_sq, new_we, new_se, state.frozen_ratio
+
+        upd, m, v, we, se, ratios = jax.lax.cond(frozen, compressed, warmup, None)
+        lr = _lr_at(learning_rate, state.count)
+        updates = jax.tree.map(lambda u, r, g: (-lr * r * u).astype(g.dtype),
+                               upd, ratios, grads)
+        return updates, OneBitLambState(count, m, v, we, se, ratios)
+
+    return optax.GradientTransformation(init, update)

@@ -71,14 +71,27 @@ def build_optimizer(opt_type: str, opt_params: Dict[str, Any],
                            eps=opt_params.get("eps", 1e-8),
                            momentum=opt_params.get("momentum", 0.0))
     elif name in ("onebitadam", "zerooneadam", "onebitlamb"):
-        # Reference 1-bit optimizers (runtime/fp16/onebit/) compress DP gradient
-        # traffic. Under SPMD the grad reduce is an XLA collective; int8-compressed
-        # collectives are provided at the ZeRO++ layer (zero_quantized_gradients)
-        # rather than inside the optimizer. Fall back to the uncompressed update.
-        log_dist(f"{opt_type}: 1-bit comm compression maps to quantized collectives "
-                 f"on TPU (zero_quantized_gradients); using standard update", ranks=[0])
-        tx = _adam_like(lr, opt_params, 0.0, decoupled=False) \
-            if "adam" in name else optax.lamb(lr)
+        from deepspeed_tpu.ops import onebit
+        betas = opt_params.get("betas", (0.9, 0.999))
+        common = dict(b1=betas[0], b2=betas[1],
+                      weight_decay=opt_params.get("weight_decay", 0.0),
+                      world_size=opt_params.get("world_size", 1),
+                      axis_name=opt_params.get("axis_name"))
+        if name == "onebitadam":
+            tx = onebit.onebit_adam(lr, eps=opt_params.get("eps", 1e-8),
+                                    freeze_step=opt_params.get("freeze_step", 100000),
+                                    **common)
+        elif name == "zerooneadam":
+            tx = onebit.zero_one_adam(
+                lr, eps=opt_params.get("eps", 1e-8),
+                var_freeze_step=opt_params.get("var_freeze_step", 100000),
+                var_update_scaler=opt_params.get("var_update_scaler", 16), **common)
+        else:
+            tx = onebit.onebit_lamb(
+                lr, eps=opt_params.get("eps", 1e-6),
+                freeze_step=opt_params.get("freeze_step", 100000),
+                max_coeff=opt_params.get("max_coeff", 10.0),
+                min_coeff=opt_params.get("min_coeff", 0.01), **common)
     else:
         raise ValueError(f"unknown optimizer type '{opt_type}'")
     return tx

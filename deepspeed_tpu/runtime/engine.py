@@ -769,4 +769,8 @@ class DeepSpeedTPUEngine:
             for step in range(1, self.global_steps):
                 self.random_ltd_scheduler.update_seq(step)
         self._advance_data_schedules()
+        if self.compressor is not None:
+            # restored pruning masks are baked into compiled steps as constants
+            # and are NOT part of _compression_key — always re-trace after load
+            self._reset_compiled_fns()
         return out

@@ -119,13 +119,17 @@ def test_scheduler_offsets_and_bit_annealing():
     assert s.state(step=0) == ()
     st10 = s.state(step=10)
     assert st10 and st10[0][0] == "weight_quantization"
-    assert s.current_bits({"start_bits": 8, "target_bits": 4,
-                           "quantization_period": 5}) == 8 - 10 // 5
+    # the anneal clock starts at schedule_offset: at the activation step the
+    # bits are still start_bits
+    anneal = {"start_bits": 8, "target_bits": 4, "quantization_period": 5,
+              "schedule_offset": 10}
+    assert s.current_bits(anneal) == 8
+    s.state(step=17)
+    assert s.current_bits(anneal) == 8 - (17 - 10) // 5
     assert dict(s.state(step=25)).keys() >= {"sparse_pruning"}
     assert "sparse_pruning" not in dict(s.state(step=31))  # past offset_end
     s.state(step=100)
-    assert s.current_bits({"start_bits": 8, "target_bits": 4,
-                           "quantization_period": 5}) == 4  # floored at target
+    assert s.current_bits(anneal) == 4  # floored at target
 
 
 # ------------------------------------------------------------------ Compressor

@@ -1,0 +1,193 @@
+"""1-bit optimizer + compressed collective tests.
+
+Reference analog: tests/unit/onebit/ (convergence of Onebit optimizers vs plain
+Adam on small problems; compressed-backend correctness).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.compressed import (
+    compress_local, compressed_allreduce, error_buffer_shapes, pack_signs,
+    unpack_signs)
+from deepspeed_tpu.ops.onebit import onebit_adam, onebit_lamb, zero_one_adam
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+
+# ---------------------------------------------------------------- packing
+def test_pack_unpack_roundtrip():
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (128,))
+    bits = (x >= 0).astype(jnp.uint8)
+    packed = pack_signs(bits)
+    assert packed.shape == (16,) and packed.dtype == jnp.uint8
+    signs = unpack_signs(packed, 128)
+    np.testing.assert_array_equal(np.asarray(signs), np.where(np.asarray(x) >= 0, 1, -1))
+
+
+def test_error_feedback_accumulates_to_truth():
+    # With error feedback, the running sum of compressed outputs tracks the
+    # running sum of inputs (the compression error does not accumulate).
+    rng = jax.random.PRNGKey(1)
+    x = jax.random.normal(rng, (256,)) * jnp.linspace(0.1, 10, 256)
+    err = jnp.zeros_like(x)
+    total_out = jnp.zeros_like(x)
+    # running-average error decays as O(1/T) — the bounded per-step compression
+    # error is carried, not accumulated
+    rels = []
+    for t in range(1, 201):
+        out, err = compress_local(x, err)
+        total_out += out
+        rels.append(float(jnp.linalg.norm(total_out / t - x) / jnp.linalg.norm(x)))
+    assert rels[199] < rels[49] < rels[9]
+    assert rels[199] < 0.05, rels[199]
+
+
+# ---------------------------------------------------------------- collective
+def _mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+
+def test_compressed_allreduce_approximates_mean():
+    mesh = _mesh8()
+    w = 8
+    n_local, chunk = error_buffer_shapes(512, w)
+    rng = jax.random.PRNGKey(2)
+    xs = jax.random.normal(rng, (w, n_local))  # one row per worker
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp", None), P("dp", None), P("dp", None)),
+             out_specs=(P("dp", None), P("dp", None), P("dp", None)))
+    def run(x, we, se):
+        out, nwe, nse = compressed_allreduce(x[0], we[0], se[0], "dp")
+        return out[None], nwe[None], nse[None]
+
+    we = jnp.zeros((w, n_local))
+    se = jnp.zeros((w, chunk))
+    true_mean = xs.mean(0)
+    # iterate: error feedback drives the estimate toward the true mean
+    est_sum = jnp.zeros_like(true_mean)
+    iters = 30
+    for _ in range(iters):
+        out, we, se = run(xs, we, se)
+        # every worker receives the same full-length result
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out[3]), rtol=1e-5)
+        est_sum += out[0]
+    rel = jnp.linalg.norm(est_sum / iters - true_mean) / jnp.linalg.norm(true_mean)
+    assert float(rel) < 0.1, float(rel)
+
+
+# ---------------------------------------------------------------- optimizers
+def _quadratic_problem(d=32, seed=0):
+    k = jax.random.PRNGKey(seed)
+    target = jax.random.normal(k, (d,))
+
+    def loss(p):
+        return jnp.sum((p - target) ** 2)
+    return loss, jnp.zeros((d,)), target
+
+
+def _run_opt(tx, loss, p0, steps):
+    state = tx.init(p0)
+    p = p0
+    for _ in range(steps):
+        g = jax.grad(loss)(p)
+        upd, state = tx.update(g, state, p)
+        p = optax.apply_updates(p, upd)
+    return p, state
+
+
+def test_onebit_adam_converges_through_freeze():
+    loss, p0, target = _quadratic_problem()
+    tx = onebit_adam(0.01, freeze_step=30)
+    p, state = _run_opt(tx, loss, p0, 120)
+    assert int(state.count) == 120
+    assert float(loss(p)) < 0.02 * float(loss(p0))
+
+
+def test_onebit_adam_variance_frozen_after_freeze_step():
+    loss, p0, _ = _quadratic_problem()
+    tx = onebit_adam(0.05, freeze_step=5)
+    state = tx.init(p0)
+    p = p0
+    for i in range(5):
+        g = jax.grad(loss)(p)
+        upd, state = tx.update(g, state, p)
+        p = optax.apply_updates(p, upd)
+    v_at_freeze = np.asarray(state.exp_avg_sq)
+    for i in range(10):
+        g = jax.grad(loss)(p)
+        upd, state = tx.update(g, state, p)
+        p = optax.apply_updates(p, upd)
+    np.testing.assert_array_equal(np.asarray(state.exp_avg_sq), v_at_freeze)
+    # worker error buffers are live (compression active)
+    assert float(jnp.abs(state.worker_error).sum()) > 0
+
+
+def test_onebit_adam_matches_adam_during_warmup():
+    loss, p0, _ = _quadratic_problem()
+    tx1 = onebit_adam(0.05, freeze_step=1000)
+    txa = optax.adam(0.05)
+    p1, _ = _run_opt(tx1, loss, p0, 20)
+    pa, _ = _run_opt(txa, loss, p0, 20)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(pa), atol=1e-5)
+
+
+def test_zero_one_adam_variance_refresh_policy():
+    loss, p0, _ = _quadratic_problem()
+    tx = zero_one_adam(0.01, var_freeze_step=1000, var_update_scaler=2)
+    p, state = _run_opt(tx, loss, p0, 40)
+    assert float(loss(p)) < 0.1 * float(loss(p0))
+    assert int(state.var_interval) > 1  # exponential policy kicked in
+
+
+def test_zero_one_adam_variance_hard_freeze():
+    loss, p0, _ = _quadratic_problem()
+    tx = zero_one_adam(0.05, var_freeze_step=3, var_update_scaler=100)
+    state = tx.init(p0)
+    p = p0
+    for _ in range(3):
+        g = jax.grad(loss)(p)
+        upd, state = tx.update(g, state, p)
+        p = optax.apply_updates(p, upd)
+    v3 = np.asarray(state.exp_avg_sq)
+    for _ in range(10):
+        g = jax.grad(loss)(p)
+        upd, state = tx.update(g, state, p)
+        p = optax.apply_updates(p, upd)
+    np.testing.assert_array_equal(np.asarray(state.exp_avg_sq), v3)
+
+
+def test_onebit_lamb_converges_and_freezes_ratio():
+    loss, p0, _ = _quadratic_problem()
+    p0 = p0 + 1.0  # nonzero params so trust ratio is meaningful
+    tx = onebit_lamb(0.01, freeze_step=20)
+    p, state = _run_opt(tx, loss, p0, 80)
+    assert float(loss(p)) < 0.1 * float(loss(p0 * 0 + p0))
+    r_frozen = np.asarray(state.frozen_ratio)
+    # frozen ratios stay fixed in compressed stage
+    g = jax.grad(loss)(p)
+    _, state2 = tx.update(g, state, p)
+    np.testing.assert_array_equal(np.asarray(state2.frozen_ratio), r_frozen)
+
+
+# ---------------------------------------------------------------- engine
+def test_engine_with_onebit_adam():
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-2, "freeze_step": 3}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=config,
+        example_batch=random_batch(4))
+    fixed = random_batch(8, seed=0)
+    losses = [float(engine.train_batch(batch=fixed)) for _ in range(20)]
+    assert losses[-1] < 0.2 * losses[0]
