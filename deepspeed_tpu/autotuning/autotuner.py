@@ -158,7 +158,8 @@ class Autotuner:
         fsdp = 1
         mesh = self.runner.mesh
         if mesh is not None:
-            fsdp = int(np.prod([mesh.shape.get(a, 1) for a in ("fsdp", "data")]))
+            fsdp = int(np.prod([mesh.shape.get(a, 1)
+                                for a in ("fsdp_out", "fsdp", "data")]))
         stages = self.feasible_stages(fsdp)
         exps = self.generate_experiments(stages)
         logger.info(f"autotuning: {len(exps)} candidates over stages {stages}, "

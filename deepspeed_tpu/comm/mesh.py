@@ -34,11 +34,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from deepspeed_tpu.config.config import MeshConfig
 from deepspeed_tpu.utils.logging import log_dist, logger
 
-# Canonical axis order, outermost (slowest, DCN-friendly) first.
-MESH_AXES: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "sequence", "tensor")
+# Canonical axis order, outermost (slowest, DCN-friendly) first. ``fsdp_out`` is
+# the hierarchical-sharding replica axis (size 1 unless MiCS / ZeRO++ hpZ splits
+# the ZeRO world): MiCS shards params over the inner ``fsdp`` sub-axis and
+# replicates across ``fsdp_out`` (reference runtime/zero/mics.py:64); hpZ keeps
+# the secondary compute shard on ``fsdp`` so per-layer gathers stay node-local
+# (reference partition_parameters.py:1664 _partition_param_sec).
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "fsdp_out", "fsdp", "expert",
+                              "sequence", "tensor")
 
 # Axes over which a replicated batch is split (DP world for batch-size math).
-BATCH_AXES: Tuple[str, ...] = ("data", "fsdp")
+BATCH_AXES: Tuple[str, ...] = ("data", "fsdp_out", "fsdp")
+
+# The full ZeRO sharding world (what stage 1-3 partition over).
+FSDP_AXES: Tuple[str, ...] = ("fsdp_out", "fsdp")
 
 _global_mesh: Optional[Mesh] = None
 
@@ -46,7 +55,8 @@ _global_mesh: Optional[Mesh] = None
 def resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> Dict[str, int]:
     """Fill the single -1 axis with the remaining device count; validate product."""
     sizes = {
-        "pipe": cfg.pipe, "data": cfg.data, "fsdp": cfg.fsdp,
+        "pipe": cfg.pipe, "data": cfg.data,
+        "fsdp_out": getattr(cfg, "fsdp_outer", 1), "fsdp": cfg.fsdp,
         "expert": cfg.expert, "sequence": cfg.sequence, "tensor": cfg.tensor,
     }
     unknown = [k for k, v in sizes.items() if v == -1]
@@ -113,8 +123,9 @@ def axis_size(mesh: Mesh, axis: str) -> int:
 
 
 def get_data_parallel_world_size(mesh: Mesh) -> int:
-    """DP world for batch math = data × fsdp (ZeRO shards inside DP)."""
-    return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
+    """DP world for batch math = data × fsdp_out × fsdp (ZeRO shards inside DP).
+    Tolerates user-built meshes that omit the optional fsdp_out axis."""
+    return int(np.prod([mesh.shape.get(a, 1) for a in BATCH_AXES]))
 
 
 def get_seq_data_parallel_world_size(mesh: Mesh) -> int:

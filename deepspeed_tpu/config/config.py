@@ -105,6 +105,9 @@ class MeshConfig(DeepSpeedTPUConfigModel):
     The reference expresses the same information via mpu / groups.py world sizes."""
     data: int = -1
     fsdp: int = 1
+    # hierarchical-sharding replica factor (MiCS / ZeRO++ hpZ): the ZeRO world is
+    # fsdp_outer x fsdp, with the inner fsdp axis holding the shard group
+    fsdp_outer: int = 1
     tensor: int = 1
     sequence: int = 1
     expert: int = 1

@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import PartitionSpec
 
 # logical activation axes -> mesh axes
-BATCH_AXES = ("data", "fsdp")
+from deepspeed_tpu.comm.mesh import BATCH_AXES  # ("data", "fsdp_out", "fsdp")
 SEQ_AXIS = "sequence"
 HEADS_AXIS = "tensor"
 

@@ -37,6 +37,7 @@ from deepspeed_tpu.runtime.lr_schedules import build_schedule, constant_lr
 from deepspeed_tpu.runtime.zero.partition import (
     build_opt_state_shardings,
     build_param_shardings,
+    build_secondary_shardings,
 )
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (
@@ -100,8 +101,31 @@ class DeepSpeedTPUEngine:
         self.model = model
         self.loss_fn = loss_fn
         self.accelerator = get_accelerator()
+
+        # --- hierarchical ZeRO world (MiCS / ZeRO++ hpZ) ---------------------
+        # Both split the ZeRO world into (fsdp_out x fsdp): MiCS shards within
+        # the inner group and replicates across groups (mics.py:64); hpZ keeps
+        # the full shard for memory but constrains the compute copy to the
+        # inner group (partition_parameters.py:1664).
+        zc = config.zero_config
+        self._mics = zc.mics_shard_size is not None and zc.mics_shard_size > 0
+        self._hpz = int(zc.zero_hpz_partition_size or 1)
+        inner = zc.mics_shard_size if self._mics else (self._hpz if self._hpz > 1 else 0)
+        if inner and mesh is None:
+            if config.mesh.fsdp == -1:
+                raise ValueError("MiCS/hpZ needs an explicit mesh.fsdp size to split")
+            if config.mesh.fsdp_outer == 1 and config.mesh.fsdp > inner:
+                if config.mesh.fsdp % inner:
+                    raise ValueError(
+                        f"fsdp={config.mesh.fsdp} not divisible by shard group {inner}")
+                config.mesh.fsdp_outer = config.mesh.fsdp // inner
+                config.mesh.fsdp = inner
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(config.mesh)
         mesh_lib.set_global_mesh(self.mesh)
+        if inner and self.mesh.shape.get("fsdp", 1) != inner \
+                and self.mesh.shape.get("fsdp_out", 1) == 1:
+            log_dist(f"MiCS/hpZ shard group {inner} != mesh fsdp "
+                     f"{self.mesh.shape['fsdp']}; using mesh layout as-is", ranks=[0])
 
         self.dp_world_size = mesh_lib.get_data_parallel_world_size(self.mesh)
         config.resolve_batch_sizes(self.dp_world_size)
@@ -155,14 +179,16 @@ class DeepSpeedTPUEngine:
             variables = jax.eval_shape(lambda r: model.init(r, example_batch), init_rng)
             params_shape = variables["params"]
             self.param_shardings = build_param_shardings(
-                params_shape, self.mesh, self.zero_stage, tensor_rules)
+                params_shape, self.mesh, self.zero_stage, tensor_rules,
+                mics=self._mics)
 
             def _init(r):
                 return model.init(r, example_batch)["params"]
             params = jax.jit(_init, out_shardings=self.param_shardings)(init_rng)
         else:
             self.param_shardings = build_param_shardings(
-                params, self.mesh, self.zero_stage, tensor_rules)
+                params, self.mesh, self.zero_stage, tensor_rules,
+                mics=self._mics)
             params = jax.device_put(
                 jax.tree.map(lambda x: np.asarray(x), params), self.param_shardings)
 
@@ -201,7 +227,7 @@ class DeepSpeedTPUEngine:
             opt_state_shape = jax.eval_shape(self.tx.init, params)
             self.opt_state_shardings = build_opt_state_shardings(
                 opt_state_shape, params, param_specs, self.mesh,
-                max(self.zero_stage, 0))
+                max(self.zero_stage, 0), mics=self._mics)
             opt_state = jax.jit(self.tx.init,
                                 out_shardings=self.opt_state_shardings)(params)
 
@@ -226,6 +252,21 @@ class DeepSpeedTPUEngine:
         self.batch_spec = batch_spec if batch_spec is not None \
             else PartitionSpec(mesh_lib.BATCH_AXES)
         self.batch_sharding = NamedSharding(self.mesh, self.batch_spec)
+
+        # hpZ secondary compute-copy shardings (stage 3 only; with the hpZ split
+        # active, compute params are constrained to the inner fsdp sub-axis so
+        # per-layer allgathers stay within the shard group)
+        self._secondary_shardings = None
+        if (self._hpz > 1 and self.zero_stage >= 3
+                and self.mesh.shape.get("fsdp_out", 1) > 1):
+            self._secondary_shardings = build_secondary_shardings(
+                self.param_shardings, self.mesh)
+        self._quantized_weights = bool(zc.zero_quantized_weights)
+        if self._quantized_weights and self._secondary_shardings is None:
+            log_dist("zero_quantized_weights (qwZ) takes effect on the hpZ "
+                     "secondary gather; set zero_hpz_partition_size > 1 — ignored",
+                     ranks=[0])
+            self._quantized_weights = False
 
         # --- compiled functions ----------------------------------------------
         self._reset_compiled_fns()
@@ -295,8 +336,38 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     # loss computation
     # ------------------------------------------------------------------
+    def _hpz_constrain(self, compute_params):
+        """ZeRO++ hpZ: re-lay the compute copy onto the secondary (inner-group)
+        sharding — one cross-group gather here, node-local gathers per layer.
+        With qwZ the cross-group hop moves int8 + per-row scales instead of the
+        compute dtype (reference: quantized-weights allgather, CUDAQuantizer
+        partition_parameters.py:761)."""
+        if not self._quantized_weights:
+            return jax.lax.with_sharding_constraint(
+                compute_params, self._secondary_shardings)
+
+        def requantize(leaf, sharding):
+            if leaf.ndim < 2 or not jnp.issubdtype(leaf.dtype, jnp.floating):
+                return jax.lax.with_sharding_constraint(leaf, sharding)
+            # symmetric per-row int8 (jnp; XLA fuses these around the collective)
+            absmax = jnp.max(jnp.abs(leaf.astype(jnp.float32)), axis=-1,
+                             keepdims=True)
+            scale = jnp.maximum(absmax / 127.0, 1e-12)
+            q = jnp.clip(jnp.round(leaf.astype(jnp.float32) / scale),
+                         -127, 127).astype(jnp.int8)
+            s_spec = PartitionSpec(*(list(sharding.spec)[:leaf.ndim - 1] + [None])) \
+                if len(sharding.spec) else PartitionSpec()
+            q = jax.lax.with_sharding_constraint(q, sharding)
+            scale = jax.lax.with_sharding_constraint(
+                scale, NamedSharding(self.mesh, s_spec))
+            return (q.astype(jnp.float32) * scale).astype(leaf.dtype)
+
+        return jax.tree.map(requantize, compute_params, self._secondary_shardings)
+
     def _compute_loss(self, params, batch, rng):
         compute_params = precision.cast_to_compute(params, self.compute_dtype)
+        if self._secondary_shardings is not None:
+            compute_params = self._hpz_constrain(compute_params)
         if self.compressor is not None:
             # fake-quant + pruning masks with straight-through grads, traced into
             # the step under the current host-side schedule snapshot

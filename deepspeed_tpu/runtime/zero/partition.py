@@ -7,31 +7,39 @@ partitioning with allgather/release module hooks and a trace-based prefetcher).
 
 On TPU none of that machinery is runtime code: a ZeRO stage is a **sharding policy** —
 a rule assigning a ``PartitionSpec`` to every parameter / optimizer-state leaf over the
-``fsdp`` mesh axis. XLA then emits exactly the collectives the reference implements by
+ZeRO mesh axes. XLA then emits exactly the collectives the reference implements by
 hand (allgather params before use ≙ stage-3 fetch; psum_scatter of grads into the
 shard ≙ stage-2 `average_tensor`; sharded optimizer update + allgather ≙ stage-1/2
 step), scheduled and overlapped by the compiler instead of a Python prefetch queue.
 
-  stage 0 — params, grads, optimizer states replicated over (data, fsdp)
-  stage 1 — optimizer states sharded over fsdp
+  stage 0 — params, grads, optimizer states replicated over the DP axes
+  stage 1 — optimizer states sharded over (fsdp_out, fsdp)
   stage 2 — + gradients reduce-scattered (same specs; XLA derives reduce-scatter
             from "grads consumed with sharded layout")
-  stage 3 — + parameters sharded over fsdp (FSDP)
+  stage 3 — + parameters sharded over (fsdp_out, fsdp) (FSDP)
 
-ZeRO++ hpZ (secondary shard within a node, ``partition_parameters.py:1664``) maps to
-sharding over a *sub-axis* of fsdp (see ``hierarchical_axes``); MiCS
-(``runtime/zero/mics.py:64``) is the same idea with replication across DCN slices.
+Hierarchical variants use the split ZeRO world (mesh axes ``fsdp_out`` × ``fsdp``):
+
+- **MiCS** (reference ``runtime/zero/mics.py:64``): everything sharded over the
+  *inner* ``fsdp`` sub-axis only and replicated across ``fsdp_out`` — gathers ride
+  ICI within the shard group; grad sync across groups is XLA's hierarchical psum
+  (the reference's ``_hierarchical_all_gather_params`` by construction).
+- **ZeRO++ hpZ** (reference ``partition_parameters.py:1664 _partition_param_sec``):
+  masters/moments keep the full (fsdp_out, fsdp) shard for memory; the engine
+  constrains the bf16 *compute* copy to the secondary spec (inner-only) so
+  per-layer gathers stay within the node/slice.
 
 Tensor-parallel sharding composes: a leaf annotated with a logical axis that maps to
 ``tensor`` keeps that axis, and fsdp shards a *different* dimension.
 """
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from deepspeed_tpu.comm.mesh import FSDP_AXES
 from deepspeed_tpu.utils.logging import warning_once
 
 # Minimum leaf size worth sharding; tiny leaves (biases, norms) stay replicated —
@@ -41,7 +49,7 @@ DEFAULT_MIN_SHARD_SIZE = 2 ** 11
 
 
 def _choose_fsdp_dim(shape, fsdp_size: int, taken_dims) -> Optional[int]:
-    """Pick the largest dimension divisible by the fsdp axis size, preferring the
+    """Pick the largest dimension divisible by the fsdp world size, preferring the
     first (row) dimension to keep matmul layouts MXU-friendly."""
     candidates = [d for d in range(len(shape))
                   if d not in taken_dims and shape[d] % fsdp_size == 0 and shape[d] >= fsdp_size]
@@ -50,12 +58,21 @@ def _choose_fsdp_dim(shape, fsdp_size: int, taken_dims) -> Optional[int]:
     return max(candidates, key=lambda d: (shape[d], -d))
 
 
+def _normalize_axes(fsdp_axes: Sequence[str]) -> Tuple:
+    """A single axis goes in bare; several as a tuple entry."""
+    axes = tuple(fsdp_axes)
+    return axes[0] if len(axes) == 1 else axes
+
+
 def param_partition_spec(shape, stage: int, fsdp_size: int,
                          tensor_spec: Optional[PartitionSpec] = None,
                          min_shard_size: int = DEFAULT_MIN_SHARD_SIZE,
-                         axis_sizes: Optional[dict] = None) -> PartitionSpec:
+                         axis_sizes: Optional[dict] = None,
+                         fsdp_axes: Sequence[str] = FSDP_AXES) -> PartitionSpec:
     """PartitionSpec for a parameter leaf under a given ZeRO stage.
 
+    ``fsdp_size`` is the product extent of ``fsdp_axes`` (the ZeRO world this
+    policy shards over — the full world by default, the inner sub-axis for MiCS).
     ``tensor_spec`` is an existing (tensor/expert/sequence) sharding from model
     annotations; fsdp sharding is layered on an unused dimension. Annotated axes
     that do not divide the dimension are dropped (e.g. GQA kv heads < tp degree —
@@ -79,16 +96,17 @@ def param_partition_spec(shape, stage: int, fsdp_size: int,
     if dim is None:
         warning_once(f"param of shape {shape} not divisible by fsdp={fsdp_size}; replicated")
         return PartitionSpec(*base) if any(a is not None for a in base) else PartitionSpec()
-    base[dim] = "fsdp"
+    base[dim] = _normalize_axes(fsdp_axes)
     return PartitionSpec(*base)
 
 
 def optimizer_state_spec_fn(param_specs, stage: int, fsdp_size: int,
-                            min_shard_size: int = DEFAULT_MIN_SHARD_SIZE):
+                            min_shard_size: int = DEFAULT_MIN_SHARD_SIZE,
+                            fsdp_axes: Sequence[str] = FSDP_AXES):
     """Build a function mapping an optimizer-state leaf (with a matching param leaf
     position) to its PartitionSpec. Optimizer moments share the param's shape, so:
 
-      stage >= 1: moments sharded over fsdp like a stage-3 param would be
+      stage >= 1: moments sharded over the ZeRO world like a stage-3 param
       stage 3:    moments follow the (already fsdp-sharded) param spec exactly
       stage 0:    replicated / follow param's tensor spec
     """
@@ -100,35 +118,74 @@ def optimizer_state_spec_fn(param_specs, stage: int, fsdp_size: int,
         # stage 1/2: shard moments even though params are replicated
         return param_partition_spec(shape, stage=3, fsdp_size=fsdp_size,
                                     tensor_spec=param_spec,
-                                    min_shard_size=min_shard_size)
+                                    min_shard_size=min_shard_size,
+                                    fsdp_axes=fsdp_axes)
     return spec_for
+
+
+def zero_fsdp_axes(mesh: Mesh, mics: bool = False) -> Tuple[Sequence[str], int]:
+    """(axes, world) the ZeRO policy shards over: the full hierarchical world, or
+    the inner sub-axis only under MiCS."""
+    if mics:
+        return ("fsdp",), mesh.shape["fsdp"]
+    axes = tuple(a for a in FSDP_AXES if a in mesh.shape)
+    world = int(np.prod([mesh.shape[a] for a in axes]))
+    return axes, world
 
 
 def build_param_shardings(params: Any, mesh: Mesh, stage: int,
                           tensor_rules: Optional[Callable] = None,
-                          min_shard_size: int = DEFAULT_MIN_SHARD_SIZE):
+                          min_shard_size: int = DEFAULT_MIN_SHARD_SIZE,
+                          mics: bool = False):
     """Pytree of NamedShardings for the model params.
 
     ``tensor_rules(path, leaf) -> PartitionSpec | None`` supplies model-parallel
     shardings (the AutoTP analog — see deepspeed_tpu.parallel.auto_tp).
+    ``mics=True`` shards over the inner fsdp sub-axis only (replicated across
+    ``fsdp_out`` shard groups).
     """
-    fsdp_size = mesh.shape["fsdp"]
+    fsdp_axes, fsdp_size = zero_fsdp_axes(mesh, mics=mics)
     axis_sizes = dict(mesh.shape)
 
     def leaf_spec(path, leaf):
         tspec = tensor_rules(path, leaf) if tensor_rules else None
         return param_partition_spec(np.shape(leaf), stage, fsdp_size, tensor_spec=tspec,
                                     min_shard_size=min_shard_size,
-                                    axis_sizes=axis_sizes)
+                                    axis_sizes=axis_sizes, fsdp_axes=fsdp_axes)
 
     specs = jax.tree_util.tree_map_with_path(leaf_spec, params)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, PartitionSpec))
 
 
+def secondary_partition_spec(spec: PartitionSpec) -> PartitionSpec:
+    """ZeRO++ hpZ secondary spec: rewrite any dim sharded over the full
+    hierarchical world to shard over the inner ``fsdp`` sub-axis only — the
+    compute copy is then replicated across ``fsdp_out`` so per-layer gathers stay
+    within the shard group (reference ``_partition_param_sec``,
+    ``zero_hpz_partition_size``)."""
+    def fix(entry):
+        if isinstance(entry, (tuple, list)) and "fsdp" in entry:
+            rest = tuple(a for a in entry if a not in FSDP_AXES)
+            return rest + ("fsdp",) if rest else "fsdp"
+        if entry in FSDP_AXES:
+            return "fsdp"
+        return entry
+    return PartitionSpec(*[fix(e) for e in spec])
+
+
+def build_secondary_shardings(param_shardings: Any, mesh: Mesh):
+    """hpZ compute-copy shardings derived from the primary param shardings."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, secondary_partition_spec(s.spec)),
+        param_shardings,
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+
+
 def build_opt_state_shardings(opt_state: Any, params: Any, param_specs: Any,
                               mesh: Mesh, stage: int,
-                              min_shard_size: int = DEFAULT_MIN_SHARD_SIZE):
+                              min_shard_size: int = DEFAULT_MIN_SHARD_SIZE,
+                              mics: bool = False):
     """Shardings for an optax state pytree: any leaf whose shape matches a param
     leaf's shape gets the corresponding (stage-aware) spec; scalars replicated.
 
@@ -136,8 +193,9 @@ def build_opt_state_shardings(opt_state: Any, params: Any, param_specs: Any,
     master copies) or scalars (step counts); we match by structure where possible and
     by shape as fallback.
     """
-    fsdp_size = mesh.shape["fsdp"]
-    spec_of = optimizer_state_spec_fn(param_specs, stage, fsdp_size, min_shard_size)
+    fsdp_axes, fsdp_size = zero_fsdp_axes(mesh, mics=mics)
+    spec_of = optimizer_state_spec_fn(param_specs, stage, fsdp_size, min_shard_size,
+                                      fsdp_axes=fsdp_axes)
 
     flat_params, _ = jax.tree_util.tree_flatten(params)
     flat_specs, _ = jax.tree_util.tree_flatten(
@@ -154,7 +212,8 @@ def build_opt_state_shardings(opt_state: Any, params: Any, param_specs: Any,
             return spec_of(shape_to_spec[shape], shape)
         # unmatched non-scalar leaf: auto-shard if big (e.g. flattened buffers)
         return param_partition_spec(shape, stage=3 if stage >= 1 else 0,
-                                    fsdp_size=fsdp_size, min_shard_size=min_shard_size)
+                                    fsdp_size=fsdp_size, min_shard_size=min_shard_size,
+                                    fsdp_axes=fsdp_axes)
 
     specs = jax.tree.map(state_leaf_spec, opt_state)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,

@@ -22,7 +22,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.comm import mesh as mesh_lib
 from deepspeed_tpu.ops.flash_attention import NEG_INF, _repeat_kv
 
-BATCH = ("data", "fsdp")
+from deepspeed_tpu.comm.mesh import BATCH_AXES as BATCH
 
 
 def ring_attention(q, k, v, causal: bool = True, mesh=None):

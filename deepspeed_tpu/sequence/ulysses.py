@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.comm import mesh as mesh_lib
 from deepspeed_tpu.ops.flash_attention import flash_attention
 
-BATCH = ("data", "fsdp")
+from deepspeed_tpu.comm.mesh import BATCH_AXES as BATCH
 
 
 def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
