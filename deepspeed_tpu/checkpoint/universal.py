@@ -1,0 +1,162 @@
+"""Universal-checkpoint tools: inspect / consolidate / convert.
+
+Reference analogs:
+- ``deepspeed/checkpoint/ds_to_universal.py:112`` (``extract_zero_shards`` /
+  ``merge_tp_slices`` — offline conversion of rank-sharded ZeRO checkpoints into
+  per-parameter atomic files that any (dp, tp, pp) topology can slice on load)
+- ``deepspeed/utils/zero_to_fp32.py`` (offline consolidation of ZeRO shards into
+  a single fp32 state dict)
+- ``deepspeed/checkpoint/universal_checkpoint.py:16`` (``load_hp_checkpoint_state``)
+
+On TPU the engine checkpoint (checkpoint/engine.py) is *already* parameter-atomic
+— orbax/tensorstore stores each array whole regardless of runtime sharding, so
+every checkpoint is a universal checkpoint and ``ds_to_universal`` has no work to
+do. What remains useful, and lives here:
+
+- ``inspect_checkpoint``  — enumerate parameters/shapes/dtypes without restoring
+  onto devices (metadata read only).
+- ``consolidate_to_fp32`` — the ``zero_to_fp32`` analog: read the checkpoint on
+  host and write one plain ``.npz`` (or per-param ``.npy`` tree) of fp32 weights
+  that any framework can load, no JAX devices needed.
+- ``extract_param``       — pull a single parameter array (the per-parameter
+  atomic-file capability, on demand instead of ahead of time).
+
+CLI: ``bin/dstpu_ckpt`` (inspect | consolidate).
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+LATEST_FILE = "latest"
+
+
+def resolve_checkpoint_dir(path: str, tag: Optional[str] = None) -> str:
+    """Accept either a checkpoint dir itself or a save_dir containing ``latest``."""
+    path = os.path.abspath(path)
+    if tag is not None:
+        return os.path.join(path, str(tag))
+    if os.path.exists(os.path.join(path, "ds_meta.json")):
+        return path
+    latest = os.path.join(path, LATEST_FILE)
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return os.path.join(path, f.read().strip())
+    raise FileNotFoundError(f"no checkpoint found under {path}")
+
+
+def _restore_host(ckpt_dir: str) -> Dict[str, Any]:
+    """Restore the composite tree fully replicated on host (numpy leaves)."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(ckpt_dir)
+    ckptr.close()
+    return restored
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    elif tree is not None:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def inspect_checkpoint(path: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """Parameter inventory + metadata; no device restore."""
+    ckpt_dir = resolve_checkpoint_dir(path, tag)
+    meta_path = os.path.join(ckpt_dir, "ds_meta.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    restored = _restore_host(ckpt_dir)
+    params = _flatten(restored.get("params", {}))
+    total = int(sum(int(np.prod(v.shape)) for v in params.values()))
+    return {
+        "checkpoint": ckpt_dir,
+        "meta": meta,
+        "num_params": total,
+        "parameters": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                       for k, v in params.items()},
+    }
+
+
+def consolidate_to_fp32(path: str, output: str, tag: Optional[str] = None,
+                        include_optimizer: bool = False) -> str:
+    """zero_to_fp32 analog: write a single ``.npz`` of fp32 weights.
+
+    The reference tool must merge per-rank ``*_optim_states.pt`` shards; here the
+    checkpoint is already whole-array, so consolidation is a host-side read +
+    dtype cast + re-pack.
+    """
+    ckpt_dir = resolve_checkpoint_dir(path, tag)
+    restored = _restore_host(ckpt_dir)
+    arrays = {f"params/{k}": v.astype(np.float32)
+              if np.issubdtype(v.dtype, np.floating) else v
+              for k, v in _flatten(restored.get("params", {})).items()}
+    if include_optimizer:
+        arrays.update({f"opt_state/{k}": v for k, v in
+                       _flatten(restored.get("opt_state", {})).items()})
+    output = os.path.abspath(output)
+    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+    np.savez(output if output.endswith(".npz") else output + ".npz", **arrays)
+    out_path = output if output.endswith(".npz") else output + ".npz"
+    logger.info(f"consolidated {len(arrays)} tensors -> {out_path}")
+    return out_path
+
+
+def extract_param(path: str, param_name: str, tag: Optional[str] = None) -> np.ndarray:
+    """Per-parameter atomic read (reference: universal ckpt per-param files)."""
+    ckpt_dir = resolve_checkpoint_dir(path, tag)
+    flat = _flatten(_restore_host(ckpt_dir).get("params", {}))
+    if param_name not in flat:
+        close = [k for k in flat if param_name in k]
+        raise KeyError(f"param {param_name!r} not in checkpoint; "
+                       f"closest: {close[:5]}")
+    return flat[param_name]
+
+
+def load_fp32_state(npz_path: str) -> Dict[str, np.ndarray]:
+    """Read back a consolidated file as {name: array}."""
+    data = np.load(npz_path)
+    return {k[len("params/"):]: data[k] for k in data.files
+            if k.startswith("params/")}
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="dstpu_ckpt",
+        description="Universal checkpoint tools (inspect / consolidate to fp32)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pi = sub.add_parser("inspect", help="list parameters + metadata")
+    pi.add_argument("path")
+    pi.add_argument("--tag", default=None)
+    pc = sub.add_parser("consolidate",
+                        help="write a single fp32 .npz (zero_to_fp32 analog)")
+    pc.add_argument("path")
+    pc.add_argument("output")
+    pc.add_argument("--tag", default=None)
+    pc.add_argument("--include-optimizer", action="store_true")
+    args = p.parse_args(argv)
+    if args.cmd == "inspect":
+        info = inspect_checkpoint(args.path, tag=args.tag)
+        print(json.dumps(info, indent=2))
+    else:
+        out = consolidate_to_fp32(args.path, args.output, tag=args.tag,
+                                  include_optimizer=args.include_optimizer)
+        print(out)
+
+
+if __name__ == "__main__":
+    main()

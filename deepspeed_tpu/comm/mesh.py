@@ -6,7 +6,8 @@ Reference analog: ``deepspeed/utils/groups.py`` (dp/mp/ep/sp group factories,
 
 On TPU, process groups are *named mesh axes* of one ``jax.sharding.Mesh``:
 
-    axes (outer→inner): ('pipe', 'data', 'fsdp', 'expert', 'sequence', 'tensor')
+    axes (outer→inner): ('pipe', 'data', 'fsdp_out', 'fsdp', 'expert',
+                         'sequence', 'tensor')
 
 - ``data``     — pure data parallelism (batch sharding, grad all-reduce)
 - ``fsdp``     — ZeRO/FSDP parameter+optimizer sharding (reference ZeRO's dp partition)
@@ -146,9 +147,15 @@ def get_pipe_parallel_world_size(mesh: Mesh) -> int:
     return mesh.shape["pipe"]
 
 
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The DP axes present in this mesh — tolerates hand-built meshes that omit
+    the optional ``fsdp_out`` axis (NamedSharding rejects unknown axis names)."""
+    return tuple(a for a in BATCH_AXES if a in mesh.shape)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a [batch, ...] array: batch split over the DP axes."""
-    return NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+    return NamedSharding(mesh, PartitionSpec(batch_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

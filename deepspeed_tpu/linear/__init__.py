@@ -1,0 +1,15 @@
+"""Optimized linear layers: LoRA + quantized frozen base.
+
+Reference analog: ``deepspeed/linear/`` (``optimized_linear.py:18,76``
+OptimizedLinear / LoRAOptimizedLinear, ``config.py`` LoRAConfig /
+QuantizationConfig, ``quantization.py`` QuantizedParameter).
+"""
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.linear.optimized_linear import (
+    LoRAOptimizedLinear, OptimizedLinear, QuantizedLinear, lora_trainable_mask,
+    make_lora_optimizer)
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "OptimizedLinear",
+           "QuantizedLinear", "LoRAOptimizedLinear", "lora_trainable_mask",
+           "make_lora_optimizer"]

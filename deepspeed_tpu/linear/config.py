@@ -1,0 +1,31 @@
+"""LoRA / quantization configs (reference: ``deepspeed/linear/config.py``)."""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class LoRAConfig:
+    """reference: linear/config.py:11. ``base_weight_sharding`` on TPU maps to
+    sharding the frozen base over the ZeRO ``fsdp`` mesh axes (the reference
+    manually flattens and narrows per rank); ``offload`` maps to the engine's
+    host-offload tier."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+    target_mods: List[str] = field(default_factory=lambda: [
+        "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj"])
+
+
+@dataclass
+class QuantizationConfig:
+    """reference: linear/config.py:37. ``q_bits`` 8 or 4 (grouped symmetric int);
+    ``mantissa_bits`` kept for config parity (the reference's FP6/FP12 formats
+    map to int quantization grain here — TPU has no FP6 datapath; fp8 lives in
+    the Pallas quant kernels)."""
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512

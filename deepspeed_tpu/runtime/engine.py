@@ -19,6 +19,7 @@ compatibility shim: ``forward`` runs a jitted value-and-grad and caches the grad
 update at the accumulation boundary — the idiomatic entry point is ``train_batch``.
 """
 
+import functools
 import os
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
@@ -84,6 +85,35 @@ def _as_apply_fn(model) -> Callable:
     raise TypeError(f"model must be a flax Module or callable, got {type(model)}")
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _qwz_regather(leaf, sec_sharding, scale_sharding):
+    """ZeRO++ qwZ re-layout: symmetric per-row int8 quantize, constrain the int8
+    codes + fp32 scales to the secondary (inner-group) sharding — so the
+    cross-``fsdp_out`` gather moves ~¼ the bytes of the compute dtype — then
+    dequantize (reference: quantized-weights allgather, CUDAQuantizer
+    partition_parameters.py:761). custom_vjp gives the straight-through
+    gradient (identity) without materializing a full-precision gather of the
+    original leaf on the forward path."""
+    absmax = jnp.max(jnp.abs(leaf.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(leaf.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    q = jax.lax.with_sharding_constraint(q, sec_sharding)
+    scale = jax.lax.with_sharding_constraint(scale, scale_sharding)
+    return (q.astype(jnp.float32) * scale).astype(leaf.dtype)
+
+
+def _qwz_fwd(leaf, sec_sharding, scale_sharding):
+    return _qwz_regather(leaf, sec_sharding, scale_sharding), None
+
+
+def _qwz_bwd(sec_sharding, scale_sharding, _, g):
+    return (g,)
+
+
+_qwz_regather.defvjp(_qwz_fwd, _qwz_bwd)
+
+
 class DeepSpeedTPUEngine:
     def __init__(self,
                  model,
@@ -110,6 +140,11 @@ class DeepSpeedTPUEngine:
         zc = config.zero_config
         self._mics = zc.mics_shard_size is not None and zc.mics_shard_size > 0
         self._hpz = int(zc.zero_hpz_partition_size or 1)
+        if self._mics and self._hpz > 1:
+            raise ValueError(
+                "mics_shard_size and zero_hpz_partition_size are mutually "
+                "exclusive: MiCS already replicates across shard groups, so an "
+                "hpZ secondary shard would be a no-op")
         inner = zc.mics_shard_size if self._mics else (self._hpz if self._hpz > 1 else 0)
         if inner and mesh is None:
             if config.mesh.fsdp == -1:
@@ -250,7 +285,7 @@ class DeepSpeedTPUEngine:
 
         # batch sharding: leading dim over (data, fsdp) unless caller overrides
         self.batch_spec = batch_spec if batch_spec is not None \
-            else PartitionSpec(mesh_lib.BATCH_AXES)
+            else PartitionSpec(mesh_lib.batch_axes(self.mesh))
         self.batch_sharding = NamedSharding(self.mesh, self.batch_spec)
 
         # hpZ secondary compute-copy shardings (stage 3 only; with the hpZ split
@@ -346,23 +381,20 @@ class DeepSpeedTPUEngine:
             return jax.lax.with_sharding_constraint(
                 compute_params, self._secondary_shardings)
 
-        def requantize(leaf, sharding):
-            if leaf.ndim < 2 or not jnp.issubdtype(leaf.dtype, jnp.floating):
+        def requantize(leaf, primary, sharding):
+            # only quantize leaves whose layout actually changes across the
+            # fsdp_out hop — replicated / tensor-only leaves have no cross-group
+            # gather to cheapen, so int8 noise there is pure loss
+            if (leaf.ndim < 2 or not jnp.issubdtype(leaf.dtype, jnp.floating)
+                    or primary.spec == sharding.spec):
                 return jax.lax.with_sharding_constraint(leaf, sharding)
-            # symmetric per-row int8 (jnp; XLA fuses these around the collective)
-            absmax = jnp.max(jnp.abs(leaf.astype(jnp.float32)), axis=-1,
-                             keepdims=True)
-            scale = jnp.maximum(absmax / 127.0, 1e-12)
-            q = jnp.clip(jnp.round(leaf.astype(jnp.float32) / scale),
-                         -127, 127).astype(jnp.int8)
             s_spec = PartitionSpec(*(list(sharding.spec)[:leaf.ndim - 1] + [None])) \
                 if len(sharding.spec) else PartitionSpec()
-            q = jax.lax.with_sharding_constraint(q, sharding)
-            scale = jax.lax.with_sharding_constraint(
-                scale, NamedSharding(self.mesh, s_spec))
-            return (q.astype(jnp.float32) * scale).astype(leaf.dtype)
+            return _qwz_regather(leaf, sharding,
+                                 NamedSharding(self.mesh, s_spec))
 
-        return jax.tree.map(requantize, compute_params, self._secondary_shardings)
+        return jax.tree.map(requantize, compute_params, self.param_shardings,
+                            self._secondary_shardings)
 
     def _compute_loss(self, params, batch, rng):
         compute_params = precision.cast_to_compute(params, self.compute_dtype)

@@ -22,7 +22,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.comm import mesh as mesh_lib
 from deepspeed_tpu.ops.flash_attention import NEG_INF, _repeat_kv
 
-from deepspeed_tpu.comm.mesh import BATCH_AXES as BATCH
+
 
 
 def ring_attention(q, k, v, causal: bool = True, mesh=None):
@@ -34,7 +34,7 @@ def ring_attention(q, k, v, causal: bool = True, mesh=None):
         return flash_attention(q, k, v, causal=causal)
 
     h = q.shape[2]
-    spec_q = P(BATCH, "sequence", "tensor", None)
+    spec_q = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
     def body(q_l, k_l, v_l):
         b, s_l, h_l, d = q_l.shape

@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.comm import mesh as mesh_lib
 from deepspeed_tpu.ops.flash_attention import flash_attention
 
-from deepspeed_tpu.comm.mesh import BATCH_AXES as BATCH
+
 
 
 def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
@@ -46,7 +46,7 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
         from deepspeed_tpu.sequence.ring import ring_attention
         return ring_attention(q, k, v, causal=causal, mesh=mesh)
 
-    spec = P(BATCH, "sequence", "tensor", None)
+    spec = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
     def body(q_l, k_l, v_l):
         # [B, S/sp, Hl, D] -> scatter heads / gather sequence -> [B, S, Hl/sp, D]

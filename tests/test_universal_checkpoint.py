@@ -1,0 +1,107 @@
+"""Universal checkpoint tool tests: inspect, fp32 consolidation, per-param
+extraction, CLI.
+
+Reference analog: tests/unit/checkpoint/test_universal_checkpoint.py +
+zero_to_fp32 usage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.universal import (
+    consolidate_to_fp32, extract_param, inspect_checkpoint, load_fp32_state,
+    resolve_checkpoint_dir)
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+
+@pytest.fixture(scope="module")
+def saved_ckpt(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt")
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=config,
+        example_batch=random_batch(4))
+    for i in range(2):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    engine.save_checkpoint(str(d), tag="step2")
+    return str(d), engine
+
+
+def test_resolve_by_tag_and_latest(saved_ckpt):
+    d, _ = saved_ckpt
+    by_tag = resolve_checkpoint_dir(d, tag="step2")
+    by_latest = resolve_checkpoint_dir(d)
+    assert by_tag == by_latest and by_tag.endswith("step2")
+    with pytest.raises(FileNotFoundError):
+        resolve_checkpoint_dir("/nonexistent/dir")
+
+
+def test_inspect_lists_all_params(saved_ckpt):
+    d, engine = saved_ckpt
+    info = inspect_checkpoint(d)
+    n_leaves = len(jax.tree.leaves(engine.state.params))
+    assert len(info["parameters"]) == n_leaves
+    assert info["meta"]["global_steps"] == 2
+    total = sum(int(np.prod(p.size)) for p in jax.tree.leaves(engine.state.params))
+    assert info["num_params"] == total
+
+
+def test_consolidate_fp32_roundtrip(saved_ckpt, tmp_path):
+    d, engine = saved_ckpt
+    out = consolidate_to_fp32(d, str(tmp_path / "fp32_model"))
+    state = load_fp32_state(out)
+    live = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        jax.device_get(engine.state.params))
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        live[name] = np.asarray(leaf, np.float32)
+    assert set(state) == set(live)
+    for k in live:
+        assert state[k].dtype == np.float32
+        np.testing.assert_allclose(state[k], live[k], rtol=1e-6)
+
+
+def test_consolidate_with_optimizer(saved_ckpt, tmp_path):
+    d, _ = saved_ckpt
+    out = consolidate_to_fp32(d, str(tmp_path / "full"), include_optimizer=True)
+    data = np.load(out)
+    assert any(k.startswith("opt_state/") for k in data.files)
+
+
+def test_extract_param(saved_ckpt):
+    d, engine = saved_ckpt
+    info = inspect_checkpoint(d)
+    name = next(iter(info["parameters"]))
+    arr = extract_param(d, name)
+    assert list(arr.shape) == info["parameters"][name]["shape"]
+    with pytest.raises(KeyError):
+        extract_param(d, "definitely/not/a/param")
+
+
+def test_cli_inspect_and_consolidate(saved_ckpt, tmp_path):
+    d, _ = saved_ckpt
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    r = subprocess.run([sys.executable, "-m", "deepspeed_tpu.checkpoint.universal",
+                        "inspect", d], capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    info = json.loads(r.stdout)
+    assert info["num_params"] > 0
+    out = str(tmp_path / "cli_fp32")
+    r2 = subprocess.run([sys.executable, "-m", "deepspeed_tpu.checkpoint.universal",
+                         "consolidate", d, out], capture_output=True, text=True, env=env)
+    assert r2.returncode == 0, r2.stderr
+    assert os.path.exists(out + ".npz")
