@@ -54,7 +54,7 @@ def initialize(args=None,
     if dist_init_required:
         _mesh_lib.init_distributed()
 
-    engine = DeepSpeedTPUEngine(
+    engine_kwargs = dict(
         model=model,
         config=ds_config,
         params=model_parameters,
@@ -66,6 +66,14 @@ def initialize(args=None,
         lr_scheduler=lr_scheduler if callable(lr_scheduler) else None,
         client_optimizer=optimizer,
     )
+    hybrid_cfg = ds_config.raw().get("hybrid_engine", {})
+    if hybrid_cfg.get("enabled", False):
+        # RLHF train<->generate engine (reference: deepspeed.initialize returns
+        # DeepSpeedHybridEngine when hybrid_engine.enabled)
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedTPUHybridEngine
+        engine = DeepSpeedTPUHybridEngine(hybrid_config=hybrid_cfg, **engine_kwargs)
+    else:
+        engine = DeepSpeedTPUEngine(**engine_kwargs)
 
     dataloader = None
     if training_data is not None:

@@ -39,7 +39,10 @@ def resolve_checkpoint_dir(path: str, tag: Optional[str] = None) -> str:
     """Accept either a checkpoint dir itself or a save_dir containing ``latest``."""
     path = os.path.abspath(path)
     if tag is not None:
-        return os.path.join(path, str(tag))
+        tagged = os.path.join(path, str(tag))
+        if not os.path.isdir(tagged):
+            raise FileNotFoundError(f"no checkpoint with tag {tag!r} under {path}")
+        return tagged
     if os.path.exists(os.path.join(path, "ds_meta.json")):
         return path
     latest = os.path.join(path, LATEST_FILE)
@@ -72,23 +75,47 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
 
 
 def inspect_checkpoint(path: str, tag: Optional[str] = None) -> Dict[str, Any]:
-    """Parameter inventory + metadata; no device restore."""
+    """Parameter inventory + metadata — reads orbax *metadata only* (shapes and
+    dtypes come from the index, no array bytes are fetched), so inspecting a
+    multi-hundred-GB checkpoint is instant."""
+    import orbax.checkpoint as ocp
     ckpt_dir = resolve_checkpoint_dir(path, tag)
     meta_path = os.path.join(ckpt_dir, "ds_meta.json")
     meta = {}
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
-    restored = _restore_host(ckpt_dir)
-    params = _flatten(restored.get("params", {}))
-    total = int(sum(int(np.prod(v.shape)) for v in params.values()))
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        tree_meta = ckptr.metadata(ckpt_dir)
+    finally:
+        ckptr.close()
+    item = getattr(tree_meta, "item_metadata", tree_meta)
+    params_meta = _flatten_meta(item.get("params", {}) if isinstance(item, dict)
+                                else getattr(item, "tree", {}).get("params", {}))
+    total = int(sum(int(np.prod(m["shape"])) for m in params_meta.values()))
     return {
         "checkpoint": ckpt_dir,
         "meta": meta,
         "num_params": total,
-        "parameters": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
-                       for k, v in params.items()},
+        "parameters": params_meta,
     }
+
+
+def _flatten_meta(tree: Any, prefix: str = "") -> Dict[str, Dict[str, Any]]:
+    """Flatten an orbax metadata tree to {name: {shape, dtype}}."""
+    out: Dict[str, Dict[str, Any]] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_meta(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_meta(v, f"{prefix}{i}/"))
+    elif tree is not None:
+        shape = list(getattr(tree, "shape", []) or [])
+        dtype = str(getattr(tree, "dtype", ""))
+        out[prefix[:-1]] = {"shape": shape, "dtype": dtype}
+    return out
 
 
 def consolidate_to_fp32(path: str, output: str, tag: Optional[str] = None,
@@ -116,14 +143,17 @@ def consolidate_to_fp32(path: str, output: str, tag: Optional[str] = None,
 
 
 def extract_param(path: str, param_name: str, tag: Optional[str] = None) -> np.ndarray:
-    """Per-parameter atomic read (reference: universal ckpt per-param files)."""
+    """Read one parameter (reference: universal ckpt per-param files). The name
+    is validated against the metadata index first (cheap); the read itself
+    restores the params tree on host — per-leaf partial restore is an orbax
+    transformation detail left to a future optimization."""
     ckpt_dir = resolve_checkpoint_dir(path, tag)
-    flat = _flatten(_restore_host(ckpt_dir).get("params", {}))
-    if param_name not in flat:
-        close = [k for k in flat if param_name in k]
+    known = inspect_checkpoint(ckpt_dir)["parameters"]
+    if param_name not in known:
+        close = [k for k in known if param_name in k]
         raise KeyError(f"param {param_name!r} not in checkpoint; "
                        f"closest: {close[:5]}")
-    return flat[param_name]
+    return _flatten(_restore_host(ckpt_dir).get("params", {}))[param_name]
 
 
 def load_fp32_state(npz_path: str) -> Dict[str, np.ndarray]:

@@ -47,5 +47,4 @@ IGNORED_CUDA_ONLY_KEYS = frozenset({
     "fp16_master_weights_and_gradients",
     "cuda_aware",
     "use_node_local_storage",
-    "hybrid_engine",
 })

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import optax
 
 from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.utils.logging import warning_once
 
 LORA_A = "lora_a"
 LORA_B = "lora_b"
@@ -126,12 +127,20 @@ class LoRAOptimizedLinear(nn.Module):
                     key, (self.input_dim, self.output_dim), jnp.float32))
             base = frozen.value.astype(self.dtype)
 
-        # base_weight_sharding: annotate for the fsdp axes; XLA shards storage
-        # and gathers at use (the reference narrows a flattened weight per rank)
+        # base_weight_sharding: annotate for the fsdp axes present in the
+        # active mesh; XLA shards storage and gathers at use (the reference
+        # narrows a flattened weight per rank)
         if lc.base_weight_sharding > 1:
-            base = jax.lax.with_sharding_constraint(
-                base, jax.sharding.PartitionSpec(("fsdp_out", "fsdp"), None)) \
-                if jax.sharding.get_abstract_mesh().shape_tuple else base
+            am = jax.sharding.get_abstract_mesh()
+            mesh_axes = [n for n, _ in getattr(am, "shape_tuple", ())]
+            axes = tuple(a for a in ("fsdp_out", "fsdp") if a in mesh_axes)
+            if axes:
+                base = jax.lax.with_sharding_constraint(
+                    base, jax.sharding.PartitionSpec(axes, None))
+            else:
+                warning_once(
+                    "base_weight_sharding>1 requires running under a mesh with "
+                    "fsdp axes (jax.sharding.use_mesh / engine mesh); ignored")
 
         # LoRA adapters (trainable, in the regular params collection)
         a = self.param(LORA_A,
@@ -166,13 +175,21 @@ def OptimizedLinear(input_dim: int,
 
 
 def lora_trainable_mask(params, target_mods=None):
-    """Bool pytree: True for LoRA adapter leaves (and nothing else). For models
-    that keep base weights inside ``params`` (HF-style), combine with
-    ``target_mods`` name matching (reference LoRAConfig.target_mods)."""
+    """Bool pytree: True for LoRA adapter leaves (and nothing else).
+
+    Without ``target_mods``, a leaf is an adapter iff its key is exactly
+    ``lora_a``/``lora_b``. With ``target_mods`` (reference
+    LoRAConfig.target_mods), HF-style trees are supported: any leaf whose key
+    contains "lora" AND whose path contains one of the target module names is
+    trainable (e.g. ``.../q_proj/lora_A/kernel``)."""
     def mask(path, leaf):
         names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
         if LORA_A in names or LORA_B in names:
             return True
+        if target_mods:
+            has_lora = any("lora" in n.lower() for n in names)
+            in_target = any(any(t in n for n in names) for t in target_mods)
+            return has_lora and in_target
         return False
     return jax.tree_util.tree_map_with_path(mask, params)
 
