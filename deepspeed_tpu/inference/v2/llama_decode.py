@@ -52,11 +52,33 @@ def _layer_params(params, i):
     return params["model"][f"layer_{i}"]
 
 
+def _windowed_context_attention(q, ctx_k, ctx_v, qpos, window, num_heads):
+    """Sliding-window prefill attention over gathered paged context.
+    q: [T,H,d]; ctx_k/v: [K,Hkv,d]; qpos: [T] absolute positions."""
+    rep = num_heads // ctx_k.shape[1]
+    if rep > 1:
+        ctx_k = jnp.repeat(ctx_k, rep, axis=1)
+        ctx_v = jnp.repeat(ctx_v, rep, axis=1)
+    d = q.shape[-1]
+    scores = jnp.einsum("thd,khd->htk", q, ctx_k,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    kpos = jnp.arange(ctx_k.shape[0])[None, :]
+    mask = (kpos <= qpos[:, None]) & (kpos > qpos[:, None] - window)
+    scores = jnp.where(mask[None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("htk,khd->thd", probs, ctx_v)
+
+
 def _qkv(lp, x, dtype):
-    """x: [T, D] -> q [T,H,d], k/v [T,Hkv,d] via DenseGeneral kernels."""
+    """x: [T, D] -> q [T,H,d], k/v [T,Hkv,d] via DenseGeneral kernels (+ bias
+    when present — qwen2-style attention_bias)."""
     q = jnp.einsum("td,dhk->thk", x, lp["attn"]["wq"]["kernel"].astype(dtype))
     k = jnp.einsum("td,dhk->thk", x, lp["attn"]["wk"]["kernel"].astype(dtype))
     v = jnp.einsum("td,dhk->thk", x, lp["attn"]["wv"]["kernel"].astype(dtype))
+    if "bias" in lp["attn"]["wq"]:
+        q = q + lp["attn"]["wq"]["bias"].astype(dtype)
+        k = k + lp["attn"]["wk"]["bias"].astype(dtype)
+        v = v + lp["attn"]["wv"]["bias"].astype(dtype)
     return q, k, v
 
 
@@ -101,8 +123,12 @@ def prefill_chunk(params, cache_data, tokens, start, block_table, true_len,
                                                      cfg.num_kv_heads, d_head)
         ctx_v = cache_data[i, 1, block_table].reshape(mb * block_size,
                                                      cfg.num_kv_heads, d_head)
-        attn = flash_attention(q[None], ctx_k[None], ctx_v[None], causal=True,
-                               q_offset=start)[0]
+        if cfg.sliding_window is not None:
+            attn = _windowed_context_attention(
+                q, ctx_k, ctx_v, positions, cfg.sliding_window, cfg.num_heads)
+        else:
+            attn = flash_attention(q[None], ctx_k[None], ctx_v[None], causal=True,
+                                   q_offset=start)[0]
         attn_out = jnp.einsum("thk,hkd->td", attn,
                               lp["attn"]["wo"]["kernel"].astype(dtype))
         x = x + attn_out
@@ -163,6 +189,8 @@ def decode_step(params, cache_data, tokens, positions, block_tables, valid,
                             preferred_element_type=jnp.float32) / np.sqrt(d_head)
         kpos = jnp.arange(mb * block_size)[None, :]
         mask = kpos <= safe_pos[:, None]
+        if cfg.sliding_window is not None:
+            mask &= kpos > (safe_pos[:, None] - cfg.sliding_window)
         scores = jnp.where(mask[:, None, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
         attn = jnp.einsum("bhk,bkhd->bhd", probs, ctx_v)

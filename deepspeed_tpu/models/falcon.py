@@ -1,0 +1,171 @@
+"""Falcon model family (parallel attention+MLP block, multi-query attention).
+
+Reference analog: ``deepspeed/inference/v2/model_implementations/falcon`` and
+the falcon container in ``module_inject/containers``. Architecture (Falcon-7B):
+one shared LayerNorm feeding BOTH attention and MLP in parallel
+(``parallel_attn`` + ``new_decoder_architecture=False``); multi-query attention
+(1 KV head); rotary embeddings; GELU MLP; tied embeddings.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, SEQ_AXIS, HEADS_AXIS, _dispatch_attention, apply_rope,
+    rope_freqs, shard_activation)
+
+
+@dataclasses.dataclass(frozen=True)
+class FalconConfig:
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    num_layers: int = 32
+    num_heads: int = 71
+    num_kv_heads: int = 1          # multi-query
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_FALCON = FalconConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                           num_heads=4, num_kv_heads=1, max_seq_len=256,
+                           dtype=jnp.float32)
+
+
+class FalconBlock(nn.Module):
+    """Parallel residual: x + attn(ln(x)) + mlp(ln(x)) — one shared LayerNorm
+    (Falcon-7B ``parallel_attn``)."""
+    cfg: FalconConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        d = cfg.head_dim_
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="input_ln")(x)
+
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(h)
+        k = dense(features=(cfg.num_kv_heads, d), name="wk")(h)
+        v = dense(features=(cfg.num_kv_heads, d), name="wv")(h)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        cos, sin = rope_freqs(d, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        attn = _dispatch_attention(cfg.attention_backend, q, k, v, causal=True)
+        attn_out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                   use_bias=False, dtype=cfg.dtype,
+                                   param_dtype=jnp.float32, name="wo")(attn)
+
+        mlp = nn.Dense(4 * cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="mlp_up")(h)
+        mlp = nn.gelu(mlp)
+        mlp_out = nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                           param_dtype=jnp.float32, name="mlp_down")(mlp)
+        # parallel residual sum
+        return shard_activation(x + attn_out + mlp_out,
+                                (BATCH_AXES, SEQ_AXIS, None))
+
+
+class FalconModel(nn.Module):
+    cfg: FalconConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[1])[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(input_ids)
+        for i in range(cfg.num_layers):
+            x = FalconBlock(cfg, name=f"layer_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_ln")(x)
+        # tied embeddings (falcon ties lm_head to word embeddings)
+        embed = self.variables["params"]["embed"]["embedding"]
+        return x.astype(jnp.float32) @ embed.astype(jnp.float32).T
+
+
+class FalconForCausalLM(nn.Module):
+    """Batch dict {"input_ids": [B,S]} -> mean next-token cross-entropy (same
+    contract as LlamaForCausalLM)."""
+    cfg: FalconConfig
+
+    def setup(self):
+        self.model = FalconModel(self.cfg)
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def __call__(self, batch):
+        input_ids = batch["input_ids"]
+        logits = self.model(input_ids, positions=batch.get("positions"))
+        labels = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+
+def falcon_tensor_rules(path, leaf):
+    """TP sharding rules (AutoTP analog) for Falcon params."""
+    from jax.sharding import PartitionSpec
+    names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    if "embed" in names:
+        return PartitionSpec(None, "tensor")
+    if "wq" in names:
+        return PartitionSpec(None, "tensor", None)
+    if any(n in names for n in ("wk", "wv")):
+        # MQA: a single KV head cannot shard across tensor ranks — replicate
+        # (the reference AutoTP replicates undersized kv projections too)
+        return PartitionSpec()
+    if "wo" in names:
+        return PartitionSpec("tensor", None, None)
+    if "mlp_up" in names:
+        return PartitionSpec(None, "tensor")
+    if "mlp_down" in names:
+        return PartitionSpec("tensor", None)
+    return None
+
+
+def convert_hf_falcon(hf_state, cfg: FalconConfig):
+    """HF falcon naming -> our tree: fused query_key_value [(H+2Hkv)*dh, D]
+    split into wq/wk/wv; dense_h_to_4h/dense_4h_to_h -> mlp_up/mlp_down."""
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    d, h, hkv, dh = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    tree = {"embed": {"embedding": get("transformer.word_embeddings.weight")},
+            "final_ln": {"scale": get("transformer.ln_f.weight"),
+                         "bias": get("transformer.ln_f.bias")}}
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        qkv = get(p + "self_attention.query_key_value.weight")
+        wq, wk, wv = np.split(qkv, [h * dh, (h + hkv) * dh], axis=0)
+        tree[f"layer_{i}"] = {
+            "input_ln": {"scale": get(p + "input_layernorm.weight"),
+                         "bias": get(p + "input_layernorm.bias")},
+            "wq": {"kernel": wq.T.reshape(d, h, dh)},
+            "wk": {"kernel": wk.T.reshape(d, hkv, dh)},
+            "wv": {"kernel": wv.T.reshape(d, hkv, dh)},
+            "wo": {"kernel": get(p + "self_attention.dense.weight").T
+                   .reshape(h, dh, d)},
+            "mlp_up": {"kernel": get(p + "mlp.dense_h_to_4h.weight").T},
+            "mlp_down": {"kernel": get(p + "mlp.dense_4h_to_h.weight").T},
+        }
+    return {"model": tree}

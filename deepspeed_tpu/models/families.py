@@ -1,0 +1,168 @@
+"""Model-family registry: arch presets + HF-checkpoint weight mappers.
+
+Reference analog: ``deepspeed/inference/v2/model_implementations/`` — per-arch
+mappers (llama_v2, mistral, mixtral, qwen, qwen_v2, phi, phi3, falcon, opt) that
+translate a HuggingFace checkpoint into the engine's layer containers.
+
+TPU shape: mistral / qwen2 / phi3 ARE the llama computation graph with knobs
+(sliding window, qkv bias, fused projections), so they map onto ``LlamaConfig``
++ ``LlamaForCausalLM`` and get training, ZeRO/TP/SP sharding, AND the FastGen
+paged decode for free. ``convert_hf_state_dict`` translates HF parameter naming
+(torch ``[out, in]`` linears, fused qkv/gate_up for phi3) into our flax tree
+(``[in, out]`` kernels, DenseGeneral ``[D, H, dh]`` attention projections).
+
+Falcon (parallel attn+mlp block, LayerNorm, MQA) and OPT (learned positions,
+LayerNorm, GELU) have genuinely different blocks — see ``models/falcon.py`` and
+``models/opt.py``.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig
+
+# ---------------------------------------------------------------------------
+# Presets (public architecture configs)
+# ---------------------------------------------------------------------------
+
+MISTRAL_7B = LlamaConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, max_seq_len=32768, rope_theta=10000.0,
+    sliding_window=4096)
+
+QWEN2_7B = LlamaConfig(
+    vocab_size=152064, hidden_size=3584, intermediate_size=18944, num_layers=28,
+    num_heads=28, num_kv_heads=4, max_seq_len=32768, rope_theta=1000000.0,
+    attention_bias=True)
+
+PHI3_MINI = LlamaConfig(
+    vocab_size=32064, hidden_size=3072, intermediate_size=8192, num_layers=32,
+    num_heads=32, num_kv_heads=32, max_seq_len=4096, rope_theta=10000.0)
+
+
+def config_from_hf(hf_config: Dict[str, Any]) -> LlamaConfig:
+    """Build a LlamaConfig from a HF config dict for any llama-family arch
+    (reference: engine_factory reads the HF config to pick a policy)."""
+    mt = hf_config.get("model_type", "llama")
+    if mt not in ("llama", "mistral", "qwen2", "phi3"):
+        raise ValueError(f"not a llama-family arch: {mt!r} "
+                         "(falcon/opt have their own model classes)")
+    return LlamaConfig(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["hidden_size"],
+        intermediate_size=hf_config["intermediate_size"],
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        num_kv_heads=hf_config.get("num_key_value_heads",
+                                   hf_config["num_attention_heads"]),
+        max_seq_len=hf_config.get("max_position_embeddings", 4096),
+        rope_theta=hf_config.get("rope_theta", 10000.0),
+        rms_norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf_config.get("tie_word_embeddings", False),
+        attention_bias=(mt == "qwen2") or hf_config.get("attention_bias", False),
+        sliding_window=hf_config.get("sliding_window")
+        if mt == "mistral" else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF -> flax-tree weight conversion
+# ---------------------------------------------------------------------------
+
+def _t(w) -> np.ndarray:
+    return np.asarray(w).T
+
+
+def convert_hf_state_dict(hf_state: Dict[str, Any], cfg: LlamaConfig,
+                          model_type: str = "llama") -> Dict[str, Any]:
+    """Map a HF state dict (numpy/torch tensors keyed 'model.layers.0.…') into
+    the LlamaForCausalLM param tree. Handles phi3's fused ``qkv_proj`` /
+    ``gate_up_proj`` (reference: phi3 containers split fused tensors) and
+    qwen2's qkv biases."""
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    d, h, hkv, dh = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    tree: Dict[str, Any] = {"model": {}}
+    m = tree["model"]
+    m["embed"] = {"embedding": get("model.embed_tokens.weight")}
+    m["final_norm"] = {"scale": get("model.norm.weight")}
+    if not cfg.tie_embeddings:
+        m["lm_head"] = {"kernel": _t(get("lm_head.weight"))}
+
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        layer: Dict[str, Any] = {}
+        layer["attn_norm"] = {"scale": get(p + "input_layernorm.weight")}
+        layer["mlp_norm"] = {"scale": get(p + "post_attention_layernorm.weight")}
+
+        if model_type == "phi3":
+            qkv = get(p + "self_attn.qkv_proj.weight")     # [(h+2hkv)*dh, D]
+            wq, wk, wv = np.split(qkv, [h * dh, (h + hkv) * dh], axis=0)
+        else:
+            wq = get(p + "self_attn.q_proj.weight")
+            wk = get(p + "self_attn.k_proj.weight")
+            wv = get(p + "self_attn.v_proj.weight")
+        attn = {
+            "wq": {"kernel": _t(wq).reshape(d, h, dh)},
+            "wk": {"kernel": _t(wk).reshape(d, hkv, dh)},
+            "wv": {"kernel": _t(wv).reshape(d, hkv, dh)},
+            "wo": {"kernel": _t(get(p + "self_attn.o_proj.weight"))
+                   .reshape(h, dh, d)},
+        }
+        if cfg.attention_bias:
+            attn["wq"]["bias"] = get(p + "self_attn.q_proj.bias").reshape(h, dh)
+            attn["wk"]["bias"] = get(p + "self_attn.k_proj.bias").reshape(hkv, dh)
+            attn["wv"]["bias"] = get(p + "self_attn.v_proj.bias").reshape(hkv, dh)
+        layer["attn"] = attn
+
+        if model_type == "phi3":
+            gu = get(p + "mlp.gate_up_proj.weight")        # [2I, D]
+            wg, wu = np.split(gu, 2, axis=0)
+        else:
+            wg = get(p + "mlp.gate_proj.weight")
+            wu = get(p + "mlp.up_proj.weight")
+        layer["mlp"] = {
+            "w_gate": {"kernel": _t(wg)},
+            "w_up": {"kernel": _t(wu)},
+            "w_down": {"kernel": _t(get(p + "mlp.down_proj.weight"))},
+        }
+        m[f"layer_{i}"] = layer
+    return tree
+
+
+def export_hf_state_dict(params: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, np.ndarray]:
+    """Inverse mapping (our tree -> HF naming), for checkpoint interchange."""
+    d, h, hkv, dh = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    m = params["model"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(m["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(m["final_norm"]["scale"]),
+    }
+    if "lm_head" in m:
+        out["lm_head.weight"] = _t(np.asarray(m["lm_head"]["kernel"]))
+    for i in range(cfg.num_layers):
+        lp = m[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        out[p + "input_layernorm.weight"] = np.asarray(lp["attn_norm"]["scale"])
+        out[p + "post_attention_layernorm.weight"] = np.asarray(lp["mlp_norm"]["scale"])
+        out[p + "self_attn.q_proj.weight"] = _t(
+            np.asarray(lp["attn"]["wq"]["kernel"]).reshape(d, h * dh))
+        out[p + "self_attn.k_proj.weight"] = _t(
+            np.asarray(lp["attn"]["wk"]["kernel"]).reshape(d, hkv * dh))
+        out[p + "self_attn.v_proj.weight"] = _t(
+            np.asarray(lp["attn"]["wv"]["kernel"]).reshape(d, hkv * dh))
+        for nm, key in (("q", "wq"), ("k", "wk"), ("v", "wv")):
+            if "bias" in lp["attn"][key]:
+                out[p + f"self_attn.{nm}_proj.bias"] = \
+                    np.asarray(lp["attn"][key]["bias"]).reshape(-1)
+        out[p + "self_attn.o_proj.weight"] = _t(
+            np.asarray(lp["attn"]["wo"]["kernel"]).reshape(h * dh, d))
+        out[p + "mlp.gate_proj.weight"] = _t(np.asarray(lp["mlp"]["w_gate"]["kernel"]))
+        out[p + "mlp.up_proj.weight"] = _t(np.asarray(lp["mlp"]["w_up"]["kernel"]))
+        out[p + "mlp.down_proj.weight"] = _t(np.asarray(lp["mlp"]["w_down"]["kernel"]))
+    return out

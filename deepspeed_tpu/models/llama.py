@@ -53,6 +53,9 @@ class LlamaConfig:
     remat_policy: str = "nothing_saveable"
     scan_layers: bool = False
     logits_soft_cap: Optional[float] = None
+    # llama-family arch knobs (mistral/qwen2/phi3 are llama variants):
+    attention_bias: bool = False          # qwen2: bias on q/k/v projections
+    sliding_window: Optional[int] = None  # mistral: attend to last W tokens only
 
     @property
     def head_dim_(self) -> int:
@@ -110,8 +113,10 @@ def apply_rope(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
-def _xla_attention(q, k, v, causal: bool = True, segment_ids=None):
-    """Plain attention; XLA fuses softmax chain. q,k,v: [B, S, H, D] / kv [B, S, Hkv, D]."""
+def _xla_attention(q, k, v, causal: bool = True, segment_ids=None, window=None):
+    """Plain attention; XLA fuses softmax chain. q,k,v: [B, S, H, D] / kv
+    [B, S, Hkv, D]. ``window`` adds mistral-style sliding-window masking
+    (token t attends to (t-window, t])."""
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     if hkv != h:
@@ -120,9 +125,12 @@ def _xla_attention(q, k, v, causal: bool = True, segment_ids=None):
         v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
     sk = k.shape[1]
-    if causal:
+    if causal or window is not None:
         qpos = jnp.arange(sq)[:, None] + (sk - sq)
-        mask = qpos >= jnp.arange(sk)[None, :]
+        kpos = jnp.arange(sk)[None, :]
+        mask = qpos >= kpos
+        if window is not None:
+            mask &= kpos > qpos - window
         scores = jnp.where(mask[None, None], scores, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -132,7 +140,11 @@ def _xla_attention(q, k, v, causal: bool = True, segment_ids=None):
 
 
 def _dispatch_attention(backend: str, q, k, v, causal=True, segment_ids=None,
-                        mesh=None):
+                        mesh=None, window=None):
+    if window is not None:
+        # sliding window: explicit mask on the XLA path (window support in the
+        # flash/SP kernels is a kernel-side TODO)
+        return _xla_attention(q, k, v, causal, segment_ids, window=window)
     if backend == "xla":
         return _xla_attention(q, k, v, causal, segment_ids)
     if backend == "flash":
@@ -155,8 +167,8 @@ class LlamaAttention(nn.Module):
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
         d = cfg.head_dim_
-        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
-                        param_dtype=jnp.float32)
+        dense = partial(nn.DenseGeneral, use_bias=cfg.attention_bias,
+                        dtype=cfg.dtype, param_dtype=jnp.float32)
         q = dense(features=(cfg.num_heads, d), name="wq")(x)
         k = dense(features=(cfg.num_kv_heads, d), name="wk")(x)
         v = dense(features=(cfg.num_kv_heads, d), name="wv")(x)
@@ -170,7 +182,8 @@ class LlamaAttention(nn.Module):
         k = apply_rope(k, cos, sin, positions)
 
         out = _dispatch_attention(cfg.attention_backend, q, k, v, causal=True,
-                                  segment_ids=segment_ids)
+                                  segment_ids=segment_ids,
+                                  window=cfg.sliding_window)
         out = shard_activation(out, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
         return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=jnp.float32, name="wo")(out)

@@ -1,0 +1,166 @@
+"""OPT model family (learned positional embeddings, pre-LayerNorm, ReLU MLP).
+
+Reference analog: ``deepspeed/inference/v2/model_implementations/opt`` and the
+OPT container in ``module_inject/containers``. Architecture: learned position
+embeddings with OPT's +2 offset convention, pre-norm decoder blocks, biased
+projections, ReLU MLP, final LayerNorm, tied lm_head.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, SEQ_AXIS, HEADS_AXIS, _dispatch_attention, shard_activation)
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 2048
+    ffn_dim: int = 8192
+    num_layers: int = 24
+    num_heads: int = 32
+    max_seq_len: int = 2048
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_OPT = OPTConfig(vocab_size=512, hidden_size=128, ffn_dim=256, num_layers=2,
+                     num_heads=4, max_seq_len=128, dtype=jnp.float32)
+
+# OPT's learned position table is offset by 2 (padding-token legacy)
+OPT_POSITION_OFFSET = 2
+
+
+class OPTBlock(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        d = cfg.head_dim_
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attn_ln")(x)
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(h)
+        k = dense(features=(cfg.num_heads, d), name="wk")(h)
+        v = dense(features=(cfg.num_heads, d), name="wv")(h)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        attn = _dispatch_attention(cfg.attention_backend, q, k, v, causal=True)
+        x = x + nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                use_bias=True, dtype=cfg.dtype,
+                                param_dtype=jnp.float32, name="wo")(attn)
+        h2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                          name="mlp_ln")(x)
+        m = nn.Dense(cfg.ffn_dim, use_bias=True, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="fc1")(h2)
+        m = nn.relu(m)
+        x = x + nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="fc2")(m)
+        return shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+
+
+class OPTModel(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[1])[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(input_ids)
+        pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
+                               (cfg.max_seq_len + OPT_POSITION_OFFSET,
+                                cfg.hidden_size), jnp.float32)
+        x = x + pos_table[positions + OPT_POSITION_OFFSET].astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = OPTBlock(cfg, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_ln")(x)
+        embed = self.variables["params"]["embed"]["embedding"]
+        return x.astype(jnp.float32) @ embed.astype(jnp.float32).T
+
+
+class OPTForCausalLM(nn.Module):
+    cfg: OPTConfig
+
+    def setup(self):
+        self.model = OPTModel(self.cfg)
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def __call__(self, batch):
+        input_ids = batch["input_ids"]
+        logits = self.model(input_ids, positions=batch.get("positions"))
+        labels = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+
+def opt_tensor_rules(path, leaf):
+    """TP sharding rules for OPT params."""
+    from jax.sharding import PartitionSpec
+    names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    if "embed" in names or "pos_embed" in names:
+        return PartitionSpec(None, "tensor")
+    if any(n in names for n in ("wq", "wk", "wv")) and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor", None)
+    if "wo" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None, None)
+    if "fc1" in names and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor")
+    if "fc2" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None)
+    return None
+
+
+def convert_hf_opt(hf_state, cfg: OPTConfig):
+    """HF OPT naming -> our tree (q/k/v/out_proj with biases, fc1/fc2,
+    embed_positions includes the +2 offset rows)."""
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    d, h, dh = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    pfx = "model.decoder."
+    tree = {
+        "embed": {"embedding": get(pfx + "embed_tokens.weight")},
+        "pos_embed": get(pfx + "embed_positions.weight"),
+        "final_ln": {"scale": get(pfx + "final_layer_norm.weight"),
+                     "bias": get(pfx + "final_layer_norm.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"{pfx}layers.{i}."
+        tree[f"layer_{i}"] = {
+            "attn_ln": {"scale": get(p + "self_attn_layer_norm.weight"),
+                        "bias": get(p + "self_attn_layer_norm.bias")},
+            "mlp_ln": {"scale": get(p + "final_layer_norm.weight"),
+                       "bias": get(p + "final_layer_norm.bias")},
+            "wq": {"kernel": get(p + "self_attn.q_proj.weight").T.reshape(d, h, dh),
+                   "bias": get(p + "self_attn.q_proj.bias").reshape(h, dh)},
+            "wk": {"kernel": get(p + "self_attn.k_proj.weight").T.reshape(d, h, dh),
+                   "bias": get(p + "self_attn.k_proj.bias").reshape(h, dh)},
+            "wv": {"kernel": get(p + "self_attn.v_proj.weight").T.reshape(d, h, dh),
+                   "bias": get(p + "self_attn.v_proj.bias").reshape(h, dh)},
+            "wo": {"kernel": get(p + "self_attn.out_proj.weight").T.reshape(h, dh, d),
+                   "bias": get(p + "self_attn.out_proj.bias")},
+            "fc1": {"kernel": get(p + "fc1.weight").T, "bias": get(p + "fc1.bias")},
+            "fc2": {"kernel": get(p + "fc2.weight").T, "bias": get(p + "fc2.bias")},
+        }
+    return {"model": tree}

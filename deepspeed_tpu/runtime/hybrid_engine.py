@@ -63,10 +63,26 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         hc = hybrid_config or {}
         self.max_out_tokens = int(hc.get("max_out_tokens", 512))
         self.release_inference_cache = bool(hc.get("release_inference_cache", False))
-        self.lora_scaling = float(hc.get("lora_scaling", 1.0))
+        # default scaling follows LoRAConfig's forward convention alpha/r
+        # (deepspeed_tpu/linear: 16/64) so the generation view matches the
+        # training forward when adapters use the default config
+        from deepspeed_tpu.linear.config import LoRAConfig as _LC
+        _lc = _LC()
+        self.lora_scaling = float(hc.get("lora_scaling",
+                                         _lc.lora_alpha / _lc.lora_r))
         self._infer_engine = None
         self._infer_params = None
         self._weights_version = -1
+
+        scaling, dtype = self.lora_scaling, self.compute_dtype
+
+        def _to_infer(p):
+            fused = fuse_lora_params(p, scaling)
+            return jax.tree.map(
+                lambda x: x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, fused)
+        # built once: refreshes hit the jit cache instead of retracing per step
+        self._to_infer_fn = jax.jit(_to_infer)
         # per-phase latency bookkeeping (reference hybrid_engine.py:54-60)
         self._generate_latency = 0.0
         self._training_latency = 0.0
@@ -87,15 +103,7 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         if self._weights_version == self.global_steps and self._infer_engine:
             return
         t0 = time.time()
-        params = self.state.params
-
-        def to_infer(p):
-            fused = fuse_lora_params(p, self.lora_scaling)
-            return jax.tree.map(
-                lambda x: x.astype(self.compute_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, fused)
-
-        self._infer_params = jax.jit(to_infer)(params)
+        self._infer_params = self._to_infer_fn(self.state.params)
         from deepspeed_tpu.inference.v2.engine_v2 import (
             InferenceEngineV2, V2EngineConfig)
         cfg = self._model_config()
@@ -118,12 +126,16 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         self._refresh_inference_view()
         eng = self._infer_engine
         if prompt_tokens and isinstance(prompt_tokens[0], (list, tuple)):
-            outs = []
-            for i, p in enumerate(prompt_tokens):
-                outs.append(eng.generate(
-                    list(p), max_new_tokens=min(max_new_tokens, self.max_out_tokens),
-                    uid=uid + i))
-            result = outs
+            # batched rollout through continuous batching: admit every prompt,
+            # then step the engine — decodes run as one padded batch per token
+            # instead of per-prompt loops
+            budget = min(max_new_tokens, self.max_out_tokens)
+            uids = [uid + i for i in range(len(prompt_tokens))]
+            eng.put(uids, [list(p) for p in prompt_tokens])
+            seqs = [eng.state.get(u) for u in uids]
+            while any(len(s.generated) < budget and not s.done for s in seqs):
+                eng.step()
+            result = [eng.flush(u)[:budget] for u in uids]
         else:
             result = eng.generate(
                 list(prompt_tokens),
@@ -137,7 +149,9 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         out = super().train_batch(*args, **kwargs)
         self._training_latency += time.time() - t0
         if self.release_inference_cache:
-            self._infer_engine = None  # free paged-KV HBM between phases
+            # free the paged-KV pool AND the bf16 weight copy for the train phase
+            self._infer_engine = None
+            self._infer_params = None
         return out
 
     # reference latency accessors (hybrid_engine _t_start/_total_latency family)
