@@ -1,0 +1,236 @@
+"""ZeRO-Inference: weight-only quantization + host weight offload for
+throughput inference on small hardware.
+
+Reference analogs:
+- ``deepspeed/inference/quantization/`` (post-training group-wise weight-only
+  quantization swapped into HF models; config ``weight_quantization`` with
+  ``quantized_initialization``/``post_init_quant`` — int8/int4 grouped)
+- ZeRO-Inference weight/KV offload (weights pinned in CPU DRAM, streamed to the
+  accelerator layer by layer so models ≫ HBM can generate; the "20× inference"
+  README claim).
+
+TPU-native shape:
+- **Quantized storage**: matched ≥2-D leaves are replaced by
+  ``{"codes": int8[..], "scale": f32[..], "_qshape": …}`` records — HBM cost
+  ≈ ¼ of bf16. Dequantization happens *inside* the jitted forward
+  (``dequantize_model_params``), where XLA fuses scale-multiply into the
+  consumer matmul.
+- **Host offload + layer streaming**: the (quantized) store lives in host RAM;
+  ``streamed_forward`` runs a per-layer jitted block fn while ``device_put``
+  prefetches the next layer's weights — double buffering over PCIe/DCN, the
+  swap-in/compute overlap the reference gets from its pinned-memory prefetcher.
+  Works for the Llama family's ``layer_{i}`` tree layout.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig, rope_freqs
+from deepspeed_tpu.utils.logging import log_dist
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """int8 codes + fp32 group scales; the original shape rides as *static*
+    pytree aux data so dequantization stays jit-friendly."""
+
+    def __init__(self, codes, scale, shape):
+        self.codes = codes
+        self.scale = scale
+        self.shape = tuple(int(s) for s in shape)
+
+    def tree_flatten(self):
+        return (self.codes, self.scale), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        return cls(children[0], children[1], shape)
+
+    @property
+    def nbytes(self) -> int:
+        return (np.asarray(self.codes).nbytes if not hasattr(self.codes, "nbytes")
+                else self.codes.nbytes) + self.scale.nbytes
+
+
+def _is_qrecord(node) -> bool:
+    return isinstance(node, QuantizedTensor)
+
+
+def quantize_model_params(params: Any, q_bits: int = 8, group_size: int = 64,
+                          modules: Optional[Sequence[str]] = None) -> Any:
+    """Group-wise symmetric weight-only quantization of a params tree
+    (reference: inference/quantization quantization.py _init_group_wise_weight_
+    quantization). ``modules``: regexes of leaf paths to quantize (default: every
+    floating leaf with ndim >= 2)."""
+    pats = [re.compile(p) for p in (modules or [".*"])]
+    qmax = 2.0 ** (q_bits - 1) - 1
+
+    def quant(path, leaf):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        arr = np.asarray(leaf)
+        if (arr.ndim < 2 or not np.issubdtype(arr.dtype, np.floating)
+                or not any(p.search(name) for p in pats)):
+            return arr
+        flat = arr.astype(np.float32).ravel()
+        pad = (-flat.size) % group_size
+        g = np.pad(flat, (0, pad)).reshape(-1, group_size)
+        scale = np.maximum(np.abs(g).max(axis=1, keepdims=True) / qmax, 1e-12)
+        codes = np.clip(np.round(g / scale), -qmax - 1, qmax).astype(np.int8)
+        return QuantizedTensor(codes, scale.astype(np.float32), arr.shape)
+
+    return jax.tree_util.tree_map_with_path(quant, params)
+
+
+def dequantize_model_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
+    """Inverse transform, jit-friendly (called inside the compiled forward so
+    XLA fuses the scale-multiply into consumers)."""
+    def deq(node):
+        if not _is_qrecord(node):
+            return node
+        n = int(np.prod(node.shape))
+        flat = (jnp.asarray(node.codes).astype(jnp.float32)
+                * jnp.asarray(node.scale)).ravel()
+        return flat[:n].reshape(node.shape).astype(dtype)
+    return jax.tree_util.tree_map(deq, qparams, is_leaf=_is_qrecord)
+
+
+def quantized_nbytes(qparams: Any) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(qparams):
+        total += np.asarray(leaf).nbytes
+    return total
+
+
+class ZeROInferenceEngine:
+    """Weight-quantized (optionally host-offloaded, layer-streamed) inference.
+
+    ``offload="none"``: quantized store lives in HBM; one jitted forward
+    dequantizes in place (≈4× HBM saving vs bf16).
+    ``offload="cpu"``: store stays in host RAM; ``forward`` streams weights
+    layer by layer with double buffering (models larger than HBM).
+    """
+
+    def __init__(self, model, params, model_config: Optional[LlamaConfig] = None,
+                 q_bits: int = 8, group_size: int = 64,
+                 offload: str = "none", dtype=jnp.bfloat16,
+                 modules: Optional[Sequence[str]] = None):
+        self.model = model
+        self.cfg = model_config or getattr(model, "config", None)
+        self.dtype = dtype
+        self.offload = offload
+        self.qstore = quantize_model_params(params, q_bits, group_size, modules)
+        if offload == "none":
+            self.qstore = jax.device_put(self.qstore)
+        orig = sum(np.asarray(x).nbytes for x in jax.tree.leaves(params))
+        log_dist(f"zero-inference: {orig / 1e6:.1f}MB fp -> "
+                 f"{quantized_nbytes(self.qstore) / 1e6:.1f}MB quantized "
+                 f"(q{q_bits}, offload={offload})", ranks=[0])
+        self._fwd = None
+
+    # -- resident (HBM) path ------------------------------------------------
+    def forward(self, batch):
+        if self.offload == "cpu":
+            return self._streamed_forward(batch)
+        if self._fwd is None:
+            model, dtype = self.model, self.dtype
+
+            def fwd(qstore, batch):
+                return model.apply({"params": dequantize_model_params(qstore, dtype)},
+                                   batch, method=lambda m, b: m.model(b["input_ids"]))
+            self._fwd = jax.jit(fwd)
+        return self._fwd(self.qstore, batch)
+
+    # -- host-offloaded, layer-streamed path --------------------------------
+    def _streamed_forward(self, batch):
+        """Per-layer streaming for the Llama-family tree layout: embed →
+        [stream layer_i weights, run block] → final norm + head. Next layer's
+        host→device transfer is issued before the current block runs
+        (device_put is async), giving copy/compute overlap."""
+        cfg = self.cfg
+        if cfg is None:
+            raise ValueError("streamed forward needs a LlamaConfig-style model config")
+        m = self.qstore["model"]
+        ids = jnp.asarray(batch["input_ids"])
+
+        embed = dequantize_model_params(jax.device_put(m["embed"]), self.dtype)
+        x = embed["embedding"][ids]
+        positions = jnp.arange(ids.shape[1])[None, :]
+        block_fn = self._block_fn()
+
+        nxt = jax.device_put(m["layer_0"])  # prefetch first layer
+        for i in range(cfg.num_layers):
+            cur = nxt
+            if i + 1 < cfg.num_layers:
+                nxt = jax.device_put(m[f"layer_{i + 1}"])  # async prefetch
+            x = block_fn(dequantize_model_params(cur, self.dtype), x, positions)
+
+        tail = dequantize_model_params(jax.device_put(
+            {"final_norm": m["final_norm"],
+             **({"lm_head": m["lm_head"]} if "lm_head" in m else {})}), self.dtype)
+        return self._head_fn()(tail, embed, x)
+
+    def _block_fn(self):
+        if getattr(self, "_block_jit", None) is None:
+            cfg = self.cfg
+
+            def block(lp, x, positions):
+                from deepspeed_tpu.inference.v2.llama_decode import _mlp, _qkv, _rms
+                from deepspeed_tpu.models.llama import _xla_attention
+                cos, sin = rope_freqs(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta)
+                from deepspeed_tpu.models.llama import apply_rope
+                h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
+                b, s, d = h.shape
+                q, k, v = _qkv(lp, h.reshape(b * s, d), self.dtype)
+                q = q.reshape(b, s, *q.shape[1:])
+                k = k.reshape(b, s, *k.shape[1:])
+                v = v.reshape(b, s, *v.shape[1:])
+                q = apply_rope(q, jnp.asarray(cos), jnp.asarray(sin), positions)
+                k = apply_rope(k, jnp.asarray(cos), jnp.asarray(sin), positions)
+                attn = _xla_attention(q, k, v, causal=True,
+                                      window=cfg.sliding_window)
+                out = jnp.einsum("bshk,hkd->bsd", attn,
+                                 lp["attn"]["wo"]["kernel"].astype(self.dtype))
+                x = x + out
+                h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
+                return x + _mlp(lp, h2, self.dtype)
+            self._block_jit = jax.jit(block)
+        return self._block_jit
+
+    def _head_fn(self):
+        if getattr(self, "_head_jit", None) is None:
+            cfg = self.cfg
+
+            def head(tail, embed, x):
+                from deepspeed_tpu.inference.v2.llama_decode import _rms
+                x = _rms(x, tail["final_norm"]["scale"], cfg.rms_norm_eps)
+                if "lm_head" in tail:
+                    return x.astype(jnp.float32) @ \
+                        tail["lm_head"]["kernel"].astype(jnp.float32)
+                return x.astype(jnp.float32) @ \
+                    embed["embedding"].astype(jnp.float32).T
+            self._head_jit = jax.jit(head)
+        return self._head_jit
+
+    # -- generation ---------------------------------------------------------
+    def generate(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32
+                 ) -> List[int]:
+        """Greedy generation. Resident mode uses the FastGen paged engine over
+        the dequantized-on-the-fly weights; offload mode re-forwards the full
+        context through the streamed path per token (throughput mode — the
+        reference's ZeRO-Inference similarly trades latency for fitting)."""
+        if self.offload == "none" and self.cfg is not None:
+            from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+            deq = jax.jit(lambda q: dequantize_model_params(q, self.dtype))(self.qstore)
+            return InferenceEngineV2(deq, self.cfg).generate(
+                list(prompt_tokens), max_new_tokens=max_new_tokens)
+        ids = list(prompt_tokens)
+        out = []
+        for _ in range(max_new_tokens):
+            logits = self._streamed_forward({"input_ids": np.asarray([ids])})
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            ids.append(nxt)
+        return out
