@@ -27,17 +27,27 @@ def main():
 
     n_devices = len(jax.devices())
     seq_len = 2048
-    batch = 8 * n_devices
+    micro_batch = int(os.environ.get("DSTPU_BENCH_MICRO_BATCH", 2))
+    gas = int(os.environ.get("DSTPU_BENCH_GAS", 4))
+    batch = micro_batch * gas * n_devices
 
+    # Fits one v5e chip (16GB HBM): remat recomputes activations, bf16 grad
+    # accumulation halves the gas scan carry, fp32 masters + adam moments for
+    # the 0.7B model are ~8.4GB.
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
         num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=seq_len,
-        dtype=jnp.bfloat16, attention_backend="flash", remat=False)
+        dtype=jnp.bfloat16,
+        attention_backend=os.environ.get("DSTPU_BENCH_ATTN", "xla"),
+        remat=os.environ.get("DSTPU_BENCH_REMAT", "1") == "1",
+        remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
+                                    "dots_with_no_batch_dims_saveable"))
     config = {
         "train_batch_size": batch,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
         "bf16": {"enabled": True},
+        "data_types": {"grad_accum_dtype": "bf16"},
         "zero_optimization": {"stage": 0 if n_devices == 1 else 3},
         "steps_per_print": 1000000,
     }
@@ -47,16 +57,20 @@ def main():
         example_batch=random_tokens(2, seq_len, vocab_size=cfg.vocab_size))
 
     def make_batch(i):
-        return random_tokens(batch, seq_len, vocab_size=cfg.vocab_size, seed=i)
+        return random_tokens(micro_batch * n_devices, seq_len,
+                             vocab_size=cfg.vocab_size, seed=i, gas=gas)
 
-    engine.train_batch(batch=make_batch(0))  # compile
-    jax.block_until_ready(engine.state.params)
+    # Sync barrier: fetch a device scalar to host. (On tunneled platforms
+    # block_until_ready can return before execution finishes; a D2H transfer
+    # cannot.)
+    loss = engine.train_batch(batch=make_batch(0), stacked=True)  # compile
+    float(jax.device_get(loss))
 
     steps = 10
     t0 = time.time()
     for i in range(1, steps + 1):
-        engine.train_batch(batch=make_batch(i))
-    jax.block_until_ready(engine.state.params)
+        loss = engine.train_batch(batch=make_batch(i), stacked=True)
+    float(jax.device_get(loss))
     dt = time.time() - t0
 
     tokens_per_sec = steps * batch * seq_len / dt

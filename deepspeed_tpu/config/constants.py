@@ -33,6 +33,8 @@ ELASTICITY = "elasticity"
 COMPRESSION_TRAINING = "compression_training"
 AUTOTUNING = "autotuning"
 CHECKPOINT = "checkpoint"
+DATA_TYPES = "data_types"                 # reference: constants.py:426
+GRAD_ACCUM_DTYPE = "grad_accum_dtype"     # reference: constants.py:427
 
 # Defaults (mirroring reference semantics)
 STEPS_PER_PRINT_DEFAULT = 10

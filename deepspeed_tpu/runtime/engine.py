@@ -315,7 +315,8 @@ class DeepSpeedTPUEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.timers = SynchronizedWallClockTimer()
+        self.timers = SynchronizedWallClockTimer(
+            synchronize=config.wall_clock_breakdown)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
             steps_per_output=config.steps_per_print)
@@ -429,24 +430,41 @@ class DeepSpeedTPUEngine:
         tx = self.tx
         lr_schedule = self.lr_schedule
 
+        acc_dtype = cfg.grad_accum_dtype
+
         def train_batch_step(state: EngineState, stacked_batch, rng) -> Tuple[EngineState, StepOutput]:
             scale = state.loss_scale.scale
             rngs = jax.random.split(rng, gas)
+
+            if gas == 1:
+                # no accumulation buffer at all: one microbatch, grads go
+                # straight into the update (saves a full param-tree carry)
+                batch = jax.tree.map(lambda x: x[0], stacked_batch)
+                loss, grads = self._grads_one_micro(state.params, batch,
+                                                    rngs[0], scale)
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) / scale, grads)
+                new_state, out = self._update(state, grads, tx, lr_schedule,
+                                              clip, fp16)
+                return new_state, out._replace(loss=loss)
 
             def micro(carry, xs):
                 grad_acc, loss_acc = carry
                 batch, r = xs
                 loss, grads = self._grads_one_micro(state.params, batch, r, scale)
-                grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+                grad_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(acc_dtype), grad_acc, grads)
                 return (grad_acc, loss_acc + loss), None
 
             zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                lambda p: jnp.zeros(p.shape, acc_dtype), state.params)
             (grads, loss_sum), _ = jax.lax.scan(
                 micro, (zero_grads, jnp.float32(0.0)), (stacked_batch, rngs))
             loss = loss_sum / gas
-            # unscale + average over gas (reference scales loss by 1/gas pre-bwd)
-            grads = jax.tree.map(lambda g: g / (scale * gas), grads)
+            # unscale + average over gas in fp32 (reference scales loss by 1/gas
+            # pre-bwd; accumulation dtype may be lower via data_types config)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32) / (scale * gas), grads)
             new_state, out = self._update(state, grads, tx, lr_schedule, clip, fp16)
             return new_state, out._replace(loss=loss)
 
@@ -562,6 +580,7 @@ class DeepSpeedTPUEngine:
         if self._offload_grad_fn is None:
             gas = self.gradient_accumulation_steps
             fp16 = cfg.fp16
+            acc_dtype = cfg.grad_accum_dtype
 
             def grad_step(params, stacked_batch, rng, scale):
                 rngs = jax.random.split(rng, gas)
@@ -570,14 +589,15 @@ class DeepSpeedTPUEngine:
                     grad_acc, loss_acc = carry
                     b, r = xs
                     loss, grads = self._grads_one_micro(params, b, r, scale)
-                    grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                    grads = jax.tree.map(lambda g: g.astype(acc_dtype), grads)
                     return (jax.tree.map(jnp.add, grad_acc, grads),
                             loss_acc + loss), None
 
-                zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zero = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
                 (grads, loss_sum), _ = jax.lax.scan(
                     micro, (zero, jnp.float32(0.0)), (stacked_batch, rngs))
-                grads = jax.tree.map(lambda g: g / (scale * gas), grads)
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) / (scale * gas), grads)
                 overflow = precision.has_inf_or_nan(grads) if fp16.enabled \
                     else jnp.bool_(False)
                 if cfg.gradient_clipping > 0:
@@ -703,11 +723,13 @@ class DeepSpeedTPUEngine:
         clip, fp16 = cfg.gradient_clipping, cfg.fp16
         grad_shardings = self.param_shardings
 
+        acc_dtype = cfg.grad_accum_dtype
+
         def fwd_bwd(params, batch, rng, scale):
             loss, grads = self._grads_one_micro(params, batch, rng, scale)
-            # fp32 accumulation even when params are compute-dtype shadows
-            # (offload mode); no-op when params are fp32 masters
-            return loss, jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            # accumulate in the configured dtype (fp32 default) even when params
+            # are compute-dtype shadows (offload mode)
+            return loss, jax.tree.map(lambda g: g.astype(acc_dtype), grads)
 
         self._micro_fwd_bwd_fn = jax.jit(
             fwd_bwd, out_shardings=(None, grad_shardings))
@@ -721,7 +743,8 @@ class DeepSpeedTPUEngine:
         def apply_update(state, grad_sum):
             gas = self.gradient_accumulation_steps
             scale = state.loss_scale.scale
-            grads = jax.tree.map(lambda g: g / (scale * gas), grad_sum)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32) / (scale * gas), grad_sum)
             return self._update(state, grads, tx, lr_schedule, clip, fp16)
 
         self._apply_update_fn = jax.jit(

@@ -82,14 +82,21 @@ class Timer:
 
 
 class SynchronizedWallClockTimer:
-    """Registry of named timers (reference: utils/timer.py:44)."""
+    """Registry of named timers (reference: utils/timer.py:44).
 
-    def __init__(self):
+    ``synchronize=False`` makes every timer measure dispatch time only (no
+    device round trip per start/stop) — the engine uses this unless
+    ``wall_clock_breakdown`` is on, mirroring the reference's gating of
+    EngineTimers; on tunneled TPU platforms a device sync costs a full RTT.
+    """
+
+    def __init__(self, synchronize: bool = True):
         self.timers: Dict[str, Timer] = {}
+        self.synchronize = synchronize
 
     def __call__(self, name: str) -> Timer:
         if name not in self.timers:
-            self.timers[name] = Timer(name)
+            self.timers[name] = Timer(name, synchronize=self.synchronize)
         return self.timers[name]
 
     def has(self, name: str) -> bool:
@@ -136,14 +143,20 @@ class ThroughputTimer:
 
     def start(self):
         self.started = True
-        _device_sync()
+        # sync only at a report-window edge: cumulative time between window
+        # edges is then accurate, without paying a device round trip per step
+        if self.steps_per_output and \
+                self.global_step_count % self.steps_per_output == 0:
+            _device_sync()
         self.start_time = time.time()
 
     def stop(self, global_step: bool = True, report_speed: bool = True):
         if not self.started:
             return
         self.started = False
-        _device_sync()
+        if self.steps_per_output and \
+                (self.global_step_count + 1) % self.steps_per_output == 0:
+            _device_sync()
         duration = time.time() - self.start_time
         self.total_elapsed_time += duration
         self.step_elapsed_time += duration
