@@ -11,8 +11,9 @@ Reference analogs:
 
 TPU-native shape:
 - **Quantized storage**: matched ≥2-D leaves are replaced by
-  ``{"codes": int8[..], "scale": f32[..], "_qshape": …}`` records — HBM cost
-  ≈ ¼ of bf16. Dequantization happens *inside* the jitted forward
+  ``QuantizedTensor`` pytree nodes (int8 codes + fp32 group scales, original
+  shape as static aux data) — HBM cost ≈ ¼ of bf16. Dequantization happens
+  *inside* the jitted forward
   (``dequantize_model_params``), where XLA fuses scale-multiply into the
   consumer matmul.
 - **Host offload + layer streaming**: the (quantized) store lives in host RAM;

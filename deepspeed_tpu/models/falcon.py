@@ -33,6 +33,10 @@ class FalconConfig:
     layer_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     attention_backend: str = "xla"
+    # HF falcon 40B/180B checkpoints interleave the fused qkv per KV group
+    # (new_decoder_architecture=True in the HF config); 7B multi_query packs
+    # q rows then k then v sequentially
+    new_decoder_architecture: bool = False
 
     @property
     def head_dim_(self) -> int:
@@ -45,16 +49,24 @@ TINY_FALCON = FalconConfig(vocab_size=512, hidden_size=128, num_layers=2,
 
 
 class FalconBlock(nn.Module):
-    """Parallel residual: x + attn(ln(x)) + mlp(ln(x)) — one shared LayerNorm
-    (Falcon-7B ``parallel_attn``)."""
+    """Parallel residual: x + attn(ln(x)) + mlp(ln(x)). Falcon-7B
+    (``parallel_attn``) shares one LayerNorm between the branches;
+    new_decoder_architecture (40B/180B) has per-branch norms ln_attn/ln_mlp."""
     cfg: FalconConfig
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
         d = cfg.head_dim_
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         name="input_ln")(x)
+        if cfg.new_decoder_architecture:
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             name="ln_attn")(x)
+            h_mlp = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                 name="ln_mlp")(x)
+        else:
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             name="input_ln")(x)
+            h_mlp = h
 
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32)
@@ -72,7 +84,7 @@ class FalconBlock(nn.Module):
                                    param_dtype=jnp.float32, name="wo")(attn)
 
         mlp = nn.Dense(4 * cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
-                       param_dtype=jnp.float32, name="mlp_up")(h)
+                       param_dtype=jnp.float32, name="mlp_up")(h_mlp)
         mlp = nn.gelu(mlp)
         mlp_out = nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
                            param_dtype=jnp.float32, name="mlp_down")(mlp)
@@ -142,9 +154,36 @@ def falcon_tensor_rules(path, leaf):
     return None
 
 
+def _split_falcon_qkv(qkv, cfg: "FalconConfig"):
+    """Split HF falcon's fused query_key_value rows into (wq, wk, wv).
+
+    HF layouts (transformers FalconAttention._split_heads):
+    - new_decoder_architecture (40B/180B): rows interleave per KV group —
+      [hkv groups × (h/hkv q-heads, 1 k-head, 1 v-head) × dh];
+    - multi_query (hkv=1, Falcon-7B): sequential q|k|v rows;
+    - old MHA (hkv=h, falcon-rw): per-head interleaved [q_i, k_i, v_i] — the
+      grouped layout with group size 1, NOT a sequential split.
+    """
+    h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    if cfg.new_decoder_architecture or hkv == h:
+        g = h // hkv
+        grouped = qkv.reshape(hkv, g + 2, dh, qkv.shape[1])
+        wq = grouped[:, :g].reshape(h * dh, qkv.shape[1])
+        wk = grouped[:, g].reshape(hkv * dh, qkv.shape[1])
+        wv = grouped[:, g + 1].reshape(hkv * dh, qkv.shape[1])
+        return wq, wk, wv
+    if hkv != 1:
+        raise ValueError(
+            f"sequential falcon qkv split is only valid for multi_query "
+            f"(hkv=1); got num_kv_heads={hkv}, num_heads={h}. Grouped "
+            f"checkpoints must set new_decoder_architecture=True.")
+    return np.split(qkv, [h * dh, (h + hkv) * dh], axis=0)
+
+
 def convert_hf_falcon(hf_state, cfg: FalconConfig):
     """HF falcon naming -> our tree: fused query_key_value [(H+2Hkv)*dh, D]
-    split into wq/wk/wv; dense_h_to_4h/dense_4h_to_h -> mlp_up/mlp_down."""
+    split into wq/wk/wv (per-KV-group interleaved for new_decoder_architecture);
+    dense_h_to_4h/dense_4h_to_h -> mlp_up/mlp_down."""
     def get(name):
         v = hf_state[name]
         return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
@@ -156,10 +195,17 @@ def convert_hf_falcon(hf_state, cfg: FalconConfig):
     for i in range(cfg.num_layers):
         p = f"transformer.h.{i}."
         qkv = get(p + "self_attention.query_key_value.weight")
-        wq, wk, wv = np.split(qkv, [h * dh, (h + hkv) * dh], axis=0)
+        wq, wk, wv = _split_falcon_qkv(qkv, cfg)
+        if cfg.new_decoder_architecture:
+            norms = {"ln_attn": {"scale": get(p + "ln_attn.weight"),
+                                 "bias": get(p + "ln_attn.bias")},
+                     "ln_mlp": {"scale": get(p + "ln_mlp.weight"),
+                                "bias": get(p + "ln_mlp.bias")}}
+        else:
+            norms = {"input_ln": {"scale": get(p + "input_layernorm.weight"),
+                                  "bias": get(p + "input_layernorm.bias")}}
         tree[f"layer_{i}"] = {
-            "input_ln": {"scale": get(p + "input_layernorm.weight"),
-                         "bias": get(p + "input_layernorm.bias")},
+            **norms,
             "wq": {"kernel": wq.T.reshape(d, h, dh)},
             "wk": {"kernel": wk.T.reshape(d, hkv, dh)},
             "wv": {"kernel": wv.T.reshape(d, hkv, dh)},

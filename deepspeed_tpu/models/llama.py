@@ -74,7 +74,24 @@ TINY_LLAMA = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
 
 
 def shard_activation(x, spec: Tuple):
-    """with_sharding_constraint that degrades to no-op outside a mesh context."""
+    """with_sharding_constraint filtered to the active mesh's axis names
+    (hand-built meshes may lack canonical axes, e.g. fsdp_out); degrades to
+    no-op outside a mesh context."""
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    mesh = mesh_lib.get_global_mesh()
+    if mesh is not None:
+        names = set(mesh.axis_names)
+
+        def filt(entry):
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in names)
+                return kept if kept else None
+            return entry if entry in names else None
+        spec = tuple(filt(e) for e in spec)
+        if all(e is None for e in spec):
+            # nothing survived filtering (fully non-canonical mesh): an
+            # all-None spec would force replication, not act as a no-op
+            return x
     try:
         return jax.lax.with_sharding_constraint(x, PartitionSpec(*spec))
     except Exception:

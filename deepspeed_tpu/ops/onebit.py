@@ -98,6 +98,9 @@ def onebit_adam(learning_rate: ScheduleOrFloat,
 
     def update(grads, state, params=None):
         count = state.count + 1
+        # compression starts at step freeze_step+1: the reference flips
+        # adam_freeze_key at the END of the step where step >= freeze_step
+        # (adam.py:249-252), so the first compressed step is > freeze_step
         frozen = count > freeze_step
 
         def warmup(_):
@@ -138,6 +141,8 @@ def onebit_adam(learning_rate: ScheduleOrFloat,
             return upd, m_c, state.exp_avg_sq, new_we, new_se
 
         upd, m, v, we, se = jax.lax.cond(frozen, compressed, warmup, None)
+        # LR schedules are 0-based repo-wide (optax scale_by_schedule and
+        # engine.get_lr() read lr_schedule(step) pre-increment)
         lr = _lr_at(learning_rate, state.count)
         if weight_decay and params is not None:
             upd = jax.tree.map(lambda u, p: u + weight_decay * p.astype(jnp.float32),
@@ -212,6 +217,8 @@ def zero_one_adam(learning_rate: ScheduleOrFloat,
         upd = jax.tree.map(
             lambda m, v: jnp.clip(m / (jnp.sqrt(v) + eps), -update_clip, update_clip),
             m_c, v)
+        # LR schedules are 0-based repo-wide (optax scale_by_schedule and
+        # engine.get_lr() read lr_schedule(step) pre-increment)
         lr = _lr_at(learning_rate, state.count)
         if weight_decay and params is not None:
             upd = jax.tree.map(lambda u, p: u + weight_decay * p.astype(jnp.float32),
@@ -263,6 +270,9 @@ def onebit_lamb(learning_rate: ScheduleOrFloat,
         if params is None:
             raise ValueError("onebit_lamb requires params (trust ratio)")
         count = state.count + 1
+        # compression starts at step freeze_step+1: the reference flips
+        # adam_freeze_key at the END of the step where step >= freeze_step
+        # (adam.py:249-252), so the first compressed step is > freeze_step
         frozen = count > freeze_step
 
         def warmup(_):
@@ -298,6 +308,8 @@ def onebit_lamb(learning_rate: ScheduleOrFloat,
             return upd, m_c, state.exp_avg_sq, new_we, new_se, state.frozen_ratio
 
         upd, m, v, we, se, ratios = jax.lax.cond(frozen, compressed, warmup, None)
+        # LR schedules are 0-based repo-wide (optax scale_by_schedule and
+        # engine.get_lr() read lr_schedule(step) pre-increment)
         lr = _lr_at(learning_rate, state.count)
         updates = jax.tree.map(lambda u, r, g: (-lr * r * u).astype(g.dtype),
                                upd, ratios, grads)

@@ -1,16 +1,28 @@
-"""Pallas flash-attention forward kernel.
+"""Pallas flash-attention kernels (forward + backward).
 
 Reference analog: the fused attention CUDA kernels
-(``csrc/transformer/inference/csrc/softmax.cu``, v2 ``blocked_flash``). TPU design:
+(``csrc/transformer/inference/csrc/softmax.cu``, the training transformer kernel
+suite ``csrc/transformer/`` fused fwd+bwd, v2 ``blocked_flash``). TPU design:
 canonical sequential-grid flash — grid (batch*heads, q_blocks, k_blocks) with the
 k dimension innermost (TPU grids execute sequentially, so VMEM scratch accumulators
 carry across k steps): online-softmax max/sum/output accumulators in fp32 scratch,
 [block_q, block_k] score panels on the MXU, GQA handled by index-mapping q heads
 onto shared KV heads (no KV repeat materialized).
 
-Backward: flash-style recompute via the blockwise lax implementation
-(``deepspeed_tpu.ops.flash_attention``) under ``jax.custom_vjp`` — same numerics,
-O(S) memory.
+Causal block skipping: score blocks entirely above the diagonal are predicated
+out with ``pl.when`` — the MXU work for the ~half of blocks that are fully
+masked is skipped (the reference's fused kernels get the same effect from their
+triangular launch bounds).
+
+Backward: FlashAttention-2 style two-kernel recompute. The forward additionally
+emits the per-row logsumexp; backward precomputes ``delta = rowsum(dO * O)``
+with XLA, then
+- a dQ kernel over grid (B*H, q_blocks, k_blocks) accumulating
+  ``dq += ds @ K`` in fp32 VMEM scratch, and
+- a dKV kernel over grid (B*Hkv, k_blocks, q_blocks * group) accumulating
+  ``dk += ds^T @ Q`` / ``dv += p^T @ dO`` — the GQA group dimension is folded
+  into the innermost grid axis so gradients for KV heads shared by several query
+  heads accumulate in-kernel (no rep-times-larger intermediate in HBM).
 """
 
 import functools
@@ -26,7 +38,22 @@ from deepspeed_tpu.ops.flash_attention import flash_attention as blockwise_refer
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _masked_scores(q, k, qi, ki, *, sm_scale, causal, block_q, block_k,
+                   seq_len_k):
+    """Shared score-panel + mask construction for the forward and both backward
+    kernels — keeps their masking numerically locked together. Returns
+    (s[bq,bk] fp32 scores, mask[bq,bk] bool: kv-padding AND causal)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = kpos < seq_len_k
+    if causal:
+        mask = jnp.logical_and(mask, qpos >= kpos)
+    return s, mask
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                   sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
@@ -37,60 +64,71 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]                       # [block_q, D]
-    k = k_ref[0]                       # [block_k, D]
-    v = v_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * sm_scale
+    def _compute():
+        q = q_ref[0]                       # [block_q, D]
+        k = k_ref[0]                       # [block_k, D]
+        v = v_ref[0]
+        s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
+                                 block_q=block_q, block_k=block_k,
+                                 seq_len_k=seq_len_k)
+        s = jnp.where(mask, s, NEG_INF)
 
-    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = kpos < seq_len_k            # kv padding
+        m_prev = m_scr[:]                  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc
+
     if causal:
-        mask = jnp.logical_and(mask, qpos >= kpos)
-    s = jnp.where(mask, s, NEG_INF)
-
-    m_prev = m_scr[:]                  # [block_q, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc = acc_scr[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
-    l_scr[:] = l_new
-    acc_scr[:] = acc
+        # skip blocks entirely above the diagonal (all-masked → no-op)
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _fold(x):
+    """[B, S, H, D] -> [B*H, S, D]."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _pad_seq(x, block):
+    pad = (-x.shape[1]) % block
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0))) if pad else x
+
+
+def _unfold(x, b, h, s):
+    return x.reshape(b, h, x.shape[1], x.shape[2]).transpose(0, 2, 1, 3)[:, :s]
 
 
 def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
                            interpret: bool):
-    """q: [B, Sq, H, D]; k,v: [B, Sk, Hkv, D]."""
+    """q: [B, Sq, H, D]; k,v: [B, Sk, Hkv, D] -> (out, lse[B*H, Sq_padded])."""
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     rep = h // hkv
     sm_scale = 1.0 / np.sqrt(d)
 
-    pad_q = (-sq) % block_q
-    pad_k = (-sk) % block_k
-    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
-    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
-    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
-
+    qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v, block_k)
     sq_p, sk_p = qp.shape[1], kp.shape[1]
-    # [B*H, S, D] layout: heads fold into the grid's batch dim
-    q2 = qp.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
-    k2 = kp.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
-    v2 = vp.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
+    q2, k2, v2 = _fold(qp), _fold(kp), _fold(vp)
 
     nq, nk = sq_p // block_q, sk_p // block_k
     grid = (b * h, nq, nk)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
                           seq_len_k=sk),
@@ -102,8 +140,16 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, d),
                          lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            # rank-3 [B*H, S, 1]: TPU blocks need sublane %8 == 0 and lane
+            # equal to the array dim — a rank-2 (1, block_q) block is rejected
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -112,32 +158,184 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
         interpret=interpret,
     )(q2, k2, v2)
 
-    out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)
-    return out[:, :sq]
+    return _unfold(out, b, h, sq), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
+               sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0]                   # [block_q, 1]
+        delta = delta_ref[0]               # [block_q, 1]
+        s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
+                                 block_q=block_q, block_k=block_k,
+                                 seq_len_k=seq_len_k)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k,
+                num_q_blocks, num_q_steps, seq_len_k):
+    j = pl.program_id(2)                   # folded (group, q_block) index
+    ki = pl.program_id(1)
+    qi = j % num_q_blocks
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0]                   # [block_q, 1]
+        delta = delta_ref[0]
+        s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
+                                 block_q=block_q, block_k=block_k,
+                                 seq_len_k=seq_len_k)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == num_q_steps - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
+                           interpret):
+    b, sq, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    rep = h // hkv
+    sm_scale = 1.0 / np.sqrt(d)
+
+    qp, op, gp = (_pad_seq(a, block_q) for a in (q, out, g))
+    kp, vp = _pad_seq(k, block_k), _pad_seq(v, block_k)
+
+    sq_p, sk_p = qp.shape[1], kp.shape[1]
+    q2, k2, v2 = _fold(qp), _fold(kp), _fold(vp)
+    do2, o2 = _fold(gp), _fold(op)
+    delta = jnp.sum(do2.astype(jnp.float32) * o2.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    nq, nk = sq_p // block_q, sk_p // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          seq_len_k=sk),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q2, k2, v2, do2, lse, delta)
+
+    # dKV: GQA group folded into the innermost grid axis → in-kernel accumulation
+    nsteps = nq * rep
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          num_q_steps=nsteps, seq_len_k=sk),
+        grid=(b * hkv, nk, nsteps),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, i, j, rep=rep, nq=nq:
+                         (bh * rep + j // nq, j % nq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, i, j, rep=rep, nq=nq:
+                         (bh * rep + j // nq, j % nq, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, i, j, rep=rep, nq=nq:
+                         (bh * rep + j // nq, j % nq, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, i, j, rep=rep, nq=nq:
+                         (bh * rep + j // nq, j % nq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hkv, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hkv, sk_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q2, k2, v2, do2, lse, delta)
+
+    return (_unfold(dq, b, h, sq), _unfold(dk, b, hkv, sk),
+            _unfold(dv, b, hkv, sk))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
                            block_k: int = 256, interpret: bool = False):
-    """Flash attention with a Pallas forward and flash-recompute backward.
-    ``interpret=True`` runs the kernel in interpreter mode (CPU CI)."""
-    return _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    """Flash attention with Pallas forward and backward kernels.
+    ``interpret=True`` runs the kernels in interpreter mode (CPU CI)."""
+    out, _ = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                                      interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # flash-style recompute through the blockwise lax implementation
-    _, vjp_fn = jax.vjp(
-        lambda q_, k_, v_: blockwise_reference(
-            q_, k_, v_, causal=causal,
-            block_q=min(block_q, q.shape[1]), block_k=min(block_k, k.shape[1])),
-        q, k, v)
-    return vjp_fn(g)
+    q, k, v, out, lse = res
+    return _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q,
+                                  block_k, interpret)
 
 
 pallas_flash_attention.defvjp(_fwd, _bwd)
@@ -147,5 +345,8 @@ def flash_attention_auto(q, k, v, causal: bool = True):
     """Dispatch: Pallas kernel on TPU, interpret/blockwise elsewhere."""
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        return pallas_flash_attention(q, k, v, causal)
+        # 512-blocks amortize grid overhead on long sequences and still fit
+        # VMEM at d=128 (512*128*4B*3 scratch ≈ 0.8MB)
+        blk = 512 if q.shape[1] % 512 == 0 and k.shape[1] % 512 == 0 else 256
+        return pallas_flash_attention(q, k, v, causal, blk, blk)
     return blockwise_reference(q, k, v, causal=causal)

@@ -63,13 +63,36 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         hc = hybrid_config or {}
         self.max_out_tokens = int(hc.get("max_out_tokens", 512))
         self.release_inference_cache = bool(hc.get("release_inference_cache", False))
-        # default scaling follows LoRAConfig's forward convention alpha/r
-        # (deepspeed_tpu/linear: 16/64) so the generation view matches the
-        # training forward when adapters use the default config
+        # scaling priority: explicit hybrid_config > the model's own LoRAConfig
+        # > the global LoRAConfig default (alpha/r) — a model whose adapters use
+        # a non-default alpha/r would otherwise get a wrong fused view
         from deepspeed_tpu.linear.config import LoRAConfig as _LC
-        _lc = _LC()
-        self.lora_scaling = float(hc.get("lora_scaling",
-                                         _lc.lora_alpha / _lc.lora_r))
+        model_lc = getattr(self.model, "lora_config", None) or \
+            getattr(getattr(self.model, "config", None), "lora_config", None)
+        if "lora_scaling" in hc:
+            self.lora_scaling = float(hc["lora_scaling"])
+        elif isinstance(model_lc, _LC):
+            self.lora_scaling = float(model_lc.lora_alpha / model_lc.lora_r)
+        else:
+            _lc = _LC()
+            self.lora_scaling = float(_lc.lora_alpha / _lc.lora_r)
+            # only meaningful (and worth a warning) if the model actually has
+            # LoRA adapters that will be fused with this default scaling
+            try:
+                has_lora = any(
+                    LORA_A in p for p in (
+                        "/".join(str(getattr(kk, "key", kk)) for kk in path)
+                        for path, _ in jax.tree_util.tree_flatten_with_path(
+                            self.state.params)[0]))
+            except Exception:
+                has_lora = False
+            if has_lora:
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(
+                    "hybrid engine: model has LoRA adapters but no "
+                    "lora_scaling in hybrid_config and no LoRAConfig on the "
+                    f"model; fusing with the global default alpha/r = "
+                    f"{self.lora_scaling}")
         self._infer_engine = None
         self._infer_params = None
         self._weights_version = -1

@@ -140,7 +140,7 @@ def build_param_shardings(params: Any, mesh: Mesh, stage: int,
     """Pytree of NamedShardings for the model params.
 
     ``tensor_rules(path, leaf) -> PartitionSpec | None`` supplies model-parallel
-    shardings (the AutoTP analog — see deepspeed_tpu.parallel.auto_tp).
+    shardings (the AutoTP analog — see deepspeed_tpu.module_inject.auto_tp).
     ``mics=True`` shards over the inner fsdp sub-axis only (replicated across
     ``fsdp_out`` shard groups).
     """

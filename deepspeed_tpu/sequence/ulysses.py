@@ -9,10 +9,13 @@ a slice of heads; an inverse all-to-all restores sequence sharding
 TPU-native: one ``shard_map`` over the mesh with ``lax.all_to_all`` on the
 ``sequence`` axis — 4 all-to-alls per attention (q,k,v + output), riding ICI.
 Composes with TP: heads are already split over ``tensor``; Ulysses further splits
-the local heads over ``sequence``. Constraint (same as reference default path):
-heads/tp must be divisible by the sequence-parallel degree; the reference's
-uneven-heads fallback (``uneven_heads_all2all`` layer.py:43) is approximated by
-falling back to ring attention when heads don't divide.
+the local heads over ``sequence``. When heads/tp is not divisible by the
+sequence-parallel degree, the reference redistributes heads unevenly with an
+explicit padded all-to-all (``uneven_heads_all2all`` layer.py:43); here the head
+dimension is zero-padded up to the next multiple of sp (GQA KV heads densified
+first so q/kv pad identically), the same even all-to-all runs, and the pad heads
+are sliced off after the inverse all-to-all — identical comm pattern and
+numerics, with at most (sp-1)/H wasted head-compute on the corner case.
 """
 
 from functools import partial
@@ -40,15 +43,24 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
         return flash_attention(q, k, v, causal=causal) if use_flash else \
             _local_attn(q, k, v, causal)
 
-    h_local = q.shape[2] // (mesh.shape["tensor"] * sp) * sp  # sanity below
-    if (q.shape[2] // mesh.shape["tensor"]) % sp != 0 or \
-            (k.shape[2] // max(mesh.shape["tensor"], 1)) % sp != 0:
-        from deepspeed_tpu.sequence.ring import ring_attention
-        return ring_attention(q, k, v, causal=causal, mesh=mesh)
+    tp = max(mesh.shape["tensor"], 1)
+    uneven = (q.shape[2] // tp) % sp != 0 or (k.shape[2] // tp) % sp != 0
 
     spec = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
     def body(q_l, k_l, v_l):
+        h_local = q_l.shape[2]
+        if uneven:
+            # densify GQA so q/kv share a head count, then zero-pad heads to a
+            # multiple of sp (reference: uneven_heads_all2all layer.py:43)
+            rep = q_l.shape[2] // k_l.shape[2]
+            if rep > 1:
+                k_l = jnp.repeat(k_l, rep, axis=2)
+                v_l = jnp.repeat(v_l, rep, axis=2)
+            pad = (-h_local) % sp
+            if pad:
+                padw = ((0, 0), (0, 0), (0, pad), (0, 0))
+                q_l, k_l, v_l = (jnp.pad(a, padw) for a in (q_l, k_l, v_l))
         # [B, S/sp, Hl, D] -> scatter heads / gather sequence -> [B, S, Hl/sp, D]
         a2a = partial(jax.lax.all_to_all, axis_name="sequence",
                       split_axis=2, concat_axis=1, tiled=True)
@@ -56,8 +68,9 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
         out = flash_attention(qg, kg, vg, causal=causal) if use_flash else \
             _local_attn(qg, kg, vg, causal)
         # inverse: scatter sequence / gather heads
-        return jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,
-                                  concat_axis=2, tiled=True)
+        out = jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,
+                                 concat_axis=2, tiled=True)
+        return out[:, :, :h_local]
 
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

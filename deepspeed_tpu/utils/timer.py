@@ -126,6 +126,15 @@ class ThroughputTimer:
 
     ``flops_per_sample`` may be supplied by the engine (e.g. from the flops profiler /
     XLA cost analysis) to report model TFLOPS at ``steps_per_print`` boundaries.
+
+    Throughput is measured **edge to edge**: the wall clock is read (after a
+    device sync) at report-window boundaries only, and the window's samples are
+    divided by the full boundary-to-boundary interval. Per-step timing would
+    undercount whenever the caller itself syncs between steps (e.g.
+    ``float(loss)`` for logging) — the device work would then drain in the
+    untimed gap between ``stop()`` and the next ``start()`` and the report
+    would only see ~ms dispatch times. Edge-to-edge includes those gaps by
+    construction, at one device round trip per window.
     """
 
     def __init__(self, batch_size: int, steps_per_output: int = 100,
@@ -135,47 +144,50 @@ class ThroughputTimer:
         self.logging = logging_fn or logger.info
         self.started = False
         self.global_step_count = 0
-        self.local_step_count = 0
-        self.total_elapsed_time = 0.0
-        self.step_elapsed_time = 0.0
-        self.start_time = 0.0
+        self.steps_since_edge = 0
+        self.total_elapsed_time = 0.0   # sum over completed report windows
+        self._steps_in_total = 0        # steps covered by total_elapsed_time
+        self._edge_time: Optional[float] = None
         self.flops_per_sample: Optional[float] = None
 
     def start(self):
         self.started = True
-        # sync only at a report-window edge: cumulative time between window
-        # edges is then accurate, without paying a device round trip per step
-        if self.steps_per_output and \
-                self.global_step_count % self.steps_per_output == 0:
+        if self._edge_time is None:
             _device_sync()
-        self.start_time = time.time()
+            self._edge_time = time.time()
 
     def stop(self, global_step: bool = True, report_speed: bool = True):
         if not self.started:
             return
         self.started = False
+        if not global_step:
+            return
+        self.global_step_count += 1
+        self.steps_since_edge += 1
         if self.steps_per_output and \
-                (self.global_step_count + 1) % self.steps_per_output == 0:
-            _device_sync()
-        duration = time.time() - self.start_time
-        self.total_elapsed_time += duration
-        self.step_elapsed_time += duration
-        self.local_step_count += 1
-        if global_step:
-            self.global_step_count += 1
-            if report_speed and self.steps_per_output and \
-                    self.global_step_count % self.steps_per_output == 0:
+                self.global_step_count % self.steps_per_output == 0:
+            _device_sync()   # drain device work belonging to this window
+            now = time.time()
+            window = max(now - self._edge_time, 1e-9)
+            self.total_elapsed_time += window
+            self._steps_in_total += self.steps_since_edge
+            if report_speed:
+                sps = self.batch_size * self.steps_since_edge / window
                 msg = (f"epoch step {self.global_step_count}: "
-                       f"{self.avg_samples_per_sec():.1f} samples/s, "
-                       f"batch time {self.step_elapsed_time / self.local_step_count * 1000:.1f} ms")
+                       f"{sps:.1f} samples/s, batch time "
+                       f"{window / self.steps_since_edge * 1000:.1f} ms")
                 if self.flops_per_sample:
-                    tflops = self.avg_samples_per_sec() * self.flops_per_sample / 1e12
-                    msg += f", {tflops:.2f} TFLOPS"
+                    msg += f", {sps * self.flops_per_sample / 1e12:.2f} TFLOPS"
                 self.logging(msg)
-                self.local_step_count = 0
-                self.step_elapsed_time = 0.0
+            self._edge_time = now
+            self.steps_since_edge = 0
 
     def avg_samples_per_sec(self) -> float:
-        if self.global_step_count > 0 and self.total_elapsed_time > 0:
-            return self.batch_size * self.global_step_count / self.total_elapsed_time
+        """Cumulative samples/sec over completed report windows (falls back to
+        the partial current window, without a sync, if none completed yet)."""
+        if self._steps_in_total > 0 and self.total_elapsed_time > 0:
+            return self.batch_size * self._steps_in_total / self.total_elapsed_time
+        if self.steps_since_edge > 0 and self._edge_time is not None:
+            partial = max(time.time() - self._edge_time, 1e-9)
+            return self.batch_size * self.steps_since_edge / partial
         return 0.0

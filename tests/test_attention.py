@@ -101,11 +101,12 @@ def test_ulysses_with_tp(sp_tp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
 
 
-def test_ulysses_uneven_heads_falls_back_to_ring(sp_mesh):
-    """heads=2 not divisible by sp=4 -> ring fallback still correct (reference:
-    uneven_heads_all2all sequence/layer.py:43)."""
+@pytest.mark.parametrize("h,hkv", [(6, 6), (2, 2), (6, 2)])
+def test_ulysses_uneven_heads(sp_mesh, h, hkv):
+    """heads not divisible by sp=4 -> padded uneven-heads all-to-all (reference:
+    uneven_heads_all2all sequence/layer.py:43), incl. GQA densification."""
     from deepspeed_tpu.sequence.ulysses import ulysses_attention
-    q, k, v = make_qkv(s=64, h=2, hkv=2)
+    q, k, v = make_qkv(s=64, h=h, hkv=hkv)
     out = ulysses_attention(q, k, v, causal=True, mesh=sp_mesh)
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)

@@ -98,6 +98,7 @@ def test_estimate_state_bytes_monotone_in_stage():
     assert vals[0] == (2 + 4 + 12) * n
 
 
+@pytest.mark.slow
 def test_autotuner_end_to_end(tmp_path, mesh_dp8):
     model = SimpleModel(hidden_dim=16)
     tuner = Autotuner(

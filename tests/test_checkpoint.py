@@ -7,6 +7,7 @@ capability: save on one mesh, resume on another.
 
 import jax
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.comm.mesh import create_mesh
@@ -28,6 +29,7 @@ CFG = {
 }
 
 
+@pytest.mark.slow
 def test_save_load_roundtrip(tmp_path, mesh_dp8):
     e1 = _make(dict(CFG), mesh_dp8, seed=1)
     for i in range(3):

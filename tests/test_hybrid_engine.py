@@ -35,6 +35,7 @@ def test_initialize_returns_hybrid_engine():
     assert isinstance(engine, DeepSpeedTPUHybridEngine)
 
 
+@pytest.mark.slow
 def test_generate_matches_model_argmax():
     engine, cfg = _hybrid_engine()
     prompt = [3, 17, 29, 5]
@@ -48,6 +49,7 @@ def test_generate_matches_model_argmax():
     assert out[0] == expect
 
 
+@pytest.mark.slow
 def test_generate_reflects_training_updates():
     engine, cfg = _hybrid_engine()
     prompt = [1, 2, 3, 4]

@@ -92,6 +92,7 @@ def test_train_llama_zero3_tp(mesh8=None):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_remat_and_scan_variants_match():
     """remat and scan_layers change compilation, not numerics."""
     batch = random_tokens(2, 16)

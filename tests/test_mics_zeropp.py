@@ -73,6 +73,7 @@ def test_mics_splits_mesh_and_shards_inner_only():
     assert any("fsdp" in tuple(s) for s in _leaf_specs(engine.param_shardings))
 
 
+@pytest.mark.slow
 def test_mics_matches_plain_zero3_training():
     fixed = random_batch(8, seed=0)
     e_mics = _engine({"stage": 3, "mics_shard_size": 2},
@@ -100,6 +101,7 @@ def test_hpz_secondary_shardings_built_and_trains():
     assert losses[-1] < 0.5 * losses[0]
 
 
+@pytest.mark.slow
 def test_hpz_matches_plain_zero3_losses():
     fixed = random_batch(8, seed=0)
     e_hpz = _engine({"stage": 3, "zero_hpz_partition_size": 2},
@@ -110,6 +112,7 @@ def test_hpz_matches_plain_zero3_losses():
     np.testing.assert_allclose(losses_h, losses_3, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_qwz_quantized_gather_close_to_exact():
     fixed = random_batch(8, seed=0)
     e_q = _engine({"stage": 3, "zero_hpz_partition_size": 2,
@@ -131,6 +134,7 @@ def test_qwz_without_hpz_is_ignored():
     assert not engine._quantized_weights
 
 
+@pytest.mark.slow
 def test_mics_checkpoint_reshape_to_plain_zero3(tmp_path):
     fixed = random_batch(8, seed=0)
     e_mics = _engine({"stage": 3, "mics_shard_size": 2},

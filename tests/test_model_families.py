@@ -218,6 +218,94 @@ def test_falcon_hf_conversion_shapes_and_forward():
     assert jnp.isfinite(loss)
 
 
+def test_falcon_qkv_split_new_decoder_architecture():
+    """40B/180B layout: qkv rows interleaved per KV group. Build a fused matrix
+    from known per-head rows and check the grouped split recovers them."""
+    import dataclasses
+    from deepspeed_tpu.models.falcon import _split_falcon_qkv
+    cfg = dataclasses.replace(TINY_FALCON, num_heads=4, num_kv_heads=2,
+                              new_decoder_architecture=True)
+    h, hkv, dh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, cfg.hidden_size
+    g = h // hkv
+    rng = np.random.default_rng(7)
+    q_heads = rng.normal(size=(h, dh, d)).astype(np.float32)
+    k_heads = rng.normal(size=(hkv, dh, d)).astype(np.float32)
+    v_heads = rng.normal(size=(hkv, dh, d)).astype(np.float32)
+    rows = []
+    for grp in range(hkv):                     # interleaved: g q's, then k, v
+        rows.extend(q_heads[grp * g:(grp + 1) * g])
+        rows.append(k_heads[grp])
+        rows.append(v_heads[grp])
+    fused = np.concatenate(rows, axis=0)
+    wq, wk, wv = _split_falcon_qkv(fused, cfg)
+    np.testing.assert_array_equal(wq, q_heads.reshape(h * dh, d))
+    np.testing.assert_array_equal(wk, k_heads.reshape(hkv * dh, d))
+    np.testing.assert_array_equal(wv, v_heads.reshape(hkv * dh, d))
+
+
+def test_falcon_new_decoder_architecture_conversion_and_forward():
+    """40B-style checkpoint (dual ln_attn/ln_mlp + grouped qkv) converts and
+    runs: param tree matches the model's init tree, loss finite."""
+    import dataclasses
+    cfg = dataclasses.replace(TINY_FALCON, num_heads=4, num_kv_heads=2,
+                              new_decoder_architecture=True)
+    d, h, hkv, dh = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    rng = np.random.default_rng(3)
+    hf = {"transformer.word_embeddings.weight":
+          rng.normal(size=(cfg.vocab_size, d)).astype(np.float32),
+          "transformer.ln_f.weight": np.ones(d, np.float32),
+          "transformer.ln_f.bias": np.zeros(d, np.float32)}
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        for ln in ("ln_attn", "ln_mlp"):
+            hf[p + ln + ".weight"] = np.ones(d, np.float32)
+            hf[p + ln + ".bias"] = np.zeros(d, np.float32)
+        hf[p + "self_attention.query_key_value.weight"] = \
+            rng.normal(size=((h + 2 * hkv) * dh, d)).astype(np.float32) * 0.02
+        hf[p + "self_attention.dense.weight"] = \
+            rng.normal(size=(d, h * dh)).astype(np.float32) * 0.02
+        hf[p + "mlp.dense_h_to_4h.weight"] = \
+            rng.normal(size=(4 * d, d)).astype(np.float32) * 0.02
+        hf[p + "mlp.dense_4h_to_h.weight"] = \
+            rng.normal(size=(d, 4 * d)).astype(np.float32) * 0.02
+    tree = convert_hf_falcon(hf, cfg)
+    model = FalconForCausalLM(cfg)
+    batch = random_tokens(2, 12, vocab_size=cfg.vocab_size)
+    init_tree = model.init(jax.random.PRNGKey(0), batch)["params"]
+    assert jax.tree_util.tree_structure(jax.tree.map(lambda x: 0, tree)) == \
+        jax.tree_util.tree_structure(jax.tree.map(lambda x: 0, init_tree))
+    loss = model.apply({"params": jax.tree.map(jnp.asarray, tree)}, batch)
+    assert jnp.isfinite(loss)
+
+
+def test_falcon_qkv_split_rejects_grouped_without_flag():
+    import dataclasses
+    from deepspeed_tpu.models.falcon import _split_falcon_qkv
+    cfg = dataclasses.replace(TINY_FALCON, num_heads=4, num_kv_heads=2)
+    fused = np.zeros(((4 + 2 * 2) * cfg.head_dim_, cfg.hidden_size), np.float32)
+    with pytest.raises(ValueError, match="new_decoder_architecture"):
+        _split_falcon_qkv(fused, cfg)
+
+
+def test_falcon_qkv_split_mha_interleaved():
+    """Old MHA falcon (falcon-rw, hkv==h) packs rows per-head [q_i, k_i, v_i]
+    (transformers FalconAttention._split_heads), not sequential q|k|v."""
+    import dataclasses
+    from deepspeed_tpu.models.falcon import _split_falcon_qkv
+    cfg = dataclasses.replace(TINY_FALCON, num_heads=4, num_kv_heads=4)
+    h, dh, d = 4, cfg.head_dim_, cfg.hidden_size
+    rng = np.random.default_rng(0)
+    qh = rng.normal(size=(h, dh, d)).astype(np.float32)
+    kh = rng.normal(size=(h, dh, d)).astype(np.float32)
+    vh = rng.normal(size=(h, dh, d)).astype(np.float32)
+    fused = np.concatenate(
+        [blk for i in range(h) for blk in (qh[i], kh[i], vh[i])], axis=0)
+    wq, wk, wv = _split_falcon_qkv(fused, cfg)
+    np.testing.assert_array_equal(wq, qh.reshape(h * dh, d))
+    np.testing.assert_array_equal(wk, kh.reshape(h * dh, d))
+    np.testing.assert_array_equal(wv, vh.reshape(h * dh, d))
+
+
 def test_opt_hf_conversion_shapes_and_forward():
     cfg = TINY_OPT
     rng = np.random.default_rng(2)

@@ -89,6 +89,7 @@ def test_expert_params_sharded_over_expert_axis():
     assert expert_specs and all("expert" in s for s in expert_specs), expert_specs
 
 
+@pytest.mark.slow
 def test_train_mixtral_ep(tmp_path=None):
     """End-to-end: Mixtral trains with expert parallelism + ZeRO-1."""
     mesh = create_mesh(MeshConfig(data=2, expert=4))

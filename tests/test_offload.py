@@ -149,6 +149,7 @@ def test_offload_device_holds_no_optimizer_state(mesh_dp8):
     assert jax.tree.leaves(engine.state.opt_state) == []  # nothing in HBM
 
 
+@pytest.mark.slow
 def test_offload_checkpoint_roundtrip(tmp_path, mesh_dp8):
     """save → load restores masters AND host moments; training continues from
     the restored weights (not stale masters)."""
@@ -262,6 +263,7 @@ def test_offload_bf16_shadows_on_device(mesh_dp8):
         assert m.dtype == np.float32
 
 
+@pytest.mark.slow
 def test_offload_matches_in_hbm_adamw(mesh_dp8):
     """Host CPU-Adam path == in-HBM optax path numerically."""
     base = {

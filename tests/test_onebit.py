@@ -56,6 +56,7 @@ def _mesh8():
     return Mesh(np.array(jax.devices()[:8]), ("dp",))
 
 
+@pytest.mark.slow
 def test_compressed_allreduce_approximates_mean():
     mesh = _mesh8()
     w = 8
@@ -104,6 +105,7 @@ def _run_opt(tx, loss, p0, steps):
     return p, state
 
 
+@pytest.mark.slow
 def test_onebit_adam_converges_through_freeze():
     loss, p0, target = _quadratic_problem()
     tx = onebit_adam(0.01, freeze_step=30)
@@ -165,6 +167,7 @@ def test_zero_one_adam_variance_hard_freeze():
     np.testing.assert_array_equal(np.asarray(state.exp_avg_sq), v3)
 
 
+@pytest.mark.slow
 def test_onebit_lamb_converges_and_freezes_ratio():
     loss, p0, _ = _quadratic_problem()
     p0 = p0 + 1.0  # nonzero params so trust ratio is meaningful

@@ -38,14 +38,49 @@ def test_pallas_flash_gqa_unaligned():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_pallas_flash_grad():
+@pytest.mark.parametrize("causal", [True, False])
+def test_pallas_flash_grad(causal):
     q, k, v = qkv(s=64)
+
+    def loss_p(q, k, v):
+        return jnp.sum(pallas_flash_attention(q, k, v, causal, 32, 32, True) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_pallas_flash_grad_gqa_unaligned():
+    # GQA (in-kernel group accumulation for dk/dv) + q/k padding in backward
+    q, k, v = qkv(s=100, h=8, hkv=2)
 
     def loss_p(q, k, v):
         return jnp.sum(pallas_flash_attention(q, k, v, True, 32, 32, True) ** 2)
 
     def loss_r(q, k, v):
         return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_pallas_flash_grad_weighted_loss():
+    # asymmetric cotangent exercises delta = rowsum(dO*O) properly
+    q, k, v = qkv(s=64, h=2)
+    w = jnp.asarray(np.random.default_rng(9).normal(size=(2, 64, 2, 32)),
+                    jnp.float32)
+
+    def loss_p(q, k, v):
+        return jnp.sum(w * pallas_flash_attention(q, k, v, True, 32, 32, True))
+
+    def loss_r(q, k, v):
+        return jnp.sum(w * attention_reference(q, k, v, causal=True))
 
     gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)

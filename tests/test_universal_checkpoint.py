@@ -91,6 +91,7 @@ def test_extract_param(saved_ckpt):
         extract_param(d, "definitely/not/a/param")
 
 
+@pytest.mark.slow
 def test_cli_inspect_and_consolidate(saved_ckpt, tmp_path):
     d, _ = saved_ckpt
     env = dict(os.environ, JAX_PLATFORMS="cpu",

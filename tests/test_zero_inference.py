@@ -10,8 +10,8 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.inference.zero_inference import (
-    ZeROInferenceEngine, dequantize_model_params, quantize_model_params,
-    quantized_nbytes)
+    QuantizedTensor, ZeROInferenceEngine, dequantize_model_params,
+    quantize_model_params, quantized_nbytes)
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, random_tokens
 
 
@@ -50,7 +50,7 @@ def test_module_scoping(tiny_model):
     attn = q["model"]["layer_0"]["attn"]["wq"]["kernel"]
     mlp = q["model"]["layer_0"]["mlp"]["w_gate"]["kernel"]
     assert isinstance(attn, np.ndarray)          # untouched
-    assert isinstance(mlp, dict) and "codes" in mlp
+    assert isinstance(mlp, QuantizedTensor) and mlp.codes.dtype == np.int8
 
 
 def test_resident_forward_close_to_fp(tiny_model):
@@ -80,6 +80,7 @@ def test_streamed_forward_matches_resident(tiny_model):
                                atol=2e-2)
 
 
+@pytest.mark.slow
 def test_generate_resident_and_streamed_agree(tiny_model):
     cfg, model, params = tiny_model
     resident = ZeROInferenceEngine(model, params, cfg, q_bits=8, group_size=64,
