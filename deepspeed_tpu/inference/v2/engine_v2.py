@@ -18,9 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.v2.generic_decode import decode_step_g, prefill_chunk_g
 from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache, KVCacheConfig
-from deepspeed_tpu.inference.v2.llama_decode import decode_step, prefill_chunk
+from deepspeed_tpu.inference.v2.modules import policy_for
 from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor, StateManager
+from deepspeed_tpu.inference.v2.sampling import SamplingConfig, sample_tokens
 from deepspeed_tpu.inference.v2.scheduler import (
     PrefillChunk,
     SchedulerConfig,
@@ -41,25 +43,42 @@ class V2EngineConfig:
     decode_batch_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
     ctx_block_buckets: Tuple[int, ...] = (4, 8, 16, 32, 64)   # blocks per table
     eos_token_id: Optional[int] = None
-    greedy: bool = True
+    greedy: bool = True            # back-compat; sampling is the full control
+    sampling: SamplingConfig = dataclasses.field(default_factory=SamplingConfig)
+    # attention implementation: auto (Pallas kernel on TPU, gather elsewhere),
+    # kernel, kernel_interpret, gather — see llama_decode._paged_attn
+    attn_impl: str = "auto"
 
 
 class InferenceEngineV2:
-    def __init__(self, params, model_config: LlamaConfig,
+    """Serves any registered arch (llama family incl. mistral/qwen2/phi3,
+    falcon, opt, mixtral) — the policy registry picks the decode implementation
+    from the model config type (reference: engine_factory + heuristics)."""
+
+    def __init__(self, params, model_config,
                  config: Optional[V2EngineConfig] = None):
         self.params = params
         self.model_config = model_config
         self.config = config or V2EngineConfig()
+        self.policy = policy_for(model_config)
+        spec = self.policy.cache_spec(model_config)
         self.kv = BlockedKVCache(KVCacheConfig(
-            num_layers=model_config.num_layers,
-            num_kv_heads=model_config.num_kv_heads,
-            head_dim=model_config.head_dim_,
+            num_layers=spec.num_layers,
+            num_kv_heads=spec.num_kv_heads,
+            head_dim=spec.head_dim,
             block_size=self.config.kv_block_size,
             num_blocks=self.config.kv_num_blocks,
-            dtype=model_config.dtype))
+            dtype=spec.dtype))
         self.state = StateManager(
             max_tracked_sequences=self.config.max_tracked_sequences,
-            max_context_length=model_config.max_seq_len)
+            max_context_length=spec.max_seq_len)
+        if not self.config.greedy and \
+                self.config.sampling.temperature <= 0.0:
+            self.config = dataclasses.replace(
+                self.config,
+                sampling=dataclasses.replace(self.config.sampling,
+                                             temperature=1.0))
+        self._rng = jax.random.PRNGKey(self.config.sampling.seed)
         self._pending_logits: Dict[int, np.ndarray] = {}
 
     # ------------------------------------------------------------------
@@ -139,15 +158,17 @@ class InferenceEngineV2:
             tokens[:chunk.length] = seq.prompt_tokens[chunk.start:end]
             mb = self._ctx_bucket_blocks(end)
             table = self._block_table(seq, mb)
-            logits, cache = prefill_chunk(
+            logits, cache = prefill_chunk_g(
                 self.params, cache, jnp.asarray(tokens), chunk.start,
                 jnp.asarray(table), chunk.length,
-                cfg=self.model_config, block_size=self.kv.cfg.block_size)
+                policy=self.policy, cfg=self.model_config,
+                block_size=self.kv.cfg.block_size,
+                attn_impl=self.config.attn_impl)
             seq.seen_tokens = end
             if not seq.in_prefill:
-                tok = self._sample(np.asarray(logits))
-                seq.generated.append(int(tok))
-                out[seq.uid] = int(tok)
+                tok = int(self._sample_batch(logits[None])[0])
+                seq.generated.append(tok)
+                out[seq.uid] = tok
 
         # --- decode batch ---
         if plan.decode_seqs:
@@ -166,25 +187,32 @@ class InferenceEngineV2:
                 positions[j] = seq.total_tokens - 1
                 tables[j] = self._block_table(seq, mb)
                 valid[j] = True
-            logits, cache = decode_step(
+            logits, cache = decode_step_g(
                 self.params, cache, jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(tables), jnp.asarray(valid),
-                cfg=self.model_config, block_size=self.kv.cfg.block_size)
-            logits_np = np.asarray(logits)
+                policy=self.policy, cfg=self.model_config,
+                block_size=self.kv.cfg.block_size,
+                attn_impl=self.config.attn_impl)
+            # sample on device; only [B] token ids cross to the host — the
+            # [B, vocab] logits D2H fetch is the decode-loop bottleneck on
+            # tunneled / multi-host topologies
+            toks = self._sample_batch(logits)
             for j, seq in enumerate(seqs):
-                tok = self._sample(logits_np[j])
+                tok = int(toks[j])
                 seq.seen_tokens = seq.total_tokens
-                seq.generated.append(int(tok))
-                out[seq.uid] = int(tok)
+                seq.generated.append(tok)
+                out[seq.uid] = tok
                 if self.config.eos_token_id is not None and \
-                        int(tok) == self.config.eos_token_id:
+                        tok == self.config.eos_token_id:
                     seq.done = True
 
         self.kv.data = cache
         return out
 
-    def _sample(self, logits: np.ndarray) -> int:
-        return int(np.argmax(logits))
+    def _sample_batch(self, logits) -> np.ndarray:
+        """[B, V] device logits -> [B] host token ids (one small D2H)."""
+        self._rng, key = jax.random.split(self._rng)
+        return np.asarray(sample_tokens(logits, key, self.config.sampling))
 
     # ------------------------------------------------------------------
     # lifecycle (reference: engine_v2.flush)

@@ -1,0 +1,83 @@
+"""Generic paged serving loop: policy-parameterized prefill + decode.
+
+Reference analog: ``inference/v2/model_implementations/inference_transformer_base.py``
+— the shared ragged forward skeleton that per-arch containers plug into. Here the
+skeleton is two jitted pure functions over (policy, config) static args; the
+policy (``modules.py``) contributes embed/block/unembed and the loop owns KV
+cache writes + the Pallas paged attention (``llama_decode._paged_attn``).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.llama_decode import _paged_attn
+
+
+@partial(jax.jit, static_argnames=("policy", "cfg", "block_size", "attn_impl"))
+def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
+                    policy, cfg, block_size: int, attn_impl: str = "auto"):
+    """One sequence, one bucket-padded chunk; returns (last-token logits [V],
+    updated cache_data). See llama_decode.prefill_chunk for the argument
+    contract — this is the arch-generic version."""
+    spec = policy.cache_spec(cfg)
+    tb = tokens.shape[0]
+    mb = block_table.shape[0]
+
+    positions = start + jnp.arange(tb)
+    safe_pos = jnp.minimum(positions, spec.max_seq_len - 1)
+    tok_block = jnp.where(jnp.arange(tb) < true_len,
+                          block_table[jnp.minimum(safe_pos // block_size, mb - 1)],
+                          cache_data.shape[3] - 1)
+    tok_off = safe_pos % block_size
+
+    x = policy.embed(params, tokens, safe_pos, cfg)
+
+    cache = cache_data
+    for i in range(spec.num_layers):
+        def attend(q, k, v, i=i):
+            nonlocal cache
+            cache = cache.at[i, 0, :, tok_block, tok_off].set(k)
+            cache = cache.at[i, 1, :, tok_block, tok_off].set(v)
+            return _paged_attn(q[None], cache, i, block_table[None],
+                               jnp.asarray(start).reshape(1), spec.window,
+                               attn_impl)[0]
+        x = policy.block(params, i, x, attend, safe_pos, cfg)
+
+    last = x[jnp.maximum(true_len - 1, 0)]
+    logits = policy.unembed(params, last[None], cfg)[0]
+    return logits, cache
+
+
+@partial(jax.jit, static_argnames=("policy", "cfg", "block_size", "attn_impl"))
+def decode_step_g(params, cache_data, tokens, positions, block_tables, valid,
+                  policy, cfg, block_size: int, attn_impl: str = "auto"):
+    """Batched single-token decode; returns (logits [B, V], updated
+    cache_data). See llama_decode.decode_step for the argument contract."""
+    spec = policy.cache_spec(cfg)
+    mb = block_tables.shape[1]
+
+    safe_pos = jnp.minimum(positions, spec.max_seq_len - 1)
+    blk = jnp.where(valid,
+                    jnp.take_along_axis(
+                        block_tables,
+                        jnp.minimum(safe_pos // block_size, mb - 1)[:, None],
+                        axis=1)[:, 0],
+                    cache_data.shape[3] - 1)
+    off = safe_pos % block_size
+
+    x = policy.embed(params, tokens, safe_pos, cfg)
+
+    cache = cache_data
+    for i in range(spec.num_layers):
+        def attend(q, k, v, i=i):
+            nonlocal cache
+            cache = cache.at[i, 0, :, blk, off].set(k)
+            cache = cache.at[i, 1, :, blk, off].set(v)
+            return _paged_attn(q[:, None], cache, i, block_tables, safe_pos,
+                               spec.window, attn_impl)[:, 0]
+        x = policy.block(params, i, x, attend, safe_pos, cfg)
+
+    logits = policy.unembed(params, x, cfg)
+    return logits, cache

@@ -2,10 +2,13 @@
 
 Reference analog: ``deepspeed/inference/v2/ragged/kv_cache.py:40``
 (``BlockedKVCache``) — a pool of fixed-size KV blocks per layer, reserved through a
-``BlockedAllocator``. TPU layout: one [num_blocks, block_size, kv_heads, head_dim]
-array per (K, V) per layer, sharded over ``tensor`` on the heads dim. Block writes
-are ``.at[].set`` scatters inside the jitted step; reads gather a sequence's block
-table into a contiguous context window.
+``BlockedAllocator``. TPU layout is **head-major**
+[kv_heads, num_blocks, block_size, head_dim], so one page of one KV head is a
+contiguous (block_size, head_dim) tile — the shape the Pallas paged-attention
+kernel DMAs per grid step (``ops/pallas/paged_attention.py``); shard over
+``tensor`` on the leading heads dim. Block writes are ``.at[].set`` scatters
+inside the jitted step; reads either go through the kernel (block table in
+scalar prefetch) or gather a contiguous context window (CPU fallback).
 """
 
 import dataclasses
@@ -34,10 +37,10 @@ class BlockedKVCache:
         # last block reserved as the trash target for padding-token writes
         # (see llama_decode.py); never handed out by the allocator
         self.allocator = BlockedAllocator(cfg.num_blocks - 1)
-        # [L, 2(kv), num_blocks, block_size, H_kv, D]
+        # [L, 2(kv), H_kv, num_blocks, block_size, D] (head-major pages)
         self.data = jnp.zeros(
-            (cfg.num_layers, 2, cfg.num_blocks, cfg.block_size,
-             cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+            (cfg.num_layers, 2, cfg.num_kv_heads, cfg.num_blocks,
+             cfg.block_size, cfg.head_dim), cfg.dtype)
 
     @property
     def free_blocks(self) -> int:
@@ -65,6 +68,8 @@ def write_kv_block_tokens(cache_data, layer: int, k_new, v_new, block_ids,
     t = k_new.shape[0]
     positions = start_pos + jnp.arange(t)
     offsets = positions % block_size
-    cache_data = cache_data.at[layer, 0, block_ids, offsets].set(k_new)
-    cache_data = cache_data.at[layer, 1, block_ids, offsets].set(v_new)
+    # head-major pages: advanced (block, offset) dims land first, so the
+    # indexed view is [T, H, D] — matching k_new directly
+    cache_data = cache_data.at[layer, 0, :, block_ids, offsets].set(k_new)
+    cache_data = cache_data.at[layer, 1, :, block_ids, offsets].set(v_new)
     return cache_data

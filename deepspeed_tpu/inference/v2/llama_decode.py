@@ -4,6 +4,12 @@ Reference analog: the inference v2 kernel pipeline (``linear_blocked_kv_rotary``
 ``blocked_flash``, ``logits_gather`` in ``inference/v2/kernels/ragged_ops/``) and
 the per-arch model implementations (``inference/v2/model_implementations/llama_v2``).
 
+Attention runs through the Pallas paged kernel on TPU (block tables in scalar
+prefetch — pages stream from the paged pool with no context re-materialization,
+``ops/pallas/paged_attention.py``); elsewhere the gather-based reference path
+with identical semantics runs (``attn_impl`` static arg: auto|kernel|
+kernel_interpret|gather).
+
 TPU redesign: pure functions over the *training* model's param pytree
 (``LlamaForCausalLM`` — same weights serve and train, no module surgery), with
 static bucketed shapes so each (bucket, batch) pair compiles once:
@@ -27,46 +33,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.models.llama import LlamaConfig, rope_freqs
-from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.models.llama import LlamaConfig
+from deepspeed_tpu.ops.pallas.paged_attention import (
+    paged_attention, paged_attention_reference)
 
-NEG_INF = -1e30
+ATTN_IMPLS = ("auto", "kernel", "kernel_interpret", "gather")
+
+
+def _paged_attn(q, cache_data, layer, block_tables, start_pos, window,
+                attn_impl: str):
+    """q: [B, T, H, d]; dispatch kernel vs gather reference over the head-major
+    cache [L, 2, Hkv, NB, bs, d]."""
+    if attn_impl not in ATTN_IMPLS:
+        raise ValueError(f"unknown attn_impl {attn_impl!r}; one of {ATTN_IMPLS}")
+    k_pages, v_pages = cache_data[layer, 0], cache_data[layer, 1]
+    impl = attn_impl
+    if impl == "auto":
+        impl = "kernel" if jax.default_backend() == "tpu" else "gather"
+    if impl == "gather":
+        return paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                         start_pos, window=window)
+    return paged_attention(q, k_pages, v_pages, block_tables, start_pos,
+                           window=window, interpret=impl == "kernel_interpret")
 
 
 def _rms(x, scale, eps):
     x32 = x.astype(jnp.float32)
     y = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + eps)
     return (y * scale).astype(x.dtype)
-
-
-def _rope_1d(x, cos, sin, positions):
-    """x: [..., T, H, D]; positions broadcastable to [..., T]."""
-    cos_p = cos[positions][..., None, :]
-    sin_p = sin[positions][..., None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], -1)
-    return out.astype(x.dtype)
-
-
-def _layer_params(params, i):
-    return params["model"][f"layer_{i}"]
-
-
-def _windowed_context_attention(q, ctx_k, ctx_v, qpos, window, num_heads):
-    """Sliding-window prefill attention over gathered paged context.
-    q: [T,H,d]; ctx_k/v: [K,Hkv,d]; qpos: [T] absolute positions."""
-    rep = num_heads // ctx_k.shape[1]
-    if rep > 1:
-        ctx_k = jnp.repeat(ctx_k, rep, axis=1)
-        ctx_v = jnp.repeat(ctx_v, rep, axis=1)
-    d = q.shape[-1]
-    scores = jnp.einsum("thd,khd->htk", q, ctx_k,
-                        preferred_element_type=jnp.float32) / np.sqrt(d)
-    kpos = jnp.arange(ctx_k.shape[0])[None, :]
-    mask = (kpos <= qpos[:, None]) & (kpos > qpos[:, None] - window)
-    scores = jnp.where(mask[None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("htk,khd->thd", probs, ctx_v)
 
 
 def _qkv(lp, x, dtype):
@@ -88,123 +82,29 @@ def _mlp(lp, x, dtype):
     return (jax.nn.silu(g) * u) @ lp["mlp"]["w_down"]["kernel"].astype(dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "block_size"))
 def prefill_chunk(params, cache_data, tokens, start, block_table, true_len,
-                  cfg: LlamaConfig, block_size: int):
+                  cfg: LlamaConfig, block_size: int, attn_impl: str = "auto"):
     """One sequence, one chunk. tokens: [Tb] (bucket-padded); start: chunk offset;
     block_table: [MB] block ids (trash-padded); true_len: real chunk tokens.
-    Returns (last-token logits [V], updated cache_data)."""
-    dtype = cfg.dtype
-    tb = tokens.shape[0]
-    mb = block_table.shape[0]
-    d_head = cfg.head_dim_
-    cos, sin = rope_freqs(d_head, cfg.max_seq_len, cfg.rope_theta)
-    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    Returns (last-token logits [V], updated cache_data).
 
-    positions = start + jnp.arange(tb)
-    safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
-    # padding tokens (t >= true_len) route to the trash block
-    tok_block = jnp.where(jnp.arange(tb) < true_len,
-                          block_table[jnp.minimum(safe_pos // block_size, mb - 1)],
-                          cache_data.shape[2] - 1)
-    tok_off = safe_pos % block_size
-
-    x = params["model"]["embed"]["embedding"].astype(dtype)[tokens]
-    for i in range(cfg.num_layers):
-        lp = _layer_params(params, i)
-        h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, h, dtype)
-        q = _rope_1d(q, cos, sin, safe_pos)
-        k = _rope_1d(k, cos, sin, safe_pos)
-        cache_data = cache_data.at[i, 0, tok_block, tok_off].set(k)
-        cache_data = cache_data.at[i, 1, tok_block, tok_off].set(v)
-        # gather full context (includes this chunk's freshly written K/V)
-        ctx_k = cache_data[i, 0, block_table].reshape(mb * block_size,
-                                                     cfg.num_kv_heads, d_head)
-        ctx_v = cache_data[i, 1, block_table].reshape(mb * block_size,
-                                                     cfg.num_kv_heads, d_head)
-        if cfg.sliding_window is not None:
-            attn = _windowed_context_attention(
-                q, ctx_k, ctx_v, positions, cfg.sliding_window, cfg.num_heads)
-        else:
-            attn = flash_attention(q[None], ctx_k[None], ctx_v[None], causal=True,
-                                   q_offset=start)[0]
-        attn_out = jnp.einsum("thk,hkd->td", attn,
-                              lp["attn"]["wo"]["kernel"].astype(dtype))
-        x = x + attn_out
-        h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2, dtype)
-
-    x = _rms(x, params["model"]["final_norm"]["scale"], cfg.rms_norm_eps)
-    last = x[jnp.maximum(true_len - 1, 0)]
-    if cfg.tie_embeddings:
-        logits = params["model"]["embed"]["embedding"].astype(jnp.float32) @ \
-            last.astype(jnp.float32)
-    else:
-        logits = last.astype(jnp.float32) @ \
-            params["model"]["lm_head"]["kernel"].astype(jnp.float32)
-    return logits, cache_data
+    Thin llama-specialized wrapper over the arch-generic loop
+    (``generic_decode.prefill_chunk_g`` + ``modules.LlamaPolicy``)."""
+    from deepspeed_tpu.inference.v2.generic_decode import prefill_chunk_g
+    from deepspeed_tpu.inference.v2.modules import LlamaPolicy
+    return prefill_chunk_g(params, cache_data, tokens, start, block_table,
+                           true_len, policy=LlamaPolicy, cfg=cfg,
+                           block_size=block_size, attn_impl=attn_impl)
 
 
-@partial(jax.jit, static_argnames=("cfg", "block_size"))
 def decode_step(params, cache_data, tokens, positions, block_tables, valid,
-                cfg: LlamaConfig, block_size: int):
+                cfg: LlamaConfig, block_size: int, attn_impl: str = "auto"):
     """Batched single-token decode. tokens/positions/valid: [B];
-    block_tables: [B, MB]. Returns (logits [B, V], updated cache_data)."""
-    dtype = cfg.dtype
-    b = tokens.shape[0]
-    mb = block_tables.shape[1]
-    d_head = cfg.head_dim_
-    cos, sin = rope_freqs(d_head, cfg.max_seq_len, cfg.rope_theta)
-    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    block_tables: [B, MB]. Returns (logits [B, V], updated cache_data).
 
-    safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
-    blk = jnp.where(valid,
-                    jnp.take_along_axis(
-                        block_tables,
-                        jnp.minimum(safe_pos // block_size, mb - 1)[:, None],
-                        axis=1)[:, 0],
-                    cache_data.shape[2] - 1)
-    off = safe_pos % block_size
-
-    x = params["model"]["embed"]["embedding"].astype(dtype)[tokens]  # [B, D]
-    for i in range(cfg.num_layers):
-        lp = _layer_params(params, i)
-        h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, h, dtype)                     # [B, H(kv), d]
-        q = _rope_1d(q[:, None], cos, sin, safe_pos[:, None])[:, 0]
-        k = _rope_1d(k[:, None], cos, sin, safe_pos[:, None])[:, 0]
-        cache_data = cache_data.at[i, 0, blk, off].set(k)
-        cache_data = cache_data.at[i, 1, blk, off].set(v)
-        # paged context gather: [B, MB*bs, Hkv, d]
-        ctx_k = cache_data[i, 0][block_tables].reshape(b, mb * block_size,
-                                                       cfg.num_kv_heads, d_head)
-        ctx_v = cache_data[i, 1][block_tables].reshape(b, mb * block_size,
-                                                       cfg.num_kv_heads, d_head)
-        rep = cfg.num_heads // cfg.num_kv_heads
-        if rep > 1:
-            ctx_k = jnp.repeat(ctx_k, rep, axis=2)
-            ctx_v = jnp.repeat(ctx_v, rep, axis=2)
-        scores = jnp.einsum("bhd,bkhd->bhk", q, ctx_k,
-                            preferred_element_type=jnp.float32) / np.sqrt(d_head)
-        kpos = jnp.arange(mb * block_size)[None, :]
-        mask = kpos <= safe_pos[:, None]
-        if cfg.sliding_window is not None:
-            mask &= kpos > (safe_pos[:, None] - cfg.sliding_window)
-        scores = jnp.where(mask[:, None, :], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-        attn = jnp.einsum("bhk,bkhd->bhd", probs, ctx_v)
-        attn_out = jnp.einsum("bhk,hkd->bd", attn,
-                              lp["attn"]["wo"]["kernel"].astype(dtype))
-        x = x + attn_out
-        h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2, dtype)
-
-    x = _rms(x, params["model"]["final_norm"]["scale"], cfg.rms_norm_eps)
-    if cfg.tie_embeddings:
-        logits = x.astype(jnp.float32) @ \
-            params["model"]["embed"]["embedding"].astype(jnp.float32).T
-    else:
-        logits = x.astype(jnp.float32) @ \
-            params["model"]["lm_head"]["kernel"].astype(jnp.float32)
-    return logits, cache_data
+    Thin llama-specialized wrapper over the arch-generic loop."""
+    from deepspeed_tpu.inference.v2.generic_decode import decode_step_g
+    from deepspeed_tpu.inference.v2.modules import LlamaPolicy
+    return decode_step_g(params, cache_data, tokens, positions, block_tables,
+                         valid, policy=LlamaPolicy, cfg=cfg,
+                         block_size=block_size, attn_impl=attn_impl)

@@ -1,0 +1,301 @@
+"""Inference v2 module system: per-arch decode policies + registry + heuristics.
+
+Reference analog: ``deepspeed/inference/v2/modules/`` (pluggable layer
+implementations behind interfaces + ``module_registry.py`` + ``heuristics.py:36``)
+and ``model_implementations/{llama_v2,mistral,mixtral,opt,phi3,qwen_v2,falcon}``.
+
+TPU shape: a *policy* is a small class of pure static methods over the training
+model's param pytree — no module surgery, no containers. The generic paged
+serving loop (``generic_decode.py``) owns the KV cache, block tables, and the
+Pallas paged-attention call; the policy contributes exactly the three
+arch-specific pieces:
+
+- ``embed(params, tokens, positions, cfg)``          -> [N, D] hidden states
+- ``block(params, i, x, attend, positions, cfg)``    -> [N, D] (one layer;
+  calls ``attend(q, k, v)`` for cache write + paged attention)
+- ``unembed(params, x, cfg)``                        -> [N, V] fp32 logits
+
+plus ``cache_spec(cfg)`` so the engine can size the paged KV pool. Policies are
+keyed both by name and by config dataclass type; ``policy_for`` is the
+heuristic (reference heuristics.py) that picks the implementation for a model
+config. mistral/qwen2/phi3 are LlamaConfig variants and route to LlamaPolicy.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.v2.llama_decode import _mlp, _qkv, _rms
+from deepspeed_tpu.models.llama import LlamaConfig, rope_freqs
+
+DECODE_POLICIES: Dict[str, type] = {}
+_CONFIG_TO_POLICY: Dict[type, type] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    max_seq_len: int
+    dtype: Any
+    window: Any = None       # sliding-window width or None
+
+
+def register_policy(name: str, config_type: type):
+    """Register a decode policy under ``name`` and for ``config_type``
+    (reference: module_registry.py)."""
+    def deco(cls):
+        DECODE_POLICIES[name] = cls
+        _CONFIG_TO_POLICY[config_type] = cls
+        cls.arch = name
+        return cls
+    return deco
+
+
+def policy_for(model_config) -> type:
+    """Heuristic: map a model config to its decode policy (reference:
+    heuristics.py:36). LlamaConfig covers llama/mistral/qwen2/phi3."""
+    cls = _CONFIG_TO_POLICY.get(type(model_config))
+    if cls is None:
+        raise ValueError(
+            f"no decode policy registered for {type(model_config).__name__}; "
+            f"known: {sorted(DECODE_POLICIES)}")
+    return cls
+
+
+def _layernorm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), -1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _rope_tables(head_dim, max_seq_len, theta):
+    """Rope tables as trace-local jnp constants. The numpy compute is cached in
+    ``rope_freqs`` (identical ndarray objects across layers → XLA CSEs the
+    constants); the jnp conversion must NOT be cached — a jnp array created
+    under one jit trace is a tracer and may not leak into the next trace."""
+    cos, sin = rope_freqs(head_dim, max_seq_len, theta)
+    return jnp.asarray(cos), jnp.asarray(sin)
+
+
+def _rope_rows(x, cos, sin, positions):
+    """x: [N, H, d]; positions: [N] — rotary on per-row absolute positions."""
+    cos_p = cos[positions][:, None, :]
+    sin_p = sin[positions][:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], -1)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Llama family (llama / mistral / qwen2 / phi3)
+# ---------------------------------------------------------------------------
+@register_policy("llama", LlamaConfig)
+class LlamaPolicy:
+    """reference: model_implementations/llama_v2 (+ mistral/qwen_v2/phi3 —
+    LlamaConfig knobs: sliding_window, attention_bias, fused mappers)."""
+
+    @staticmethod
+    def cache_spec(cfg: LlamaConfig) -> KVCacheSpec:
+        return KVCacheSpec(cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_,
+                           cfg.max_seq_len, cfg.dtype, cfg.sliding_window)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        return params["model"]["embed"]["embedding"].astype(cfg.dtype)[tokens]
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        lp = params["model"][f"layer_{i}"]
+        dtype = cfg.dtype
+        cos, sin = _rope_tables(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta)
+        h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, h, dtype)
+        q = _rope_rows(q, cos, sin, positions)
+        k = _rope_rows(k, cos, sin, positions)
+        attn = attend(q, k, v)
+        x = x + jnp.einsum("thk,hkd->td", attn,
+                           lp["attn"]["wo"]["kernel"].astype(dtype))
+        h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
+        return x + _mlp(lp, h2, dtype)
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        x = _rms(x, params["model"]["final_norm"]["scale"], cfg.rms_norm_eps)
+        if cfg.tie_embeddings:
+            return x.astype(jnp.float32) @ \
+                params["model"]["embed"]["embedding"].astype(jnp.float32).T
+        return x.astype(jnp.float32) @ \
+            params["model"]["lm_head"]["kernel"].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Falcon (parallel attn+mlp, LayerNorm, MQA/GQA)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.falcon import FalconConfig  # noqa: E402
+
+
+@register_policy("falcon", FalconConfig)
+class FalconPolicy:
+    """reference: model_implementations/falcon."""
+
+    @staticmethod
+    def cache_spec(cfg: FalconConfig) -> KVCacheSpec:
+        return KVCacheSpec(cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_,
+                           cfg.max_seq_len, cfg.dtype, None)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        return params["model"]["embed"]["embedding"].astype(cfg.dtype)[tokens]
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        lp = params["model"][f"layer_{i}"]
+        dtype = cfg.dtype
+        eps = cfg.layer_norm_eps
+        if cfg.new_decoder_architecture:
+            h = _layernorm(x, lp["ln_attn"]["scale"], lp["ln_attn"]["bias"], eps)
+            h_mlp = _layernorm(x, lp["ln_mlp"]["scale"], lp["ln_mlp"]["bias"], eps)
+        else:
+            h = _layernorm(x, lp["input_ln"]["scale"], lp["input_ln"]["bias"], eps)
+            h_mlp = h
+        q = jnp.einsum("td,dhk->thk", h, lp["wq"]["kernel"].astype(dtype))
+        k = jnp.einsum("td,dhk->thk", h, lp["wk"]["kernel"].astype(dtype))
+        v = jnp.einsum("td,dhk->thk", h, lp["wv"]["kernel"].astype(dtype))
+        cos, sin = _rope_tables(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta)
+        q = _rope_rows(q, cos, sin, positions)
+        k = _rope_rows(k, cos, sin, positions)
+        attn = attend(q, k, v)
+        attn_out = jnp.einsum("thk,hkd->td", attn,
+                              lp["wo"]["kernel"].astype(dtype))
+        mlp = jax.nn.gelu(h_mlp @ lp["mlp_up"]["kernel"].astype(dtype))
+        mlp_out = mlp @ lp["mlp_down"]["kernel"].astype(dtype)
+        return x + attn_out + mlp_out        # parallel residual
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        m = params["model"]
+        x = _layernorm(x, m["final_ln"]["scale"], m["final_ln"]["bias"],
+                       cfg.layer_norm_eps)
+        return x.astype(jnp.float32) @ \
+            m["embed"]["embedding"].astype(jnp.float32).T   # tied
+
+
+# ---------------------------------------------------------------------------
+# OPT (learned positions, LayerNorm, ReLU MLP, biases everywhere, no rope)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.opt import OPT_POSITION_OFFSET, OPTConfig  # noqa: E402
+
+
+@register_policy("opt", OPTConfig)
+class OPTPolicy:
+    """reference: model_implementations/opt."""
+
+    @staticmethod
+    def cache_spec(cfg: OPTConfig) -> KVCacheSpec:
+        return KVCacheSpec(cfg.num_layers, cfg.num_heads, cfg.head_dim_,
+                           cfg.max_seq_len, cfg.dtype, None)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        m = params["model"]
+        x = m["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        pos = m["pos_embed"][positions + OPT_POSITION_OFFSET].astype(cfg.dtype)
+        return x + pos
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        lp = params["model"][f"layer_{i}"]
+        dtype = cfg.dtype
+        eps = cfg.layer_norm_eps
+        h = _layernorm(x, lp["attn_ln"]["scale"], lp["attn_ln"]["bias"], eps)
+        q = jnp.einsum("td,dhk->thk", h, lp["wq"]["kernel"].astype(dtype)) + \
+            lp["wq"]["bias"].astype(dtype)
+        k = jnp.einsum("td,dhk->thk", h, lp["wk"]["kernel"].astype(dtype)) + \
+            lp["wk"]["bias"].astype(dtype)
+        v = jnp.einsum("td,dhk->thk", h, lp["wv"]["kernel"].astype(dtype)) + \
+            lp["wv"]["bias"].astype(dtype)
+        attn = attend(q, k, v)               # no rope
+        x = x + jnp.einsum("thk,hkd->td", attn,
+                           lp["wo"]["kernel"].astype(dtype)) + \
+            lp["wo"]["bias"].astype(dtype)
+        h2 = _layernorm(x, lp["mlp_ln"]["scale"], lp["mlp_ln"]["bias"], eps)
+        m = jax.nn.relu(h2 @ lp["fc1"]["kernel"].astype(dtype) +
+                        lp["fc1"]["bias"].astype(dtype))
+        return x + m @ lp["fc2"]["kernel"].astype(dtype) + \
+            lp["fc2"]["bias"].astype(dtype)
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        m = params["model"]
+        x = _layernorm(x, m["final_ln"]["scale"], m["final_ln"]["bias"],
+                       cfg.layer_norm_eps)
+        return x.astype(jnp.float32) @ \
+            m["embed"]["embedding"].astype(jnp.float32).T   # tied
+
+
+# ---------------------------------------------------------------------------
+# Mixtral (llama attention + top-k MoE MLP)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.mixtral import MixtralConfig  # noqa: E402
+
+
+@register_policy("mixtral", MixtralConfig)
+class MixtralPolicy:
+    """reference: model_implementations/mixtral (+ qwen_v2_moe shape). Serving
+    MoE runs all experts densely on the (small) token batch and combines the
+    renormalized top-k gate weights — equivalent to the training dispatch when
+    no token is dropped (eval capacity factor keeps that true at decode sizes).
+    """
+
+    @staticmethod
+    def cache_spec(cfg: MixtralConfig) -> KVCacheSpec:
+        b = cfg.base
+        return KVCacheSpec(b.num_layers, b.num_kv_heads, b.head_dim_,
+                           b.max_seq_len, b.dtype, b.sliding_window)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        return params["embed"]["embedding"].astype(cfg.base.dtype)[tokens]
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        base = cfg.base
+        dtype = base.dtype
+        lp = params[f"layer_{i}"]
+        cos, sin = _rope_tables(base.head_dim_, base.max_seq_len, base.rope_theta)
+        h = _rms(x, lp["attn_norm"]["scale"], base.rms_norm_eps)
+        q, k, v = _qkv({"attn": lp["attn"]}, h, dtype)
+        q = _rope_rows(q, cos, sin, positions)
+        k = _rope_rows(k, cos, sin, positions)
+        attn = attend(q, k, v)
+        x = x + jnp.einsum("thk,hkd->td", attn,
+                           lp["attn"]["wo"]["kernel"].astype(dtype))
+        h2 = _rms(x, lp["mlp_norm"]["scale"], base.rms_norm_eps)
+        # dense all-expert compute + renormalized top-k combine
+        moe = lp["moe"]
+        gate_logits = h2.astype(jnp.float32) @ moe["gate"]["wg"]["kernel"]
+        probs = jax.nn.softmax(gate_logits, axis=-1)              # [T, E]
+        topv, topi = jax.lax.top_k(probs, cfg.moe.top_k)          # [T, K]
+        w = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+        ex = moe["experts"]
+        g = jnp.einsum("td,edf->etf", h2, ex["w_gate"].astype(dtype))
+        u = jnp.einsum("td,edf->etf", h2, ex["w_up"].astype(dtype))
+        eo = jnp.einsum("etf,efd->etd", jax.nn.silu(g) * u,
+                        ex["w_down"].astype(dtype))               # [E, T, D]
+        t_idx = jnp.arange(h2.shape[0])[:, None]                  # [T, 1]
+        picked = eo[topi, t_idx]                                  # [T, K, D]
+        moe_out = jnp.einsum("tk,tkd->td", w.astype(dtype), picked)
+        return x + moe_out
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        x = _rms(x, params["final_norm"]["scale"], cfg.base.rms_norm_eps)
+        return x.astype(jnp.float32) @ \
+            params["lm_head"]["kernel"].astype(jnp.float32)

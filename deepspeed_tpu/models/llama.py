@@ -19,7 +19,7 @@ modules), but its inference stack ships per-arch implementations
 """
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
@@ -114,7 +114,11 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(orig_dtype)
 
 
+@lru_cache(maxsize=32)
 def rope_freqs(head_dim: int, max_len: int, theta: float) -> Tuple[np.ndarray, np.ndarray]:
+    # cached: serving policies call this per layer per trace; the cache also
+    # keeps the returned ndarrays identical objects so tracers embed one
+    # constant instead of num_layers copies
     inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
     t = np.arange(max_len, dtype=np.float64)
     freqs = np.outer(t, inv)

@@ -1,0 +1,166 @@
+"""Pallas paged (blocked) attention over the serving KV cache.
+
+Reference analog: ``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash``
+(flash attention over paged KV) + ``atom_builder`` (ragged batch splitting).
+
+TPU design: the block table rides as a **scalar-prefetch** argument
+(``pltpu.PrefetchScalarGridSpec``), so the BlockSpec index map dereferences it
+and the kernel DMAs each sequence's KV pages *directly out of the paged pool in
+HBM* — the gather fallback's [B, MB*bs, H, d] context re-materialization (plus
+rep-times KV expansion for GQA) never exists. Grid (batch, kv_head, page) with
+the page dimension innermost: online-softmax accumulators live in VMEM scratch
+and carry across pages, flash-style.
+
+GQA/T folding: the kernel processes one KV head per grid cell; the q rows for
+that cell are the (group × chunk) fold — ``rep`` query heads that share the KV
+head times ``T`` chunk tokens — zero-padded to a multiple of 8 sublanes. Decode
+is T=1; prefill is B=1, T=chunk. Pages entirely above the causal horizon (or
+entirely below the sliding window) are predicated out with ``pl.when``.
+
+Cache layout is head-major ``[Hkv, num_blocks, block_size, d]`` so one page of
+one KV head is a contiguous ``(block_size, d)`` tile (legal TPU block shape).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, block_size, num_pages, chunk, rep,
+                  window):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = start_ref[b]
+    max_qpos = start + chunk - 1
+
+    def _compute():
+        q = q_ref[0, 0]                    # [Gp, d]
+        k = k_ref[0, 0]                    # [bs, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * (1.0 / np.sqrt(q.shape[-1]))
+        # row r of the fold is (q-head r // chunk, chunk token r % chunk)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        qpos = start + row % chunk
+        kpos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kpos <= qpos                # causal == context-length mask
+        if window is not None:
+            mask = jnp.logical_and(mask, kpos > qpos - window)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = j * block_size <= max_qpos      # page overlaps the causal horizon
+    if window is not None:
+        live = jnp.logical_and(live, (j + 1) * block_size - 1 > start - window)
+    pl.when(live)(_compute)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+                       ).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, start_pos,
+                    window=None, interpret: bool = False):
+    """q: [B, T, H, d] (T=1 decode / B=1 prefill chunk);
+    k_pages/v_pages: [Hkv, NB, block_size, d]; block_tables: [B, MB] int32
+    (trash-padded); start_pos: [B] int32 — global position of q row t=0
+    (row t attends kpos <= start+t). Returns [B, T, H, d].
+
+    The KV written for q's own tokens must already be in the pages (the decode/
+    prefill step scatters K/V before calling attention); causal masking then
+    doubles as the context-length mask, so trash-padded table slots and stale
+    tail entries of the last page are never visible.
+    """
+    b, t, h, d = q.shape
+    hkv, _, bs, _ = k_pages.shape
+    rep = h // hkv
+    g = rep * t
+    gp = -(-g // 8) * 8                    # pad fold rows to sublane multiple
+    mb = block_tables.shape[1]
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b, hkv, g, d)
+    if gp != g:
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, d), lambda bi, hi, j, tables, start:
+                         (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, j, tables, start, mb=mb:
+                         (hi, tables[bi * mb + j], 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, j, tables, start, mb=mb:
+                         (hi, tables[bi * mb + j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, d), lambda bi, hi, j, tables, start:
+                               (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, 1), jnp.float32),
+            pltpu.VMEM((gp, 1), jnp.float32),
+            pltpu.VMEM((gp, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, block_size=bs, num_pages=mb,
+                          chunk=t, rep=rep, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.reshape(-1).astype(jnp.int32),
+      start_pos.astype(jnp.int32), qf, k_pages, v_pages)
+
+    out = out[:, :, :g].reshape(b, hkv, rep, t, d)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, start_pos,
+                              window=None):
+    """Gather-based jnp reference with identical semantics (numerics oracle for
+    kernel tests; also the CPU fallback path)."""
+    b, t, h, d = q.shape
+    hkv, _, bs, _ = k_pages.shape
+    rep = h // hkv
+    mb = block_tables.shape[1]
+    # [Hkv, B, MB, bs, d] -> [B, MB*bs, Hkv, d]
+    ctx_k = k_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
+        b, mb * bs, hkv, d)
+    ctx_v = v_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
+        b, mb * bs, hkv, d)
+    if rep > 1:
+        ctx_k = jnp.repeat(ctx_k, rep, axis=2)
+        ctx_v = jnp.repeat(ctx_v, rep, axis=2)
+    s = jnp.einsum("bthd,bkhd->bhtk", q, ctx_k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
+    qpos = start_pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
+    kpos = jnp.arange(mb * bs)[None, None, :]
+    mask = kpos <= qpos[..., None]
+    if window is not None:
+        mask = jnp.logical_and(mask, kpos > qpos[..., None] - window)
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(ctx_v.dtype)
+    return jnp.einsum("bhtk,bkhd->bthd", p, ctx_v)
