@@ -243,6 +243,25 @@ class ElasticityConfig(DeepSpeedTPUConfigModel):
     prefer_larger_batch: bool = True
 
 
+class PLDConfig(DeepSpeedTPUConfigModel):
+    """reference: progressive_layer_drop config keys (PLD_THETA/PLD_GAMMA)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+class EigenvalueSectionConfig(DeepSpeedTPUConfigModel):
+    """reference: get_eigenvalue_config (runtime/config.py:565)."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "model"
+    layer_num: int = 0
+
+
 class DeepSpeedTPUConfig:
     """Parses the single JSON/dict config (reference: DeepSpeedConfig,
     runtime/config.py). Performs the batch-size triple reconciliation with
@@ -288,6 +307,12 @@ class DeepSpeedTPUConfig:
         self.data_efficiency = DataEfficiencyConfig(
             **self._raw.get(C.DATA_EFFICIENCY, {}))
         self.data_types = DataTypesConfig(**self._raw.get(C.DATA_TYPES, {}))
+        self.pld = PLDConfig(**self._raw.get("progressive_layer_drop", {}))
+        self.eigenvalue = EigenvalueSectionConfig(
+            **self._raw.get("eigenvalue", {}))
+        # reference: get_sparse_gradients_enabled (runtime/config.py:247)
+        self.sparse_gradients_enabled: bool = bool(
+            self._raw.get("sparse_gradients", False))
 
         self.gradient_clipping: float = float(
             self._raw.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -45,7 +45,6 @@ GRADIENT_ACCUMULATION_STEPS_DEFAULT = 1
 IGNORED_CUDA_ONLY_KEYS = frozenset({
     "amp",
     "communication_data_type",
-    "sparse_gradients",
     "fp16_master_weights_and_gradients",
     "cuda_aware",
     "use_node_local_storage",

@@ -1,0 +1,131 @@
+"""FP8 (e4m3/e5m2) quantization Pallas kernels + selective gather.
+
+Reference analog: ``csrc/fp_quantizer/{fp_quantize.cu,fp_quantize.cpp}`` (FP8/
+FP6/FP12 group quantize/dequantize with ``selective_dequantize`` for gathering
+a row subset) and ``deepspeed/ops/fp_quantizer/fp8_gemm.py``.
+
+TPU shape: native ``float8_e4m3fn`` / ``float8_e5m2`` storage — the MXU and
+XLA understand these dtypes directly, so "dequantize" is a cast fused into the
+consumer matmul (or a future native fp8 GEMM keeps the operands in fp8). Group
+scaling is per-row (last-dim groups) symmetric fp32, like the int8 kernels in
+``quant.py``; usable by qwZ-style quantized gathers wherever int8's 256 levels
+are overkill and fp8's dynamic range fits better.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# max finite magnitude per format
+FP8_FORMATS = {
+    "e4m3": (jnp.float8_e4m3fn, 448.0),
+    "e5m2": (jnp.float8_e5m2, 57344.0),
+}
+
+
+def _fp8_quant_kernel(x_ref, q_ref, s_ref, *, fmax):
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax / fmax, 1e-12)
+    q_ref[:] = (x / scale).astype(q_ref.dtype)
+    s_ref[:] = scale
+
+
+def _fp8_dequant_kernel(q_ref, s_ref, o_ref):
+    o_ref[:] = (q_ref[:].astype(jnp.float32) * s_ref[:]).astype(o_ref.dtype)
+
+
+def _auto_interpret():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("fmt", "block_rows", "interpret"))
+def quantize_fp8(x, fmt: str = "e4m3", block_rows: int = 256,
+                 interpret: bool = None):
+    """x: [..., D] -> (fp8 values [..., D], fp32 scales [..., 1]) per-row."""
+    interpret = _auto_interpret() if interpret is None else interpret
+    dtype, fmax = FP8_FORMATS[fmt]
+    shape = x.shape
+    d = shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    qv, sv = pl.pallas_call(
+        functools.partial(_fp8_quant_kernel, fmax=fmax),
+        grid=(x2.shape[0] // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, dtype),
+            jax.ShapeDtypeStruct((x2.shape[0], 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2)
+    return qv[:n].reshape(shape), sv[:n].reshape(*shape[:-1], 1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret", "dtype"))
+def dequantize_fp8(q, scales, dtype=jnp.bfloat16, block_rows: int = 256,
+                   interpret: bool = None):
+    interpret = _auto_interpret() if interpret is None else interpret
+    shape = q.shape
+    d = shape[-1]
+    q2 = q.reshape(-1, d)
+    s2 = scales.reshape(-1, 1)
+    n = q2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        q2 = jnp.pad(q2, ((0, pad), (0, 0)))
+        s2 = jnp.pad(s2, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        _fp8_dequant_kernel,
+        grid=(q2.shape[0] // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q2.shape, dtype),
+        interpret=interpret,
+    )(q2, s2)
+    return out[:n].reshape(shape)
+
+
+def selective_dequantize_fp8(q, scales, rows, dtype=jnp.bfloat16,
+                             interpret: bool = None):
+    """Gather a subset of quantized rows and dequantize only those
+    (reference: ``selective_dequantize`` in fp_quantize.cu — used to fetch
+    sub-slices of a quantized parameter without expanding the whole tensor).
+    q: [N, D]; scales: [N, 1]; rows: [K] int32 -> [K, D] in ``dtype``."""
+    qg = jnp.take(q, rows, axis=0)
+    sg = jnp.take(scales, rows, axis=0)
+    return dequantize_fp8(qg, sg, dtype=dtype, interpret=interpret)
+
+
+def quantized_all_gather_fp8(x, axis_name: str, fmt: str = "e4m3"):
+    """qwZ-style collective with fp8 wire format (1 byte/elem like int8 but
+    wider dynamic range per group). Usable inside shard_map."""
+    q, s = quantize_fp8(x, fmt=fmt)
+    qg = jax.lax.all_gather(q, axis_name, axis=0, tiled=True)
+    sg = jax.lax.all_gather(s, axis_name, axis=0, tiled=True)
+    return dequantize_fp8(qg, sg, dtype=x.dtype)
+
+
+def fp8_matmul(a, b_q, b_scales, preferred=jnp.float32):
+    """Matmul against an fp8-quantized weight: the dequant scale-multiply is
+    applied to the fp32 accumulator per output column group (reference:
+    ops/fp_quantizer/fp8_gemm.py matmul_fp8). a: [M, K]; b_q: [K, N] fp8 with
+    per-ROW (K) scales [K, 1] — scales fold into ``a`` before the MXU matmul so
+    the fp8 operand feeds the MXU directly."""
+    # fold the per-K scales into the activation side: a' = a * s_k
+    a_scaled = a.astype(jnp.float32) * b_scales.reshape(1, -1)
+    return jax.lax.dot_general(
+        a_scaled.astype(jnp.bfloat16), b_q.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())), preferred_element_type=preferred)

@@ -1,0 +1,9 @@
+"""Block-sparse attention (reference: deepspeed/ops/sparse_attention/)."""
+from deepspeed_tpu.ops.sparse_attention.attention import (     # noqa: F401
+    SparseSelfAttention, block_sparse_attention,
+    dense_mask_from_layout, pallas_block_sparse_attention,
+    sparse_attention_reference)
+from deepspeed_tpu.ops.sparse_attention.sparsity_configs import (  # noqa: F401
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparsityConfig,
+    VariableSparsityConfig)

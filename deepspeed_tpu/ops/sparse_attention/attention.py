@@ -1,0 +1,234 @@
+"""Block-sparse attention compute over a sparsity layout.
+
+Reference analog: ``deepspeed/ops/sparse_attention/{matmul.py:819,
+softmax.py:296}`` + ``sparse_self_attention.py`` — Triton block-sparse SDD/DSD
+matmuls with a block-masked softmax between them.
+
+TPU shape: two paths over the same [H, nb, nb] layout:
+
+- ``block_sparse_attention`` — blockwise online-softmax in ``lax.scan``
+  (flash-style O(S) memory) with the layout folded into the mask; fully
+  differentiable, runs anywhere. XLA still executes all block panels (masked),
+  so this is the numerics/autodiff path.
+- ``pallas_block_sparse_attention`` — the Pallas grid kernel: the layout rides
+  as a scalar-prefetch argument and inactive (layout==0) blocks are predicated
+  out with ``pl.when``, so the MXU executes only the live blocks — compute
+  proportional to the layout density, the Triton kernels' actual win.
+
+Both follow the reference semantics: token (i, j) may attend iff
+``layout[h, i//block, j//block] == 1``; layouts already encode causality
+(unidirectional configs emit lower-triangular layouts) at *block* granularity,
+and ``causal=True`` additionally applies the exact token-level triangle.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def dense_mask_from_layout(layout, block: int, seq_len: int):
+    """[H, nb, nb] {0,1} -> [H, S, S] boolean token mask (test oracle)."""
+    m = np.repeat(np.repeat(np.asarray(layout, bool), block, 1), block, 2)
+    return m[:, :seq_len, :seq_len]
+
+
+def sparse_attention_reference(q, k, v, layout, block: int,
+                               causal: bool = False):
+    """Naive masked softmax oracle. q,k,v: [B, S, H, D]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / \
+        np.sqrt(q.shape[-1])
+    mask = jnp.asarray(dense_mask_from_layout(layout, block, q.shape[1]))
+    if causal:
+        sq = q.shape[1]
+        mask = jnp.logical_and(
+            mask, (jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]))
+    s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (no live block) produce uniform probs; zero them like
+    # the blocked implementations (l == 0 -> output 0)
+    alive = mask.any(-1)[None, ..., None]
+    return jnp.einsum("bhqk,bkhd->bqhd",
+                      jnp.where(alive, p, 0.0).astype(v.dtype), v)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "causal"))
+def block_sparse_attention(q, k, v, layout, block: int, causal: bool = False):
+    """Blockwise lax path (differentiable). q,k,v: [B, S, H, D];
+    layout: [H, nb, nb]."""
+    b, sq, h, d = q.shape
+    nb = sq // block
+    scale = 1.0 / np.sqrt(d)
+    qb = q.reshape(b, nb, block, h, d).transpose(1, 0, 3, 2, 4)  # [nb,B,H,blk,D]
+    kb = k.reshape(b, nb, block, h, d).transpose(1, 0, 3, 2, 4)
+    vb = v.reshape(b, nb, block, h, d).transpose(1, 0, 3, 2, 4)
+    lay = jnp.asarray(layout)
+
+    def per_q_block(qi, q_blk):
+        def kv_step(carry, inputs):
+            m, l, o = carry
+            ki, k_blk, v_blk = inputs
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk,
+                           preferred_element_type=jnp.float32) * scale
+            live = lay[:, qi, ki].astype(bool)          # [H]
+            mask = jnp.broadcast_to(live[None, :, None, None], s.shape)
+            if causal:
+                qpos = qi * block + jnp.arange(block)
+                kpos = ki * block + jnp.arange(block)
+                mask = jnp.logical_and(
+                    mask, (qpos[:, None] >= kpos[None, :])[None, None])
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            o_new = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32)
+            return (m_new, l_new, o_new), None
+
+        m0 = jnp.full((b, h, block), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, block), jnp.float32)
+        o0 = jnp.zeros((b, h, block, d), jnp.float32)
+        (m, l, o), _ = jax.lax.scan(kv_step, (m0, l0, o0),
+                                    (jnp.arange(nb), kb, vb))
+        return o / jnp.maximum(l, 1e-30)[..., None]
+
+    outs = jax.lax.map(lambda args: per_q_block(*args), (jnp.arange(nb), qb))
+    return outs.transpose(1, 0, 3, 2, 4).reshape(b, sq, h, d).astype(q.dtype)
+
+
+def _sparse_kernel(lay_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, sm_scale, causal, block, num_k_blocks, num_heads):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    h = bh % num_heads
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = qpos >= kpos
+            s = jnp.where(mask, s, NEG_INF)
+            p_mask = mask
+        else:
+            p_mask = jnp.ones(s.shape, bool)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(p_mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # the layout lookup is THE sparsity win: dead blocks never hit the MXU
+    live = lay_ref[(h * pl.num_programs(1) + qi) * num_k_blocks + ki] != 0
+    pl.when(live)(_compute)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def _pallas_sparse_fwd(q, k, v, layout, block, causal, interpret):
+    b, sq, h, d = q.shape
+    nb = sq // block
+    q2 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    k2 = k.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    v2 = v.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    lay = jnp.asarray(layout, jnp.int32).reshape(-1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, nb, nb),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda bh, i, j, lay: (bh, i, 0)),
+            pl.BlockSpec((1, block, d), lambda bh, i, j, lay: (bh, j, 0)),
+            pl.BlockSpec((1, block, d), lambda bh, i, j, lay: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, d), lambda bh, i, j, lay: (bh, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_sparse_kernel, sm_scale=1.0 / np.sqrt(d),
+                          causal=causal, block=block, num_k_blocks=nb,
+                          num_heads=h),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(lay, q2, k2, v2)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def pallas_block_sparse_attention(q, k, v, layout, block: int,
+                                  causal: bool = False,
+                                  interpret: bool = False):
+    """Pallas path: dead blocks are skipped on the MXU. Backward recomputes
+    through the blockwise lax path (same numerics)."""
+    return _pallas_sparse_fwd(q, k, v, layout, block, causal, interpret)
+
+
+def _sp_fwd(q, k, v, layout, block, causal, interpret):
+    out = _pallas_sparse_fwd(q, k, v, layout, block, causal, interpret)
+    return out, (q, k, v, layout)
+
+
+def _sp_bwd(block, causal, interpret, res, g):
+    q, k, v, layout = res
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: block_sparse_attention(q_, k_, v_, layout, block,
+                                                  causal), q, k, v)
+    return (*vjp_fn(g), None)
+
+
+pallas_block_sparse_attention.defvjp(_sp_fwd, _sp_bwd)
+
+
+class SparseSelfAttention:
+    """Config-driven entry point (reference sparse_self_attention.py):
+    holds a SparsityConfig, builds/caches the layout per sequence length and
+    dispatches to the Pallas kernel on TPU or the lax path elsewhere."""
+
+    def __init__(self, sparsity_config, causal: Optional[bool] = None):
+        self.config = sparsity_config
+        self.causal = (sparsity_config.attention == "unidirectional"
+                       if causal is None and
+                       hasattr(sparsity_config, "attention") else bool(causal))
+        self._layouts = {}
+
+    def layout(self, seq_len):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, q, k, v):
+        lay = self.layout(q.shape[1])
+        if jax.default_backend() == "tpu":
+            return pallas_block_sparse_attention(q, k, v, lay,
+                                                 self.config.block, self.causal)
+        return block_sparse_attention(q, k, v, lay, self.config.block,
+                                      self.causal)

@@ -1,0 +1,144 @@
+"""Hessian max-eigenvalue estimation by power iteration.
+
+Reference analog: ``deepspeed/runtime/eigenvalue.py:13`` (``Eigenvalue`` —
+per-block power iteration on the loss curvature, used by the compression
+scheduler to order layers by sensitivity).
+
+TPU shape: the reference differentiates twice through torch autograd per block;
+here the Hessian-vector product is ``jvp`` of ``grad`` (forward-over-reverse),
+jitted once and iterated under ``lax.while_loop`` with the reference's
+convergence test (relative eigenvalue change < tol). Blocks are top-level
+entries of a params subtree (e.g. ``params["model"]["layer_3"]``) instead of
+module scopes.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass
+class EigenvalueConfig:
+    """reference: get_eigenvalue_config (runtime/config.py:565)."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "model"
+    layer_num: int = 0
+
+
+def _tree_dot(a, b):
+    return sum(jnp.sum(x.astype(jnp.float32) * y.astype(jnp.float32))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def _normalize(v, stability):
+    norm = jnp.sqrt(_tree_dot(v, v)) + stability
+    return jax.tree.map(lambda x: jnp.nan_to_num(x / norm, posinf=0.0,
+                                                 neginf=0.0), v)
+
+
+class Eigenvalue:
+    """Power iteration over per-block Hessians (reference Eigenvalue)."""
+
+    def __init__(self, config: Optional[EigenvalueConfig] = None, **kwargs):
+        self.cfg = config or EigenvalueConfig(**kwargs)
+
+    def compute_eigenvalue(self, loss_fn: Callable, params: Any,
+                           rng: jax.Array) -> Dict[str, float]:
+        """loss_fn(params) -> scalar. Returns {block_name: max_eigenvalue}.
+
+        Blocks are resolved from ``cfg.layer_name`` (a '/'-joined path into the
+        params tree); each child of that subtree is one block (reference:
+        get_layers + layer_num). The HVP holds all other blocks fixed,
+        matching the reference's per-block curvature.
+        """
+        cfg = self.cfg
+        node = params
+        for part in [p for p in cfg.layer_name.split("/") if p]:
+            node = node[part]
+        names = sorted(node.keys(), key=_natural_key)
+        if cfg.layer_num:
+            names = names[:cfg.layer_num]
+
+        results = {}
+        for i, name in enumerate(names):
+            block = node[name]
+            rng, sub = jax.random.split(rng)
+
+            def block_loss(b, name=name):
+                patched = dict(node)
+                patched[name] = b
+                whole = _set_path(params, cfg.layer_name, patched)
+                return loss_fn(whole)
+
+            ev = _power_iterate(block_loss, block, sub, cfg.max_iter, cfg.tol,
+                                cfg.stability)
+            results[name] = float(ev)
+            if cfg.verbose:
+                log_dist(f"eigenvalue[{name}] = {results[name]:.4e}", ranks=[0])
+        # reference post-processing: replace non-positive estimates with the
+        # max so ordering degrades gracefully
+        max_ev = max([v for v in results.values() if v > 0], default=1.0)
+        return {k: (v if v > 0 else max_ev) for k, v in results.items()}
+
+
+def _natural_key(name: str):
+    """layer_2 < layer_10 (lexicographic sort would interleave them and pick
+    the wrong blocks for layer_num truncation)."""
+    import re
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", name)]
+
+
+def _set_path(params, path, value):
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return value
+
+    def rec(node, parts):
+        if len(parts) == 1:
+            out = dict(node)
+            out[parts[0]] = value
+            return out
+        out = dict(node)
+        out[parts[0]] = rec(node[parts[0]], parts[1:])
+        return out
+    return rec(params, parts)
+
+
+def _power_iterate(block_loss, block, rng, max_iter, tol, stability):
+    """NOT jit-wrapped: ``block_loss`` is a fresh closure per block per call,
+    so a jit static-arg cache would grow without bound and recompile every
+    invocation; the ``lax.while_loop`` below compiles its body once per call,
+    which is the right cost for an occasional (gas-boundary) computation."""
+    grad_fn = jax.grad(block_loss)
+
+    def hvp(v):
+        return jax.jvp(grad_fn, (block,), (v,))[1]
+
+    v0 = _normalize(jax.tree.map(
+        lambda x: jax.random.normal(rng, x.shape, jnp.float32), block),
+        stability)
+
+    def cond(carry):
+        i, _, ev, prev = carry
+        rel = jnp.abs(ev - prev) / (jnp.abs(ev) + 1e-12)
+        return jnp.logical_and(i < max_iter,
+                               jnp.logical_or(i < 2, rel > tol))
+
+    def body(carry):
+        i, v, ev, _ = carry
+        hv = hvp(v)
+        new_ev = _tree_dot(v, hv)
+        return i + 1, _normalize(hv, stability), new_ev, ev
+
+    _, _, ev, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), v0, jnp.float32(0.0), jnp.float32(1e9)))
+    return ev

@@ -302,6 +302,14 @@ class DeepSpeedTPUEngine:
                      "secondary gather; set zero_hpz_partition_size > 1 — ignored",
                      ranks=[0])
             self._quantized_weights = False
+        # qgZ: int8 gradient quantization at the reduction boundary (reference
+        # all_to_all_quant_reduce, runtime/comm/coalesced_collectives.py:31 +
+        # csrc/quantization/quant_reduce.cu). On the SPMD path XLA owns the
+        # collective schedule, so the quantization numerics (per-microbatch
+        # int8 round-trip before the cross-device reduce) apply here; the
+        # explicit int8-wire collective for manual shard_map paths is
+        # ops.pallas.quant.all_to_all_quant_reduce.
+        self._quantized_gradients = bool(zc.zero_quantized_gradients)
 
         # --- compiled functions ----------------------------------------------
         self._reset_compiled_fns()
@@ -310,6 +318,30 @@ class DeepSpeedTPUEngine:
         self._grad_buffer = None
         self._accum_count = 0
         self._pending = None            # cached (loss, grads) from forward()
+
+        # progressive layer drop (reference: engine.py:346 _configure_pld +
+        # :1871 per-step update_state)
+        self.progressive_layer_drop = None
+        if config.pld.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                ProgressiveLayerDrop)
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.pld.theta, gamma=config.pld.gamma)
+        # eigenvalue (reference: engine.py eigenvalue_enabled + compression MoQ)
+        self.eigenvalue = None
+        self.block_eigenvalues = None
+        if config.eigenvalue.enabled:
+            from deepspeed_tpu.runtime.eigenvalue import (
+                Eigenvalue, EigenvalueConfig)
+            self.eigenvalue = Eigenvalue(
+                EigenvalueConfig(**config.eigenvalue.model_dump()))
+        self.sparse_gradients_enabled = config.sparse_gradients_enabled
+        if self.sparse_gradients_enabled:
+            log_dist(
+                "sparse_gradients: the SPMD path reduces gradients densely "
+                "(XLA collectives); runtime.sparse_tensor.SparseTensor/"
+                "sparse_all_gather provide the sparse wire format for manual "
+                "shard_map paths", ranks=[0])
 
         # --- bookkeeping / observability -------------------------------------
         self.global_steps = 0
@@ -413,10 +445,25 @@ class DeepSpeedTPUEngine:
         return jnp.asarray(out, jnp.float32)
 
     def _grads_one_micro(self, params, batch, rng, scale):
-        """Value-and-grad of (scaled) loss for one microbatch."""
+        """Value-and-grad of (scaled) loss for one microbatch. With qgZ on,
+        every microbatch gradient goes through an int8 round-trip before it is
+        accumulated/reduced — the fidelity contract of the reference's
+        quantized-gradient collectives."""
         def scaled_loss(p):
             return self._compute_loss(p, batch, rng) * scale
         loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
+        if self._quantized_gradients:
+            from deepspeed_tpu.ops.pallas.quant import dequantize_int8, quantize_int8
+
+            def qdq(g):
+                # tiny leaves (norm scales, biases) are bandwidth-irrelevant —
+                # the reference buckets them with everything else, but skipping
+                # them avoids int8 noise on the most sensitive parameters
+                if g.ndim < 1 or g.size < 2048:
+                    return g
+                q, s = quantize_int8(g)
+                return dequantize_int8(q, s, dtype=g.dtype)
+            grads = jax.tree.map(qdq, grads)
         return loss_scaled / scale, grads
 
     # ------------------------------------------------------------------
@@ -462,7 +509,13 @@ class DeepSpeedTPUEngine:
                 micro, (zero_grads, jnp.float32(0.0)), (stacked_batch, rngs))
             loss = loss_sum / gas
             # unscale + average over gas in fp32 (reference scales loss by 1/gas
-            # pre-bwd; accumulation dtype may be lower via data_types config)
+            # pre-bwd; accumulation dtype may be lower via data_types config).
+            # No per-microbatch overflow check is needed (the reference checks
+            # per-reduction, stage3.py:1290): IEEE non-finites are absorbing
+            # under addition (inf + -inf = NaN, inf + x = inf), so any
+            # microbatch overflow survives into the accumulated sum and the
+            # single check in _update catches it — tested in
+            # test_fp16_per_microbatch_overflow_detected.
             grads = jax.tree.map(
                 lambda g: g.astype(jnp.float32) / (scale * gas), grads)
             new_state, out = self._update(state, grads, tx, lr_schedule, clip, fp16)
@@ -482,7 +535,9 @@ class DeepSpeedTPUEngine:
         if fp16.enabled:
             # fp16: detect overflow, neutralize non-finite grads so the (discarded)
             # update arithmetic stays clean, and skip the step (reference
-            # _overflow_check_and_loss_scale_update).
+            # _overflow_check_and_loss_scale_update). This single post-sum
+            # check also covers per-microbatch overflow under the gas scan —
+            # IEEE non-finites are absorbing under addition.
             overflow = precision.has_inf_or_nan(grads)
             safe_grads = jax.tree.map(
                 lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads)
@@ -566,6 +621,19 @@ class DeepSpeedTPUEngine:
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.eigenvalue is not None and self.global_steps % max(
+                self.eigenvalue.cfg.gas_boundary_resolution, 1) == 0:
+            # reference: eigenvalue at gas boundaries feeding compression MoQ
+            # (engine.py quantizer hooks); results cached on the engine
+            import jax as _jax
+            eval_batch = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[0]),
+                                      batch)
+            self.block_eigenvalues = self.eigenvalue.compute_eigenvalue(
+                lambda p: self._compute_loss(p, eval_batch,
+                                             _jax.random.PRNGKey(0)),
+                self.state.params, _jax.random.PRNGKey(self.global_steps))
         self._advance_data_schedules()
         self._record_metrics(out)
         return out.loss

@@ -1,0 +1,55 @@
+"""Progressive Layer Dropping (PLD).
+
+Reference analog: ``deepspeed/runtime/progressive_layer_drop.py`` — the theta
+schedule ``theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar`` (paper:
+arxiv 2010.13369), updated by the engine each global step and handed to the
+model, which drops transformer layers stochastically with depth-scaled keep
+probabilities.
+"""
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self) -> Dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        # reference _prob: (1 - p) * exp(-gamma * x) + p
+        self.current_theta = (1.0 - self.theta) * \
+            math.exp(-self.gamma * global_step) + self.theta
+
+
+def layer_survival_probs(theta: float, num_layers: int):
+    """Depth-scaled keep probabilities (PLD paper eq. 5): layer i survives
+    with probability 1 - i/L * (1 - theta) — shallow layers almost always
+    kept, deepest layer kept with probability theta."""
+    import numpy as np
+    i = np.arange(num_layers, dtype=np.float32)
+    return 1.0 - i / max(num_layers - 1, 1) * (1.0 - theta)
+
+
+def maybe_drop_layer(rng, x, layer_out, keep_prob):
+    """Stochastic identity-skip for one layer (jit-friendly): with probability
+    ``1 - keep_prob`` the layer's contribution is dropped; the kept output is
+    scaled by 1/keep_prob so expectations match (inverted-dropout convention,
+    as in the PLD paper's PreLN formulation)."""
+    keep = jax.random.bernoulli(rng, keep_prob)
+    scale = 1.0 / jnp.maximum(keep_prob, 1e-6)
+    return jnp.where(keep, x + (layer_out - x) * scale, x)

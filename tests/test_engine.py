@@ -226,3 +226,33 @@ def test_dataloader_drop_last():
     assert batches[-1]["x"].shape[0] == 2
     drop = DeepSpeedTPUDataLoader(ds, batch_size=4, drop_last=True)
     assert len(list(iter(drop))) == len(drop) == 2
+
+
+def test_fp16_per_microbatch_overflow_detected():
+    """A transient inf in one microbatch that cancels in the gas sum must still
+    skip the step (reference checks per-reduction, not on the summed grads)."""
+    import deepspeed_tpu.runtime.engine as eng_mod
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_batch_size": 16, "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "fp16": {"enabled": True, "loss_scale": 0.0}},
+        example_batch=random_batch(4))
+    orig = engine._grads_one_micro
+    calls = {"n": 0}
+
+    def poisoned(params, batch, rng, scale):
+        loss, grads = orig(params, batch, rng, scale)
+        calls["n"] += 1
+        # inject +inf into microbatch 0 and -inf into microbatch 1 on the same
+        # leaf: the accumulated sum is NaN-free only by cancellation
+        leaves, tree = jax.tree_util.tree_flatten(grads)
+        sign = jnp.where((calls["n"] % 2) == 1, jnp.inf, -jnp.inf)
+        leaves[0] = leaves[0].at[(0,) * leaves[0].ndim].set(sign)
+        return loss, jax.tree_util.tree_unflatten(tree, leaves)
+
+    engine._grads_one_micro = poisoned
+    engine._reset_compiled_fns()
+    skipped_before = int(engine.state.skipped_steps)
+    engine.train_batch(batch=random_batch(8, seed=0, gas=2))
+    assert int(engine.state.skipped_steps) == skipped_before + 1

@@ -144,3 +144,101 @@ def test_pipeline_single_stage_passthrough():
     out = pipeline_apply(_block_fn, params, x_mb, mesh=mesh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(_sequential(params, x_mb)),
                                atol=1e-6)
+
+
+# ---------------------------------------------------------------- 1F1B
+def _toy_setup(l=8, d=32, vocab=64):
+    rng = np.random.default_rng(0)
+    stacked = {
+        "w1": jnp.asarray(rng.normal(size=(l, d, 2 * d)) * 0.1, jnp.float32),
+        "w2": jnp.asarray(rng.normal(size=(l, 2 * d, d)) * 0.1, jnp.float32),
+    }
+    tied = {"embed": jnp.asarray(rng.normal(size=(vocab, d)) * 0.1, jnp.float32)}
+
+    def block_fn(lp, x):
+        return x + jax.nn.relu(x @ lp["w1"]) @ lp["w2"]
+
+    def first_fn(tp, toks):
+        return tp["embed"][toks]
+
+    def last_fn(tp, y, toks):
+        logits = y @ tp["embed"].T            # tied unembed
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        ll = jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    toks = jnp.asarray(rng.integers(0, vocab, size=(8, 2, 16)), jnp.int32)
+    return stacked, tied, toks, block_fn, first_fn, last_fn
+
+
+def test_1f1b_matches_no_pipe():
+    """1F1B executor: loss AND grads (incl. tied embedding grads from both
+    pipeline ends) match the unpipelined computation."""
+    from deepspeed_tpu.runtime.pipe.one_f_one_b import (
+        _no_pipe, pipeline_train_step_1f1b)
+    stacked, tied, toks, block_fn, first_fn, last_fn = _toy_setup()
+    mesh = create_mesh(MeshConfig(pipe=4, data=2))
+    set_global_mesh(mesh)
+
+    loss_p, gp_p, gt_p = pipeline_train_step_1f1b(
+        block_fn, stacked, tied, toks, first_fn, last_fn, mesh=mesh)
+    loss_r, gp_r, gt_r = _no_pipe(block_fn, stacked, tied, toks, first_fn,
+                                  last_fn)
+    np.testing.assert_allclose(float(loss_p), float(loss_r), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gp_p), jax.tree.leaves(gp_r)):
+        np.testing.assert_allclose(
+            np.asarray(a).reshape(np.asarray(b).shape), np.asarray(b),
+            atol=1e-5, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(gt_p), jax.tree.leaves(gt_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_1f1b_bounded_activation_memory():
+    """The 1F1B property: the ring buffer holds min(M, 2S-1) stage inputs —
+    independent of the microbatch count (GPipe would hold M)."""
+    from deepspeed_tpu.runtime.pipe import one_f_one_b as mod
+    stacked, tied, toks, block_fn, first_fn, last_fn = _toy_setup()
+    m, s = toks.shape[0], 4
+    assert min(m, 2 * s - 1) == 7 < m + s - 1     # tighter than GPipe's M
+
+    # 32 microbatches: buffer stays at 2S-1 = 7
+    toks32 = jnp.tile(toks, (4, 1, 1))
+    mesh = create_mesh(MeshConfig(pipe=4, data=2))
+    set_global_mesh(mesh)
+    loss, _, _ = mod.pipeline_train_step_1f1b(
+        block_fn, stacked, tied, toks32, first_fn, last_fn, mesh=mesh)
+    assert np.isfinite(float(loss))
+
+
+def test_trainschedule_inflight_matches_pipe_buffers():
+    """The 1F1B instruction stream never holds more in-flight microbatches
+    than num_pipe_buffers (reference: schedule.py:268)."""
+    from deepspeed_tpu.runtime.pipe.schedule import (
+        BackwardPass, ForwardPass, TrainSchedule)
+    for stages in (2, 4):
+        for m in (1, 4, 8):
+            for p in range(stages):
+                sched = TrainSchedule(m, stages, p)
+                inflight = 0
+                peak = 0
+                for cmds in sched.steps():
+                    for c in cmds:
+                        if isinstance(c, ForwardPass):
+                            inflight += 1
+                        elif isinstance(c, BackwardPass):
+                            inflight -= 1
+                    peak = max(peak, inflight)
+                assert peak <= sched.num_pipe_buffers(), \
+                    (stages, m, p, peak, sched.num_pipe_buffers())
+
+
+def test_bubble_fraction_model():
+    """Executor macro-step count obeys the (S-1)/(M+S-1) bubble model: total
+    steps = fwd-critical-path + drain = (M + S - 1) + (S - 1)."""
+    from deepspeed_tpu.runtime.pipe.schedule import bubble_fraction
+    m, s = 8, 4
+    total = 2 * (s - 1) + m                      # executor's scan length
+    fwd_steps = m + s - 1
+    assert total == fwd_steps + (s - 1)
+    assert bubble_fraction(m, s) == (s - 1) / (m + s - 1)

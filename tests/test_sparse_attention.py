@@ -1,0 +1,145 @@
+"""Block-sparse attention tests.
+
+Reference analog: tests/unit/ops/sparse_attention/test_sparse_attention.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention, VariableSparsityConfig, block_sparse_attention,
+    pallas_block_sparse_attention, sparse_attention_reference)
+
+
+def _qkv(b=2, s=64, h=4, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+                 for _ in range(3))
+
+
+# ------------------------------------------------------------- layouts
+def test_fixed_layout_pattern():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1, attention="unidirectional")
+    lay = cfg.make_layout(128)         # 8x8 blocks
+    assert lay.shape == (2, 8, 8)
+    assert (lay == np.tril(lay)).all()                   # causal at block level
+    assert lay[0, 1, 0] == 1 and lay[0, 1, 1] == 1       # local window
+    assert lay[0, 2, 0] == 0                             # outside window...
+    assert lay[0, 7, 1] == 1                             # ...except global col
+    assert (lay[0] == lay[1]).all()                      # propagated head 0
+
+
+def test_bigbird_layout_connectivity():
+    cfg = BigBirdSparsityConfig(num_heads=2, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    lay = cfg.make_layout(128)
+    assert (lay[0, 0, :] == 1).all() and (lay[0, :, 0] == 1).all()  # ITC global
+    for r in range(1, 7):
+        assert lay[0, r, r - 1:r + 2].all()              # sliding diag
+    assert lay.sum() < 2 * 8 * 8                         # actually sparse
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[2])
+    lay = cfg.make_layout(128)
+    assert (lay[0, 2, :] == 1).all() and (lay[0, :, 2] == 1).all()
+    assert lay[0, 7, 0] == 0
+
+
+def test_dense_and_local_window_layouts():
+    assert DenseSparsityConfig(num_heads=1, block=16).make_layout(64).all()
+    lay = LocalSlidingWindowSparsityConfig(
+        num_heads=1, block=16, num_sliding_window_blocks=3).make_layout(128)
+    assert (lay == np.tril(lay)).all()
+    assert lay[0, 5, 4] == 1 and lay[0, 5, 1] == 0
+
+
+def test_variable_layout_random_seeded():
+    cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=2,
+                                 seed=3)
+    a = cfg.make_layout(256)
+    b = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=2,
+                               seed=3).make_layout(256)
+    np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------------- compute
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_sparse_matches_reference(causal):
+    q, k, v = _qkv()
+    cfg = BigBirdSparsityConfig(num_heads=4, block=16,
+                                different_layout_per_head=True, seed=1)
+    lay = cfg.make_layout(64)
+    out = block_sparse_attention(q, k, v, lay, 16, causal=causal)
+    ref = sparse_attention_reference(q, k, v, lay, 16, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_sparse_matches_reference(causal):
+    q, k, v = _qkv()
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                              attention="bidirectional")
+    lay = cfg.make_layout(64)
+    out = pallas_block_sparse_attention(q, k, v, lay, 16, causal, True)
+    ref = sparse_attention_reference(q, k, v, lay, 16, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_sparse_attention_grads():
+    q, k, v = _qkv(s=32)
+    lay = BSLongformerSparsityConfig(
+        num_heads=4, block=8, num_sliding_window_blocks=3).make_layout(32)
+
+    def loss_s(q, k, v):
+        return jnp.sum(block_sparse_attention(q, k, v, lay, 8) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(sparse_attention_reference(q, k, v, lay, 8) ** 2)
+
+    gs = jax.grad(loss_s, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4)
+
+
+def test_pallas_sparse_grad_via_recompute():
+    q, k, v = _qkv(s=32)
+    lay = FixedSparsityConfig(num_heads=4, block=8,
+                              num_local_blocks=2).make_layout(32)
+
+    def loss_p(q, k, v):
+        return jnp.sum(pallas_block_sparse_attention(q, k, v, lay, 8, False,
+                                                     True) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(sparse_attention_reference(q, k, v, lay, 8) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4)
+
+
+def test_sparse_self_attention_entry_point():
+    q, k, v = _qkv(s=64)
+    sa = SparseSelfAttention(LocalSlidingWindowSparsityConfig(
+        num_heads=4, block=16, num_sliding_window_blocks=3))
+    assert sa.causal                      # unidirectional config -> causal
+    out = sa(q, k, v)
+    ref = sparse_attention_reference(
+        q, k, v, sa.layout(64), 16, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    assert 64 in sa._layouts              # layout cached
