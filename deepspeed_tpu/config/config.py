@@ -250,18 +250,6 @@ class PLDConfig(DeepSpeedTPUConfigModel):
     gamma: float = 0.001
 
 
-class EigenvalueSectionConfig(DeepSpeedTPUConfigModel):
-    """reference: get_eigenvalue_config (runtime/config.py:565)."""
-    enabled: bool = False
-    verbose: bool = False
-    max_iter: int = 100
-    tol: float = 1e-2
-    stability: float = 1e-6
-    gas_boundary_resolution: int = 1
-    layer_name: str = "model"
-    layer_num: int = 0
-
-
 class DeepSpeedTPUConfig:
     """Parses the single JSON/dict config (reference: DeepSpeedConfig,
     runtime/config.py). Performs the batch-size triple reconciliation with
@@ -308,8 +296,10 @@ class DeepSpeedTPUConfig:
             **self._raw.get(C.DATA_EFFICIENCY, {}))
         self.data_types = DataTypesConfig(**self._raw.get(C.DATA_TYPES, {}))
         self.pld = PLDConfig(**self._raw.get("progressive_layer_drop", {}))
-        self.eigenvalue = EigenvalueSectionConfig(
-            **self._raw.get("eigenvalue", {}))
+        # single schema shared with the implementation (no parallel copy to
+        # keep in sync): reference get_eigenvalue_config (runtime/config.py:565)
+        from deepspeed_tpu.runtime.eigenvalue import EigenvalueConfig
+        self.eigenvalue = EigenvalueConfig(**self._raw.get("eigenvalue", {}))
         # reference: get_sparse_gradients_enabled (runtime/config.py:247)
         self.sparse_gradients_enabled: bool = bool(
             self._raw.get("sparse_gradients", False))
@@ -323,6 +313,11 @@ class DeepSpeedTPUConfig:
             self._raw.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
         self.wall_clock_breakdown: bool = bool(self._raw.get(C.WALL_CLOCK_BREAKDOWN, False))
         self.dump_state: bool = bool(self._raw.get("dump_state", False))
+        # numerical sanitizer (SURVEY §5.2): aborts with a traceback at the
+        # first NaN-producing op instead of silently propagating — the
+        # jax_debug_nans analog of the reference's CheckOverflow/_has_inf_or_nan
+        # guards (with fp16 enabled, prefer the loss-scaler's overflow skip)
+        self.debug_nans: bool = bool(self._raw.get("debug_nans", False))
 
         # --- batch size triple reconciliation (reference: config.py
         #     _configure_train_batch_size / _batch_assertion) ---

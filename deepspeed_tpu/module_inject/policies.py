@@ -134,6 +134,53 @@ POLICIES = {
         "bert",
         column=("query", "key", "value", "intermediate/dense"),
         row=("attention/output/dense", "output/dense")),
+    "distilbert": TPPolicy(
+        "distilbert",
+        column=("q_lin", "k_lin", "v_lin", "lin1"),
+        row=("out_lin", "lin2")),
+    "gpt_neo": TPPolicy(
+        "gpt_neo",
+        column=("q_proj", "k_proj", "v_proj", "c_fc"),
+        row=("out_proj", "c_proj")),
+    "gpt_bigcode": TPPolicy(      # starcoder: MQA fused qkv
+        "gpt_bigcode",
+        column=("c_fc",),
+        row=("attn/c_proj", "mlp/c_proj"),
+        fused_qkv=("c_attn",)),
+    "codegen": TPPolicy(
+        "codegen",
+        column=("fc_in",),
+        row=("out_proj", "fc_out"),
+        fused_qkv=("qkv_proj",)),
+    "gemma": TPPolicy("gemma", **_LLAMA_LIKE),
+    "stablelm": TPPolicy("stablelm", **_LLAMA_LIKE),
+    "chatglm": TPPolicy(
+        "chatglm",
+        column=("dense_h_to_4h",),
+        row=("self_attention/dense", "dense_4h_to_h"),
+        fused_qkv=("query_key_value",)),
+    "megatron_gpt": TPPolicy(
+        "megatron_gpt",
+        column=("dense_h_to_4h",),
+        row=("attention/dense", "dense_4h_to_h"),
+        fused_qkv=("query_key_value",)),
+    "clip": TPPolicy(
+        "clip",
+        column=("q_proj", "k_proj", "v_proj", "fc1"),
+        row=("out_proj", "fc2")),
+    "t5": TPPolicy(
+        "t5",
+        # scoped patterns: bare "k/" would false-match "block/0"
+        column=("SelfAttention/q", "SelfAttention/k", "SelfAttention/v",
+                "EncDecAttention/q", "EncDecAttention/k", "EncDecAttention/v",
+                "DenseReluDense/wi"),
+        row=("SelfAttention/o", "EncDecAttention/o", "DenseReluDense/wo"),
+        vocab_in=("shared/", "embed_tokens"),
+        vocab_out=("lm_head",)),
+    "whisper": TPPolicy(
+        "whisper",
+        column=("q_proj", "k_proj", "v_proj", "fc1"),
+        row=("out_proj", "fc2")),
 }
 
 # aliases: HF model_type / class-name spellings -> canonical key
@@ -151,6 +198,17 @@ _ALIASES = {
     "gptjforcausallm": "gptj",
     "optforcausallm": "opt",
     "bertmodel": "bert", "bertforsequenceclassification": "bert",
+    "distilbertmodel": "distilbert",
+    "gptneoforcausallm": "gpt_neo",
+    "gptbigcodeforcausallm": "gpt_bigcode", "starcoder": "gpt_bigcode",
+    "codegenforcausallm": "codegen",
+    "gemmaforcausallm": "gemma", "gemma2forcausallm": "gemma",
+    "stablelmforcausallm": "stablelm",
+    "chatglmforconditionalgeneration": "chatglm", "glm": "chatglm",
+    "megatrongptmodel": "megatron_gpt", "megatron": "megatron_gpt",
+    "clipmodel": "clip", "cliptextmodel": "clip", "clipvisionmodel": "clip",
+    "t5forconditionalgeneration": "t5", "mt5forconditionalgeneration": "t5",
+    "whisperforconditionalgeneration": "whisper",
 }
 
 

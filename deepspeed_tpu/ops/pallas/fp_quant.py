@@ -16,7 +16,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
+
+from deepspeed_tpu.ops.pallas.quant import rowwise_pallas_op
 
 # max finite magnitude per format
 FP8_FORMATS = {
@@ -37,65 +38,28 @@ def _fp8_dequant_kernel(q_ref, s_ref, o_ref):
     o_ref[:] = (q_ref[:].astype(jnp.float32) * s_ref[:]).astype(o_ref.dtype)
 
 
-def _auto_interpret():
-    return jax.default_backend() != "tpu"
-
-
 @functools.partial(jax.jit, static_argnames=("fmt", "block_rows", "interpret"))
 def quantize_fp8(x, fmt: str = "e4m3", block_rows: int = 256,
                  interpret: bool = None):
     """x: [..., D] -> (fp8 values [..., D], fp32 scales [..., 1]) per-row."""
-    interpret = _auto_interpret() if interpret is None else interpret
     dtype, fmax = FP8_FORMATS[fmt]
     shape = x.shape
     d = shape[-1]
-    x2 = x.reshape(-1, d)
-    n = x2.shape[0]
-    pad = (-n) % block_rows
-    if pad:
-        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
-    qv, sv = pl.pallas_call(
-        functools.partial(_fp8_quant_kernel, fmax=fmax),
-        grid=(x2.shape[0] // block_rows,),
-        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))],
-        out_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, dtype),
-            jax.ShapeDtypeStruct((x2.shape[0], 1), jnp.float32),
-        ],
-        interpret=interpret,
-    )(x2)
-    return qv[:n].reshape(shape), sv[:n].reshape(*shape[:-1], 1)
+    qv, sv = rowwise_pallas_op(
+        functools.partial(_fp8_quant_kernel, fmax=fmax), [x.reshape(-1, d)],
+        [(d, dtype), (1, jnp.float32)], block_rows, interpret)
+    return qv.reshape(shape), sv.reshape(*shape[:-1], 1)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret", "dtype"))
 def dequantize_fp8(q, scales, dtype=jnp.bfloat16, block_rows: int = 256,
                    interpret: bool = None):
-    interpret = _auto_interpret() if interpret is None else interpret
     shape = q.shape
     d = shape[-1]
-    q2 = q.reshape(-1, d)
-    s2 = scales.reshape(-1, 1)
-    n = q2.shape[0]
-    pad = (-n) % block_rows
-    if pad:
-        q2 = jnp.pad(q2, ((0, pad), (0, 0)))
-        s2 = jnp.pad(s2, ((0, pad), (0, 0)))
-    out = pl.pallas_call(
-        _fp8_dequant_kernel,
-        grid=(q2.shape[0] // block_rows,),
-        in_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q2.shape, dtype),
-        interpret=interpret,
-    )(q2, s2)
-    return out[:n].reshape(shape)
+    (out,) = rowwise_pallas_op(
+        _fp8_dequant_kernel, [q.reshape(-1, d), scales.reshape(-1, 1)],
+        [(d, dtype)], block_rows, interpret)
+    return out.reshape(shape)
 
 
 def selective_dequantize_fp8(q, scales, rows, dtype=jnp.bfloat16,
@@ -116,6 +80,7 @@ def quantized_all_gather_fp8(x, axis_name: str, fmt: str = "e4m3"):
     qg = jax.lax.all_gather(q, axis_name, axis=0, tiled=True)
     sg = jax.lax.all_gather(s, axis_name, axis=0, tiled=True)
     return dequantize_fp8(qg, sg, dtype=x.dtype)
+# (collective shape mirrors quant.quantized_all_gather — int8 variant)
 
 
 def fp8_matmul(a, b_q, b_scales, preferred=jnp.float32):

@@ -36,60 +36,52 @@ def _auto_interpret():
     return jax.default_backend() != "tpu"
 
 
+def rowwise_pallas_op(kernel, inputs, out_shapes, block_rows: int,
+                      interpret):
+    """Shared scaffolding for per-row (last-dim-group) quantization kernels:
+    flatten [..., D] inputs to row-blocks, pad the row count to ``block_rows``,
+    run ``kernel`` over a 1-D row-block grid, unpad. ``inputs``: list of
+    [N, D_i] arrays (same N); ``out_shapes``: list of (last_dim, dtype).
+    Used by the int8 kernels here and the fp8 kernels in ``fp_quant.py``."""
+    interpret = _auto_interpret() if interpret is None else interpret
+    n = inputs[0].shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        inputs = [jnp.pad(x, ((0, pad), (0, 0))) for x in inputs]
+    rows = inputs[0].shape[0]
+    outs = pl.pallas_call(
+        kernel,
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, x.shape[1]), lambda i: (i, 0))
+                  for x in inputs],
+        out_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))
+                   for d, _ in out_shapes],
+        out_shape=[jax.ShapeDtypeStruct((rows, d), dt) for d, dt in out_shapes],
+        interpret=interpret,
+    )(*inputs)
+    return [o[:n] for o in outs]
+
+
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
 def quantize_int8(x, block_rows: int = 256, interpret: bool = None):
     """x: [..., D] -> (int8 values [..., D], fp32 scales [..., 1]) per-row."""
-    interpret = _auto_interpret() if interpret is None else interpret
     shape = x.shape
     d = shape[-1]
-    x2 = x.reshape(-1, d)
-    n = x2.shape[0]
-    pad = (-n) % block_rows
-    if pad:
-        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
-    qv, sv = pl.pallas_call(
-        _quant_kernel,
-        grid=(x2.shape[0] // block_rows,),
-        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))],
-        out_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, jnp.int8),
-            jax.ShapeDtypeStruct((x2.shape[0], 1), jnp.float32),
-        ],
-        interpret=interpret,
-    )(x2)
-    return (qv[:n].reshape(shape),
-            sv[:n].reshape(*shape[:-1], 1))
+    qv, sv = rowwise_pallas_op(
+        _quant_kernel, [x.reshape(-1, d)],
+        [(d, jnp.int8), (1, jnp.float32)], block_rows, interpret)
+    return qv.reshape(shape), sv.reshape(*shape[:-1], 1)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret", "dtype"))
 def dequantize_int8(q, scales, dtype=jnp.bfloat16, block_rows: int = 256,
                     interpret: bool = None):
-    interpret = _auto_interpret() if interpret is None else interpret
     shape = q.shape
     d = shape[-1]
-    q2 = q.reshape(-1, d)
-    s2 = scales.reshape(-1, 1)
-    n = q2.shape[0]
-    pad = (-n) % block_rows
-    if pad:
-        q2 = jnp.pad(q2, ((0, pad), (0, 0)))
-        s2 = jnp.pad(s2, ((0, pad), (0, 0)))
-    out = pl.pallas_call(
-        _dequant_kernel,
-        grid=(q2.shape[0] // block_rows,),
-        in_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q2.shape, dtype),
-        interpret=interpret,
-    )(q2, s2)
-    return out[:n].reshape(shape)
+    (out,) = rowwise_pallas_op(
+        _dequant_kernel, [q.reshape(-1, d), scales.reshape(-1, 1)],
+        [(d, dtype)], block_rows, interpret)
+    return out.reshape(shape)
 
 
 def quantized_all_gather(x, axis_name: str):
@@ -112,6 +104,11 @@ def quantized_psum_scatter(x, axis_name: str, mean: bool = False):
     ``csrc/quantization/quant_reduce.cu``): quantize locally, all-to-all the
     int8 chunks + scales (4x less wire traffic than fp32), dequantize and
     reduce on the receiver.
+
+    When N is not divisible by W the input is zero-padded, so the returned
+    shard is [(N + pad)/W, D] and the pad rows surface as trailing zero rows
+    in the LAST devices' shards — reassembling over the axis yields the padded
+    [N + pad, D] sum; slice to N if exact shape matters.
     """
     w = jax.lax.axis_size(axis_name)
     n, d = x.shape

@@ -1,0 +1,66 @@
+"""Spatial (diffusion UNet) fused ops.
+
+Reference analog: ``csrc/spatial/csrc/opt_bias_add.cu`` + ``pt_binding.cpp``
+(``nhwc_bias_add`` / ``nhwc_bias_add_add`` / ``nhwc_bias_add_bias_add`` — the
+channels-last fused bias/residual adds on the diffusion UNet hot path) and the
+diffusers attention/group-norm glue in
+``deepspeed/ops/transformer/inference/``.
+
+TPU shape: these are elementwise chains — exactly what XLA fuses into a single
+VPU pass — so the TPU-native implementation is the jnp expression under jit;
+the value of this module is the stable reference-named API (and NCHW/NHWC
+handling: TPU convolutions prefer NHWC, the reference kernels assume
+channels-last memory format of an NCHW tensor, which is the same byte layout).
+Group norm rides along since the reference fuses it in the diffusion path.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _bias_for(activations, bias, channel_axis: int):
+    shape = [1] * activations.ndim
+    shape[channel_axis] = bias.shape[0]
+    return bias.reshape(shape)
+
+
+@partial(jax.jit, static_argnames=("channel_axis",))
+def nhwc_bias_add(activations, bias, channel_axis: int = -1):
+    """activations: [B, H, W, C] (NHWC; pass channel_axis=1 for NCHW);
+    bias: [C]."""
+    return activations + _bias_for(activations, bias, channel_axis)
+
+
+@partial(jax.jit, static_argnames=("channel_axis",))
+def nhwc_bias_add_add(activations, bias, other, channel_axis: int = -1):
+    """(activations + bias) + other — residual fused in one pass."""
+    return activations + _bias_for(activations, bias, channel_axis) + other
+
+
+@partial(jax.jit, static_argnames=("channel_axis",))
+def nhwc_bias_add_bias_add(activations, bias, other, other_bias,
+                           channel_axis: int = -1):
+    """(activations + bias) + (other + other_bias)."""
+    return (activations + _bias_for(activations, bias, channel_axis)
+            + other + _bias_for(other, other_bias, channel_axis))
+
+
+@partial(jax.jit, static_argnames=("num_groups", "eps", "channel_axis"))
+def group_norm(x, scale, bias, num_groups: int = 32, eps: float = 1e-5,
+               channel_axis: int = -1):
+    """GroupNorm over NHWC activations (diffusion UNet norm; the reference
+    fuses it via its inference kernel path). scale/bias: [C]."""
+    if channel_axis != -1 and channel_axis != x.ndim - 1:
+        x = jnp.moveaxis(x, channel_axis, -1)
+        out = group_norm(x, scale, bias, num_groups, eps)
+        return jnp.moveaxis(out, -1, channel_axis)
+    c = x.shape[-1]
+    g = x.reshape(x.shape[0], -1, num_groups, c // num_groups)
+    x32 = g.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=(1, 3), keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=(1, 3), keepdims=True)
+    norm = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    norm = norm.reshape(x.shape)
+    return (norm * scale + bias).astype(x.dtype)

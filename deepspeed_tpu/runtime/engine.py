@@ -131,6 +131,15 @@ class DeepSpeedTPUEngine:
         self.model = model
         self.loss_fn = loss_fn
         self.accelerator = get_accelerator()
+        if config.debug_nans:
+            if config.fp16.enabled:
+                log_dist("debug_nans ignored with fp16: transient overflows "
+                         "are expected and handled by the loss scaler",
+                         ranks=[0])
+            else:
+                jax.config.update("jax_debug_nans", True)
+                log_dist("debug_nans: aborting at the first NaN-producing op",
+                         ranks=[0])
 
         # --- hierarchical ZeRO world (MiCS / ZeRO++ hpZ) ---------------------
         # Both split the ZeRO world into (fsdp_out x fsdp): MiCS shards within
@@ -331,10 +340,8 @@ class DeepSpeedTPUEngine:
         self.eigenvalue = None
         self.block_eigenvalues = None
         if config.eigenvalue.enabled:
-            from deepspeed_tpu.runtime.eigenvalue import (
-                Eigenvalue, EigenvalueConfig)
-            self.eigenvalue = Eigenvalue(
-                EigenvalueConfig(**config.eigenvalue.model_dump()))
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+            self.eigenvalue = Eigenvalue(config.eigenvalue)
         self.sparse_gradients_enabled = config.sparse_gradients_enabled
         if self.sparse_gradients_enabled:
             log_dist(
@@ -627,13 +634,12 @@ class DeepSpeedTPUEngine:
                 self.eigenvalue.cfg.gas_boundary_resolution, 1) == 0:
             # reference: eigenvalue at gas boundaries feeding compression MoQ
             # (engine.py quantizer hooks); results cached on the engine
-            import jax as _jax
             eval_batch = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[0]),
                                       batch)
             self.block_eigenvalues = self.eigenvalue.compute_eigenvalue(
                 lambda p: self._compute_loss(p, eval_batch,
-                                             _jax.random.PRNGKey(0)),
-                self.state.params, _jax.random.PRNGKey(self.global_steps))
+                                             jax.random.PRNGKey(0)),
+                self.state.params, jax.random.PRNGKey(self.global_steps))
         self._advance_data_schedules()
         self._record_metrics(out)
         return out.loss

@@ -1,0 +1,9 @@
+"""Pipeline parallelism (reference: deepspeed/runtime/pipe/)."""
+from deepspeed_tpu.runtime.pipe.engine import PipeModule, PipelineEngine  # noqa: F401
+from deepspeed_tpu.runtime.pipe.module import (                           # noqa: F401
+    partition_balanced, partition_uniform)
+from deepspeed_tpu.runtime.pipe.one_f_one_b import (                      # noqa: F401
+    pipeline_train_step_1f1b)
+from deepspeed_tpu.runtime.pipe.schedule import (                         # noqa: F401
+    InferenceSchedule, TrainSchedule, bubble_fraction)
+from deepspeed_tpu.runtime.pipe.spmd import pipeline_apply, stack_to_stages  # noqa: F401

@@ -1,0 +1,140 @@
+"""Pipeline training engine — the PipelineEngine.train_batch analog.
+
+Reference analog: ``deepspeed/runtime/pipe/engine.py:61`` (``PipelineEngine``:
+owns the 1F1B schedule execution, grad reduction, tied-grad reduction, and the
+optimizer step; call stack SURVEY.md §3.3).
+
+TPU shape: one jitted step = 1F1B executor (``one_f_one_b.py``, a shard_map
+over the ``pipe`` axis) + gradient clipping + optax update, with stage
+parameters sharded ``P("pipe", ...)`` (each stage's optimizer state lives with
+its layers — the reference's per-stage optimizer) and tied parameters
+replicated. The module contract mirrors ``PipelineModule``: a stacked-layer
+``block_fn`` plus the embedding/head ``first_fn``/``last_fn`` pair over tied
+parameters.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.runtime.pipe.one_f_one_b import pipeline_train_step_1f1b
+from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass
+class PipeModule:
+    """The PipelineModule analog (reference: runtime/pipe/module.py:86).
+
+    block_fn(layer_params, x) -> x      one transformer layer
+    first_fn(tied, tokens) -> x         stage-0 embedding
+    last_fn(tied, y, tokens) -> loss    last-stage head + per-microbatch loss
+    stacked_params: leaves [L, ...]     (flax nn.scan layout)
+    tied_params: pytree                 replicated, grads reduced across stages
+    """
+    block_fn: Callable
+    first_fn: Callable
+    last_fn: Callable
+    stacked_params: Any
+    tied_params: Any
+
+
+class PipelineEngine:
+    """train_batch over a PipeModule (reference PipelineEngine.train_batch,
+    engine.py:338)."""
+
+    def __init__(self, module: PipeModule, config: Optional[Dict] = None,
+                 mesh=None):
+        cfg = config or {}
+        self.module = module
+        self.mesh = mesh or mesh_lib.get_global_mesh()
+        if self.mesh is None:
+            raise ValueError("PipelineEngine needs a mesh with a 'pipe' axis")
+        self.num_stages = self.mesh.shape.get("pipe", 1)
+        self.micro_batches = int(cfg.get("gradient_accumulation_steps",
+                                         cfg.get("micro_batches", 2)))
+        opt_cfg = cfg.get("optimizer", {"type": "AdamW",
+                                        "params": {"lr": 1e-3}})
+        lr = float(opt_cfg.get("params", {}).get("lr", 1e-3))
+        wd = float(opt_cfg.get("params", {}).get("weight_decay", 0.0))
+        self.clip = float(cfg.get("gradient_clipping", 0.0))
+        self.tx = optax.adamw(lr, weight_decay=wd) \
+            if opt_cfg.get("type", "AdamW").lower() in ("adam", "adamw") \
+            else optax.sgd(lr)
+
+        # stage-sharded layout: stacked leaves [P, L/P, ...] over pipe, tied
+        # replicated (reference: per-stage parameter/optimizer ownership)
+        from deepspeed_tpu.runtime.pipe.spmd import stack_to_stages
+        staged = stack_to_stages(module.stacked_params, self.num_stages) \
+            if self.num_stages > 1 else module.stacked_params
+        self._staged_spec = jax.tree.map(
+            lambda x: NamedSharding(self.mesh, P("pipe",
+                                                 *([None] * (x.ndim - 1))))
+            if self.num_stages > 1 else NamedSharding(self.mesh, P()), staged)
+        self.staged_params = jax.device_put(staged, self._staged_spec)
+        self.tied_params = jax.device_put(
+            module.tied_params,
+            jax.tree.map(lambda x: NamedSharding(self.mesh, P()),
+                         module.tied_params))
+        self.opt_state = self.tx.init((self.staged_params, self.tied_params))
+        self.global_steps = 0
+        self._step_fn = None
+        log_dist(f"pipeline engine: {self.num_stages} stages x "
+                 f"{self.micro_batches} microbatches "
+                 f"(bubble {(self.num_stages - 1) / (self.micro_batches + self.num_stages - 1):.2f})",
+                 ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        mod = self.module
+        tx = self.tx
+        clip = self.clip
+        mesh = self.mesh
+        stages = self.num_stages
+
+        def step(staged, tied, opt_state, toks_mb):
+            if stages > 1:
+                # executor expects [L, ...] stacking; re-fold the stage dim
+                flat = jax.tree.map(
+                    lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+                    staged)
+            else:
+                flat = staged
+            loss, g_staged, g_tied = pipeline_train_step_1f1b(
+                mod.block_fn, flat, tied, toks_mb, mod.first_fn, mod.last_fn,
+                mesh=mesh)
+            if stages > 1:
+                g_staged = jax.tree.map(
+                    lambda g, p: g.reshape(p.shape), g_staged, staged)
+            grads = (g_staged, g_tied)
+            if clip:
+                gnorm = optax.global_norm(grads)
+                scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * scale, grads)
+            updates, new_opt = tx.update(grads, opt_state, (staged, tied))
+            new_staged, new_tied = optax.apply_updates((staged, tied), updates)
+            return new_staged, new_tied, new_opt, loss
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def train_batch(self, tokens) -> float:
+        """tokens: [B, S] int32 with B divisible by micro_batches (reference
+        train_batch consumes micro_batches x micro_batch_size samples)."""
+        tokens = np.asarray(tokens)
+        b, s = tokens.shape
+        m = self.micro_batches
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by micro_batches {m}")
+        toks_mb = jnp.asarray(tokens.reshape(m, b // m, s), jnp.int32)
+        if self._step_fn is None:
+            self._build_step()
+        self.staged_params, self.tied_params, self.opt_state, loss = \
+            self._step_fn(self.staged_params, self.tied_params,
+                          self.opt_state, toks_mb)
+        self.global_steps += 1
+        return float(loss)

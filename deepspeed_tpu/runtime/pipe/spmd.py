@@ -1,5 +1,11 @@
 """SPMD pipeline executor — GPipe over the ``pipe`` mesh axis inside one jit.
 
+NOTE: for TRAINING use ``one_f_one_b.pipeline_train_step_1f1b`` (driven by
+``pipe/engine.PipelineEngine``) — it bounds activation memory by pipeline depth
+and takes token inputs, avoiding this executor's replicated [M, B, S, D]
+activation input. This GPipe rotation remains the forward/inference pipeline
+and the autodiff-through-scan baseline.
+
 Reference analog: ``PipelineEngine._exec_schedule`` (``runtime/pipe/engine.py:1408``)
 + p2p send/recv (``runtime/pipe/p2p.py``). TPU redesign (SURVEY.md §7 hard-part 2):
 instead of a host-driven instruction loop with point-to-point sends, the whole

@@ -1,0 +1,61 @@
+"""Vocab-parallel cross entropy.
+
+Reference analog: ``deepspeed/sequence/cross_entropy.py``
+(``vocab_parallel_cross_entropy`` — CE over a vocab-sharded lm head without
+gathering the full logits, Megatron-style).
+
+TPU shape: inside ``shard_map`` over the ``tensor`` axis each device holds
+``logits_local [*, V/P]``; the softmax statistics compose across shards with
+two psums (max, sum-exp) and the target logit is recovered with a masked local
+lookup + psum — the full ``[*, V]`` logits never materialize, which matters
+when V is 128k+ and the sequence is long.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+
+
+def _local_vocab_ce(logits_local, labels, axis_name: str):
+    """logits_local: [N, V/P] fp32; labels: [N] global vocab ids.
+    Returns per-token loss [N] (replicated across the axis)."""
+    vp = logits_local.shape[-1]
+    rank = jax.lax.axis_index(axis_name)
+    lo = rank * vp
+
+    lmax = jax.lax.pmax(jnp.max(logits_local, axis=-1), axis_name)     # [N]
+    shifted = logits_local - lmax[..., None]
+    sumexp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
+
+    local_idx = labels - lo
+    in_shard = (local_idx >= 0) & (local_idx < vp)
+    safe_idx = jnp.clip(local_idx, 0, vp - 1)
+    tgt = jnp.take_along_axis(shifted, safe_idx[..., None], axis=-1)[..., 0]
+    tgt = jax.lax.psum(jnp.where(in_shard, tgt, 0.0), axis_name)
+
+    return jnp.log(sumexp) - tgt
+
+
+def vocab_parallel_cross_entropy(logits, labels, mesh=None,
+                                 axis_name: str = "tensor"):
+    """logits: [B, S, V] sharded on V over ``axis_name``; labels: [B, S].
+    Returns per-token loss [B, S]. Degrades to dense CE when the axis is 1."""
+    mesh = mesh or mesh_lib.get_global_mesh()
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+    def body(logits_l, labels_l):
+        b, s, vp = logits_l.shape
+        loss = _local_vocab_ce(logits_l.astype(jnp.float32).reshape(b * s, vp),
+                               labels_l.reshape(b * s), axis_name)
+        return loss.reshape(b, s)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, None, axis_name), P()),
+        out_specs=P(), check_vma=False)(logits, labels)

@@ -1,0 +1,51 @@
+"""Device/host memory reporting.
+
+Reference analog: ``deepspeed/runtime/utils.py see_memory_usage`` (allocator
+stats printed at engine milestones). TPU shape: per-device HBM stats from
+``Device.memory_stats()`` (bytes_in_use / peak / limit) + host RSS.
+"""
+
+import os
+from typing import Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_memory_stats() -> Dict[str, Dict[str, float]]:
+    import jax
+    out = {}
+    for d in jax.local_devices():
+        stats = d.memory_stats() or {}
+        out[str(d)] = {
+            "bytes_in_use_gb": stats.get("bytes_in_use", 0) / 1e9,
+            "peak_bytes_in_use_gb": stats.get("peak_bytes_in_use", 0) / 1e9,
+            "bytes_limit_gb": stats.get("bytes_limit", 0) / 1e9,
+        }
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["host"] = {"rss_gb": rss_pages * os.sysconf("SC_PAGE_SIZE") / 1e9}
+    except Exception:
+        pass
+    return out
+
+
+def see_memory_usage(message: str, force: bool = False,
+                     ranks=(0,)) -> Optional[Dict]:
+    """Log device+host memory (reference signature: see_memory_usage(msg,
+    force)). Returns the stats dict for programmatic use."""
+    import jax
+    if not force:
+        return None
+    if jax.process_index() not in ranks:
+        return None
+    stats = get_memory_stats()
+    parts = []
+    for dev, s in stats.items():
+        if dev == "host":
+            parts.append(f"host rss {s['rss_gb']:.2f}GB")
+        else:
+            parts.append(f"{dev}: {s['bytes_in_use_gb']:.2f}GB in use "
+                         f"(peak {s['peak_bytes_in_use_gb']:.2f}GB)")
+    logger.info(f"MEM {message} | " + " | ".join(parts))
+    return stats

@@ -256,3 +256,24 @@ def test_fp16_per_microbatch_overflow_detected():
     skipped_before = int(engine.state.skipped_steps)
     engine.train_batch(batch=random_batch(8, seed=0, gas=2))
     assert int(engine.state.skipped_steps) == skipped_before + 1
+
+
+def test_debug_nans_mode_aborts_on_nan():
+    """debug_nans (SURVEY §5.2 sanitizer): a NaN produced inside the compiled
+    step raises instead of propagating silently."""
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "debug_nans": True},
+        example_batch=random_batch(4))
+    try:
+        bad = random_batch(8, seed=0)
+        bad["x"] = np.asarray(bad["x"])
+        bad["x"][0, 0] = np.inf   # inf - inf / 0*inf chains produce NaN
+        bad["x"][0, 1] = -np.inf
+        with pytest.raises((FloatingPointError, Exception)) as e:
+            float(engine.train_batch(batch=bad))
+        assert "nan" in str(e.value).lower() or "NaN" in str(e.value)
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -65,6 +65,7 @@ def test_generate_reflects_training_updates():
     assert engine.generate_latency > 0 and engine.training_latency > 0
 
 
+@pytest.mark.slow
 def test_batch_generate():
     engine, _ = _hybrid_engine()
     outs = engine.generate([[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=2)

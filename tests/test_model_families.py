@@ -174,6 +174,7 @@ def test_falcon_trains_and_tp_rules():
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
 
 
+@pytest.mark.slow
 def test_opt_trains():
     model = OPTForCausalLM(TINY_OPT)
     config = {"train_batch_size": 8,

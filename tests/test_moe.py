@@ -63,6 +63,7 @@ def test_aux_loss_balanced_vs_unbalanced():
     np.testing.assert_allclose(float(aux_u), 1.0, rtol=1e-2)  # E * (1/E * 1/E) * E = 1
 
 
+@pytest.mark.slow
 def test_mixtral_forward_and_logits():
     model = MixtralForCausalLM(TINY_MIXTRAL)
     batch = random_tokens(2, 16, vocab_size=512)

@@ -125,6 +125,7 @@ def test_offload_unsupported_optimizer_raises(mesh_dp8):
         _train(cfg, steps=0, mesh=mesh_dp8)
 
 
+@pytest.mark.slow
 def test_offload_lion_and_adagrad_train(mesh_dp8):
     for opt in ("lion", "adagrad"):
         cfg = {

@@ -90,6 +90,7 @@ def test_serve_opt():
         prompt)
 
 
+@pytest.mark.slow
 def test_serve_mixtral():
     cfg = dataclasses.replace(
         TINY_MIXTRAL,
