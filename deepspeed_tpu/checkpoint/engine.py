@@ -33,8 +33,33 @@ def _ckpt_dir(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), str(tag))
 
 
+def wait_pending_checkpoint(engine) -> None:
+    """Block until a previous async save (if any) has fully committed, and
+    re-raise any error the background finalizer hit (reference: nebula async
+    checkpoint engine's commit barrier)."""
+    t = getattr(engine, "_pending_ckpt", None)
+    if t is not None:
+        t.join()
+        engine._pending_ckpt = None
+        err = getattr(engine, "_pending_ckpt_error", None)
+        if err is not None:
+            engine._pending_ckpt_error = None
+            raise RuntimeError("async checkpoint save failed") from err
+
+
 def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                           client_state: Optional[Dict[str, Any]] = None) -> str:
+                           client_state: Optional[Dict[str, Any]] = None,
+                           async_save: Optional[bool] = None) -> str:
+    """``async_save`` (default: engine config ``checkpoint.async_save``):
+    orbax fetches the arrays synchronously (so the training step may donate
+    buffers immediately after return) and persists + commits the ``latest``
+    tag from a background thread — the reference's Nebula-style async engine
+    (``runtime/checkpoint_engine/nebula_checkpoint_engine.py``)."""
+    if async_save is None:
+        async_save = bool(getattr(engine.config, "checkpoint_config",
+                                  None) and
+                          engine.config.checkpoint_config.async_save)
+    wait_pending_checkpoint(engine)          # one in flight at a time
     tag = tag if tag is not None else f"global_step{engine.global_steps}"
     path = _ckpt_dir(save_dir, tag)
     state = engine.state
@@ -58,31 +83,56 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         },
     }
     ckptr = ocp.StandardCheckpointer()
+    # orbax's save is async by design: device->host fetch happens before it
+    # returns, disk persistence + atomic rename happen in the background
     ckptr.save(path, composite, force=True)
-    ckptr.wait_until_finished()
-    ckptr.close()
 
+    # sidecar state (host optimizer moments, compression masks, step counters)
+    # mutates every train_batch — snapshot it NOW so async persistence commits
+    # a consistent point-in-time checkpoint
+    sidecars = _snapshot_sidecars(engine, client_state)
+
+    def _finalize():
+        try:
+            ckptr.wait_until_finished()
+            ckptr.close()
+            _write_sidecars_and_commit(save_dir, tag, path, sidecars)
+        except BaseException as e:           # surfaced by wait_pending_checkpoint
+            engine._pending_ckpt_error = e
+            raise
+
+    if async_save:
+        import threading
+        # non-daemon: a save in flight at interpreter exit completes instead
+        # of silently losing the run's final checkpoint
+        t = threading.Thread(target=_finalize, daemon=False,
+                             name="dstpu-async-ckpt")
+        t.start()
+        engine._pending_ckpt = t
+        log_dist(f"async checkpoint scheduled: {path}", ranks=[0])
+        return path
+    _finalize()
+    return path
+
+
+def _snapshot_sidecars(engine, client_state):
+    """Capture everything outside the orbax composite at save time."""
+    offload = getattr(engine, "_offload", None)
+    offload_sd = None
     if offload is not None:
-        # host optimizer moments, one file per process (process-local shards)
         sd = offload.state_dict()
-        np.savez(
-            os.path.join(path, f"offload_state_proc{jax.process_index()}.npz"),
-            step_count=np.int64(sd["step_count"]),
-            **{f"s_{i}_{j}": s for i, states in enumerate(sd["states"])
-               for j, s in enumerate(states)})
-
+        offload_sd = {"step_count": int(sd["step_count"]),
+                      "states": [[np.array(s, copy=True) for s in states]
+                                 for states in sd["states"]]}
     compressor = getattr(engine, "compressor", None)
-    if compressor is not None and jax.process_index() == 0:
-        # pruning masks must survive resume: refreezing from restored (or fresh
-        # random) weights would silently change the sparsity pattern
+    comp_sd = None
+    if compressor is not None:
         sd = compressor.state_dict()
-        arrays = {f"mask::{m}::{name}": arr
-                  for m, d in sd["masks"].items() for name, arr in d.items()}
-        np.savez(os.path.join(path, "compression_state.npz"),
-                 training_steps=np.int64(sd["training_steps"]),
-                 mask_frozen=np.array(json.dumps(sd["mask_frozen"])),
-                 **arrays)
-
+        comp_sd = {"training_steps": sd["training_steps"],
+                   "mask_frozen": sd["mask_frozen"],
+                   "masks": {m: {k: np.array(v, copy=True)
+                                 for k, v in d.items()}
+                             for m, d in sd["masks"].items()}}
     meta = {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
@@ -91,17 +141,46 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "mesh_shape": dict(engine.mesh.shape),
         "client_state": client_state or {},
     }
+    return {"offload": offload_sd, "compression": comp_sd, "meta": meta}
+
+
+def _write_sidecars_and_commit(save_dir, tag, path, sidecars):
+    """Persist the point-in-time sidecar snapshot + the ``latest`` tag commit
+    (the tag is the durability marker, so it is written strictly after the
+    array write)."""
+    offload_sd = sidecars["offload"]
+    if offload_sd is not None:
+        # host optimizer moments, one file per process (process-local shards)
+        np.savez(
+            os.path.join(path, f"offload_state_proc{jax.process_index()}.npz"),
+            step_count=np.int64(offload_sd["step_count"]),
+            **{f"s_{i}_{j}": s
+               for i, states in enumerate(offload_sd["states"])
+               for j, s in enumerate(states)})
+
+    comp_sd = sidecars["compression"]
+    if comp_sd is not None and jax.process_index() == 0:
+        # pruning masks must survive resume: refreezing from restored (or fresh
+        # random) weights would silently change the sparsity pattern
+        arrays = {f"mask::{m}::{name}": arr
+                  for m, d in comp_sd["masks"].items()
+                  for name, arr in d.items()}
+        np.savez(os.path.join(path, "compression_state.npz"),
+                 training_steps=np.int64(comp_sd["training_steps"]),
+                 mask_frozen=np.array(json.dumps(comp_sd["mask_frozen"])),
+                 **arrays)
+
     if jax.process_index() == 0:
         with open(os.path.join(path, "ds_meta.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
+            json.dump(sidecars["meta"], f, indent=2, default=str)
         with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
             f.write(tag)
     log_dist(f"saved checkpoint {path}", ranks=[0])
-    return path
 
 
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            load_optimizer_states: bool = True):
+    wait_pending_checkpoint(engine)      # an in-flight async save must commit
     load_dir = os.path.abspath(load_dir)
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)

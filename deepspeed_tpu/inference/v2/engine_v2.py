@@ -82,10 +82,10 @@ class InferenceEngineV2:
         self._pending_logits: Dict[int, np.ndarray] = {}
         # persistent device-side decode tables: in steady-state decode the
         # block tables only change when a sequence crosses a block boundary,
-        # so the [B, MB] table upload is skipped while the allocation signature
-        # (uids + per-seq block counts + bucket) is unchanged (addresses the
-        # per-step host re-pad/re-upload cost; tokens/positions are [B] ints
-        # and always refresh)
+        # so the [B, MB] table upload is skipped while the allocation
+        # signature (bucket shape + every sequence's block-id list) is
+        # unchanged (addresses the per-step host re-pad/re-upload cost;
+        # tokens/positions are [B] ints and always refresh)
         self._table_sig = None
         self._dev_tables = None
 
@@ -193,8 +193,9 @@ class InferenceEngineV2:
                     seq.prompt_tokens[-1]
                 positions[j] = seq.total_tokens - 1
                 valid[j] = True
-            sig = (b, mb, tuple(s.uid for s in seqs),
-                   tuple(len(s.blocks) for s in seqs))
+            # signature covers the actual block ids: uid reuse after flush()
+            # can hand a same-shaped batch different pages
+            sig = (b, mb, tuple(tuple(s.blocks) for s in seqs))
             if sig != self._table_sig:
                 tables = np.full((b, mb), self.kv.cfg.num_blocks - 1, np.int32)
                 for j, seq in enumerate(seqs):

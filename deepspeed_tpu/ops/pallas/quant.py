@@ -141,3 +141,18 @@ def all_to_all_quant_reduce(x, axis_name: str, outer_axis_name=None,
     if outer_axis_name is not None:
         y = quantized_psum_scatter(y, outer_axis_name, mean=mean)
     return y
+
+
+def quantized_all_to_all(x, axis_name: str, split_axis: int = 0,
+                         concat_axis: int = 0):
+    """MoE-dispatch collective with int8 wire format (cf. EQuARX): quantize
+    per-row groups, all-to-all codes + scales, dequantize on the receiver —
+    4x less ICI traffic than fp32 expert dispatch for the same top-k routing.
+    x: [..., D] with the split axis divisible by the axis size. Usable inside
+    shard_map."""
+    q, s = quantize_int8(x)
+    qx = jax.lax.all_to_all(q, axis_name, split_axis=split_axis,
+                            concat_axis=concat_axis, tiled=True)
+    sx = jax.lax.all_to_all(s, axis_name, split_axis=split_axis,
+                            concat_axis=concat_axis, tiled=True)
+    return dequantize_int8(qx, sx, dtype=x.dtype)

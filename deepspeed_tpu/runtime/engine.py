@@ -137,9 +137,16 @@ class DeepSpeedTPUEngine:
                          "are expected and handled by the loss scaler",
                          ranks=[0])
             else:
+                # NOTE: jax_debug_nans is process-global by construction
                 jax.config.update("jax_debug_nans", True)
-                log_dist("debug_nans: aborting at the first NaN-producing op",
-                         ranks=[0])
+                log_dist("debug_nans: aborting at the first NaN-producing op "
+                         "(process-global jax flag)", ranks=[0])
+        elif config.fp16.enabled and jax.config.jax_debug_nans:
+            # another engine in this process enabled the global flag; fp16
+            # training NEEDS transient non-finites for its overflow skip
+            jax.config.update("jax_debug_nans", False)
+            log_dist("debug_nans disabled: fp16 loss scaling relies on "
+                     "transient inf/NaN gradients", ranks=[0])
 
         # --- hierarchical ZeRO world (MiCS / ZeRO++ hpZ) ---------------------
         # Both split the ZeRO world into (fsdp_out x fsdp): MiCS shards within
@@ -722,6 +729,17 @@ class DeepSpeedTPUEngine:
                 loss_scale=new_scale)
         self._record_metrics(StepOutput(loss=loss, grad_norm=norm,
                                         lr=jnp.float32(lr), overflow=overflow))
+
+    def start_profile_trace(self, log_dir: str) -> None:
+        """Start an XLA/TPU profiler trace (reference: NVTX ranges + torch
+        profiler hooks; here jax.profiler writes a TensorBoard-viewable trace
+        with the engine's named timer scopes)."""
+        jax.profiler.start_trace(log_dir)
+        log_dist(f"profiler trace started -> {log_dir}", ranks=[0])
+
+    def stop_profile_trace(self) -> None:
+        jax.profiler.stop_trace()
+        log_dist("profiler trace stopped", ranks=[0])
 
     def _run_flops_profile(self, stacked_batch):
         """Profile the forward pass at ``profile_step`` (reference: engine.py:1850

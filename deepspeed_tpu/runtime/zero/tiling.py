@@ -53,7 +53,7 @@ class TiledLinear(nn.Module):
 
         acc0 = jnp.zeros((*x.shape[:-1], self.out_splits, to), self.dtype)
         xt_scan = jnp.moveaxis(xt, -2, 0)          # [in_splits, ..., ti]
-        (acc, _) = jax.lax.scan(in_tile, acc0, (kernel, xt_scan))[0], None
+        acc, _ = jax.lax.scan(in_tile, acc0, (kernel, xt_scan))
         y = acc.reshape(*x.shape[:-1], self.features)
         if bias is not None:
             y = y + bias.astype(self.dtype)

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm import mesh as mesh_lib
-from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_auto
 
 
 
@@ -40,7 +40,7 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
     mesh = mesh or mesh_lib.get_global_mesh()
     sp = mesh.shape["sequence"]
     if sp == 1:
-        return flash_attention(q, k, v, causal=causal) if use_flash else \
+        return flash_attention_auto(q, k, v, causal=causal) if use_flash else \
             _local_attn(q, k, v, causal)
 
     tp = max(mesh.shape["tensor"], 1)
@@ -65,7 +65,8 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
         a2a = partial(jax.lax.all_to_all, axis_name="sequence",
                       split_axis=2, concat_axis=1, tiled=True)
         qg, kg, vg = a2a(q_l), a2a(k_l), a2a(v_l)
-        out = flash_attention(qg, kg, vg, causal=causal) if use_flash else \
+        # Pallas kernel on TPU (runs inside the shard_map), lax elsewhere
+        out = flash_attention_auto(qg, kg, vg, causal=causal) if use_flash else \
             _local_attn(qg, kg, vg, causal)
         # inverse: scatter sequence / gather heads
         out = jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,

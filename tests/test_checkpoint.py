@@ -85,3 +85,31 @@ def test_latest_tag_protocol(tmp_path, mesh_dp8):
     e2 = _make(dict(CFG), mesh_dp8, seed=3)
     path, _ = e2.load_checkpoint(str(tmp_path))
     assert path.endswith("step_b")
+
+
+def test_async_save_commits_latest_after_wait(tmp_path):
+    """async_save: save returns immediately; the latest tag is committed by
+    the background finalizer; a fresh engine loads the result (reference:
+    nebula async checkpoint engine)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.checkpoint.engine import wait_pending_checkpoint
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "checkpoint": {"async_save": True}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=config,
+        example_batch=random_batch(4))
+    engine.train_batch(batch=random_batch(8, seed=0))
+    engine.save_checkpoint(str(tmp_path))
+    wait_pending_checkpoint(engine)
+    assert (tmp_path / "latest").exists()
+
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=config,
+        example_batch=random_batch(4))
+    engine2.load_checkpoint(str(tmp_path))
+    a = jax.tree.leaves(engine.state.params)[0]
+    b = jax.tree.leaves(engine2.state.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)

@@ -129,3 +129,19 @@ def test_engine_profiles_at_step():
     engine.train_batch(batch=random_batch(8))  # profiles at step 1
     assert hasattr(engine, "flops_profiler")
     assert engine.flops_profiler.get_total_flops() > 0
+
+
+def test_engine_profile_trace(tmp_path):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}},
+        example_batch=random_batch(4))
+    engine.start_profile_trace(str(tmp_path))
+    engine.train_batch(batch=random_batch(8, seed=0))
+    engine.stop_profile_trace()
+    import os
+    found = [f for _, _, fs in os.walk(tmp_path) for f in fs]
+    assert found, "no trace files written"
