@@ -41,6 +41,9 @@ def main():
         num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=seq_len,
         dtype=jnp.bfloat16,
         attention_backend=os.environ.get("DSTPU_BENCH_ATTN", "flash"),
+        # chunked head+CE fusion: the fp32 [B*S,V] logits (1GB at mb=4) never
+        # materialize, freeing ~3GB of HLO temps (enables micro_batch 4)
+        loss_chunk_size=int(os.environ.get("DSTPU_BENCH_LOSS_CHUNK", 2048)) or None,
         remat=os.environ.get("DSTPU_BENCH_REMAT", "1") == "1",
         remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
                                     "dots_with_no_batch_dims_saveable"))

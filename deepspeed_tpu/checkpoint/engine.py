@@ -97,8 +97,9 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             ckptr.wait_until_finished()
             ckptr.close()
             _write_sidecars_and_commit(save_dir, tag, path, sidecars)
-        except BaseException as e:           # surfaced by wait_pending_checkpoint
-            engine._pending_ckpt_error = e
+        except BaseException as e:
+            if async_save:                   # surfaced by wait_pending_checkpoint
+                engine._pending_ckpt_error = e
             raise
 
     if async_save:
@@ -126,7 +127,8 @@ def _snapshot_sidecars(engine, client_state):
                                  for states in sd["states"]]}
     compressor = getattr(engine, "compressor", None)
     comp_sd = None
-    if compressor is not None:
+    # only process 0 writes sidecars — don't copy masks anywhere else
+    if compressor is not None and jax.process_index() == 0:
         sd = compressor.state_dict()
         comp_sd = {"training_steps": sd["training_steps"],
                    "mask_frozen": sd["mask_frozen"],

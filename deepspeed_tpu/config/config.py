@@ -11,7 +11,7 @@ host-memory tier; mesh describes the named-axis device mesh).
 
 import json
 import os
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from pydantic import Field, model_validator
 
@@ -143,6 +143,8 @@ class ActivationCheckpointingConfig(DeepSpeedTPUConfigModel):
     profile: bool = False
     # TPU-native: name of the remat policy (see runtime/activation_checkpointing.py)
     policy: str = "nothing_saveable"
+    # values tagged via checkpoint_name() that named save/offload policies act on
+    saved_names: List[str] = ["block_out", "attn_out", "mlp_out"]
 
 
 class FlopsProfilerConfig(DeepSpeedTPUConfigModel):
@@ -179,6 +181,19 @@ class WandbConfig(DeepSpeedTPUConfigModel):
     group: Optional[str] = None
     team: Optional[str] = None
     project: str = "deepspeed_tpu"
+
+
+class CometConfig(DeepSpeedTPUConfigModel):
+    """reference: monitor/config.py CometConfig (monitor/comet.py)."""
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
 
 
 class CheckpointConfig(DeepSpeedTPUConfigModel):
@@ -284,6 +299,7 @@ class DeepSpeedTPUConfig:
         self.tensorboard = TensorBoardConfig(**self._raw.get(C.MONITOR_TENSORBOARD, {}))
         self.csv_monitor = CSVConfig(**self._raw.get(C.MONITOR_CSV, {}))
         self.wandb = WandbConfig(**self._raw.get(C.MONITOR_WANDB, {}))
+        self.comet = CometConfig(**self._raw.get(C.MONITOR_COMET, {}))
         self.checkpoint_config = CheckpointConfig(**self._raw.get(C.CHECKPOINT, {}))
         self.elasticity = ElasticityConfig(**self._raw.get(C.ELASTICITY, {}))
         self.curriculum_learning_legacy = CurriculumLegacyConfig(

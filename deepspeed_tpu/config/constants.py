@@ -26,6 +26,7 @@ FLOPS_PROFILER = "flops_profiler"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_CSV = "csv_monitor"
 MONITOR_WANDB = "wandb"
+MONITOR_COMET = "comet"
 COMMS_LOGGER = "comms_logger"
 DATA_EFFICIENCY = "data_efficiency"
 CURRICULUM_LEARNING = "curriculum_learning"

@@ -1,0 +1,102 @@
+"""NVMe/SSD tuning sweep — find the (threads, block size) that saturates disk.
+
+Reference analog: ``bin/ds_nvme_tune`` + ``deepspeed/nvme/`` (1283 LoC:
+``sweep_main`` runs a grid over queue depth / block size / submit mode /
+io-parallelism and writes the winning config for ``aio`` JSON blocks).
+
+TPU redesign: the swap engine (``ops/csrc/aio.cpp``) is a pread/pwrite thread
+pool, so the tunables are worker threads x request block size; large transfers
+are split into block-sized sub-requests at different file offsets so all
+workers pull concurrently (the same role as the reference's queue-depth x
+block-size grid for libaio). The winner is printed as the ``"aio"`` config
+block the offload tier consumes.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(description="NVMe tuning sweep (ds_nvme_tune analog)")
+    p.add_argument("--nvme_dir", "--path", dest="nvme_dir", default=None,
+                   help="directory on the device under test (default: tmp)")
+    p.add_argument("--size_mb", type=int, default=512)
+    p.add_argument("--threads", type=int, nargs="+", default=[1, 2, 4, 8, 16])
+    p.add_argument("--block_mb", type=int, nargs="+", default=[1, 4, 16, 64])
+    p.add_argument("--trials", type=int, default=2)
+    p.add_argument("--out", default=None, help="write winning config JSON here")
+    return p.parse_args(args)
+
+
+def _run_chunked(handle, arr, path, block_bytes, write: bool) -> float:
+    """Submit |arr| as block-sized sub-requests at increasing offsets; return
+    seconds to drain them all."""
+    n = arr.nbytes
+    t0 = time.perf_counter()
+    reqs = []
+    for off in range(0, n, block_bytes):
+        chunk = arr[off:off + block_bytes]
+        reqs.append(handle.async_pwrite(chunk, path, offset=off) if write
+                    else handle.async_pread(chunk, path, offset=off))
+    failed = sum(handle.wait(r) for r in reqs)
+    if failed:
+        raise IOError(f"{failed}/{len(reqs)} aio requests failed on {path} "
+                      f"({'write' if write else 'read'}, block={block_bytes})")
+    return time.perf_counter() - t0
+
+
+def sweep(nvme_dir=None, size_mb=512, threads=(1, 4, 8), block_mb=(1, 16),
+          trials=2):
+    from deepspeed_tpu.ops.async_io import AsyncIOHandle
+
+    nvme_dir = nvme_dir or tempfile.gettempdir()
+    nbytes = size_mb << 20
+    data = np.random.randint(0, 255, size=nbytes, dtype=np.uint8)
+    dst = np.empty(nbytes, dtype=np.uint8)
+    fname = os.path.join(nvme_dir, f"dstpu_nvme_tune_{os.getpid()}.bin")
+    results = []
+    try:
+        for t in threads:
+            handle = AsyncIOHandle(num_threads=t)
+            for b in block_mb:
+                bb = min(b << 20, nbytes)
+                w = min(_run_chunked(handle, data, fname, bb, write=True)
+                        for _ in range(trials))
+                r = min(_run_chunked(handle, dst, fname, bb, write=False)
+                        for _ in range(trials))
+                results.append({
+                    "threads": t, "block_mb": b,
+                    "write_gbps": round(nbytes / w / 1e9, 3),
+                    "read_gbps": round(nbytes / r / 1e9, 3),
+                })
+    finally:
+        if os.path.exists(fname):
+            os.unlink(fname)
+    return results
+
+
+def main(args=None):
+    a = parse_args(args)
+    results = sweep(a.nvme_dir, a.size_mb, a.threads, a.block_mb, a.trials)
+    for row in results:
+        print(json.dumps(row))
+    best = max(results, key=lambda r: r["read_gbps"] + r["write_gbps"])
+    config = {"aio": {
+        "thread_count": best["threads"],
+        "block_size": best["block_mb"] << 20,
+        "single_submit": False, "overlap_events": True,
+        "measured_read_gbps": best["read_gbps"],
+        "measured_write_gbps": best["write_gbps"],
+    }}
+    print(json.dumps(config))
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(config, f, indent=2)
+        print(f"wrote {a.out}", file=sys.stderr)
+    return 0

@@ -1,0 +1,70 @@
+"""Parallel ssh fanout over a hostfile.
+
+Reference analog: ``bin/ds_ssh`` — reads the DLTS hostfile and runs the given
+command on every host (pdsh-style), used for cluster-wide setup/inspection.
+Here: threads + ``subprocess ssh`` with per-host prefixed output, the same
+hostfile grammar as the launcher (``launcher/runner.py:fetch_hostfile``).
+"""
+
+import argparse
+import subprocess
+import sys
+import threading
+
+from deepspeed_tpu.launcher.runner import DLTS_HOSTFILE, fetch_hostfile
+
+SSH_OPTS = ["-o", "StrictHostKeyChecking=no", "-o", "PasswordAuthentication=no"]
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(
+        description="run a command on every hostfile host (ds_ssh analog)")
+    p.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE)
+    p.add_argument("--ssh_port", type=int, default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run remotely")
+    return p.parse_args(args)
+
+
+def run_on_host(host: str, command, port=None, runner=subprocess.run):
+    cmd = ["ssh"] + SSH_OPTS + (["-p", str(port)] if port else []) + \
+        [host, " ".join(command)]
+    proc = runner(cmd, capture_output=True, text=True)
+    return host, proc.returncode, proc.stdout, proc.stderr
+
+
+def fanout(hosts, command, port=None, runner=subprocess.run):
+    results = {}
+    lock = threading.Lock()
+
+    def work(h):
+        host, rc, out, err = run_on_host(h, command, port, runner)
+        with lock:
+            results[host] = (rc, out, err)
+
+    threads = [threading.Thread(target=work, args=(h,)) for h in hosts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def main(args=None):
+    a = parse_args(args)
+    if not a.command:
+        print("usage: dstpu_ssh [-H hostfile] <command...>", file=sys.stderr)
+        return 2
+    pool = fetch_hostfile(a.hostfile)
+    hosts = list(pool) or ["localhost"]
+    results = fanout(hosts, a.command, a.ssh_port)
+    worst = 0
+    for host in hosts:
+        rc, out, err = results[host]
+        if rc != 0 and worst == 0:
+            worst = rc if 0 < rc < 256 else 1  # signal-killed ssh: rc<0 -> 1
+        for line in (out or "").splitlines():
+            print(f"{host}: {line}")
+        for line in (err or "").splitlines():
+            print(f"{host}: {line}", file=sys.stderr)
+    return worst

@@ -53,6 +53,10 @@ class LlamaConfig:
     remat_policy: str = "nothing_saveable"
     scan_layers: bool = False
     logits_soft_cap: Optional[float] = None
+    # fuse the lm-head matmul with softmax-CE per token-chunk so the fp32
+    # [B*S, V] logits tensor never materializes (see
+    # sequence/cross_entropy.py:chunked_cross_entropy). None = dense loss.
+    loss_chunk_size: Optional[int] = None
     # llama-family arch knobs (mistral/qwen2/phi3 are llama variants):
     attention_bias: bool = False          # qwen2: bias on q/k/v projections
     sliding_window: Optional[int] = None  # mistral: attend to last W tokens only
@@ -248,12 +252,32 @@ REMAT_POLICIES = {
 }
 
 
+class LMHead(nn.Module):
+    """Unembedding projection with the kernel exposed as an attribute so the
+    chunked-loss path can scan over it (same param path/init as the nn.Dense it
+    replaces: ``lm_head/kernel``, fp32 master, lecun-normal)."""
+    hidden_size: int
+    vocab_size: int
+    dtype: Any = jnp.bfloat16
+
+    def setup(self):
+        self.kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                                 (self.hidden_size, self.vocab_size), jnp.float32)
+
+    def __call__(self, x):
+        return jnp.dot(x.astype(self.dtype), self.kernel.astype(self.dtype))
+
+
 class LlamaModel(nn.Module):
-    """Backbone: embed -> N blocks -> final norm. Call with token ids [B, S]."""
+    """Backbone: embed -> N blocks -> final norm. Call with token ids [B, S].
+    ``return_hidden=True`` skips the unembed matmul and returns
+    ``(hidden [B,S,H], head weights)`` for the chunked-CE loss path (head
+    weights are ``embedding [V,H]`` when tied, else ``kernel [H,V]``)."""
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, segment_ids=None):
+    def __call__(self, input_ids, positions=None, segment_ids=None,
+                 return_hidden=False):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
@@ -285,10 +309,15 @@ class LlamaModel(nn.Module):
         # head matmul in compute dtype (bf16 on the MXU, fp32 accumulation);
         # downstream softmax casts to fp32 — an fp32 head matmul is ~8x slower
         if cfg.tie_embeddings:
+            if return_hidden:
+                return x, embed.embedding
             logits = embed.attend(x)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                              param_dtype=jnp.float32, name="lm_head")(x)
+            head = LMHead(cfg.hidden_size, cfg.vocab_size, cfg.dtype,
+                          name="lm_head")
+            if return_hidden:
+                return x, head.kernel
+            logits = head(x)
         logits = logits.astype(jnp.float32)
         if cfg.logits_soft_cap:
             logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
@@ -305,6 +334,8 @@ class LlamaForCausalLM(nn.Module):
 
     def __call__(self, batch):
         input_ids = batch["input_ids"]
+        if self.cfg.loss_chunk_size:
+            return self._chunked_loss(batch)
         logits = self.model(input_ids,
                             positions=batch.get("positions"),
                             segment_ids=batch.get("segment_ids"))
@@ -320,6 +351,32 @@ class LlamaForCausalLM(nn.Module):
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         mask = mask.astype(jnp.float32)
         return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def _chunked_loss(self, batch):
+        """Same loss as the dense path, via chunked head-matmul + CE fusion.
+        Labels/mask are aligned to all S positions (last position masked out in
+        the next-token case) so chunk shapes stay static."""
+        from deepspeed_tpu.sequence.cross_entropy import chunked_cross_entropy
+
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)))
+            mask = batch.get("loss_mask")
+            mask = mask[:, 1:] if mask is not None else \
+                jnp.ones_like(input_ids[:, 1:])
+            mask = jnp.pad(mask, ((0, 0), (0, 1)))
+        else:
+            mask = batch.get("loss_mask", jnp.ones_like(labels))
+        hidden, head = self.model(input_ids,
+                                  positions=batch.get("positions"),
+                                  segment_ids=batch.get("segment_ids"),
+                                  return_hidden=True)
+        kw = {"embedding": head} if self.cfg.tie_embeddings else {"kernel": head}
+        return chunked_cross_entropy(
+            hidden, labels, mask, chunk_size=self.cfg.loss_chunk_size,
+            soft_cap=self.cfg.logits_soft_cap, compute_dtype=self.cfg.dtype,
+            **kw)
 
     def logits(self, batch):
         return self.model(batch["input_ids"], positions=batch.get("positions"),

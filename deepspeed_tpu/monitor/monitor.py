@@ -93,6 +93,42 @@ class WandbMonitor(Monitor):
             self._wandb.log({tag: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """reference: monitor/comet.py (CometMonitor — rank-0 comet_ml experiment,
+    metrics logged at samples_log_interval)."""
+
+    def __init__(self, comet_config):
+        self.enabled = False
+        if not (comet_config.enabled and jax.process_index() == 0):
+            return
+        try:
+            import comet_ml
+            self._experiment = comet_ml.start(
+                api_key=comet_config.api_key,
+                project=comet_config.project,
+                workspace=comet_config.workspace,
+                experiment_key=comet_config.experiment_key,
+                mode=comet_config.mode,
+                online=comet_config.online)
+            if comet_config.experiment_name:
+                self._experiment.set_name(comet_config.experiment_name)
+            self._interval = max(1, comet_config.samples_log_interval)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"comet_ml unavailable: {e}")
+
+    @property
+    def experiment(self):
+        return self._experiment
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            if step % self._interval == 0:
+                self._experiment.log_metric(tag, value, step=step)
+
+
 class MonitorMaster(Monitor):
     """reference: monitor/monitor.py:30."""
 
@@ -101,6 +137,7 @@ class MonitorMaster(Monitor):
             CSVMonitor(config.csv_monitor),
             TensorBoardMonitor(config.tensorboard),
             WandbMonitor(config.wandb),
+            CometMonitor(config.comet),
         ]
         self.enabled = any(b.enabled for b in self.backends)
 

@@ -142,11 +142,13 @@ class DeepSpeedTPUEngine:
                 log_dist("debug_nans: aborting at the first NaN-producing op "
                          "(process-global jax flag)", ranks=[0])
         elif config.fp16.enabled and jax.config.jax_debug_nans:
-            # another engine in this process enabled the global flag; fp16
-            # training NEEDS transient non-finites for its overflow skip
-            jax.config.update("jax_debug_nans", False)
-            log_dist("debug_nans disabled: fp16 loss scaling relies on "
-                     "transient inf/NaN gradients", ranks=[0])
+            # another engine in this process owns the global flag — don't
+            # silently revoke its NaN protection; fp16 loss scaling here WILL
+            # trip it on expected transient overflows, so the user must pick one
+            log_dist("WARNING: jax_debug_nans is enabled process-globally by "
+                     "another engine; this fp16 engine's overflow-skip "
+                     "produces transient inf/NaN that will abort under it. "
+                     "Disable debug_nans or fp16.", ranks=[0])
 
         # --- hierarchical ZeRO world (MiCS / ZeRO++ hpZ) ---------------------
         # Both split the ZeRO world into (fsdp_out x fsdp): MiCS shards within

@@ -147,9 +147,16 @@ def build_param_shardings(params: Any, mesh: Mesh, stage: int,
     fsdp_axes, fsdp_size = zero_fsdp_axes(mesh, mics=mics)
     axis_sizes = dict(mesh.shape)
 
+    from deepspeed_tpu.utils.tree import tree_path_str
+    from deepspeed_tpu.utils.z3_leaf_module import is_z3_leaf_path
+
     def leaf_spec(path, leaf):
         tspec = tensor_rules(path, leaf) if tensor_rules else None
-        return param_partition_spec(np.shape(leaf), stage, fsdp_size, tensor_spec=tspec,
+        path_s = tree_path_str(path)
+        # z3 leaf modules: subtree opted out of fsdp sharding (TP still applies)
+        leaf_stage = 0 if is_z3_leaf_path(path_s) else stage
+        return param_partition_spec(np.shape(leaf), leaf_stage, fsdp_size,
+                                    tensor_spec=tspec,
                                     min_shard_size=min_shard_size,
                                     axis_sizes=axis_sizes, fsdp_axes=fsdp_axes)
 

@@ -59,3 +59,56 @@ def vocab_parallel_cross_entropy(logits, labels, mesh=None,
         body, mesh=mesh,
         in_specs=(P(None, None, axis_name), P()),
         out_specs=P(), check_vma=False)(logits, labels)
+
+
+def chunked_cross_entropy(hidden, labels, mask, *, kernel=None, embedding=None,
+                          chunk_size: int = 1024,
+                          soft_cap=None, compute_dtype=jnp.bfloat16):
+    """Next-token CE from *hidden states* without materializing [B*S, V] fp32.
+
+    The reference computes full logits and feeds them to torch CE (its fused
+    vocab kernel lives in Megatron, not DeepSpeed); on TPU the fp32 logits tensor
+    is the single largest HBM temp of a training step (B*S*V*4 bytes — 1 GB at
+    B=4, S=2k, V=32k), and it is written + re-read across the fwd/bwd boundary.
+    Here the head matmul and the softmax-CE reduction run fused per token-chunk
+    under ``jax.checkpoint`` inside a ``lax.scan``: peak logits memory drops to
+    ``chunk_size * V`` and the backward recomputes each chunk's logits instead
+    of fetching them from HBM (one extra head matmul — ~3% of model FLOPs for
+    a 0.7B Llama — traded for ~3 GB of temps).
+
+    hidden: [B, S, H]; labels/mask: [B, S]; exactly one of
+    ``kernel`` [H, V] / ``embedding`` [V, H] (tied) supplies the head weights.
+    Returns mean CE over masked tokens (same contract as the dense path).
+    """
+    if (kernel is None) == (embedding is None):
+        raise ValueError("pass exactly one of kernel / embedding")
+    b, s, h = hidden.shape
+    n = b * s
+    c = min(chunk_size, n)
+    pad = (-n) % c
+    xf = hidden.reshape(n, h)
+    lf = labels.reshape(n).astype(jnp.int32)
+    mf = mask.reshape(n).astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        lf = jnp.pad(lf, (0, pad))
+        mf = jnp.pad(mf, (0, pad))
+    nc = (n + pad) // c
+    w = (kernel if kernel is not None else embedding).astype(compute_dtype)
+    contract = "ch,hv->cv" if kernel is not None else "ch,vh->cv"
+
+    def body(total, inp):
+        xc, lc, mc = inp
+        logits = jnp.einsum(contract, xc.astype(compute_dtype), w,
+                            preferred_element_type=jnp.float32)
+        if soft_cap:
+            logits = soft_cap * jnp.tanh(logits / soft_cap)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+        return total + jnp.sum((lse - tgt) * mc), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body),
+        jnp.zeros((), jnp.float32),
+        (xf.reshape(nc, c, h), lf.reshape(nc, c), mf.reshape(nc, c)))
+    return total / jnp.maximum(jnp.sum(mf), 1.0)

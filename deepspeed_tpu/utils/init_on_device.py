@@ -1,0 +1,76 @@
+"""Abstract ("meta"-device) model construction.
+
+Reference analog: ``deepspeed/utils/init_on_device.py`` (``OnDevice`` context:
+patches ``Tensor.__new__``/module ``__init__`` so a model builds with
+meta-device tensors — no host/device memory — until real weights arrive), used
+by ZeRO-3's ``zero.Init`` to construct >RAM models.
+
+TPU redesign: flax modules are already lazy — parameters exist only when
+``init`` runs — so the meta-device trick reduces to two first-class functions:
+
+- ``abstract_init``: ``jax.eval_shape`` over ``model.init`` — the full param
+  pytree as ShapeDtypeStructs, zero bytes allocated. This is what the engine
+  uses to plan shardings before any weight exists.
+- ``sharded_init``: jit ``model.init`` with ``out_shardings`` from the ZeRO
+  partitioner so every parameter materializes *directly into its shard* —
+  no rank ever holds a full replica (the actual ``zero.Init`` semantic:
+  reference ``runtime/zero/partition_parameters.py:816``).
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.runtime.zero.partition import build_param_shardings
+
+
+def abstract_init(model, rng, *args, method: Optional[Callable] = None,
+                  **kwargs) -> Any:
+    """Shape/dtype pytree of ``model.init(rng, *args)`` with no allocation."""
+    return jax.eval_shape(
+        lambda r: model.init(r, *args, method=method, **kwargs)
+        if method else model.init(r, *args, **kwargs), rng)
+
+
+def sharded_init(model, rng, *args, mesh, stage: int = 3,
+                 tensor_rules: Optional[Callable] = None, **kwargs) -> Any:
+    """Initialize directly into ZeRO-``stage`` shards over ``mesh``.
+
+    Returns ``(variables, shardings)``: every leaf of ``variables`` is born
+    sharded per the partitioner — construction memory per device is
+    ``params / fsdp_size``, the zero.Init contract."""
+    shapes = abstract_init(model, rng, *args, **kwargs)
+    params = shapes.get("params", shapes) if isinstance(shapes, dict) else shapes
+    shardings = build_param_shardings(params, mesh, stage=stage,
+                                      tensor_rules=tensor_rules)
+    out_sh = dict(shapes, params=shardings) if isinstance(shapes, dict) and \
+        "params" in shapes else shardings
+    # non-param collections (batch_stats, cache...) default to replicated
+    out_sh = jax.tree.map(
+        lambda s: s if hasattr(s, "spec") else None, out_sh,
+        is_leaf=lambda x: hasattr(x, "spec") or x is None)
+    with mesh:
+        variables = jax.jit(
+            lambda r: model.init(r, *args, **kwargs),
+            out_shardings=out_sh)(rng)
+    return variables, shardings
+
+
+class OnDevice:
+    """Context-manager shim with the reference's spelling. flax needs no
+    patching, so this only records the requested dtype/device and offers
+    ``abstract_init``/``sharded_init`` bound to them."""
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    abstract_init = staticmethod(abstract_init)
+    sharded_init = staticmethod(sharded_init)

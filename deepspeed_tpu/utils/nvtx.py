@@ -1,0 +1,54 @@
+"""Profiler range annotation.
+
+Reference analog: ``deepspeed/utils/nvtx.py`` (``instrument_w_nvtx`` pushes an
+NVTX range via ``get_accelerator().range_push/pop`` around hot functions, e.g.
+every ZeRO-3 coordinator method).
+
+TPU redesign: ranges are ``jax.named_scope`` (names land in the HLO and show up
+in XLA/TPU profiler traces under the op hierarchy) plus
+``jax.profiler.TraceAnnotation`` for host-side spans (visible in perfetto
+traces captured by ``jax.profiler.trace``). One decorator serves both: inside
+jit the named_scope tags the emitted ops; outside it the TraceAnnotation times
+the Python call.
+"""
+
+import functools
+
+import jax
+
+
+def instrument(fn=None, *, name: str = None):
+    """Decorator: wrap ``fn`` in a profiler range named after it (reference
+    ``instrument_w_nvtx``). Usable bare (``@instrument``) or with a name
+    (``@instrument(name="fetch")``)."""
+    if fn is None:
+        return functools.partial(instrument, name=name)
+    label = name or getattr(fn, "__qualname__", getattr(fn, "__name__", "fn"))
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+# reference-name alias so call sites read the same
+instrument_w_nvtx = instrument
+
+
+def range_push(name: str):
+    """Manual range begin (reference accelerator.range_push). Returns a context
+    object; prefer ``with annotate(name):``."""
+    ctx = jax.profiler.TraceAnnotation(name)
+    ctx.__enter__()
+    return ctx
+
+
+def range_pop(ctx) -> None:
+    ctx.__exit__(None, None, None)
+
+
+def annotate(name: str):
+    """``with annotate("step"): ...`` — host-side profiler span."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,98 @@
+"""Debug access to full fp32 params / optimizer state under any sharding.
+
+Reference analog: ``deepspeed/utils/tensor_fragment.py`` — maps each rank's
+low-precision fragment to its slice of the fp32 master flat buffer so user code
+can call ``safe_get_full_fp32_param`` / ``safe_get_full_optimizer_state`` /
+``safe_set_full_fp32_param`` regardless of ZeRO stage (the fragment bookkeeping
+is also what universal checkpointing rides on).
+
+TPU redesign: there are no fragments to map — ``engine.state.params`` leaves
+are *global* ``jax.Array``\\ s whose shards live across the mesh; fetching one
+is a ``jax.device_get`` (XLA gathers), setting one is a ``device_put`` to the
+leaf's NamedSharding. What remains of the reference API is path-based lookup
+into the state pytree, which these helpers provide with the same spellings.
+"""
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+from deepspeed_tpu.utils.tree import tree_path_str as _path_str
+
+
+def _find_leaf(tree: Any, name: str):
+    """(path_str, leaf) for the unique leaf whose path contains ``name``."""
+    hits = [(p, leaf) for p, leaf in
+            jax.tree_util.tree_flatten_with_path(tree)[0][0:]
+            if name in _path_str(p)]
+    if not hits:
+        raise KeyError(f"no state leaf matches {name!r}")
+    if len(hits) > 1:
+        paths = [_path_str(p) for p, _ in hits][:5]
+        raise KeyError(f"{name!r} is ambiguous: {paths}")
+    return hits[0]
+
+
+def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
+    """Full (gathered) fp32 master value of the parameter whose path contains
+    ``name`` (reference ``tensor_fragment.py:safe_get_full_fp32_param``)."""
+    _, leaf = _find_leaf(engine.state.params, name)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    """Overwrite a master parameter, re-laying it out onto the leaf's existing
+    sharding (reference ``safe_set_full_fp32_param``)."""
+    path, leaf = _find_leaf(engine.state.params, name)
+    value = np.asarray(value, dtype=np.float32).reshape(np.shape(leaf))
+    path_s = _path_str(path)
+
+    def replace(p, l):
+        if _path_str(p) == path_s:
+            return jax.device_put(value.astype(l.dtype), l.sharding)
+        return l
+
+    new_params = jax.tree_util.tree_map_with_path(replace, engine.state.params)
+    engine.state = engine.state._replace(params=new_params)
+
+
+def safe_get_full_optimizer_state(engine, name: str,
+                                  state_name: str = "mu") -> np.ndarray:
+    """Gathered optimizer-state leaf (``mu``/``nu`` for adam moments) matching
+    a parameter path (reference ``safe_get_full_optimizer_state``)."""
+    pstate = _find_optimizer_tree(engine.state.opt_state, state_name)
+    _, leaf = _find_leaf(pstate, name)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """Last step's gradient if the engine retains one. The fused train step
+    consumes grads inside jit (they never persist), so this returns None unless
+    the engine ran a compat ``backward()`` that kept ``engine.last_grads`` —
+    mirrored from the reference where grads are also None post-step."""
+    grads = getattr(engine, "last_grads", None)
+    if grads is None:
+        return None
+    _, leaf = _find_leaf(grads, name)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def _find_optimizer_tree(opt_state: Any, state_name: str):
+    """Locate the sub-tree of an optax state owning ``state_name`` (e.g. the
+    ScaleByAdamState with .mu/.nu)."""
+    found = []
+
+    def visit(node):
+        if hasattr(node, state_name):
+            found.append(getattr(node, state_name))
+            return
+        if isinstance(node, (tuple, list)):
+            for c in node:
+                visit(c)
+
+    visit(opt_state)
+    if not found:
+        raise KeyError(f"optimizer state has no {state_name!r} collection")
+    return found[0]

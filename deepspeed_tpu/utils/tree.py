@@ -1,0 +1,12 @@
+"""Pytree path utilities shared across the partitioner, policies, and debug APIs.
+
+(Reference keeps the analogous parameter-naming helpers in
+``deepspeed/utils/tensor_fragment.py`` / ``runtime/utils.py``.)
+"""
+
+
+def tree_path_str(path) -> str:
+    """Render a jax tree path (DictKey/SequenceKey/... entries) as
+    ``"model/layer_0/attn/wq/kernel"`` — the canonical spelling every
+    path-matching rule in the framework keys on."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)

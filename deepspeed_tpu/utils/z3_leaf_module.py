@@ -1,0 +1,42 @@
+"""ZeRO-3 leaf modules — exclude a subtree from parameter sharding.
+
+Reference analog: ``deepspeed/utils/z3_leaf_module.py``
+(``set_z3_leaf_modules``): marks module classes whose parameters ZeRO-3 should
+fetch as one unit instead of hooking every child (fixes thrashing on
+fine-grained modules like MoE expert stacks).
+
+TPU redesign: "fetch granularity" doesn't exist — XLA schedules gathers — but
+the useful half of the semantic survives: *don't shard below this subtree*.
+``set_z3_leaf_modules`` registers parameter-path prefixes; the ZeRO partitioner
+(``runtime/zero/partition.py:build_param_shardings``) keeps every leaf under a
+registered prefix replicated on the fsdp axis (tensor-parallel rules still
+apply), so tiny per-expert weights aren't sliced into sub-tile shards.
+"""
+
+from typing import Iterable, List
+
+_LEAF_PREFIXES: List[str] = []
+
+
+def set_z3_leaf_modules(prefixes: Iterable[str]) -> List[str]:
+    """Register path prefixes/substrings (e.g. ``"experts"``) to keep unsharded
+    on the fsdp axis. Returns the active registry."""
+    for p in prefixes:
+        if p not in _LEAF_PREFIXES:
+            _LEAF_PREFIXES.append(str(p))
+    return list(_LEAF_PREFIXES)
+
+
+def unset_z3_leaf_modules(prefixes: Iterable[str]) -> List[str]:
+    for p in prefixes:
+        if p in _LEAF_PREFIXES:
+            _LEAF_PREFIXES.remove(p)
+    return list(_LEAF_PREFIXES)
+
+
+def z3_leaf_parameters() -> List[str]:
+    return list(_LEAF_PREFIXES)
+
+
+def is_z3_leaf_path(path_str: str) -> bool:
+    return any(p in path_str for p in _LEAF_PREFIXES)

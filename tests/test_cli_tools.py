@@ -1,0 +1,63 @@
+"""CLI tooling tests: nvme tune sweep, ssh fanout, comet monitor backend.
+
+Reference analogs: ``bin/ds_nvme_tune`` (``deepspeed/nvme/perf_sweep``),
+``bin/ds_ssh``, ``deepspeed/monitor/comet.py`` — pure-unit (no ssh, no
+comet_ml service), mirroring ``tests/unit/launcher`` style.
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+
+from deepspeed_tpu.launcher.nvme_tune import main as nvme_main, sweep
+from deepspeed_tpu.launcher.ssh_fanout import fanout, parse_args, run_on_host
+from deepspeed_tpu.monitor.monitor import CometMonitor, MonitorMaster
+
+
+def test_nvme_sweep_measures_and_picks_config(tmp_path, capsys):
+    rc = nvme_main(["--nvme_dir", str(tmp_path), "--size_mb", "8",
+                    "--threads", "1", "2", "--block_mb", "1", "4",
+                    "--trials", "1", "--out", str(tmp_path / "aio.json")])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    rows = [l for l in lines if "threads" in l]
+    assert len(rows) == 4 and all(r["read_gbps"] > 0 for r in rows)
+    cfg = json.load(open(tmp_path / "aio.json"))
+    assert cfg["aio"]["thread_count"] in (1, 2)
+    assert cfg["aio"]["block_size"] % (1 << 20) == 0
+
+
+def test_ssh_fanout_prefixes_and_aggregates_rc():
+    class FakeProc:
+        def __init__(self, rc, out):
+            self.returncode, self.stdout, self.stderr = rc, out, ""
+
+    def fake_runner(cmd, capture_output, text):
+        host = cmd[-2]
+        return FakeProc(1 if host == "bad" else 0, f"hello from {host}\n")
+
+    res = fanout(["a", "bad", "c"], ["uptime"], runner=fake_runner)
+    assert res["a"][0] == 0 and res["bad"][0] == 1
+    host, rc, out, _ = run_on_host("a", ["echo", "x"], runner=fake_runner)
+    assert host == "a" and rc == 0 and "hello" in out
+
+
+def test_ssh_parse_args_remainder():
+    a = parse_args(["-H", "/tmp/hosts", "nvidia-smi", "-L"])
+    assert a.hostfile == "/tmp/hosts" and a.command == ["nvidia-smi", "-L"]
+
+
+def test_comet_monitor_gated_and_master_includes_it():
+    from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+    cfg = DeepSpeedTPUConfig({"train_batch_size": 8,
+                              "comet": {"enabled": False}}, dp_world_size=1)
+    mon = CometMonitor(cfg.comet)
+    assert not mon.enabled  # disabled config -> no comet_ml import attempted
+    master = MonitorMaster(cfg)
+    assert any(isinstance(b, CometMonitor) for b in master.backends)
+    # enabled but comet_ml not installed -> graceful degrade, not crash
+    cfg2 = DeepSpeedTPUConfig({"train_batch_size": 8,
+                               "comet": {"enabled": True}}, dp_world_size=1)
+    assert not CometMonitor(cfg2.comet).enabled

@@ -1,0 +1,76 @@
+"""Domino TP compute/comm overlap tests.
+
+Reference analog: ``tests/unit/runtime`` Domino coverage is indirect in the
+reference; here we assert the TPU redesign's correctness contract directly —
+chunking must not change the math, only expose independent per-chunk psums to
+the scheduler (``deepspeed/runtime/domino/transformer.py:338-430``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.comm.mesh import create_mesh, set_global_mesh
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.runtime.domino import (
+    DominoTransformerLayer, chunk_tokens, domino_overlap)
+
+
+def _layer(n_chunks):
+    return DominoTransformerLayer(num_heads=4, head_dim=8, intermediate=64,
+                                  n_chunks=n_chunks, dtype=jnp.float32)
+
+
+def test_chunking_is_exact():
+    x = np.random.default_rng(0).normal(size=(4, 8, 32)).astype(np.float32)
+    params = _layer(1).init(jax.random.PRNGKey(0), x)["params"]
+    base = _layer(1).apply({"params": params}, x)
+    # params are chunk-count independent: same weights, chunked execution
+    for n in (2, 4):
+        out = _layer(n).apply({"params": params}, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_domino_under_tp_mesh_matches_dense():
+    mesh = create_mesh(MeshConfig(data=4, tensor=2))
+    set_global_mesh(mesh)
+    x = np.random.default_rng(1).normal(size=(4, 8, 32)).astype(np.float32)
+    params = _layer(2).init(jax.random.PRNGKey(1), x)["params"]
+    dense = _layer(1).apply({"params": params}, x)
+
+    from deepspeed_tpu.module_inject import AutoTP
+    from deepspeed_tpu.runtime.zero.partition import build_param_shardings
+    rules = AutoTP.infer_rules(params=params)
+    shardings = build_param_shardings(params, mesh, stage=0, tensor_rules=rules)
+    sharded = jax.device_put(params, shardings)
+    with mesh:
+        out = jax.jit(lambda p, b: _layer(2).apply({"params": p}, b))(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+    set_global_mesh(None)
+
+
+def test_domino_grads_match_unchunked():
+    x = np.random.default_rng(2).normal(size=(4, 8, 32)).astype(np.float32)
+    params = _layer(1).init(jax.random.PRNGKey(2), x)["params"]
+
+    def loss(p, n):
+        return jnp.sum(_layer(n).apply({"params": p}, x) ** 2)
+
+    g1 = jax.grad(lambda p: loss(p, 1))(params)
+    g2 = jax.grad(lambda p: loss(p, 2))(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), g1, g2)
+
+
+def test_domino_overlap_wrapper_and_chunk_errors():
+    fn = lambda x: x * 2.0
+    x = jnp.arange(8.0).reshape(4, 2)
+    np.testing.assert_allclose(np.asarray(domino_overlap(fn, 2)(x)),
+                               np.asarray(fn(x)))
+    try:
+        chunk_tokens(x, 3)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

@@ -119,3 +119,33 @@ def test_gqa_heads():
     batch = random_tokens(2, 8)
     params = model.init(jax.random.PRNGKey(0), batch)["params"]
     assert np.isfinite(float(model.apply({"params": params}, batch)))
+
+
+def test_chunked_loss_matches_dense():
+    """Chunked head+CE fusion (sequence/cross_entropy.py:chunked_cross_entropy)
+    must reproduce the dense log_softmax loss and grads, tied and untied."""
+    import dataclasses
+
+    from deepspeed_tpu.models.llama import TINY_LLAMA, LlamaForCausalLM, random_tokens
+
+    cfg_d = dataclasses.replace(TINY_LLAMA, dtype=jnp.float32)
+    cfg_c = dataclasses.replace(cfg_d, loss_chunk_size=24)
+    batch = random_tokens(2, 36, vocab_size=cfg_d.vocab_size)
+    m_d, m_c = LlamaForCausalLM(cfg_d), LlamaForCausalLM(cfg_c)
+    p = m_d.init(jax.random.PRNGKey(0), batch)["params"]
+    assert jax.tree.structure(p) == jax.tree.structure(
+        m_c.init(jax.random.PRNGKey(0), batch)["params"])
+    np.testing.assert_allclose(
+        float(m_d.apply({"params": p}, batch)),
+        float(m_c.apply({"params": p}, batch)), rtol=1e-6)
+    gd = jax.grad(lambda v: m_d.apply({"params": v}, batch))(p)
+    gc = jax.grad(lambda v: m_c.apply({"params": v}, batch))(p)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6), gd, gc)
+
+    cfg_t = dataclasses.replace(cfg_d, tie_embeddings=True)
+    cfg_tc = dataclasses.replace(cfg_t, loss_chunk_size=24)
+    pt = LlamaForCausalLM(cfg_t).init(jax.random.PRNGKey(1), batch)["params"]
+    np.testing.assert_allclose(
+        float(LlamaForCausalLM(cfg_t).apply({"params": pt}, batch)),
+        float(LlamaForCausalLM(cfg_tc).apply({"params": pt}, batch)), rtol=1e-6)

@@ -1,0 +1,98 @@
+"""Utils breadth tests: nvtx/instrument, init_on_device, tensor_fragment,
+z3 leaf modules.
+
+Reference analogs: ``deepspeed/utils/{nvtx,init_on_device,tensor_fragment,
+z3_leaf_module}.py``; tests mirror ``tests/unit/runtime/zero/test_zero_leaf_
+module.py`` and the tensor-fragment debug API cases.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import create_mesh
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.models.llama import TINY_LLAMA, LlamaForCausalLM, random_tokens
+from deepspeed_tpu.utils.init_on_device import OnDevice, abstract_init, sharded_init
+from deepspeed_tpu.utils.nvtx import annotate, instrument, instrument_w_nvtx
+from deepspeed_tpu.utils.tensor_fragment import (
+    safe_get_full_fp32_param, safe_get_full_grad,
+    safe_get_full_optimizer_state, safe_set_full_fp32_param)
+from deepspeed_tpu.utils.z3_leaf_module import (
+    is_z3_leaf_path, set_z3_leaf_modules, unset_z3_leaf_modules)
+
+
+def _engine(mesh=None):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+    }
+    return deepspeed_tpu.initialize(
+        model=LlamaForCausalLM(TINY_LLAMA), config=cfg, mesh=mesh,
+        example_batch=random_tokens(2, 16, vocab_size=TINY_LLAMA.vocab_size))[0]
+
+
+def test_instrument_and_annotate():
+    @instrument
+    def f(x):
+        return x + 1
+
+    @instrument_w_nvtx(name="scaled")
+    def g(x):
+        return x * 2
+
+    with annotate("outer"):
+        assert int(f(jnp.asarray(1))) == 2
+        assert int(jax.jit(g)(jnp.asarray(3))) == 6
+
+
+def test_abstract_init_allocates_nothing_and_matches_real():
+    model = LlamaForCausalLM(TINY_LLAMA)
+    batch = random_tokens(2, 16, vocab_size=TINY_LLAMA.vocab_size)
+    shapes = abstract_init(model, jax.random.PRNGKey(0), batch)
+    real = model.init(jax.random.PRNGKey(0), batch)
+    assert jax.tree.structure(shapes) == jax.tree.structure(real)
+    jax.tree.map(lambda s, r: (s.shape, s.dtype) == (r.shape, r.dtype) or
+                 (_ for _ in ()).throw(AssertionError((s, r.shape))),
+                 shapes, real)
+    assert isinstance(OnDevice(dtype=jnp.bfloat16).__enter__(), OnDevice)
+
+
+def test_sharded_init_births_params_sharded():
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    model = LlamaForCausalLM(TINY_LLAMA)
+    batch = random_tokens(2, 16, vocab_size=TINY_LLAMA.vocab_size)
+    variables, shardings = sharded_init(model, jax.random.PRNGKey(0), batch,
+                                        mesh=mesh, stage=3)
+    kernel = variables["params"]["model"]["lm_head"]["kernel"]
+    assert "fsdp" in str(kernel.sharding.spec)
+
+
+def test_tensor_fragment_get_set_roundtrip():
+    eng = _engine()
+    w = safe_get_full_fp32_param(eng, "lm_head/kernel")
+    assert w.dtype == np.float32 and w.ndim == 2
+    mu = safe_get_full_optimizer_state(eng, "lm_head/kernel", "mu")
+    assert mu.shape == w.shape
+    assert safe_get_full_grad(eng, "lm_head/kernel") is None
+    new = np.zeros_like(w)
+    safe_set_full_fp32_param(eng, "lm_head/kernel", new)
+    np.testing.assert_allclose(
+        safe_get_full_fp32_param(eng, "lm_head/kernel"), new)
+
+
+def test_z3_leaf_modules_opt_out_of_fsdp():
+    from deepspeed_tpu.runtime.zero.partition import build_param_shardings
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    params = {"experts": {"w": np.zeros((64, 64), np.float32)},
+              "dense": {"w": np.zeros((64, 64), np.float32)}}
+    set_z3_leaf_modules(["experts"])
+    try:
+        assert is_z3_leaf_path("moe/experts/w")
+        sh = build_param_shardings(params, mesh, stage=3, min_shard_size=1)
+        assert "fsdp" not in str(sh["experts"]["w"].spec)
+        assert "fsdp" in str(sh["dense"]["w"].spec)
+    finally:
+        unset_z3_leaf_modules(["experts"])
