@@ -61,11 +61,17 @@ def sweep(nvme_dir=None, size_mb=512, threads=(1, 4, 8), block_mb=(1, 16),
     dst = np.empty(nbytes, dtype=np.uint8)
     fname = os.path.join(nvme_dir, f"dstpu_nvme_tune_{os.getpid()}.bin")
     results = []
+    # pre-size the target so concurrent offset writes never race on creation
+    # (the thread-pool fallback opens 'wb' when the file doesn't exist yet)
+    with open(fname, "wb") as f:
+        f.truncate(nbytes)
+    # blocks >= the file are one whole-file request: test that size once
+    blocks = sorted({min(b, size_mb) for b in block_mb})
     try:
         for t in threads:
             handle = AsyncIOHandle(num_threads=t)
-            for b in block_mb:
-                bb = min(b << 20, nbytes)
+            for b in blocks:
+                bb = b << 20
                 w = min(_run_chunked(handle, data, fname, bb, write=True)
                         for _ in range(trials))
                 r = min(_run_chunked(handle, dst, fname, bb, write=False)

@@ -7,6 +7,7 @@ hostfile grammar as the launcher (``launcher/runner.py:fetch_hostfile``).
 """
 
 import argparse
+import shlex
 import subprocess
 import sys
 import threading
@@ -28,7 +29,7 @@ def parse_args(args=None):
 
 def run_on_host(host: str, command, port=None, runner=subprocess.run):
     cmd = ["ssh"] + SSH_OPTS + (["-p", str(port)] if port else []) + \
-        [host, " ".join(command)]
+        [host, shlex.join(command)]
     proc = runner(cmd, capture_output=True, text=True)
     return host, proc.returncode, proc.stdout, proc.stderr
 

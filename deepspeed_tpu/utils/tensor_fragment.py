@@ -25,7 +25,7 @@ from deepspeed_tpu.utils.tree import tree_path_str as _path_str
 def _find_leaf(tree: Any, name: str):
     """(path_str, leaf) for the unique leaf whose path contains ``name``."""
     hits = [(p, leaf) for p, leaf in
-            jax.tree_util.tree_flatten_with_path(tree)[0][0:]
+            jax.tree_util.tree_flatten_with_path(tree)[0]
             if name in _path_str(p)]
     if not hits:
         raise KeyError(f"no state leaf matches {name!r}")
@@ -35,9 +35,27 @@ def _find_leaf(tree: Any, name: str):
     return hits[0]
 
 
+def _leaf_index(tree: Any, name: str) -> int:
+    """Flat-leaf index (jax.tree.leaves order) of the unique match — the order
+    the host-offload tier stores its master list in (engine.py builds it from
+    ``jax.tree.leaves(params)``)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    hits = [i for i, (p, _) in enumerate(flat) if name in _path_str(p)]
+    if len(hits) != 1:
+        raise KeyError(f"{name!r} matched {len(hits)} leaves")
+    return hits[0]
+
+
 def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
     """Full (gathered) fp32 master value of the parameter whose path contains
-    ``name`` (reference ``tensor_fragment.py:safe_get_full_fp32_param``)."""
+    ``name`` (reference ``tensor_fragment.py:safe_get_full_fp32_param``).
+    Under optimizer host-offload the authoritative fp32 masters live on the
+    host tier — ``engine.state.params`` are compute-dtype shadows — so the
+    master list is consulted first."""
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        idx = _leaf_index(engine.state.params, name)
+        return np.asarray(offload.masters()[idx], dtype=np.float32)
     _, leaf = _find_leaf(engine.state.params, name)
     return np.asarray(jax.device_get(leaf), dtype=np.float32)
 
@@ -48,6 +66,14 @@ def safe_set_full_fp32_param(engine, name: str, value) -> None:
     path, leaf = _find_leaf(engine.state.params, name)
     value = np.asarray(value, dtype=np.float32).reshape(np.shape(leaf))
     path_s = _path_str(path)
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        # write the authoritative host master (keeps moments), then fall
+        # through to refresh the device shadow so forward sees it immediately
+        idx = _leaf_index(engine.state.params, name)
+        masters = offload.masters()
+        masters[idx] = value.copy()
+        offload.set_masters(masters)
 
     def replace(p, l):
         if _path_str(p) == path_s:
@@ -62,6 +88,17 @@ def safe_get_full_optimizer_state(engine, name: str,
                                   state_name: str = "mu") -> np.ndarray:
     """Gathered optimizer-state leaf (``mu``/``nu`` for adam moments) matching
     a parameter path (reference ``safe_get_full_optimizer_state``)."""
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        idx = _leaf_index(engine.state.params, name)
+        slot = {"mu": 0, "exp_avg": 0, "nu": 1, "exp_avg_sq": 1}.get(state_name)
+        if slot is None:
+            raise KeyError(f"unknown offloaded state {state_name!r}")
+        states = offload.state_dict()["states"][idx]
+        if slot >= len(states):
+            raise KeyError(f"{state_name!r}: optimizer keeps {len(states)} "
+                           "state slots")
+        return np.asarray(states[slot], dtype=np.float32)
     pstate = _find_optimizer_tree(engine.state.opt_state, state_name)
     _, leaf = _find_leaf(pstate, name)
     return np.asarray(jax.device_get(leaf), dtype=np.float32)

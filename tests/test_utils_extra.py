@@ -96,3 +96,28 @@ def test_z3_leaf_modules_opt_out_of_fsdp():
         assert "fsdp" in str(sh["dense"]["w"].spec)
     finally:
         unset_z3_leaf_modules(["experts"])
+
+
+def test_tensor_fragment_routes_through_offload_masters():
+    """Under host offload, get/set must hit the fp32 masters, not the
+    compute-dtype device shadows (reference tensor_fragment fragment map)."""
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    eng = deepspeed_tpu.initialize(
+        model=LlamaForCausalLM(TINY_LLAMA), config=cfg,
+        example_batch=random_tokens(2, 16, vocab_size=TINY_LLAMA.vocab_size))[0]
+    assert eng._offload is not None
+    w = safe_get_full_fp32_param(eng, "lm_head/kernel")
+    assert w.dtype == np.float32
+    new = np.full_like(w, 0.125)
+    safe_set_full_fp32_param(eng, "lm_head/kernel", new)
+    np.testing.assert_allclose(
+        safe_get_full_fp32_param(eng, "lm_head/kernel"), new)
+    # master survives on the host tier (not just the shadow)
+    idx_master = safe_get_full_optimizer_state(eng, "lm_head/kernel", "mu")
+    assert idx_master.shape == w.shape
