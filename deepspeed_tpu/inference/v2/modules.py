@@ -299,3 +299,64 @@ class MixtralPolicy:
         x = _rms(x, params["final_norm"]["scale"], cfg.base.rms_norm_eps)
         return x.astype(jnp.float32) @ \
             params["lm_head"]["kernel"].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# BLOOM (ALiBi attention via head-dim augmentation, fused-qkv arch, LayerNorm)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.bloom import (  # noqa: E402
+    BloomConfig, alibi_augment, alibi_slopes)
+
+
+@register_policy("bloom", BloomConfig)
+class BloomPolicy:
+    """reference: the BLOOM container + alibi softmax kernel
+    (``module_inject/containers/bloom.py``,
+    ``csrc/transformer/inference/csrc/softmax.cu`` alibi variant). ALiBi rides
+    in one augmented head-dim column (``models/bloom.py:alibi_augment``), so
+    the KV cache stores head_dim+2 and the paged kernel runs unchanged."""
+
+    @staticmethod
+    def cache_spec(cfg: BloomConfig) -> KVCacheSpec:
+        return KVCacheSpec(cfg.num_layers, cfg.num_heads, cfg.head_dim_ + 2,
+                           cfg.max_seq_len, cfg.dtype, None)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        m = params["model"]
+        x = m["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        return _layernorm(x, m["embed_ln"]["scale"], m["embed_ln"]["bias"],
+                          cfg.layer_norm_eps)
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        lp = params["model"][f"layer_{i}"]
+        dtype = cfg.dtype
+        eps = cfg.layer_norm_eps
+        d = cfg.head_dim_
+        h = _layernorm(x, lp["input_ln"]["scale"], lp["input_ln"]["bias"], eps)
+        q = jnp.einsum("td,dhk->thk", h, lp["wq"]["kernel"].astype(dtype)) + \
+            lp["wq"]["bias"].astype(dtype)
+        k = jnp.einsum("td,dhk->thk", h, lp["wk"]["kernel"].astype(dtype)) + \
+            lp["wk"]["bias"].astype(dtype)
+        v = jnp.einsum("td,dhk->thk", h, lp["wv"]["kernel"].astype(dtype)) + \
+            lp["wv"]["bias"].astype(dtype)
+        slopes = jnp.asarray(alibi_slopes(cfg.num_heads))
+        q, k, v = alibi_augment(q, k, v, slopes, positions)
+        attn = attend(q, k, v)[..., :d]
+        x = x + jnp.einsum("thk,hkd->td", attn,
+                           lp["wo"]["kernel"].astype(dtype)) + \
+            lp["wo"]["bias"].astype(dtype)
+        h2 = _layernorm(x, lp["post_ln"]["scale"], lp["post_ln"]["bias"], eps)
+        m = jax.nn.gelu(h2 @ lp["mlp_up"]["kernel"].astype(dtype) +
+                        lp["mlp_up"]["bias"].astype(dtype))
+        return x + m @ lp["mlp_down"]["kernel"].astype(dtype) + \
+            lp["mlp_down"]["bias"].astype(dtype)
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        m = params["model"]
+        x = _layernorm(x, m["final_ln"]["scale"], m["final_ln"]["bias"],
+                       cfg.layer_norm_eps)
+        return x.astype(jnp.float32) @ \
+            m["embed"]["embedding"].astype(jnp.float32).T   # tied

@@ -28,8 +28,12 @@ def parse_args(args=None):
 
 
 def run_on_host(host: str, command, port=None, runner=subprocess.run):
+    # one argument = a shell snippet, passed through verbatim so pipes/&&/env
+    # expand remotely (ds_ssh behavior); multiple argv words are quoted so
+    # boundaries and metacharacters survive the ssh hop
+    remote = command[0] if len(command) == 1 else shlex.join(command)
     cmd = ["ssh"] + SSH_OPTS + (["-p", str(port)] if port else []) + \
-        [host, shlex.join(command)]
+        [host, remote]
     proc = runner(cmd, capture_output=True, text=True)
     return host, proc.returncode, proc.stdout, proc.stderr
 

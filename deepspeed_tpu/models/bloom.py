@@ -1,0 +1,233 @@
+"""BLOOM model family (ALiBi attention, fused QKV, LayerNorms, tied head).
+
+Reference analog: the BLOOM container (``module_inject/containers/bloom.py``)
+and v1 inference policy (ALiBi handled inside the softmax kernel,
+``csrc/transformer/inference/csrc/softmax.cu`` alibi variant). Architecture:
+word embeddings + embedding LayerNorm, pre-LN blocks with fused
+query_key_value (per-head [q|k|v] interleave), ALiBi position bias (no
+rope/learned positions), GELU MLP, final LayerNorm, tied lm_head.
+
+TPU redesign of ALiBi: instead of a bias-aware softmax kernel, the bias
+``slope_h * (j - i)`` is folded into the dot product by augmenting the head
+dim with two columns (hi/lo position split so the bias stays exact in a bf16
+KV cache — see ``alibi_augment``), with a ``sqrt(d+2)/sqrt(d)`` factor
+compensating the kernel's ``1/sqrt(head_dim)`` scale. Per-row constants
+(``-slope*i``) vanish under softmax, so scores are exactly ALiBi — and every
+attention backend (XLA, Pallas flash, ring, Ulysses, paged serving) supports
+BLOOM with zero kernel changes.
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, HEADS_AXIS, SEQ_AXIS, _dispatch_attention, shard_activation)
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 4096
+    num_layers: int = 30
+    num_heads: int = 32
+    max_seq_len: int = 2048
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_BLOOM = BloomConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                         num_heads=4, max_seq_len=128, dtype=jnp.float32)
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (geometric in 2^(-8/n), interpolated for
+    non-power-of-two head counts — the published ALiBi recipe)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-8.0 / n)
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        return np.asarray(pow2_slopes(num_heads), np.float32)
+    closest = 2 ** math.floor(math.log2(num_heads))
+    extra = pow2_slopes(2 * closest)[0::2][:num_heads - closest]
+    return np.asarray(pow2_slopes(closest) + extra, np.float32)
+
+
+# pos = ALIBI_POS_SPLIT*hi + lo; hi and lo are small integers that stay exact
+# in bf16 (mantissa 8 bits), so the bias is bit-accurate to 32k context even
+# with a bf16 KV cache — a single absolute-position column would round above
+# position 256 in bf16
+ALIBI_POS_SPLIT = 128
+
+
+def alibi_augment(q, k, v, slopes, positions):
+    """Fold ALiBi into (q, k, v) by two extra head-dim columns (module
+    docstring). q/k/v: [..., H, d] (batched [B,S,H,d] or token-major [T,H,d]);
+    ``positions``: matching leading shape, absolute key positions. The bias
+    ``slope*pos`` is decomposed as ``(slope*SPLIT)*hi + slope*lo`` with
+    ``hi = pos // SPLIT, lo = pos % SPLIT``. Returns the augmented
+    [..., H, d+2] triple; slice the output ``[..., :d]`` after attention."""
+    d = q.shape[-1]
+    h = q.shape[-2]
+    s = jnp.sqrt(jnp.asarray(d + 2, jnp.float32) / d).astype(q.dtype)
+    kscale = np.sqrt(d + 2)
+    lead = (1,) * (q.ndim - 2)
+    sl32 = slopes.astype(jnp.float32)
+    q_cols = jnp.broadcast_to(
+        jnp.stack([sl32 * ALIBI_POS_SPLIT * kscale, sl32 * kscale],
+                  axis=-1).astype(q.dtype).reshape(lead + (h, 2)),
+        q.shape[:-1] + (2,))
+    pos = positions.astype(jnp.int32)
+    k_cols = jnp.broadcast_to(
+        jnp.stack([(pos // ALIBI_POS_SPLIT).astype(q.dtype),
+                   (pos % ALIBI_POS_SPLIT).astype(q.dtype)],
+                  axis=-1)[..., None, :], k.shape[:-1] + (2,))
+    q_a = jnp.concatenate([q * s, q_cols], axis=-1)
+    k_a = jnp.concatenate([k, k_cols], axis=-1)
+    v_a = jnp.concatenate([v, jnp.zeros_like(v[..., :2])], axis=-1)
+    return q_a, k_a, v_a
+
+
+class BloomBlock(nn.Module):
+    cfg: BloomConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        d = cfg.head_dim_
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="input_ln")(x)
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(h)
+        k = dense(features=(cfg.num_heads, d), name="wk")(h)
+        v = dense(features=(cfg.num_heads, d), name="wv")(h)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        slopes = jnp.asarray(alibi_slopes(cfg.num_heads))
+        q, k, v = alibi_augment(q, k, v, slopes, positions)
+        attn = _dispatch_attention(cfg.attention_backend, q, k, v,
+                                   causal=True)[..., :d]
+        x = x + nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                use_bias=True, dtype=cfg.dtype,
+                                param_dtype=jnp.float32, name="wo")(attn)
+        h2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                          name="post_ln")(x)
+        m = nn.Dense(4 * cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(h2)
+        m = jax.nn.gelu(m)
+        x = x + nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="mlp_down")(m)
+        return shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+
+
+class BloomModel(nn.Module):
+    cfg: BloomConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
+                                         input_ids.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(input_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embed_ln")(x)
+        x = shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+        for i in range(cfg.num_layers):
+            x = BloomBlock(cfg, name=f"layer_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_ln")(x)
+        embed = self.variables["params"]["embed"]["embedding"]
+        return x.astype(jnp.float32) @ embed.astype(jnp.float32).T  # tied
+
+
+class BloomForCausalLM(nn.Module):
+    cfg: BloomConfig
+
+    def setup(self):
+        self.model = BloomModel(self.cfg)
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def __call__(self, batch):
+        input_ids = batch["input_ids"]
+        logits = self.model(input_ids, positions=batch.get("positions"))
+        labels = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+
+def bloom_tensor_rules(path, leaf):
+    """TP sharding rules (reference container: qkv column-, dense row-parallel;
+    ALiBi slopes are per-head so head sharding composes)."""
+    from jax.sharding import PartitionSpec
+    names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    if "embed" in names:
+        return PartitionSpec(None, "tensor")
+    if any(n in names for n in ("wq", "wk", "wv")) and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor", None)
+    if "wo" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None, None)
+    if "mlp_up" in names and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor")
+    if "mlp_down" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None)
+    return None
+
+
+def convert_hf_bloom(hf_state, cfg: BloomConfig):
+    """HF BLOOM naming -> our tree. HF fuses query_key_value rows as
+    ``[h, 3, d]`` per-head interleave (the layout the reference's
+    fusedqkv_utils splits, ``module_inject/fusedqkv_utils.py``)."""
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    dmodel, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    pfx = "transformer."
+    tree = {
+        "embed": {"embedding": get(pfx + "word_embeddings.weight")},
+        "embed_ln": {"scale": get(pfx + "word_embeddings_layernorm.weight"),
+                     "bias": get(pfx + "word_embeddings_layernorm.bias")},
+        "final_ln": {"scale": get(pfx + "ln_f.weight"),
+                     "bias": get(pfx + "ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"{pfx}h.{i}."
+        qkv_w = get(p + "self_attention.query_key_value.weight")  # [3hd, D]
+        qkv_b = get(p + "self_attention.query_key_value.bias")    # [3hd]
+        w = qkv_w.reshape(h, 3, d, dmodel)
+        b = qkv_b.reshape(h, 3, d)
+        tree[f"layer_{i}"] = {
+            "input_ln": {"scale": get(p + "input_layernorm.weight"),
+                         "bias": get(p + "input_layernorm.bias")},
+            "post_ln": {"scale": get(p + "post_attention_layernorm.weight"),
+                        "bias": get(p + "post_attention_layernorm.bias")},
+            "wq": {"kernel": w[:, 0].transpose(2, 0, 1), "bias": b[:, 0]},
+            "wk": {"kernel": w[:, 1].transpose(2, 0, 1), "bias": b[:, 1]},
+            "wv": {"kernel": w[:, 2].transpose(2, 0, 1), "bias": b[:, 2]},
+            "wo": {"kernel": get(p + "self_attention.dense.weight")
+                   .T.reshape(h, d, dmodel),
+                   "bias": get(p + "self_attention.dense.bias")},
+            "mlp_up": {"kernel": get(p + "mlp.dense_h_to_4h.weight").T,
+                       "bias": get(p + "mlp.dense_h_to_4h.bias")},
+            "mlp_down": {"kernel": get(p + "mlp.dense_4h_to_h.weight").T,
+                         "bias": get(p + "mlp.dense_4h_to_h.bias")},
+        }
+    return {"model": tree}

@@ -106,22 +106,37 @@ def run_distributed(fn: Union[Callable, str], world_size: int = 2,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=repo_root))
 
-    deadline = time.time() + timeout
+    # drain all ranks concurrently: a rank blocking on a full stdout pipe would
+    # stall its collectives and masquerade as a hang of its peers
+    import threading
     outs = [None] * world_size
+
+    def drain(rank, p):
+        outs[rank], _ = p.communicate()
+
+    readers = [threading.Thread(target=drain, args=(r, p), daemon=True)
+               for r, p in enumerate(procs)]
+    for t in readers:
+        t.start()
+    deadline = time.time() + timeout
     try:
-        for rank, p in enumerate(procs):
-            left = deadline - time.time()
-            if left <= 0:
-                raise TimeoutError(f"distributed test timed out ({timeout}s)")
-            try:
-                outs[rank], _ = p.communicate(timeout=left)
-            except subprocess.TimeoutExpired:
-                raise TimeoutError(
-                    f"rank {rank} timed out ({timeout}s)") from None
+        for t in readers:
+            t.join(max(0.0, deadline - time.time()))
+        timed_out = [r for r, t in enumerate(readers) if t.is_alive()]
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    for t in readers:
+        t.join(10)
+    # a rank that crashed while its peers hung in a collective is the root
+    # cause — report its traceback, not the peers' timeout
+    for rank, p in enumerate(procs):
+        if p.returncode not in (0, None) and rank not in timed_out:
+            raise RuntimeError(
+                f"rank {rank} exited {p.returncode}:\n{outs[rank]}")
+    if timed_out:
+        raise TimeoutError(f"ranks {timed_out} timed out ({timeout}s)")
     for rank, p in enumerate(procs):
         if p.returncode != 0:
             raise RuntimeError(

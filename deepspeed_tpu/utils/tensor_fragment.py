@@ -68,12 +68,11 @@ def safe_set_full_fp32_param(engine, name: str, value) -> None:
     path_s = _path_str(path)
     offload = getattr(engine, "_offload", None)
     if offload is not None:
-        # write the authoritative host master (keeps moments), then fall
-        # through to refresh the device shadow so forward sees it immediately
+        # write the authoritative host master in place (masters() returns the
+        # live buffers; a set_masters() round-trip would memcpy every leaf),
+        # then fall through to refresh the device shadow for the next forward
         idx = _leaf_index(engine.state.params, name)
-        masters = offload.masters()
-        masters[idx] = value.copy()
-        offload.set_masters(masters)
+        np.copyto(offload.masters()[idx], value)
 
     def replace(p, l):
         if _path_str(p) == path_s:
@@ -94,7 +93,9 @@ def safe_get_full_optimizer_state(engine, name: str,
         slot = {"mu": 0, "exp_avg": 0, "nu": 1, "exp_avg_sq": 1}.get(state_name)
         if slot is None:
             raise KeyError(f"unknown offloaded state {state_name!r}")
-        states = offload.state_dict()["states"][idx]
+        # per-leaf materialization: swap in only this leaf's moments (a full
+        # state_dict() would drag every NVMe leaf into host RAM)
+        states = offload._materialized_states(offload.leaves[idx])
         if slot >= len(states):
             raise KeyError(f"{state_name!r}: optimizer keeps {len(states)} "
                            "state slots")

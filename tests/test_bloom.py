@@ -1,0 +1,136 @@
+"""BLOOM family tests: ALiBi math, training, TP rules, HF conversion, serving.
+
+Reference analog: the BLOOM container tests under ``tests/unit/inference``
+(alibi softmax parity) and ``module_inject`` bloom policy cases.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.bloom import (
+    TINY_BLOOM, BloomConfig, BloomForCausalLM, alibi_augment, alibi_slopes,
+    bloom_tensor_rules, convert_hf_bloom)
+from deepspeed_tpu.models.llama import random_tokens
+
+
+def test_alibi_slopes_published_values():
+    np.testing.assert_allclose(alibi_slopes(8),
+                               [2.0 ** (-i) for i in range(1, 9)], rtol=1e-6)
+    s6 = alibi_slopes(6)  # non-power-of-two: 4 base + 2 interpolated
+    assert len(s6) == 6 and np.all(s6 > 0) and np.all(np.diff(s6[:4]) < 0)
+
+
+def test_alibi_augmentation_equals_explicit_bias():
+    """q'k' trick == softmax(qk/sqrt(d) + slope*(j-i)) exactly (module
+    docstring derivation)."""
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 16, 4, 8
+    q, k, v = (rng.normal(size=(b, s, h, d)).astype(np.float32) for _ in range(3))
+    slopes = alibi_slopes(h)
+    positions = np.broadcast_to(np.arange(s), (b, s))
+
+    # explicit reference
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    i_idx, j_idx = np.arange(s)[:, None], np.arange(s)[None, :]
+    scores = scores + slopes[None, :, None, None] * (j_idx - i_idx)
+    scores = np.where(j_idx <= i_idx, scores, -np.inf)
+    probs = jax.nn.softmax(jnp.asarray(scores), axis=-1)
+    want = np.einsum("bhqk,bkhd->bqhd", np.asarray(probs), v)
+
+    from deepspeed_tpu.models.llama import _xla_attention
+    qa, ka, va = alibi_augment(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                               jnp.asarray(slopes), jnp.asarray(positions))
+    got = np.asarray(_xla_attention(qa, ka, va, True, None))[..., :d]
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_bloom_trains_and_tp_rules():
+    model = BloomForCausalLM(TINY_BLOOM)
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 3},
+              "mesh": {"data": 4, "fsdp": 2}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=config,
+        example_batch=random_tokens(8, 16, vocab_size=TINY_BLOOM.vocab_size),
+        tensor_rules=bloom_tensor_rules)
+    fixed = random_tokens(8, 16, vocab_size=TINY_BLOOM.vocab_size, seed=0)
+    losses = [float(engine.train_batch(batch=fixed)) for _ in range(5)]
+    assert losses[-1] < losses[0] and all(np.isfinite(losses))
+
+
+def test_bloom_hf_conversion_shapes_and_forward():
+    cfg = TINY_BLOOM
+    rng = np.random.default_rng(2)
+    d, h, dh = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+
+    hf = {"transformer.word_embeddings.weight":
+          rng.normal(size=(cfg.vocab_size, d)).astype(np.float32) * 0.02,
+          "transformer.word_embeddings_layernorm.weight": np.ones(d, np.float32),
+          "transformer.word_embeddings_layernorm.bias": np.zeros(d, np.float32),
+          "transformer.ln_f.weight": np.ones(d, np.float32),
+          "transformer.ln_f.bias": np.zeros(d, np.float32)}
+    per_head_q = rng.normal(size=(h, dh, d)).astype(np.float32) * 0.02
+    per_head_k = rng.normal(size=(h, dh, d)).astype(np.float32) * 0.02
+    per_head_v = rng.normal(size=(h, dh, d)).astype(np.float32) * 0.02
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        fused = np.stack([per_head_q, per_head_k, per_head_v], axis=1)  # [h,3,dh,d]
+        hf[p + "self_attention.query_key_value.weight"] = fused.reshape(3 * h * dh, d)
+        hf[p + "self_attention.query_key_value.bias"] = np.zeros(3 * h * dh, np.float32)
+        hf[p + "self_attention.dense.weight"] = \
+            rng.normal(size=(d, d)).astype(np.float32) * 0.02
+        hf[p + "self_attention.dense.bias"] = np.zeros(d, np.float32)
+        hf[p + "input_layernorm.weight"] = np.ones(d, np.float32)
+        hf[p + "input_layernorm.bias"] = np.zeros(d, np.float32)
+        hf[p + "post_attention_layernorm.weight"] = np.ones(d, np.float32)
+        hf[p + "post_attention_layernorm.bias"] = np.zeros(d, np.float32)
+        hf[p + "mlp.dense_h_to_4h.weight"] = \
+            rng.normal(size=(4 * d, d)).astype(np.float32) * 0.02
+        hf[p + "mlp.dense_h_to_4h.bias"] = np.zeros(4 * d, np.float32)
+        hf[p + "mlp.dense_4h_to_h.weight"] = \
+            rng.normal(size=(d, 4 * d)).astype(np.float32) * 0.02
+        hf[p + "mlp.dense_4h_to_h.bias"] = np.zeros(d, np.float32)
+
+    params = convert_hf_bloom(hf, cfg)
+    # fused split: wq kernel row h0 equals per-head q transposed
+    np.testing.assert_allclose(params["model"]["layer_0"]["wq"]["kernel"],
+                               per_head_q.transpose(2, 0, 1))
+    model = BloomForCausalLM(cfg)
+    batch = random_tokens(2, 12, vocab_size=cfg.vocab_size)
+    ref = model.init(jax.random.PRNGKey(0), batch)["params"]
+    assert jax.tree.structure(ref) == jax.tree.structure(
+        jax.tree.map(jnp.asarray, params))
+    loss = model.apply({"params": jax.tree.map(jnp.asarray, params)}, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_serve_bloom_paged_matches_full():
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, V2EngineConfig)
+    from deepspeed_tpu.inference.v2.modules import BloomPolicy, policy_for
+    from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+
+    cfg = TINY_BLOOM
+    assert policy_for(cfg) is BloomPolicy
+    model = BloomForCausalLM(cfg)
+    prompt = list(np.random.default_rng(5).integers(0, cfg.vocab_size, 11))
+    params = model.init(jax.random.PRNGKey(3),
+                        random_tokens(1, 8, vocab_size=cfg.vocab_size))["params"]
+    engine = InferenceEngineV2(params, cfg, V2EngineConfig(
+        kv_block_size=16, kv_num_blocks=64,
+        scheduler=SchedulerConfig(max_tokens_per_step=64,
+                                  prefill_buckets=(16, 32, 64))))
+    got = engine.generate(list(prompt), max_new_tokens=4)
+    ids = list(prompt)
+    for _ in range(4):
+        logits = model.apply({"params": params},
+                             jnp.asarray([ids], jnp.int32),
+                             method=lambda m, x: m.model(x))
+        ids.append(int(np.argmax(np.asarray(logits)[0, -1])))
+    assert got == ids[len(prompt):], (got, ids[len(prompt):])
