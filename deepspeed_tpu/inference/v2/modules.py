@@ -360,3 +360,61 @@ class BloomPolicy:
                        cfg.layer_norm_eps)
         return x.astype(jnp.float32) @ \
             m["embed"]["embedding"].astype(jnp.float32).T   # tied
+
+
+# ---------------------------------------------------------------------------
+# GPT-NeoX / GPT-J (partial rotary, parallel residual, untied embed_out head)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.gpt_neox import (  # noqa: E402
+    GPTNeoXConfig, apply_partial_rotary)
+
+
+@register_policy("gpt_neox", GPTNeoXConfig)
+class GPTNeoXPolicy:
+    """reference: gptneox/gptj containers (module_inject/containers)."""
+
+    @staticmethod
+    def cache_spec(cfg: GPTNeoXConfig) -> KVCacheSpec:
+        return KVCacheSpec(cfg.num_layers, cfg.num_heads, cfg.head_dim_,
+                           cfg.max_seq_len, cfg.dtype, None)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        return params["model"]["embed"]["embedding"].astype(cfg.dtype)[tokens]
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        lp = params["model"][f"layer_{i}"]
+        dtype = cfg.dtype
+        eps = cfg.layer_norm_eps
+        h = _layernorm(x, lp["input_ln"]["scale"], lp["input_ln"]["bias"], eps)
+        q = jnp.einsum("td,dhk->thk", h, lp["wq"]["kernel"].astype(dtype)) + \
+            lp["wq"]["bias"].astype(dtype)
+        k = jnp.einsum("td,dhk->thk", h, lp["wk"]["kernel"].astype(dtype)) + \
+            lp["wk"]["bias"].astype(dtype)
+        v = jnp.einsum("td,dhk->thk", h, lp["wv"]["kernel"].astype(dtype)) + \
+            lp["wv"]["bias"].astype(dtype)
+        q = apply_partial_rotary(q, positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 cfg.max_seq_len)
+        k = apply_partial_rotary(k, positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 cfg.max_seq_len)
+        attn = attend(q, k, v)
+        attn_out = jnp.einsum("thk,hkd->td", attn,
+                              lp["wo"]["kernel"].astype(dtype)) + \
+            lp["wo"]["bias"].astype(dtype)
+        h2_src = x if cfg.parallel_residual else x + attn_out
+        h2 = _layernorm(h2_src, lp["post_ln"]["scale"], lp["post_ln"]["bias"],
+                        eps)
+        m = jax.nn.gelu(h2 @ lp["mlp_up"]["kernel"].astype(dtype) +
+                        lp["mlp_up"]["bias"].astype(dtype))
+        mlp_out = m @ lp["mlp_down"]["kernel"].astype(dtype) + \
+            lp["mlp_down"]["bias"].astype(dtype)
+        return (x + attn_out + mlp_out) if cfg.parallel_residual \
+            else h2_src + mlp_out
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        m = params["model"]
+        x = _layernorm(x, m["final_ln"]["scale"], m["final_ln"]["bias"],
+                       cfg.layer_norm_eps)
+        return x.astype(jnp.float32) @ m["embed_out"].astype(jnp.float32)

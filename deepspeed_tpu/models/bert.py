@@ -1,0 +1,194 @@
+"""BERT encoder family (bidirectional attention, post-LN, MLM head).
+
+Reference analog: the BERT container (``module_inject/containers/bert.py``),
+the vendored regression BERT (``tests/unit/modeling.py``), and the compression
+suite's standard target (``deepspeed/compression`` examples train BERT). The
+training kernel suite (``csrc/transformer/``, ``DeepSpeedTransformerLayer``)
+was likewise built around BERT-style post-LN blocks.
+
+Architecture: word + learned position + token-type embeddings with LayerNorm;
+post-LN encoder blocks (attn -> add&LN -> GELU FFN -> add&LN); MLM head
+(transform dense + GELU + LN, decoder tied to the embedding table + output
+bias). Attention is bidirectional (``causal=False``) with an optional padding
+mask via ``attention_mask``.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, HEADS_AXIS, SEQ_AXIS, shard_activation)
+
+MLM_IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_BERT = BertConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                       num_layers=2, num_heads=4, max_position_embeddings=128)
+
+
+def _bidirectional_attention(q, k, v, attention_mask):
+    """[B,S,H,d] attention without causal masking; ``attention_mask`` [B,S]
+    (1 = attend, 0 = padding) masks keys."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    if attention_mask is not None:
+        bias = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                         0.0, jnp.finfo(jnp.float32).min)
+        scores = scores + bias.astype(scores.dtype)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class BertBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.cfg
+        d = cfg.head_dim_
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(x)
+        k = dense(features=(cfg.num_heads, d), name="wk")(x)
+        v = dense(features=(cfg.num_heads, d), name="wv")(x)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        attn = _bidirectional_attention(q, k, v, attention_mask)
+        attn_out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                   use_bias=True, dtype=cfg.dtype,
+                                   param_dtype=jnp.float32, name="wo")(attn)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attn_ln")(x + attn_out)          # post-LN
+        m = nn.Dense(cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="fc1")(x)
+        m = jax.nn.gelu(m)
+        m = nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="fc2")(m)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlp_ln")(x + m)
+        return shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+
+
+class BertModel(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
+                                         input_ids.shape)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")
+        x = embed(input_ids)
+        x = x + self.param("pos_embed", nn.initializers.normal(0.02),
+                           (cfg.max_position_embeddings, cfg.hidden_size),
+                           jnp.float32)[positions].astype(cfg.dtype)
+        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embed_ln")(x)
+        x = shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+        for i in range(cfg.num_layers):
+            x = BertBlock(cfg, name=f"layer_{i}")(x, attention_mask)
+        return x, embed
+
+
+class BertForMaskedLM(nn.Module):
+    """batch: {"input_ids", "labels" (MLM targets, -100 = unmasked),
+    optional "token_type_ids"/"attention_mask"} -> mean MLM loss.
+    ``logits(batch)`` returns [B, S, V] for evaluation."""
+    cfg: BertConfig
+
+    def setup(self):
+        self.model = BertModel(self.cfg)
+        self.mlm_dense = nn.Dense(self.cfg.hidden_size, dtype=self.cfg.dtype,
+                                  param_dtype=jnp.float32, name="mlm_dense")
+        self.mlm_ln = nn.LayerNorm(epsilon=self.cfg.layer_norm_eps,
+                                   dtype=self.cfg.dtype, name="mlm_ln")
+        self.mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                                   (self.cfg.vocab_size,), jnp.float32)
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def _logits(self, batch):
+        x, embed = self.model(batch["input_ids"],
+                              batch.get("token_type_ids"),
+                              batch.get("attention_mask"))
+        h = jax.nn.gelu(self.mlm_dense(x))
+        h = self.mlm_ln(h)
+        return embed.attend(h).astype(jnp.float32) + self.mlm_bias  # tied
+
+    def logits(self, batch):
+        return self._logits(batch)
+
+    def __call__(self, batch):
+        logits = self._logits(batch)
+        labels = batch.get("labels")
+        if labels is None:   # engine warmup / perplexity eval: all positions
+            labels = batch["input_ids"]
+        mask = (labels != MLM_IGNORE_INDEX).astype(jnp.float32)
+        safe = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def bert_tensor_rules(path, leaf):
+    from jax.sharding import PartitionSpec
+    names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    if "embed" in names or "type_embed" in names:
+        return PartitionSpec(None, "tensor")
+    if any(n in names for n in ("wq", "wk", "wv")) and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor", None)
+    if "wo" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None, None)
+    if "fc1" in names and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor")
+    if "fc2" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None)
+    return None
+
+
+def mlm_mask_batch(input_ids: np.ndarray, rng: np.random.Generator,
+                   mask_token_id: int, vocab_size: int,
+                   mask_prob: float = 0.15):
+    """Standard BERT masking: select mask_prob positions as targets; of those
+    80% -> [MASK], 10% -> random token, 10% -> unchanged."""
+    input_ids = np.array(input_ids, copy=True)
+    labels = np.full_like(input_ids, MLM_IGNORE_INDEX)
+    sel = rng.random(input_ids.shape) < mask_prob
+    labels[sel] = input_ids[sel]
+    roll = rng.random(input_ids.shape)
+    input_ids[sel & (roll < 0.8)] = mask_token_id
+    rand = sel & (roll >= 0.8) & (roll < 0.9)
+    input_ids[rand] = rng.integers(0, vocab_size, size=int(rand.sum()))
+    return {"input_ids": input_ids, "labels": labels}

@@ -1,0 +1,209 @@
+"""GPT-NeoX / GPT-J model family (partial rotary, parallel residual).
+
+Reference analog: the gptneox/gptj containers
+(``module_inject/containers/{gptneox,gptj}.py``) and their v1 inference
+policies. Architecture knobs covering both archs:
+
+- ``rotary_pct``: rotary applied to the first ``pct`` of each head dim
+  (NeoX default 0.25; GPT-J uses a fixed ``rotary_dim``, expressed as a pct)
+- ``parallel_residual``: ``x + attn(ln1(x)) + mlp(ln2(x))`` (NeoX
+  ``use_parallel_residual`` / GPT-J's single-LN parallel block)
+- untied ``embed_out`` lm head (NeoX) — unlike gpt2/bloom
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, HEADS_AXIS, SEQ_AXIS, _dispatch_attention, rope_freqs,
+    shard_activation)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 4096
+    intermediate_size: int = 16384
+    num_layers: int = 32
+    num_heads: int = 32
+    max_seq_len: int = 2048
+    rotary_pct: float = 0.25
+    rope_theta: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    parallel_residual: bool = True
+    dtype: Any = jnp.bfloat16
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def rotary_dim_(self) -> int:
+        # even size, like NeoX's int(head_dim * rotary_pct)
+        return (int(self.head_dim_ * self.rotary_pct) // 2) * 2
+
+
+TINY_NEOX = GPTNeoXConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_layers=2, num_heads=4,
+                          max_seq_len=128, dtype=jnp.float32)
+
+# GPT-J-style preset: fixed rotary_dim=64 on head_dim 256 -> pct 0.25,
+# parallel residual with one shared LN is approximated by parallel_residual
+GPTJ_6B = GPTNeoXConfig(vocab_size=50400, hidden_size=4096,
+                        intermediate_size=16384, num_layers=28, num_heads=16,
+                        rotary_pct=64 / 256, parallel_residual=True)
+
+
+def apply_partial_rotary(x, positions, rot_dim, theta, max_seq_len):
+    """Rotate the first ``rot_dim`` of each head dim; pass the rest through
+    (NeoX rotary_pct semantics). x: [..., H, d]; positions broadcastable to
+    the leading dims."""
+    if rot_dim <= 0:
+        return x
+    cos, sin = rope_freqs(rot_dim, max_seq_len, theta)
+    cos = jnp.asarray(cos)[positions][..., None, :]   # [..., 1, rot/2]
+    sin = jnp.asarray(sin)[positions][..., None, :]
+    rot, rest = x[..., :rot_dim], x[..., rot_dim:]
+    r1, r2 = jnp.split(rot.astype(jnp.float32), 2, axis=-1)
+    rot = jnp.concatenate([r1 * cos - r2 * sin, r2 * cos + r1 * sin], -1)
+    return jnp.concatenate([rot.astype(x.dtype), rest], axis=-1)
+
+
+class GPTNeoXBlock(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        d = cfg.head_dim_
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="input_ln")(x)
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(h)
+        k = dense(features=(cfg.num_heads, d), name="wk")(h)
+        v = dense(features=(cfg.num_heads, d), name="wv")(h)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        q = apply_partial_rotary(q, positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 cfg.max_seq_len)
+        k = apply_partial_rotary(k, positions, cfg.rotary_dim_, cfg.rope_theta,
+                                 cfg.max_seq_len)
+        attn = _dispatch_attention(cfg.attention_backend, q, k, v, causal=True)
+        attn_out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                   use_bias=True, dtype=cfg.dtype,
+                                   param_dtype=jnp.float32, name="wo")(attn)
+        h2_src = x if cfg.parallel_residual else x + attn_out
+        h2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                          name="post_ln")(h2_src)
+        m = nn.Dense(cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(h2)
+        m = jax.nn.gelu(m)
+        mlp_out = nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+                           param_dtype=jnp.float32, name="mlp_down")(m)
+        if cfg.parallel_residual:
+            x = x + attn_out + mlp_out
+        else:
+            x = h2_src + mlp_out
+        return shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+
+
+class GPTNeoXModel(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
+                                         input_ids.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(input_ids)
+        x = shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+        for i in range(cfg.num_layers):
+            x = GPTNeoXBlock(cfg, name=f"layer_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_ln")(x)
+        kernel = self.param("embed_out", nn.initializers.lecun_normal(),
+                            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        return x.astype(jnp.float32) @ kernel  # untied NeoX head
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    cfg: GPTNeoXConfig
+
+    def setup(self):
+        self.model = GPTNeoXModel(self.cfg)
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def __call__(self, batch):
+        input_ids = batch["input_ids"]
+        logits = self.model(input_ids, positions=batch.get("positions"))
+        labels = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+
+def gpt_neox_tensor_rules(path, leaf):
+    from jax.sharding import PartitionSpec
+    names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    if "embed" in names or "embed_out" in names:
+        return PartitionSpec(None, "tensor")
+    if any(n in names for n in ("wq", "wk", "wv")) and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor", None)
+    if "wo" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None, None)
+    if "mlp_up" in names and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor")
+    if "mlp_down" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None)
+    return None
+
+
+def convert_hf_gpt_neox(hf_state, cfg: GPTNeoXConfig):
+    """HF GPT-NeoX naming -> our tree. HF fuses query_key_value rows as
+    ``[h, 3, d]`` per-head interleave (same layout fusedqkv_utils splits for
+    bloom/neox)."""
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    dmodel, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    pfx = "gpt_neox."
+    tree = {
+        "embed": {"embedding": get(pfx + "embed_in.weight")},
+        "final_ln": {"scale": get(pfx + "final_layer_norm.weight"),
+                     "bias": get(pfx + "final_layer_norm.bias")},
+        "embed_out": get("embed_out.weight").T,
+    }
+    for i in range(cfg.num_layers):
+        p = f"{pfx}layers.{i}."
+        w = get(p + "attention.query_key_value.weight").reshape(h, 3, d, dmodel)
+        b = get(p + "attention.query_key_value.bias").reshape(h, 3, d)
+        tree[f"layer_{i}"] = {
+            "input_ln": {"scale": get(p + "input_layernorm.weight"),
+                         "bias": get(p + "input_layernorm.bias")},
+            "post_ln": {"scale": get(p + "post_attention_layernorm.weight"),
+                        "bias": get(p + "post_attention_layernorm.bias")},
+            "wq": {"kernel": w[:, 0].transpose(2, 0, 1), "bias": b[:, 0]},
+            "wk": {"kernel": w[:, 1].transpose(2, 0, 1), "bias": b[:, 1]},
+            "wv": {"kernel": w[:, 2].transpose(2, 0, 1), "bias": b[:, 2]},
+            "wo": {"kernel": get(p + "attention.dense.weight")
+                   .T.reshape(h, d, dmodel),
+                   "bias": get(p + "attention.dense.bias")},
+            "mlp_up": {"kernel": get(p + "mlp.dense_h_to_4h.weight").T,
+                       "bias": get(p + "mlp.dense_h_to_4h.bias")},
+            "mlp_down": {"kernel": get(p + "mlp.dense_4h_to_h.weight").T,
+                         "bias": get(p + "mlp.dense_4h_to_h.bias")},
+        }
+    return {"model": tree}

@@ -137,10 +137,6 @@ def run_distributed(fn: Union[Callable, str], world_size: int = 2,
                 f"rank {rank} exited {p.returncode}:\n{outs[rank]}")
     if timed_out:
         raise TimeoutError(f"ranks {timed_out} timed out ({timeout}s)")
-    for rank, p in enumerate(procs):
-        if p.returncode != 0:
-            raise RuntimeError(
-                f"rank {rank} exited {p.returncode}:\n{outs[rank]}")
     return outs
 
 

@@ -54,7 +54,7 @@ def test_bloom_trains_and_tp_rules():
     config = {"train_batch_size": 8,
               "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
               "zero_optimization": {"stage": 3},
-              "mesh": {"data": 4, "fsdp": 2}}
+              "mesh": {"data": 2, "fsdp": 2, "tensor": 2}}
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, config=config,
         example_batch=random_tokens(8, 16, vocab_size=TINY_BLOOM.vocab_size),

@@ -1,0 +1,116 @@
+"""GPT-NeoX / GPT-J family tests: partial rotary, parallel residual, training,
+HF conversion, paged serving.
+
+Reference analog: gptneox/gptj container cases under ``tests/unit/inference``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt_neox import (
+    GPTJ_6B, TINY_NEOX, GPTNeoXConfig, GPTNeoXForCausalLM,
+    apply_partial_rotary, convert_hf_gpt_neox, gpt_neox_tensor_rules)
+from deepspeed_tpu.models.llama import random_tokens
+
+
+def test_partial_rotary_rotates_prefix_only():
+    x = np.random.default_rng(0).normal(size=(2, 8, 4, 16)).astype(np.float32)
+    pos = np.broadcast_to(np.arange(8), (2, 8))
+    out = np.asarray(apply_partial_rotary(jnp.asarray(x), jnp.asarray(pos),
+                                          8, 10000.0, 64))
+    # tail passes through untouched; rotated prefix differs (except pos 0)
+    np.testing.assert_allclose(out[..., 8:], x[..., 8:])
+    assert not np.allclose(out[:, 1:, :, :8], x[:, 1:, :, :8])
+    np.testing.assert_allclose(out[:, 0], x[:, 0], rtol=1e-6)  # angle 0
+
+
+def test_presets():
+    assert TINY_NEOX.rotary_dim_ == int(32 * 0.25) * 0 + (int(32 * 0.25) // 2) * 2
+    assert GPTJ_6B.rotary_dim_ == 64
+    assert GPTJ_6B.head_dim_ == 256
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_neox_trains(parallel):
+    cfg = dataclasses.replace(TINY_NEOX, parallel_residual=parallel)
+    model = GPTNeoXForCausalLM(cfg)
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 3},
+              "mesh": {"data": 2, "fsdp": 2, "tensor": 2}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=config,
+        example_batch=random_tokens(8, 16, vocab_size=cfg.vocab_size),
+        tensor_rules=gpt_neox_tensor_rules)
+    fixed = random_tokens(8, 16, vocab_size=cfg.vocab_size, seed=0)
+    losses = [float(engine.train_batch(batch=fixed)) for _ in range(5)]
+    assert losses[-1] < losses[0] and all(np.isfinite(losses))
+
+
+def test_hf_conversion_roundtrip_forward():
+    cfg = TINY_NEOX
+    rng = np.random.default_rng(3)
+    d, h, dh = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    hf = {"gpt_neox.embed_in.weight":
+          rng.normal(size=(cfg.vocab_size, d)).astype(np.float32) * 0.02,
+          "gpt_neox.final_layer_norm.weight": np.ones(d, np.float32),
+          "gpt_neox.final_layer_norm.bias": np.zeros(d, np.float32),
+          "embed_out.weight":
+          rng.normal(size=(cfg.vocab_size, d)).astype(np.float32) * 0.02}
+    for i in range(cfg.num_layers):
+        p = f"gpt_neox.layers.{i}."
+        hf[p + "input_layernorm.weight"] = np.ones(d, np.float32)
+        hf[p + "input_layernorm.bias"] = np.zeros(d, np.float32)
+        hf[p + "post_attention_layernorm.weight"] = np.ones(d, np.float32)
+        hf[p + "post_attention_layernorm.bias"] = np.zeros(d, np.float32)
+        hf[p + "attention.query_key_value.weight"] = \
+            rng.normal(size=(3 * h * dh, d)).astype(np.float32) * 0.02
+        hf[p + "attention.query_key_value.bias"] = np.zeros(3 * h * dh, np.float32)
+        hf[p + "attention.dense.weight"] = \
+            rng.normal(size=(d, d)).astype(np.float32) * 0.02
+        hf[p + "attention.dense.bias"] = np.zeros(d, np.float32)
+        hf[p + "mlp.dense_h_to_4h.weight"] = \
+            rng.normal(size=(cfg.intermediate_size, d)).astype(np.float32) * 0.02
+        hf[p + "mlp.dense_h_to_4h.bias"] = np.zeros(cfg.intermediate_size, np.float32)
+        hf[p + "mlp.dense_4h_to_h.weight"] = \
+            rng.normal(size=(d, cfg.intermediate_size)).astype(np.float32) * 0.02
+        hf[p + "mlp.dense_4h_to_h.bias"] = np.zeros(d, np.float32)
+
+    params = jax.tree.map(jnp.asarray, convert_hf_gpt_neox(hf, cfg))
+    model = GPTNeoXForCausalLM(cfg)
+    batch = random_tokens(2, 12, vocab_size=cfg.vocab_size)
+    ref = model.init(jax.random.PRNGKey(0), batch)["params"]
+    assert jax.tree.structure(ref) == jax.tree.structure(params)
+    assert np.isfinite(float(model.apply({"params": params}, batch)))
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_serve_neox_paged_matches_full(parallel):
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, V2EngineConfig)
+    from deepspeed_tpu.inference.v2.modules import GPTNeoXPolicy, policy_for
+    from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+
+    cfg = dataclasses.replace(TINY_NEOX, parallel_residual=parallel)
+    assert policy_for(cfg) is GPTNeoXPolicy
+    model = GPTNeoXForCausalLM(cfg)
+    prompt = list(np.random.default_rng(6).integers(0, cfg.vocab_size, 10))
+    params = model.init(jax.random.PRNGKey(2),
+                        random_tokens(1, 8, vocab_size=cfg.vocab_size))["params"]
+    engine = InferenceEngineV2(params, cfg, V2EngineConfig(
+        kv_block_size=16, kv_num_blocks=64,
+        scheduler=SchedulerConfig(max_tokens_per_step=64,
+                                  prefill_buckets=(16, 32, 64))))
+    got = engine.generate(list(prompt), max_new_tokens=4)
+    ids = list(prompt)
+    for _ in range(4):
+        logits = model.apply({"params": params},
+                             jnp.asarray([ids], jnp.int32),
+                             method=lambda m, x: m.model(x))
+        ids.append(int(np.argmax(np.asarray(logits)[0, -1])))
+    assert got == ids[len(prompt):], (got, ids[len(prompt):])
