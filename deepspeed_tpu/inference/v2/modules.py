@@ -418,3 +418,55 @@ class GPTNeoXPolicy:
         x = _layernorm(x, m["final_ln"]["scale"], m["final_ln"]["bias"],
                        cfg.layer_norm_eps)
         return x.astype(jnp.float32) @ m["embed_out"].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 (learned positions, pre-LN, tied wte head)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.gpt2 import GPT2Config  # noqa: E402
+
+
+@register_policy("gpt2", GPT2Config)
+class GPT2Policy:
+    """reference: HFGPT2LayerPolicy / megatron-gpt container."""
+
+    @staticmethod
+    def cache_spec(cfg: GPT2Config) -> KVCacheSpec:
+        return KVCacheSpec(cfg.num_layers, cfg.num_heads, cfg.head_dim_,
+                           cfg.max_seq_len, cfg.dtype, None)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        m = params["model"]
+        return m["embed"]["embedding"].astype(cfg.dtype)[tokens] + \
+            m["pos_embed"][positions].astype(cfg.dtype)
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        lp = params["model"][f"layer_{i}"]
+        dtype = cfg.dtype
+        eps = cfg.layer_norm_eps
+        h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps)
+        q = jnp.einsum("td,dhk->thk", h, lp["wq"]["kernel"].astype(dtype)) + \
+            lp["wq"]["bias"].astype(dtype)
+        k = jnp.einsum("td,dhk->thk", h, lp["wk"]["kernel"].astype(dtype)) + \
+            lp["wk"]["bias"].astype(dtype)
+        v = jnp.einsum("td,dhk->thk", h, lp["wv"]["kernel"].astype(dtype)) + \
+            lp["wv"]["bias"].astype(dtype)
+        attn = attend(q, k, v)               # no rope: positions are learned
+        x = x + jnp.einsum("thk,hkd->td", attn,
+                           lp["wo"]["kernel"].astype(dtype)) + \
+            lp["wo"]["bias"].astype(dtype)
+        h2 = _layernorm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps)
+        m = jax.nn.gelu(h2 @ lp["mlp_up"]["kernel"].astype(dtype) +
+                        lp["mlp_up"]["bias"].astype(dtype))
+        return x + m @ lp["mlp_down"]["kernel"].astype(dtype) + \
+            lp["mlp_down"]["bias"].astype(dtype)
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        m = params["model"]
+        x = _layernorm(x, m["final_ln"]["scale"], m["final_ln"]["bias"],
+                       cfg.layer_norm_eps)
+        return x.astype(jnp.float32) @ \
+            m["embed"]["embedding"].astype(jnp.float32).T   # tied

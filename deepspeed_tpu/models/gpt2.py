@@ -1,0 +1,166 @@
+"""GPT-2 model family (learned positions, pre-LN, fused c_attn, tied head).
+
+Reference analog: the megatron/gpt2-style containers
+(``module_inject/containers/megatron_gpt.py``, ``distil_bert.py`` sibling) and
+HFGPT2LayerPolicy (``module_inject/containers/gpt2.py``). Architecture: wte +
+wpe embeddings, pre-LN blocks (ln_1 -> attn -> residual; ln_2 -> GELU MLP ->
+residual), final ln_f, head tied to wte. HF stores Conv1D weights as
+``[in, out]`` (already kernel-oriented — no transpose in the converter,
+unlike Linear-based archs).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, HEADS_AXIS, SEQ_AXIS, _dispatch_attention, shard_activation)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_GPT2 = GPT2Config(vocab_size=512, hidden_size=128, num_layers=2,
+                       num_heads=4, max_seq_len=128, dtype=jnp.float32)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        d = cfg.head_dim_
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_1")(x)
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(h)
+        k = dense(features=(cfg.num_heads, d), name="wk")(h)
+        v = dense(features=(cfg.num_heads, d), name="wv")(h)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        attn = _dispatch_attention(cfg.attention_backend, q, k, v, causal=True)
+        x = x + nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                                use_bias=True, dtype=cfg.dtype,
+                                param_dtype=jnp.float32, name="wo")(attn)
+        h2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                          name="ln_2")(x)
+        m = nn.Dense(4 * cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(h2)
+        m = jax.nn.gelu(m)
+        x = x + nn.Dense(cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="mlp_down")(m)
+        return shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+
+
+class GPT2Model(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
+                                         input_ids.shape)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")
+        x = embed(input_ids)
+        x = x + self.param("pos_embed", nn.initializers.normal(0.02),
+                           (cfg.max_seq_len, cfg.hidden_size),
+                           jnp.float32)[positions].astype(cfg.dtype)
+        x = shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+        for i in range(cfg.num_layers):
+            x = GPT2Block(cfg, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_ln")(x)
+        return x.astype(jnp.float32) @ \
+            embed.embedding.astype(jnp.float32).T   # tied wte head
+
+
+class GPT2ForCausalLM(nn.Module):
+    cfg: GPT2Config
+
+    def setup(self):
+        self.model = GPT2Model(self.cfg)
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def __call__(self, batch):
+        input_ids = batch["input_ids"]
+        logits = self.model(input_ids, positions=batch.get("positions"))
+        labels = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+
+def gpt2_tensor_rules(path, leaf):
+    from jax.sharding import PartitionSpec
+    names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    if "embed" in names or "pos_embed" in names:
+        return PartitionSpec(None, "tensor")
+    if any(n in names for n in ("wq", "wk", "wv")) and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor", None)
+    if "wo" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None, None)
+    if "mlp_up" in names and names[-1] == "kernel":
+        return PartitionSpec(None, "tensor")
+    if "mlp_down" in names and names[-1] == "kernel":
+        return PartitionSpec("tensor", None)
+    return None
+
+
+def convert_hf_gpt2(hf_state, cfg: GPT2Config):
+    """HF GPT-2 naming -> our tree. c_attn fuses q|k|v COLUMNS of a Conv1D
+    ``[D, 3D]`` (sequential split, not per-head interleave — the layout
+    fusedqkv_utils calls 'glmtype' sequential)."""
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    dmodel, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    tree = {
+        "embed": {"embedding": get("wte.weight")},
+        "pos_embed": get("wpe.weight"),
+        "final_ln": {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        ca_w = get(p + "attn.c_attn.weight")          # [D, 3D] Conv1D
+        ca_b = get(p + "attn.c_attn.bias")            # [3D]
+        qw, kw, vw = np.split(ca_w, 3, axis=1)
+        qb, kb, vb = np.split(ca_b, 3)
+        tree[f"layer_{i}"] = {
+            "ln_1": {"scale": get(p + "ln_1.weight"), "bias": get(p + "ln_1.bias")},
+            "ln_2": {"scale": get(p + "ln_2.weight"), "bias": get(p + "ln_2.bias")},
+            "wq": {"kernel": qw.reshape(dmodel, h, d), "bias": qb.reshape(h, d)},
+            "wk": {"kernel": kw.reshape(dmodel, h, d), "bias": kb.reshape(h, d)},
+            "wv": {"kernel": vw.reshape(dmodel, h, d), "bias": vb.reshape(h, d)},
+            "wo": {"kernel": get(p + "attn.c_proj.weight").reshape(h, d, dmodel),
+                   "bias": get(p + "attn.c_proj.bias")},
+            "mlp_up": {"kernel": get(p + "mlp.c_fc.weight"),
+                       "bias": get(p + "mlp.c_fc.bias")},
+            "mlp_down": {"kernel": get(p + "mlp.c_proj.weight"),
+                         "bias": get(p + "mlp.c_proj.bias")},
+        }
+    return {"model": tree}
