@@ -42,8 +42,11 @@ def main():
         dtype=jnp.bfloat16,
         attention_backend=os.environ.get("DSTPU_BENCH_ATTN", "flash"),
         # chunked head+CE fusion: the fp32 [B*S,V] logits (1GB at mb=4) never
-        # materialize, freeing ~3GB of HLO temps (enables micro_batch 4)
-        loss_chunk_size=int(os.environ.get("DSTPU_BENCH_LOSS_CHUNK", 2048)) or None,
+        # materialize, freeing ~3GB of HLO temps (enables micro_batch 4).
+        # OFF by default: its TPU compile was in flight when the axon tunnel
+        # wedged (2026-07-30) and is unproven on hardware — flip the default
+        # only after DSTPU_BENCH_LOSS_CHUNK=2048 measures clean on a chip
+        loss_chunk_size=int(os.environ.get("DSTPU_BENCH_LOSS_CHUNK", 0)) or None,
         remat=os.environ.get("DSTPU_BENCH_REMAT", "1") == "1",
         remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
                                     "dots_with_no_batch_dims_saveable"))

@@ -162,18 +162,77 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def discover_cluster_env() -> dict:
+    """Rendezvous discovery chain (reference: ``comm/comm.py:619``
+    init_distributed env:// + ``mpi_discovery:688`` + the AML/AWS-SM env
+    patching ``:744,:776``): DSTPU_* > torch-style RANK/WORLD_SIZE/MASTER_ADDR
+    > OpenMPI OMPI_COMM_WORLD_* > SLURM_*. Returns possibly-empty kwargs for
+    ``jax.distributed.initialize``."""
+    env = os.environ
+    out = {}
+    # DSTPU_* vars are independent (any launcher may set a subset)
+    if "DSTPU_NUM_PROCESSES" in env:
+        out["num_processes"] = int(env["DSTPU_NUM_PROCESSES"])
+    if "DSTPU_PROCESS_ID" in env:
+        out["process_id"] = int(env["DSTPU_PROCESS_ID"])
+    if env.get("DSTPU_COORDINATOR_ADDRESS"):
+        out["coordinator_address"] = env["DSTPU_COORDINATOR_ADDRESS"]
+    if out:
+        return out
+    # torch-style: the full triple is only ever set together by a launcher, so
+    # requiring all three avoids hijacking unrelated runs
+    if "WORLD_SIZE" in env and "RANK" in env and env.get("MASTER_ADDR"):
+        return {"num_processes": int(env["WORLD_SIZE"]),
+                "process_id": int(env["RANK"]),
+                "coordinator_address":
+                    f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '29500')}"}
+    # MPI/SLURM allocations leak their env into interactive shells (a bare
+    # python under sbatch sees SLURM_NTASKS), so these are opt-in — the analog
+    # of the reference's auto_mpi_discovery arg (comm/comm.py:619)
+    if env.get("DSTPU_AUTO_MPI_DISCOVERY") != "1":
+        return {}
+    if "OMPI_COMM_WORLD_SIZE" in env:             # mpirun (mpi_discovery)
+        out["num_processes"] = int(env["OMPI_COMM_WORLD_SIZE"])
+        out["process_id"] = int(env["OMPI_COMM_WORLD_RANK"])
+        if env.get("MASTER_ADDR"):
+            out["coordinator_address"] = \
+                f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '29500')}"
+    elif "SLURM_NTASKS" in env and "SLURM_PROCID" in env:   # srun
+        out["num_processes"] = int(env["SLURM_NTASKS"])
+        out["process_id"] = int(env["SLURM_PROCID"])
+        nodelist = env.get("SLURM_STEP_NODELIST", env.get("SLURM_NODELIST", ""))
+        head = _slurm_head_node(nodelist)
+        if head:
+            out["coordinator_address"] = \
+                f"{head}:{env.get('MASTER_PORT', '29500')}"
+    return out
+
+
+def _slurm_head_node(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist. Handles hyphenated prefixes and
+    bracket ranges: ``tpu-pod-node[1-4,7]`` -> ``tpu-pod-node1``."""
+    import re
+    first = nodelist.split(",")[0].strip()
+    m = re.match(r"^([^\[]+)\[(\d+)", first)
+    if m:
+        return m.group(1) + m.group(2)
+    return first
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:
     """Multi-host bring-up (reference: comm.init_distributed env:// rendezvous,
     comm/comm.py:619). On TPU pods JAX auto-discovers peers from the TPU metadata;
-    explicit args support DCN/CPU clusters. No-op when single-process."""
+    explicit args support DCN/CPU clusters; env discovery covers torchrun/MPI/
+    SLURM launches (``discover_cluster_env``). No-op when single-process."""
+    disc = discover_cluster_env()
     if num_processes is None:
-        num_processes = int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+        num_processes = disc.get("num_processes", 1)
     if coordinator_address is None:
-        coordinator_address = os.environ.get("DSTPU_COORDINATOR_ADDRESS")
-    if process_id is None and "DSTPU_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["DSTPU_PROCESS_ID"])
+        coordinator_address = disc.get("coordinator_address")
+    if process_id is None:
+        process_id = disc.get("process_id")
     if num_processes <= 1 and coordinator_address is None:
         return
     kwargs = {}

@@ -39,6 +39,11 @@ def parse_args(args=None):
     parser.add_argument("--coordinator_port", type=int, default=8476)
     parser.add_argument("--nproc_per_node", type=int, default=None,
                         help="processes on this node (default: from world_info)")
+    parser.add_argument("--bind_cores_to_rank", action="store_true",
+                        help="pin each local rank to an equal slice of host "
+                        "cores via taskset (reference launch.py numactl "
+                        "binding — keeps host-side input pipelines and the "
+                        "offload-tier CPU optimizer off each other's cores)")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -63,6 +68,24 @@ def build_rank_env(world_info: dict, node_rank: int, local_rank: int,
     return env
 
 
+def core_binding_prefix(local_rank: int, nproc: int) -> List[str]:
+    """An equal slice of this process's ALLOWED cores per local rank
+    (reference ``launch.py`` numactl/core-binding path; ``utils/numa.py``).
+    Uses sched_getaffinity, not cpu_count — in a cgroup/cpuset-limited
+    container the machine's full core list is not bindable. Empty when cores
+    can't be split."""
+    try:
+        cores = sorted(os.sched_getaffinity(0))
+    except AttributeError:       # non-linux
+        cores = list(range(os.cpu_count() or 1))
+    per = len(cores) // nproc
+    if per < 1:
+        return []
+    mine = cores[local_rank * per:] if local_rank == nproc - 1 \
+        else cores[local_rank * per:(local_rank + 1) * per]
+    return ["taskset", "-c", ",".join(str(c) for c in mine)]
+
+
 def main(args=None):
     args = parse_args(args)
     world_info = decode_world_info(args.world_info)
@@ -83,6 +106,8 @@ def main(args=None):
         env = build_rank_env(world_info, node_rank, local_rank,
                              args.coordinator_addr, args.coordinator_port)
         cmd = [sys.executable, "-u", args.user_script] + args.user_args
+        if args.bind_cores_to_rank:
+            cmd = core_binding_prefix(local_rank, nproc) + cmd
         logger.info(f"launching local rank {local_rank}: {' '.join(cmd)}")
         processes.append(subprocess.Popen(cmd, env=env))
 

@@ -181,6 +181,21 @@ POLICIES = {
         "whisper",
         column=("q_proj", "k_proj", "v_proj", "fc1"),
         row=("out_proj", "fc2")),
+    # diffusers UNet2DConditionModel (reference containers/unet.py): only the
+    # cross/self-attention projections and GEGLU net shard; convs replicate
+    "unet": TPPolicy(
+        "unet",
+        column=("to_q", "to_k", "to_v", "ff/net_0/proj", "net/0/proj"),
+        row=("to_out/0", "to_out_0", "ff/net_2", "net/2"),
+        vocab_in=(), vocab_out=()),
+    # diffusers AutoencoderKL (reference containers/vae.py): attention block
+    # projections shard, conv encoder/decoder replicates
+    "vae": TPPolicy(
+        "vae",
+        column=("to_q", "to_k", "to_v", "attention/query", "attention/key",
+                "attention/value"),
+        row=("to_out/0", "attention/proj_attn"),
+        vocab_in=(), vocab_out=()),
 }
 
 # aliases: HF model_type / class-name spellings -> canonical key
@@ -209,6 +224,8 @@ _ALIASES = {
     "clipmodel": "clip", "cliptextmodel": "clip", "clipvisionmodel": "clip",
     "t5forconditionalgeneration": "t5", "mt5forconditionalgeneration": "t5",
     "whisperforconditionalgeneration": "whisper",
+    "unet2dconditionmodel": "unet",
+    "autoencoderkl": "vae",
 }
 
 

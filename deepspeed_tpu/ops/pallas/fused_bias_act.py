@@ -1,0 +1,194 @@
+"""Fused bias + activation (+ dropout) Pallas kernels, fwd and bwd.
+
+Reference analog: ``csrc/transformer/gelu_kernels.cu`` (fused_bias_gelu +
+d_gelu_bias backward) and ``dropout_kernels.cu`` (``dropout_act``-style fused
+variants) — the elementwise tail of the reference's fused transformer layer.
+
+TPU note: XLA fuses a plain ``act(x + b)`` into the producing matmul, so the
+un-dropout forms exist mainly for the op-level parity surface; the fused
+*dropout* variant is the one XLA cannot reproduce exactly — it fuses the PRNG
+(Pallas ``prng_random_bits``, threefry-seeded per block) with bias+activation
+in one VMEM pass, like the CUDA kernel's curand-in-kernel design, and its
+backward regenerates the same mask from the seed instead of storing it
+(memory: zero mask bytes vs B*S*F bools).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only primitives; interpret-mode fallbacks used off-TPU
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "identity": lambda x: x,
+}
+
+
+def _act_grad(name, x):
+    return jax.grad(lambda v: jnp.sum(_ACTS[name](v)))(x)
+
+
+def _bias_act_kernel(x_ref, b_ref, o_ref, *, act):
+    x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    o_ref[:] = _ACTS[act](x).astype(o_ref.dtype)
+
+
+def _bias_act_bwd_kernel(x_ref, b_ref, g_ref, dx_ref, *, act):
+    x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    dx_ref[:] = (_act_grad(act, x) * g_ref[:].astype(jnp.float32)) \
+        .astype(dx_ref.dtype)
+
+
+def _call_rows(kernel, args, out_dtype, block_rows, interpret):
+    """Row-blocked elementwise pallas_call over [N, D] operands (+[D] bias)."""
+    n, d = args[0].shape
+    pad = (-n) % block_rows
+    if pad:
+        args = [jnp.pad(a, ((0, pad), (0, 0))) if a.ndim == 2 else a
+                for a in args]
+    specs = [pl.BlockSpec((block_rows, d), lambda i: (i, 0)) if a.ndim == 2
+             else pl.BlockSpec((d,), lambda i: (0,)) for a in args]
+    out = pl.pallas_call(
+        kernel,
+        grid=((n + pad) // block_rows,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n + pad, d), out_dtype),
+        interpret=interpret,
+    )(*args)
+    return out[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def fused_bias_act(x, bias, act: str = "gelu", block_rows: int = 256,
+                   interpret: bool = False):
+    """act(x + bias) in one VMEM pass. x: [..., D]; bias: [D]."""
+    shape = x.shape
+    out = _call_rows(functools.partial(_bias_act_kernel, act=act),
+                     [x.reshape(-1, shape[-1]), bias], x.dtype, block_rows,
+                     interpret)
+    return out.reshape(shape)
+
+
+def _fba_fwd(x, bias, act, block_rows, interpret):
+    return fused_bias_act(x, bias, act, block_rows, interpret), (x, bias)
+
+
+def _fba_bwd(act, block_rows, interpret, res, g):
+    x, bias = res
+    shape = x.shape
+    dx = _call_rows(
+        functools.partial(_bias_act_bwd_kernel, act=act),
+        [x.reshape(-1, shape[-1]), bias, g.reshape(-1, shape[-1])],
+        x.dtype, block_rows, interpret).reshape(shape)
+    db = jnp.sum(dx.astype(jnp.float32),
+                 axis=tuple(range(x.ndim - 1))).astype(bias.dtype)
+    return dx, db
+
+
+fused_bias_act.defvjp(_fba_fwd, _fba_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused bias + activation + dropout (mask regenerated in backward)
+# ---------------------------------------------------------------------------
+
+def _u32_to_unit_float(bits):
+    # upper 24 bits -> [0, 1) floats, unbiased
+    return (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+def _bias_act_dropout_kernel(seed_ref, x_ref, b_ref, o_ref, *, act, rate, bwd):
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0], i)
+    bits = pltpu.prng_random_bits(x_ref.shape).astype(jnp.uint32)
+    keep = _u32_to_unit_float(bits) >= rate
+    scale = 1.0 / (1.0 - rate)
+    x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    val = _act_grad(act, x) if bwd else _ACTS[act](x)
+    o_ref[:] = jnp.where(keep, val * scale, 0.0).astype(o_ref.dtype)
+
+
+def _dropout_call(x2, bias, seed, act, rate, bwd, block_rows, interpret):
+    if interpret:
+        # pltpu PRNG primitives have no CPU lowering; the interpret-mode path
+        # derives the keep mask from the same seed with jax.random — the
+        # fwd/bwd mask-identity contract holds per platform
+        keep = jax.random.uniform(jax.random.PRNGKey(seed[0]),
+                                  x2.shape) >= rate
+        x = x2.astype(jnp.float32) + bias.astype(jnp.float32)
+        val = _act_grad(act, x) if bwd else _ACTS[act](x)
+        return jnp.where(keep, val / (1.0 - rate), 0.0).astype(x2.dtype)
+    n, d = x2.shape
+    pad = (-n) % block_rows
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_bias_act_dropout_kernel, act=act, rate=rate,
+                          bwd=bwd),
+        grid=((n + pad) // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n + pad, d), x2.dtype),
+        interpret=interpret,
+    )(seed, x2, bias)
+    return out[:n]
+
+
+def _fbad_impl(x, bias, seed, act, rate, block_rows, interpret, bwd, g=None):
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    seed_arr = jnp.asarray([seed], jnp.int32) if jnp.ndim(seed) == 0 \
+        else seed.reshape(1).astype(jnp.int32)
+    out = _dropout_call(x2, bias, seed_arr, act, rate, bwd, block_rows,
+                        interpret)
+    if bwd:
+        out = out * g.reshape(-1, shape[-1]).astype(out.dtype)
+    return out.reshape(shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def fused_bias_act_dropout(x, bias, seed, act: str = "gelu",
+                           rate: float = 0.1, block_rows: int = 256,
+                           interpret: bool = False):
+    """dropout(act(x + bias)) with the mask generated in-kernel from ``seed``
+    (int32 scalar). The backward re-derives the identical mask from the same
+    seed — no mask tensor is ever written to HBM."""
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(f"dropout rate must be in [0, 1), got {rate}")
+    if rate == 0.0:
+        return fused_bias_act(x, bias, act, block_rows, interpret)
+    return _fbad_impl(x, bias, seed, act, rate, block_rows, interpret,
+                      bwd=False)
+
+
+def _fbad_fwd(x, bias, seed, act, rate, block_rows, interpret):
+    return fused_bias_act_dropout(x, bias, seed, act, rate, block_rows,
+                                  interpret), (x, bias, seed)
+
+
+def _fbad_bwd(act, rate, block_rows, interpret, res, g):
+    x, bias, seed = res
+    if rate == 0.0:
+        dx, db = _fba_bwd(act, block_rows, interpret, (x, bias), g)
+        return dx, db, None
+    dx = _fbad_impl(x, bias, seed, act, rate, block_rows, interpret,
+                    bwd=True, g=g)
+    db = jnp.sum(dx.astype(jnp.float32),
+                 axis=tuple(range(x.ndim - 1))).astype(bias.dtype)
+    return dx, db, None
+
+
+fused_bias_act_dropout.defvjp(_fbad_fwd, _fbad_bwd)

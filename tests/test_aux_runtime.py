@@ -36,6 +36,7 @@ def test_power_iteration_matches_dense_hessian():
     assert abs(out["b0"] - expected) / expected < 0.05, (out, expected)
 
 
+@pytest.mark.slow
 def test_eigenvalue_orders_model_blocks():
     """Per-layer eigenvalues over a real model's loss come out positive and
     finite (ordering input for the compression scheduler)."""

@@ -49,6 +49,7 @@ def test_alibi_augmentation_equals_explicit_bias():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bloom_trains_and_tp_rules():
     model = BloomForCausalLM(TINY_BLOOM)
     config = {"train_batch_size": 8,

@@ -131,6 +131,7 @@ def test_engine_profiles_at_step():
     assert engine.flops_profiler.get_total_flops() > 0
 
 
+@pytest.mark.slow
 def test_engine_profile_trace(tmp_path):
     import deepspeed_tpu
     from deepspeed_tpu.models.simple import SimpleModel, random_batch

@@ -72,6 +72,7 @@ def test_batch_generate():
     assert len(outs) == 2 and all(len(o) == 2 for o in outs)
 
 
+@pytest.mark.slow
 def test_release_inference_cache():
     engine, cfg = _hybrid_engine(release_inference_cache=True)
     engine.generate([1, 2, 3], max_new_tokens=2)

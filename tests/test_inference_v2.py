@@ -70,6 +70,7 @@ def model_and_params():
     return cfg, model, params
 
 
+@pytest.mark.slow
 def test_paged_forward_matches_full(model_and_params):
     """Greedy generation via paged prefill+decode == argmax chain of the training
     model's full forward."""

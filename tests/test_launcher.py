@@ -172,6 +172,7 @@ def test_launch_spawns_and_propagates_failure(tmp_path):
     assert proc.returncode == 3
 
 
+@pytest.mark.slow
 def test_launch_success(tmp_path):
     script = tmp_path / "ok.py"
     script.write_text("print('hello from', __import__('os').environ['DSTPU_PROCESS_ID'])\n")
@@ -183,3 +184,55 @@ def test_launch_success(tmp_path):
          str(script)],
         cwd="/root/repo", capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
+
+
+def test_core_binding_prefix_slices_cores():
+    from deepspeed_tpu.launcher.launch import core_binding_prefix
+    import os
+    n = os.cpu_count() or 1
+    cores = sorted(os.sched_getaffinity(0))
+    if len(cores) >= 2:
+        p0 = core_binding_prefix(0, 2)
+        p1 = core_binding_prefix(1, 2)
+        assert p0[:2] == ["taskset", "-c"]
+        assert p0[2].split(",")[0] == str(cores[0])
+        assert p1[2].split(",")[-1] == str(cores[-1])
+        # slices are disjoint and only use allowed cores
+        s0 = {int(c) for c in p0[2].split(",")}
+        s1 = {int(c) for c in p1[2].split(",")}
+        assert not (s0 & s1) and (s0 | s1) <= set(cores)
+    assert core_binding_prefix(0, len(cores) + 1) == []
+
+
+def test_discover_cluster_env_chains(monkeypatch):
+    from deepspeed_tpu.comm.mesh import discover_cluster_env
+    for var in ("DSTPU_NUM_PROCESSES", "WORLD_SIZE", "RANK", "MASTER_ADDR",
+                "OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS"):
+        monkeypatch.delenv(var, raising=False)
+    assert discover_cluster_env() == {}
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    monkeypatch.setenv("RANK", "2")
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    d = discover_cluster_env()
+    assert d == {"num_processes": 4, "process_id": 2,
+                 "coordinator_address": "10.0.0.1:29500"}
+    # DSTPU_* takes precedence over torch-style
+    monkeypatch.setenv("DSTPU_NUM_PROCESSES", "8")
+    monkeypatch.setenv("DSTPU_PROCESS_ID", "5")
+    d = discover_cluster_env()
+    assert d["num_processes"] == 8 and d["process_id"] == 5
+    # SLURM fallback
+    for var in ("DSTPU_NUM_PROCESSES", "DSTPU_PROCESS_ID", "WORLD_SIZE", "RANK"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.delenv("MASTER_ADDR", raising=False)
+    monkeypatch.setenv("SLURM_NTASKS", "16")
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NODELIST", "tpu-pod-node[1-4],tpu-pod-node7")
+    # stray SLURM env without opt-in must NOT trigger discovery (a bare
+    # python under sbatch would otherwise hang waiting for peers)
+    assert discover_cluster_env() == {}
+    monkeypatch.setenv("DSTPU_AUTO_MPI_DISCOVERY", "1")
+    d = discover_cluster_env()
+    assert d["num_processes"] == 16 and d["process_id"] == 3
+    assert d["coordinator_address"].startswith("tpu-pod-node1:")
+    monkeypatch.delenv("DSTPU_AUTO_MPI_DISCOVERY")

@@ -159,6 +159,7 @@ def test_mistral_style_paged_decode_matches_full():
 
 
 # ------------------------------------------------------------- falcon / opt
+@pytest.mark.slow
 def test_falcon_trains_and_tp_rules():
     model = FalconForCausalLM(TINY_FALCON)
     config = {"train_batch_size": 8,

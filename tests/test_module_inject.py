@@ -258,3 +258,19 @@ def test_policy_alias_lookup_breadth():
                          ("megatron", "megatron_gpt")]:
         p = get_policy(alias)
         assert p is not None and p.arch == canon, (alias, p)
+
+
+def test_diffusion_policies_unet_vae():
+    """UNet/VAE containers (reference module_inject/containers/{unet,vae}.py):
+    attention projections shard, convs replicate."""
+    for arch, cls in [("unet", "UNet2DConditionModel"), ("vae", "AutoencoderKL")]:
+        pol = get_policy(arch)
+        assert pol is not None and get_policy(cls) is pol
+    rules = get_policy("unet").tensor_rules()
+    w = np.zeros((64, 64))
+    assert rules(_path("down_blocks_0/attentions_0/transformer_blocks_0/attn1/to_q/kernel"), w) \
+        == PartitionSpec(None, "tensor")
+    assert rules(_path("down_blocks_0/attentions_0/transformer_blocks_0/attn1/to_out/0/kernel"), w) \
+        == PartitionSpec("tensor", None)
+    # convs replicate (no rule)
+    assert rules(_path("down_blocks_0/resnets_0/conv1/kernel"), np.zeros((3, 3, 8, 8))) is None

@@ -9,6 +9,7 @@ module.py`` and the tensor-fragment debug API cases.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.comm.mesh import create_mesh
@@ -98,6 +99,7 @@ def test_z3_leaf_modules_opt_out_of_fsdp():
         unset_z3_leaf_modules(["experts"])
 
 
+@pytest.mark.slow
 def test_tensor_fragment_routes_through_offload_masters():
     """Under host offload, get/set must hit the fp32 masters, not the
     compute-dtype device shadows (reference tensor_fragment fragment map)."""

@@ -50,6 +50,7 @@ def _serve_and_reference(model, params, cfg, logits_method, prompt, n=4):
     assert got == ids[len(prompt):], (got, ids[len(prompt):])
 
 
+@pytest.mark.slow
 def test_serve_falcon():
     cfg = dataclasses.replace(TINY_FALCON, dtype=jnp.float32)
     model = FalconForCausalLM(cfg)
@@ -63,6 +64,7 @@ def test_serve_falcon():
         prompt)
 
 
+@pytest.mark.slow
 def test_serve_falcon_new_decoder_architecture():
     cfg = dataclasses.replace(TINY_FALCON, dtype=jnp.float32, num_heads=4,
                               num_kv_heads=2, new_decoder_architecture=True)
