@@ -29,6 +29,7 @@ def initialize(args=None,
                lr_scheduler=None,
                distributed_port: Optional[int] = None,
                mesh=None,
+               mpu=None,
                dist_init_required: Optional[bool] = None,
                collate_fn: Optional[Callable] = None,
                config: Any = None,
@@ -53,6 +54,27 @@ def initialize(args=None,
 
     if dist_init_required:
         _mesh_lib.init_distributed()
+
+    if mesh is None and mpu is not None:
+        # Megatron-style mpu compat (reference: initialize(..., mpu=) —
+        # engine.py:1184 reads the mp/pp world sizes off it): translate the
+        # mpu's world sizes into a named-axis mesh
+        from deepspeed_tpu.config.config import MeshConfig
+
+        def _ws(*names):
+            for n in names:
+                fn = getattr(mpu, n, None)
+                if fn is not None:
+                    return int(fn())
+            return 1
+
+        mesh = _mesh_lib.create_mesh(MeshConfig(
+            tensor=_ws("get_tensor_model_parallel_world_size",
+                       "get_model_parallel_world_size"),
+            pipe=_ws("get_pipeline_model_parallel_world_size",
+                     "get_pipe_parallel_world_size"),
+            sequence=_ws("get_sequence_parallel_world_size"),
+            data=-1))
 
     engine_kwargs = dict(
         model=model,

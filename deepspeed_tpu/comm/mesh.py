@@ -197,6 +197,22 @@ def discover_cluster_env() -> dict:
         if env.get("MASTER_ADDR"):
             out["coordinator_address"] = \
                 f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '29500')}"
+        else:
+            # mpirun sets no MASTER_ADDR; the reference bcasts rank 0's IP
+            # over MPI (comm.py:688 mpi_discovery) — same here when mpi4py
+            # is present, else the user must export MASTER_ADDR
+            try:
+                from mpi4py import MPI
+                import socket
+                comm = MPI.COMM_WORLD
+                host = comm.bcast(
+                    socket.gethostbyname(socket.gethostname()), root=0)
+                out["coordinator_address"] = \
+                    f"{host}:{env.get('MASTER_PORT', '29500')}"
+            except ImportError:
+                logger.warning(
+                    "OMPI discovery: mpi4py unavailable and MASTER_ADDR "
+                    "unset — cannot derive the coordinator address")
     elif "SLURM_NTASKS" in env and "SLURM_PROCID" in env:   # srun
         out["num_processes"] = int(env["SLURM_NTASKS"])
         out["process_id"] = int(env["SLURM_PROCID"])

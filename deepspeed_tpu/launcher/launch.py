@@ -76,8 +76,8 @@ def core_binding_prefix(local_rank: int, nproc: int) -> List[str]:
     can't be split."""
     try:
         cores = sorted(os.sched_getaffinity(0))
-    except AttributeError:       # non-linux
-        cores = list(range(os.cpu_count() or 1))
+    except AttributeError:       # non-linux: no taskset either — skip binding
+        return []
     per = len(cores) // nproc
     if per < 1:
         return []

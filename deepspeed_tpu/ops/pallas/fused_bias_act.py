@@ -41,10 +41,13 @@ def _bias_act_kernel(x_ref, b_ref, o_ref, *, act):
     o_ref[:] = _ACTS[act](x).astype(o_ref.dtype)
 
 
-def _bias_act_bwd_kernel(x_ref, b_ref, g_ref, dx_ref, *, act):
+def _bias_act_bwd_kernel(x_ref, b_ref, g_ref, dx_ref, db_ref, *, act):
     x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
-    dx_ref[:] = (_act_grad(act, x) * g_ref[:].astype(jnp.float32)) \
-        .astype(dx_ref.dtype)
+    dx = _act_grad(act, x) * g_ref[:].astype(jnp.float32)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    # per-block bias-grad partial, fused in the same VMEM pass (reference
+    # d_gelu_bias accumulates db in-kernel) — no second HBM sweep over dx
+    db_ref[:] = jnp.sum(dx, axis=0, keepdims=True)
 
 
 def _call_rows(kernel, args, out_dtype, block_rows, interpret):
@@ -85,13 +88,35 @@ def _fba_fwd(x, bias, act, block_rows, interpret):
 def _fba_bwd(act, block_rows, interpret, res, g):
     x, bias = res
     shape = x.shape
-    dx = _call_rows(
+    d = shape[-1]
+    x2, g2 = x.reshape(-1, d), g.reshape(-1, d)
+    n = x2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        # zero-padded rows: act_grad(0+b)*g where g=0 -> dx=0, db unaffected
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+        g2 = jnp.pad(g2, ((0, pad), (0, 0)))
+    grid = (n + pad) // block_rows
+    dx, db_parts = pl.pallas_call(
         functools.partial(_bias_act_bwd_kernel, act=act),
-        [x.reshape(-1, shape[-1]), bias, g.reshape(-1, shape[-1])],
-        x.dtype, block_rows, interpret).reshape(shape)
-    db = jnp.sum(dx.astype(jnp.float32),
-                 axis=tuple(range(x.ndim - 1))).astype(bias.dtype)
-    return dx, db
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n + pad, d), x.dtype),
+            jax.ShapeDtypeStruct((grid, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, bias, g2)
+    return dx[:n].reshape(shape), \
+        jnp.sum(db_parts, axis=0).astype(bias.dtype)
 
 
 fused_bias_act.defvjp(_fba_fwd, _fba_bwd)
@@ -106,34 +131,60 @@ def _u32_to_unit_float(bits):
     return (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
 
 
-def _bias_act_dropout_kernel(seed_ref, x_ref, b_ref, o_ref, *, act, rate, bwd):
+def _bias_act_dropout_kernel(seed_ref, x_ref, b_ref, o_ref, *, act, rate):
     i = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0], i)
     bits = pltpu.prng_random_bits(x_ref.shape).astype(jnp.uint32)
     keep = _u32_to_unit_float(bits) >= rate
     scale = 1.0 / (1.0 - rate)
     x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
-    val = _act_grad(act, x) if bwd else _ACTS[act](x)
-    o_ref[:] = jnp.where(keep, val * scale, 0.0).astype(o_ref.dtype)
+    o_ref[:] = jnp.where(keep, _ACTS[act](x) * scale, 0.0).astype(o_ref.dtype)
 
 
-def _dropout_call(x2, bias, seed, act, rate, bwd, block_rows, interpret):
+def _bias_act_dropout_bwd_kernel(seed_ref, x_ref, b_ref, g_ref, dx_ref, db_ref,
+                                 *, act, rate):
+    # regenerate the SAME mask as forward: identical seed, grid index, shape
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0], i)
+    bits = pltpu.prng_random_bits(x_ref.shape).astype(jnp.uint32)
+    keep = _u32_to_unit_float(bits) >= rate
+    scale = 1.0 / (1.0 - rate)
+    x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    dx = jnp.where(keep, _act_grad(act, x) * scale, 0.0) * \
+        g_ref[:].astype(jnp.float32)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    db_ref[:] = jnp.sum(dx, axis=0, keepdims=True)
+
+
+def _seed_arr(seed):
+    return jnp.asarray([seed], jnp.int32) if jnp.ndim(seed) == 0 \
+        else seed.reshape(1).astype(jnp.int32)
+
+
+def _interp_keep(seed, shape, rate):
+    # pltpu PRNG primitives have no CPU lowering; the interpret-mode path
+    # derives the keep mask from the same seed with jax.random — the
+    # fwd/bwd mask-identity contract holds per platform
+    return jax.random.uniform(jax.random.PRNGKey(seed[0]), shape) >= rate
+
+
+def _fbad_impl(x, bias, seed, act, rate, block_rows, interpret):
+    shape = x.shape
+    d = shape[-1]
+    x2 = x.reshape(-1, d)
+    seed = _seed_arr(seed)
     if interpret:
-        # pltpu PRNG primitives have no CPU lowering; the interpret-mode path
-        # derives the keep mask from the same seed with jax.random — the
-        # fwd/bwd mask-identity contract holds per platform
-        keep = jax.random.uniform(jax.random.PRNGKey(seed[0]),
-                                  x2.shape) >= rate
-        x = x2.astype(jnp.float32) + bias.astype(jnp.float32)
-        val = _act_grad(act, x) if bwd else _ACTS[act](x)
-        return jnp.where(keep, val / (1.0 - rate), 0.0).astype(x2.dtype)
-    n, d = x2.shape
+        keep = _interp_keep(seed, x2.shape, rate)
+        xb = x2.astype(jnp.float32) + bias.astype(jnp.float32)
+        out = jnp.where(keep, _ACTS[act](xb) / (1.0 - rate), 0.0) \
+            .astype(x2.dtype)
+        return out.reshape(shape)
+    n = x2.shape[0]
     pad = (-n) % block_rows
     if pad:
         x2 = jnp.pad(x2, ((0, pad), (0, 0)))
     out = pl.pallas_call(
-        functools.partial(_bias_act_dropout_kernel, act=act, rate=rate,
-                          bwd=bwd),
+        functools.partial(_bias_act_dropout_kernel, act=act, rate=rate),
         grid=((n + pad) // block_rows,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -144,19 +195,48 @@ def _dropout_call(x2, bias, seed, act, rate, bwd, block_rows, interpret):
         out_shape=jax.ShapeDtypeStruct((n + pad, d), x2.dtype),
         interpret=interpret,
     )(seed, x2, bias)
-    return out[:n]
+    return out[:n].reshape(shape)
 
 
-def _fbad_impl(x, bias, seed, act, rate, block_rows, interpret, bwd, g=None):
+def _fbad_bwd_impl(x, bias, seed, g, act, rate, block_rows, interpret):
     shape = x.shape
-    x2 = x.reshape(-1, shape[-1])
-    seed_arr = jnp.asarray([seed], jnp.int32) if jnp.ndim(seed) == 0 \
-        else seed.reshape(1).astype(jnp.int32)
-    out = _dropout_call(x2, bias, seed_arr, act, rate, bwd, block_rows,
-                        interpret)
-    if bwd:
-        out = out * g.reshape(-1, shape[-1]).astype(out.dtype)
-    return out.reshape(shape)
+    d = shape[-1]
+    x2, g2 = x.reshape(-1, d), g.reshape(-1, d)
+    seed = _seed_arr(seed)
+    if interpret:
+        keep = _interp_keep(seed, x2.shape, rate)
+        xb = x2.astype(jnp.float32) + bias.astype(jnp.float32)
+        dx = jnp.where(keep, _act_grad(act, xb) / (1.0 - rate), 0.0) * \
+            g2.astype(jnp.float32)
+        return dx.astype(x.dtype).reshape(shape), \
+            jnp.sum(dx, axis=0).astype(bias.dtype)
+    n = x2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+        g2 = jnp.pad(g2, ((0, pad), (0, 0)))
+    grid = (n + pad) // block_rows
+    dx, db_parts = pl.pallas_call(
+        functools.partial(_bias_act_dropout_bwd_kernel, act=act, rate=rate),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n + pad, d), x.dtype),
+            jax.ShapeDtypeStruct((grid, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed, x2, bias, g2)
+    return dx[:n].reshape(shape), \
+        jnp.sum(db_parts, axis=0).astype(bias.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -170,8 +250,7 @@ def fused_bias_act_dropout(x, bias, seed, act: str = "gelu",
         raise ValueError(f"dropout rate must be in [0, 1), got {rate}")
     if rate == 0.0:
         return fused_bias_act(x, bias, act, block_rows, interpret)
-    return _fbad_impl(x, bias, seed, act, rate, block_rows, interpret,
-                      bwd=False)
+    return _fbad_impl(x, bias, seed, act, rate, block_rows, interpret)
 
 
 def _fbad_fwd(x, bias, seed, act, rate, block_rows, interpret):
@@ -184,10 +263,8 @@ def _fbad_bwd(act, rate, block_rows, interpret, res, g):
     if rate == 0.0:
         dx, db = _fba_bwd(act, block_rows, interpret, (x, bias), g)
         return dx, db, None
-    dx = _fbad_impl(x, bias, seed, act, rate, block_rows, interpret,
-                    bwd=True, g=g)
-    db = jnp.sum(dx.astype(jnp.float32),
-                 axis=tuple(range(x.ndim - 1))).astype(bias.dtype)
+    dx, db = _fbad_bwd_impl(x, bias, seed, g, act, rate, block_rows,
+                            interpret)
     return dx, db, None
 
 

@@ -84,3 +84,27 @@ def test_optimizer_scheduler_parse():
     })
     assert cfg.optimizer.type == "AdamW"
     assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_initialize_accepts_megatron_mpu():
+    """reference: deepspeed.initialize(..., mpu=) reads world sizes off the
+    Megatron mpu object (engine.py:1184)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+    class FakeMPU:
+        def get_tensor_model_parallel_world_size(self):
+            return 2
+
+        def get_pipeline_model_parallel_world_size(self):
+            return 1
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}},
+        mpu=FakeMPU(), example_batch=random_batch(4))
+    assert engine.mesh.shape["tensor"] == 2
+    assert engine.mesh.shape["data"] == 4
+    import numpy as np
+    assert np.isfinite(float(engine.train_batch(batch=random_batch(8))))

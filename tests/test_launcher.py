@@ -206,8 +206,12 @@ def test_core_binding_prefix_slices_cores():
 
 def test_discover_cluster_env_chains(monkeypatch):
     from deepspeed_tpu.comm.mesh import discover_cluster_env
-    for var in ("DSTPU_NUM_PROCESSES", "WORLD_SIZE", "RANK", "MASTER_ADDR",
-                "OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS"):
+    for var in ("DSTPU_NUM_PROCESSES", "DSTPU_PROCESS_ID",
+                "DSTPU_COORDINATOR_ADDRESS", "DSTPU_AUTO_MPI_DISCOVERY",
+                "WORLD_SIZE", "RANK", "MASTER_ADDR", "MASTER_PORT",
+                "OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK",
+                "SLURM_NTASKS", "SLURM_PROCID", "SLURM_NODELIST",
+                "SLURM_STEP_NODELIST"):
         monkeypatch.delenv(var, raising=False)
     assert discover_cluster_env() == {}
     monkeypatch.setenv("WORLD_SIZE", "4")
