@@ -203,16 +203,14 @@ def discover_cluster_env() -> dict:
             # is present, else the user must export MASTER_ADDR
             try:
                 from mpi4py import MPI
-                import socket
-                comm = MPI.COMM_WORLD
-                host = comm.bcast(
-                    socket.gethostbyname(socket.gethostname()), root=0)
-                out["coordinator_address"] = \
-                    f"{host}:{env.get('MASTER_PORT', '29500')}"
-            except ImportError:
+                host = MPI.COMM_WORLD.bcast(_non_loopback_ip(), root=0)
+                if host:
+                    out["coordinator_address"] = \
+                        f"{host}:{env.get('MASTER_PORT', '29500')}"
+            except Exception as e:   # degrade, never crash startup
                 logger.warning(
-                    "OMPI discovery: mpi4py unavailable and MASTER_ADDR "
-                    "unset — cannot derive the coordinator address")
+                    "OMPI discovery: cannot derive the coordinator address "
+                    f"({e}); export MASTER_ADDR to rendezvous")
     elif "SLURM_NTASKS" in env and "SLURM_PROCID" in env:   # srun
         out["num_processes"] = int(env["SLURM_NTASKS"])
         out["process_id"] = int(env["SLURM_PROCID"])
@@ -222,6 +220,22 @@ def discover_cluster_env() -> dict:
             out["coordinator_address"] = \
                 f"{head}:{env.get('MASTER_PORT', '29500')}"
     return out
+
+
+def _non_loopback_ip() -> str:
+    """This host's outbound-interface IP (reference mpi_discovery uses
+    ``hostname -I``'s first entry for the same reason:
+    gethostbyname(gethostname()) is 127.0.1.1 on stock Debian images)."""
+    import socket
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))   # no traffic sent; routes the socket
+        return s.getsockname()[0]
+    except OSError:
+        ip = socket.gethostbyname(socket.gethostname())
+        return "" if ip.startswith("127.") else ip
+    finally:
+        s.close()
 
 
 def _slurm_head_node(nodelist: str) -> str:

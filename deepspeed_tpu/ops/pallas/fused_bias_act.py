@@ -85,26 +85,32 @@ def _fba_fwd(x, bias, act, block_rows, interpret):
     return fused_bias_act(x, bias, act, block_rows, interpret), (x, bias)
 
 
-def _fba_bwd(act, block_rows, interpret, res, g):
-    x, bias = res
+def _bwd_call(kernel, x, bias, g, block_rows, interpret, seed=None):
+    """Shared bwd scaffolding: pad rows, run the (dx, db-partials) kernel,
+    slice, reduce partials. Zero-padded rows contribute nothing — g is padded
+    with zeros, so dx=0 there and db is unaffected."""
     shape = x.shape
     d = shape[-1]
     x2, g2 = x.reshape(-1, d), g.reshape(-1, d)
     n = x2.shape[0]
     pad = (-n) % block_rows
     if pad:
-        # zero-padded rows: act_grad(0+b)*g where g=0 -> dx=0, db unaffected
         x2 = jnp.pad(x2, ((0, pad), (0, 0)))
         g2 = jnp.pad(g2, ((0, pad), (0, 0)))
     grid = (n + pad) // block_rows
+    in_specs = [
+        pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        pl.BlockSpec((d,), lambda i: (0,)),
+        pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+    ]
+    args = [x2, bias, g2]
+    if seed is not None:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.insert(0, seed)
     dx, db_parts = pl.pallas_call(
-        functools.partial(_bias_act_bwd_kernel, act=act),
+        kernel,
         grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
             pl.BlockSpec((1, d), lambda i: (i, 0)),
@@ -114,9 +120,15 @@ def _fba_bwd(act, block_rows, interpret, res, g):
             jax.ShapeDtypeStruct((grid, d), jnp.float32),
         ],
         interpret=interpret,
-    )(x2, bias, g2)
+    )(*args)
     return dx[:n].reshape(shape), \
         jnp.sum(db_parts, axis=0).astype(bias.dtype)
+
+
+def _fba_bwd(act, block_rows, interpret, res, g):
+    x, bias = res
+    return _bwd_call(functools.partial(_bias_act_bwd_kernel, act=act),
+                     x, bias, g, block_rows, interpret)
 
 
 fused_bias_act.defvjp(_fba_fwd, _fba_bwd)
@@ -199,44 +211,19 @@ def _fbad_impl(x, bias, seed, act, rate, block_rows, interpret):
 
 
 def _fbad_bwd_impl(x, bias, seed, g, act, rate, block_rows, interpret):
-    shape = x.shape
-    d = shape[-1]
-    x2, g2 = x.reshape(-1, d), g.reshape(-1, d)
     seed = _seed_arr(seed)
     if interpret:
+        shape = x.shape
+        x2, g2 = x.reshape(-1, shape[-1]), g.reshape(-1, shape[-1])
         keep = _interp_keep(seed, x2.shape, rate)
         xb = x2.astype(jnp.float32) + bias.astype(jnp.float32)
         dx = jnp.where(keep, _act_grad(act, xb) / (1.0 - rate), 0.0) * \
             g2.astype(jnp.float32)
         return dx.astype(x.dtype).reshape(shape), \
             jnp.sum(dx, axis=0).astype(bias.dtype)
-    n = x2.shape[0]
-    pad = (-n) % block_rows
-    if pad:
-        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
-        g2 = jnp.pad(g2, ((0, pad), (0, 0)))
-    grid = (n + pad) // block_rows
-    dx, db_parts = pl.pallas_call(
+    return _bwd_call(
         functools.partial(_bias_act_dropout_bwd_kernel, act=act, rate=rate),
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n + pad, d), x.dtype),
-            jax.ShapeDtypeStruct((grid, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(seed, x2, bias, g2)
-    return dx[:n].reshape(shape), \
-        jnp.sum(db_parts, axis=0).astype(bias.dtype)
+        x, bias, g, block_rows, interpret, seed=seed)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

@@ -89,3 +89,31 @@ def test_rate_zero_falls_back_and_bad_rate_rejected():
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
     with pytest.raises(ValueError, match="rate"):
         fused_bias_act_dropout(x, b, 0, "relu", 1.5, 4, True)
+
+
+def test_bwd_padding_path_uneven_rows():
+    """Row counts NOT divisible by block_rows exercise the pad-then-slice
+    backward path; padded rows must not pollute dx or db."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(13, 24)).astype(np.float32))  # 13 % 8 != 0
+    b = jnp.asarray(rng.normal(size=(24,)).astype(np.float32))
+
+    gx, gb = jax.grad(
+        lambda x, b: jnp.sum(fused_bias_act(x, b, "gelu", 8, True) ** 2),
+        argnums=(0, 1))(x, b)
+    rx, rb = jax.grad(
+        lambda x, b: jnp.sum(jax.nn.gelu(x + b) ** 2), argnums=(0, 1))(x, b)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=1e-4,
+                               atol=1e-5)
+
+    # dropout variant on uneven rows: db consistent with dx
+    g = jnp.asarray(rng.normal(size=(13, 24)).astype(np.float32))
+    out, vjp = jax.vjp(
+        lambda x, b: fused_bias_act_dropout(x, b, 13, "silu", 0.2, 8, True),
+        x, b)
+    dx, db = vjp(g)
+    np.testing.assert_allclose(np.asarray(db),
+                               np.asarray(dx).astype(np.float32).sum(0),
+                               rtol=1e-5)
