@@ -201,16 +201,19 @@ def discover_cluster_env() -> dict:
             # mpirun sets no MASTER_ADDR; the reference bcasts rank 0's IP
             # over MPI (comm.py:688 mpi_discovery) — same here when mpi4py
             # is present, else the user must export MASTER_ADDR
+            host = None
             try:
                 from mpi4py import MPI
                 host = MPI.COMM_WORLD.bcast(_non_loopback_ip(), root=0)
-                if host:
-                    out["coordinator_address"] = \
-                        f"{host}:{env.get('MASTER_PORT', '29500')}"
             except Exception as e:   # degrade, never crash startup
+                logger.warning(f"OMPI discovery failed ({e})")
+            if host:
+                out["coordinator_address"] = \
+                    f"{host}:{env.get('MASTER_PORT', '29500')}"
+            else:
                 logger.warning(
-                    "OMPI discovery: cannot derive the coordinator address "
-                    f"({e}); export MASTER_ADDR to rendezvous")
+                    "OMPI discovery: cannot derive the coordinator address; "
+                    "export MASTER_ADDR to rendezvous")
     elif "SLURM_NTASKS" in env and "SLURM_PROCID" in env:   # srun
         out["num_processes"] = int(env["SLURM_NTASKS"])
         out["process_id"] = int(env["SLURM_PROCID"])

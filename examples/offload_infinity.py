@@ -49,8 +49,7 @@ def main():
         model=LlamaForCausalLM(TINY_LLAMA), config=config,
         example_batch=random_tokens(2, 32, vocab_size=TINY_LLAMA.vocab_size))
     assert engine._offload is not None
-    fixed = random_tokens(8 // engine.dp_world_size * engine.dp_world_size, 32,
-                          vocab_size=TINY_LLAMA.vocab_size, seed=0)
+    fixed = random_tokens(8, 32, vocab_size=TINY_LLAMA.vocab_size, seed=0)
     losses = [float(engine.train_batch(batch=fixed)) for _ in range(args.steps)]
     print(f"offload={args.device}: loss {losses[0]:.4f} -> {losses[-1]:.4f}")
     assert losses[-1] < losses[0] and all(np.isfinite(losses))

@@ -69,15 +69,22 @@ def main():
     in_flight = set()
     done = {}
     while pending or in_flight:
-        admit = [u for u in list(pending)
-                 if engine.can_schedule([u], [len(pending[u])])]
+        # grow the admitted wave while the BATCH still fits (put() re-checks
+        # the combined batch, so admission must be checked combined too)
+        admit = []
+        for u in list(pending):
+            if engine.can_schedule(admit + [u],
+                                   [len(pending[c]) for c in admit] +
+                                   [len(pending[u])]):
+                admit.append(u)
         if admit:
             engine.put(admit, [pending.pop(u) for u in admit])
             in_flight.update(admit)
         engine.step()
         for uid in list(in_flight):
             if len(engine.state.get(uid).generated) >= args.max_new_tokens:
-                done[uid] = engine.flush(uid)
+                # put()/step() may overshoot by a token; honor the budget
+                done[uid] = engine.flush(uid)[:args.max_new_tokens]
                 in_flight.discard(uid)
     for uid in sorted(done):
         print(f"request {uid}: prompt {len(prompts[uid])} tokens -> "

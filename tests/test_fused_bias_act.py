@@ -108,7 +108,10 @@ def test_bwd_padding_path_uneven_rows():
     np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=1e-4,
                                atol=1e-5)
 
-    # dropout variant on uneven rows: db consistent with dx
+    # dropout variant on uneven rows: db consistent with dx. NOTE: under
+    # interpret=True this exercises the jnp fallback, not _bwd_call's
+    # seed-in-SMEM insertion — that branch only lowers on real TPU hardware
+    # (pltpu PRNG has no CPU path) and is covered by on-chip smoke runs.
     g = jnp.asarray(rng.normal(size=(13, 24)).astype(np.float32))
     out, vjp = jax.vjp(
         lambda x, b: fused_bias_act_dropout(x, b, 13, "silu", 0.2, 8, True),
