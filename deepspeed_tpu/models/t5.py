@@ -1,0 +1,342 @@
+"""T5 encoder-decoder family (relative position bias, RMS norms, no biases).
+
+Reference analog: the T5 injection policy (``module_inject`` t5 container) —
+the reference serves T5 via v1 kernel injection; here the family is a full
+training model plus a jitted greedy decode. Covers v1.0 (ReLU FFN, tied
+head) and v1.1/flan (gated-GELU FFN, untied head) via config knobs.
+
+Architecture notes (verified against HF T5):
+- T5LayerNorm == RMSNorm (no mean subtraction, no bias), pre-norm blocks.
+- Attention has NO scaling by 1/sqrt(d) (folded into init) and no biases.
+- Relative position bias: bucketed (bidirectional for the encoder, causal
+  buckets for the decoder), learned per head, owned by layer 0 of each stack
+  and shared by the rest; cross-attention has none.
+- Tied head multiplies by d_model**-0.5 before the shared embedding.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, HEADS_AXIS, SEQ_AXIS, shard_activation)
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6          # encoder layers (decoder matches)
+    num_decoder_layers: Optional[int] = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    gated_act: bool = False      # v1.1/flan: GEGLU; v1.0: ReLU
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_dec_(self) -> int:
+        return self.num_decoder_layers or self.num_layers
+
+
+TINY_T5 = T5Config(vocab_size=512, d_model=64, d_kv=16, d_ff=128,
+                   num_layers=2, num_heads=4, dtype=jnp.float32)
+TINY_T5_V11 = dataclasses.replace(TINY_T5, gated_act=True,
+                                  tie_word_embeddings=False)
+
+
+def relative_position_bucket(rel_pos, bidirectional: bool, num_buckets: int,
+                             max_distance: int):
+    """HF T5 bucketing: half the buckets exact, half log-spaced to
+    max_distance (t5 semantics; symmetric halves when bidirectional)."""
+    ret = 0
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+class _T5RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        x32 = x.astype(jnp.float32)
+        inv = jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) +
+                            self.eps)
+        return (x32 * inv * scale).astype(self.dtype)
+
+
+class _T5Attention(nn.Module):
+    """Unscaled multi-head attention with optional relative-position bias and
+    masking. ``kv`` defaults to ``x`` (self-attention)."""
+    cfg: T5Config
+    has_rel_bias: bool = False
+    bidirectional: bool = True
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None, bias=None):
+        cfg = self.cfg
+        kv = x if kv is None else kv
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, cfg.d_kv), name="q")(x)
+        k = dense(features=(cfg.num_heads, cfg.d_kv), name="k")(kv)
+        v = dense(features=(cfg.num_heads, cfg.d_kv), name="v")(kv)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)   # NO 1/sqrt(d) in T5
+        if self.has_rel_bias:
+            table = self.param(
+                "rel_bias", nn.initializers.normal(1.0),
+                (cfg.relative_attention_num_buckets, cfg.num_heads),
+                jnp.float32)
+            qlen, klen = x.shape[1], kv.shape[1]
+            rel = jnp.arange(klen)[None, :] - jnp.arange(qlen)[:, None]
+            buckets = relative_position_bucket(
+                rel, self.bidirectional, cfg.relative_attention_num_buckets,
+                cfg.relative_attention_max_distance)
+            bias = table[buckets].transpose(2, 0, 1)[None]  # [1, H, Q, K]
+        if bias is not None:
+            scores = scores + bias.astype(scores.dtype)
+        if mask is not None:
+            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1) \
+            .astype(cfg.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(features=cfg.d_model, axis=(-2, -1),
+                              use_bias=False, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="o")(ctx)
+        # re-exported so sibling layers reuse layer 0's bias (T5 sharing)
+        return out, (bias if self.has_rel_bias else None)
+
+
+class _T5FFN(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        if cfg.gated_act:
+            g = jax.nn.gelu(dense(cfg.d_ff, name="wi_0")(x))
+            h = g * dense(cfg.d_ff, name="wi_1")(x)
+        else:
+            h = jax.nn.relu(dense(cfg.d_ff, name="wi")(x))
+        return dense(cfg.d_model, name="wo")(h)
+
+
+class _T5Block(nn.Module):
+    cfg: T5Config
+    is_decoder: bool = False
+    has_rel_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x, enc=None, self_mask=None, cross_mask=None,
+                 rel_bias=None):
+        cfg = self.cfg
+        norm = partial(_T5RMSNorm, eps=cfg.layer_norm_eps, dtype=cfg.dtype)
+        h = norm(name="ln_self")(x)
+        attn, bias_out = _T5Attention(
+            cfg, has_rel_bias=self.has_rel_bias,
+            bidirectional=not self.is_decoder, name="self_attn")(
+                h, mask=self_mask, bias=rel_bias)
+        x = x + attn
+        if self.is_decoder:
+            h = norm(name="ln_cross")(x)
+            cross, _ = _T5Attention(cfg, name="cross_attn")(
+                h, kv=enc, mask=cross_mask)
+            x = x + cross
+        h = norm(name="ln_ffn")(x)
+        x = x + _T5FFN(cfg, name="ffn")(h)
+        return shard_activation(x, (BATCH_AXES, SEQ_AXIS, None)), bias_out
+
+
+class T5Model(nn.Module):
+    """Encoder-decoder backbone -> decoder logits [B, T, V]."""
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, enc_mask=None):
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="shared")
+
+        # ---- encoder ----
+        x = embed(input_ids)
+        key_mask = None if enc_mask is None else \
+            enc_mask[:, None, None, :].astype(bool)
+        bias = None
+        for i in range(cfg.num_layers):
+            x, b = _T5Block(cfg, has_rel_bias=(i == 0),
+                            name=f"enc_layer_{i}")(
+                x, self_mask=key_mask, rel_bias=bias)
+            bias = b if b is not None else bias
+        enc = _T5RMSNorm(cfg.layer_norm_eps, cfg.dtype,
+                         name="enc_final_norm")(x)
+
+        # ---- decoder ----
+        t = decoder_input_ids.shape[1]
+        causal = jnp.tril(jnp.ones((t, t), bool))[None, None]
+        y = embed(decoder_input_ids)
+        bias = None
+        for i in range(cfg.n_dec_):
+            y, b = _T5Block(cfg, is_decoder=True, has_rel_bias=(i == 0),
+                            name=f"dec_layer_{i}")(
+                y, enc=enc, self_mask=causal, cross_mask=key_mask,
+                rel_bias=bias)
+            bias = b if b is not None else bias
+        y = _T5RMSNorm(cfg.layer_norm_eps, cfg.dtype, name="dec_final_norm")(y)
+
+        if cfg.tie_word_embeddings:
+            y = y * (cfg.d_model ** -0.5)
+            return embed.attend(y).astype(jnp.float32)
+        kernel = self.param("lm_head", nn.initializers.lecun_normal(),
+                            (cfg.d_model, cfg.vocab_size), jnp.float32)
+        return y.astype(jnp.float32) @ kernel
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """batch: {"input_ids", "labels", optional "attention_mask",
+    "decoder_input_ids"} -> mean teacher-forcing CE (labels -100 ignored).
+    decoder inputs default to labels shifted right with pad=0 start token."""
+    cfg: T5Config
+
+    def setup(self):
+        self.model = T5Model(self.cfg)
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def logits(self, batch):
+        labels = batch["labels"]
+        dec_in = batch.get("decoder_input_ids")
+        if dec_in is None:
+            dec_in = jnp.pad(labels, ((0, 0), (1, 0)))[:, :-1]
+            dec_in = jnp.maximum(dec_in, 0)    # -100 ignore -> pad id 0
+        return self.model(batch["input_ids"], dec_in,
+                          enc_mask=batch.get("attention_mask"))
+
+    def __call__(self, batch):
+        labels = batch["labels"]
+        logits = self.logits(batch)
+        mask = (labels >= 0).astype(jnp.float32)
+        safe = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits, -1)
+        ll = jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def generate_greedy(self, params, input_ids, max_new_tokens=16,
+                        enc_mask=None):
+        """Simple greedy seq2seq decode: the decoder length grows per step,
+        so every step retraces (fine for demos/eval; a production loop would
+        pad the decoder to max length and reuse one compiled step — the paged
+        v2 path is decoder-only by design)."""
+        b = input_ids.shape[0]
+        dec = jnp.zeros((b, 1), jnp.int32)
+        for _ in range(max_new_tokens):
+            logits = self.apply({"params": params}, input_ids, dec,
+                                enc_mask=enc_mask,
+                                method=lambda m, i, d, enc_mask: m.model(
+                                    i, d, enc_mask=enc_mask))
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
+        return dec[:, 1:]
+
+
+def t5_tensor_rules(path, leaf):
+    """TP rules (reference t5 policy: q/k/v/wi column, o/wo row). The shared
+    embedding shards its hidden dim, so the tied ``attend`` head contracts
+    over the sharded axis (row-parallel psum) like the other tied-head
+    families here."""
+    from jax.sharding import PartitionSpec
+    names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    if "shared" in names or "lm_head" in names:
+        return PartitionSpec(None, "tensor")
+    if names[-1] != "kernel":
+        return None
+    if any(n in names for n in ("q", "k", "v")):
+        return PartitionSpec(None, "tensor", None)
+    if "o" in names:
+        return PartitionSpec("tensor", None, None)
+    if any(n in names for n in ("wi", "wi_0", "wi_1")):
+        return PartitionSpec(None, "tensor")
+    if "wo" in names:
+        return PartitionSpec("tensor", None)
+    return None
+
+
+def convert_hf_t5(hf_state, cfg: T5Config):
+    """HF T5 naming -> our tree (q/k/v/o Linear weights transpose into
+    DenseGeneral kernels; rel-bias tables live on layer 0 of each stack)."""
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    d, h, dk = cfg.d_model, cfg.num_heads, cfg.d_kv
+    tree = {"shared": {"embedding": get("shared.weight")},
+            "enc_final_norm": {"scale": get("encoder.final_layer_norm.weight")},
+            "dec_final_norm": {"scale": get("decoder.final_layer_norm.weight")}}
+    if not cfg.tie_word_embeddings:
+        tree["lm_head"] = get("lm_head.weight").T
+
+    def attn(prefix, has_bias, bias_name):
+        out = {
+            "q": {"kernel": get(prefix + "q.weight").T.reshape(d, h, dk)},
+            "k": {"kernel": get(prefix + "k.weight").T.reshape(d, h, dk)},
+            "v": {"kernel": get(prefix + "v.weight").T.reshape(d, h, dk)},
+            "o": {"kernel": get(prefix + "o.weight").T.reshape(h, dk, d)},
+        }
+        if has_bias:
+            out["rel_bias"] = get(prefix + bias_name)
+        return out
+
+    for stack, n, dec in (("encoder", cfg.num_layers, False),
+                          ("decoder", cfg.n_dec_, True)):
+        for i in range(n):
+            p = f"{stack}.block.{i}.layer."
+            name = f"{'dec' if dec else 'enc'}_layer_{i}"
+            layer = {
+                "ln_self": {"scale": get(p + "0.layer_norm.weight")},
+                "self_attn": attn(p + "0.SelfAttention.", i == 0,
+                                  "relative_attention_bias.weight"),
+            }
+            ff_idx = 2 if dec else 1
+            if dec:
+                layer["ln_cross"] = {"scale": get(p + "1.layer_norm.weight")}
+                layer["cross_attn"] = attn(p + "1.EncDecAttention.", False, "")
+            layer["ln_ffn"] = {"scale": get(p + f"{ff_idx}.layer_norm.weight")}
+            ffn = {}
+            if cfg.gated_act:
+                ffn["wi_0"] = {"kernel": get(p + f"{ff_idx}.DenseReluDense.wi_0.weight").T}
+                ffn["wi_1"] = {"kernel": get(p + f"{ff_idx}.DenseReluDense.wi_1.weight").T}
+            else:
+                ffn["wi"] = {"kernel": get(p + f"{ff_idx}.DenseReluDense.wi.weight").T}
+            ffn["wo"] = {"kernel": get(p + f"{ff_idx}.DenseReluDense.wo.weight").T}
+            layer["ffn"] = ffn
+            tree[name] = layer
+    return {"model": tree}
