@@ -210,8 +210,14 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         "opt_state": jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             state.opt_state, engine.opt_state_shardings),
+        # explicit replicated sharding: restoring without one only works when
+        # the saved topology matches (orbax falls back to the sharding file,
+        # which references the SAVING processes' devices)
         "scalars": jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype),
+            lambda x: jax.ShapeDtypeStruct(
+                np.shape(x), x.dtype,
+                sharding=jax.sharding.NamedSharding(
+                    engine.mesh, jax.sharding.PartitionSpec())),
             {
                 "step": state.step,
                 "loss_scale": state.loss_scale.scale,

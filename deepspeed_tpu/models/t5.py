@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.models.llama import (
-    BATCH_AXES, HEADS_AXIS, SEQ_AXIS, shard_activation)
+    BATCH_AXES, HEADS_AXIS, SEQ_AXIS, RMSNorm, shard_activation)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,19 +76,8 @@ def relative_position_bucket(rel_pos, bidirectional: bool, num_buckets: int,
     return ret + jnp.where(is_small, n, large)
 
 
-class _T5RMSNorm(nn.Module):
-    eps: float
-    dtype: Any
-
-    @nn.compact
-    def __call__(self, x):
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
-                           jnp.float32)
-        x32 = x.astype(jnp.float32)
-        inv = jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) +
-                            self.eps)
-        return (x32 * inv * scale).astype(self.dtype)
-
+# T5LayerNorm IS RMSNorm (no mean subtraction, no bias) — reuse llama's
+_T5RMSNorm = RMSNorm
 
 class _T5Attention(nn.Module):
     """Unscaled multi-head attention with optional relative-position bias and

@@ -111,6 +111,7 @@ def test_bloom_hf_conversion_shapes_and_forward():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_serve_bloom_paged_matches_full():
     from deepspeed_tpu.inference.v2.engine_v2 import (
         InferenceEngineV2, V2EngineConfig)

@@ -9,6 +9,7 @@ the scheduler (``deepspeed/runtime/domino/transformer.py:338-430``).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deepspeed_tpu.comm.mesh import create_mesh, set_global_mesh
 from deepspeed_tpu.config.config import MeshConfig
@@ -21,6 +22,7 @@ def _layer(n_chunks):
                                   n_chunks=n_chunks, dtype=jnp.float32)
 
 
+@pytest.mark.slow
 def test_chunking_is_exact():
     x = np.random.default_rng(0).normal(size=(4, 8, 32)).astype(np.float32)
     params = _layer(1).init(jax.random.PRNGKey(0), x)["params"]
@@ -51,6 +53,7 @@ def test_domino_under_tp_mesh_matches_dense():
     set_global_mesh(None)
 
 
+@pytest.mark.slow
 def test_domino_grads_match_unchunked():
     x = np.random.default_rng(2).normal(size=(4, 8, 32)).astype(np.float32)
     params = _layer(1).init(jax.random.PRNGKey(2), x)["params"]

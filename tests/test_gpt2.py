@@ -7,11 +7,13 @@ Reference analog: HFGPT2LayerPolicy / megatron-gpt container cases.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models.llama import random_tokens
 
 
+@pytest.mark.slow
 def test_gpt2_trains_and_serves():
     """GPT-2: train on a TP mesh, HF Conv1D conversion, paged serving parity."""
     from deepspeed_tpu.inference.v2.engine_v2 import (
@@ -79,3 +81,16 @@ def test_gpt2_trains_and_serves():
         ids.append(int(np.argmax(np.asarray(logits)[0, -1])))
     assert got == ids[len(prompt):], (got, ids[len(prompt):])
 
+
+
+def test_gpt2_forward_and_policy_lookup():
+    """Fast default-suite coverage: registry routing + finite forward loss
+    (the full train/convert/serve integration runs under -m slow)."""
+    from deepspeed_tpu.inference.v2.modules import GPT2Policy, policy_for
+    from deepspeed_tpu.models.gpt2 import TINY_GPT2, GPT2ForCausalLM
+
+    assert policy_for(TINY_GPT2) is GPT2Policy
+    model = GPT2ForCausalLM(TINY_GPT2)
+    batch = random_tokens(2, 16, vocab_size=TINY_GPT2.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    assert np.isfinite(float(model.apply({"params": params}, batch)))

@@ -89,7 +89,8 @@ def test_hf_conversion_roundtrip_forward():
     assert np.isfinite(float(model.apply({"params": params}, batch)))
 
 
-@pytest.mark.parametrize("parallel", [True, False])
+@pytest.mark.parametrize("parallel", [
+    pytest.param(True, marks=pytest.mark.slow), False])
 def test_serve_neox_paged_matches_full(parallel):
     from deepspeed_tpu.inference.v2.engine_v2 import (
         InferenceEngineV2, V2EngineConfig)

@@ -39,8 +39,10 @@ def test_relative_position_buckets():
     assert causal.max() < 32
 
 
-@pytest.mark.parametrize("cfg", [TINY_T5, TINY_T5_V11],
-                         ids=["v1.0-tied-relu", "v1.1-untied-geglu"])
+@pytest.mark.parametrize("cfg", [
+    TINY_T5,
+    pytest.param(TINY_T5_V11, marks=pytest.mark.slow),
+], ids=["v1.0-tied-relu", "v1.1-untied-geglu"])
 def test_t5_trains(cfg):
     model = T5ForConditionalGeneration(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -81,6 +83,7 @@ def test_ignore_index_and_decoder_shift():
     assert float(model.apply({"params": params}, b0)) == 0.0
 
 
+@pytest.mark.slow
 def test_greedy_generate_shapes():
     model = T5ForConditionalGeneration(TINY_T5)
     b = _batch(2)
