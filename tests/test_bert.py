@@ -8,6 +8,7 @@ and BERT container cases; the compression suite's standard target.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models.bert import (
@@ -62,6 +63,7 @@ def test_mlm_loss_ignores_unmasked_positions():
     assert float(model.apply({"params": params}, b0)) == 0.0
 
 
+@pytest.mark.slow
 def test_bert_trains_with_engine_tp():
     model = BertForMaskedLM(TINY_BERT)
     config = {"train_batch_size": 8,

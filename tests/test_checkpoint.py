@@ -87,6 +87,7 @@ def test_latest_tag_protocol(tmp_path, mesh_dp8):
     assert path.endswith("step_b")
 
 
+@pytest.mark.slow
 def test_async_save_commits_latest_after_wait(tmp_path):
     """async_save: save returns immediately; the latest tag is committed by
     the background finalizer; a fresh engine loads the result (reference:

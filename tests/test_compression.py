@@ -217,6 +217,7 @@ def test_engine_qat_trains_and_recompiles_on_schedule():
     assert dict(engine.compressor.schedule_key()).keys() == {"weight_quantization"}
 
 
+@pytest.mark.slow
 def test_pruning_masks_survive_checkpoint_resume(tmp_path):
     config = {
         "train_batch_size": 8,

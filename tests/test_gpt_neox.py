@@ -35,7 +35,8 @@ def test_presets():
     assert GPTJ_6B.head_dim_ == 256
 
 
-@pytest.mark.parametrize("parallel", [True, False])
+@pytest.mark.parametrize("parallel", [
+    True, pytest.param(False, marks=pytest.mark.slow)])
 def test_neox_trains(parallel):
     cfg = dataclasses.replace(TINY_NEOX, parallel_residual=parallel)
     model = GPTNeoXForCausalLM(cfg)
@@ -90,7 +91,8 @@ def test_hf_conversion_roundtrip_forward():
 
 
 @pytest.mark.parametrize("parallel", [
-    pytest.param(True, marks=pytest.mark.slow), False])
+    pytest.param(True, marks=pytest.mark.slow),
+    pytest.param(False, marks=pytest.mark.slow)])
 def test_serve_neox_paged_matches_full(parallel):
     from deepspeed_tpu.inference.v2.engine_v2 import (
         InferenceEngineV2, V2EngineConfig)
