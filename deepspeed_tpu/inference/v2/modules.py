@@ -240,6 +240,23 @@ class OPTPolicy:
             m["embed"]["embedding"].astype(jnp.float32).T   # tied
 
 
+def _dense_moe_combine(moe, h2, top_k, dtype):
+    """Dense all-expert compute + renormalized top-k combine (serving-side
+    MoE; equivalent to the training dispatch when no token drops)."""
+    gate_logits = h2.astype(jnp.float32) @ moe["gate"]["wg"]["kernel"]
+    probs = jax.nn.softmax(gate_logits, axis=-1)              # [T, E]
+    topv, topi = jax.lax.top_k(probs, top_k)                  # [T, K]
+    w = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+    ex = moe["experts"]
+    g = jnp.einsum("td,edf->etf", h2, ex["w_gate"].astype(dtype))
+    u = jnp.einsum("td,edf->etf", h2, ex["w_up"].astype(dtype))
+    eo = jnp.einsum("etf,efd->etd", jax.nn.silu(g) * u,
+                    ex["w_down"].astype(dtype))               # [E, T, D]
+    t_idx = jnp.arange(h2.shape[0])[:, None]                  # [T, 1]
+    picked = eo[topi, t_idx]                                  # [T, K, D]
+    return jnp.einsum("tk,tkd->td", w.astype(dtype), picked)
+
+
 # ---------------------------------------------------------------------------
 # Mixtral (llama attention + top-k MoE MLP)
 # ---------------------------------------------------------------------------
@@ -278,21 +295,7 @@ class MixtralPolicy:
         x = x + jnp.einsum("thk,hkd->td", attn,
                            lp["attn"]["wo"]["kernel"].astype(dtype))
         h2 = _rms(x, lp["mlp_norm"]["scale"], base.rms_norm_eps)
-        # dense all-expert compute + renormalized top-k combine
-        moe = lp["moe"]
-        gate_logits = h2.astype(jnp.float32) @ moe["gate"]["wg"]["kernel"]
-        probs = jax.nn.softmax(gate_logits, axis=-1)              # [T, E]
-        topv, topi = jax.lax.top_k(probs, cfg.moe.top_k)          # [T, K]
-        w = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
-        ex = moe["experts"]
-        g = jnp.einsum("td,edf->etf", h2, ex["w_gate"].astype(dtype))
-        u = jnp.einsum("td,edf->etf", h2, ex["w_up"].astype(dtype))
-        eo = jnp.einsum("etf,efd->etd", jax.nn.silu(g) * u,
-                        ex["w_down"].astype(dtype))               # [E, T, D]
-        t_idx = jnp.arange(h2.shape[0])[:, None]                  # [T, 1]
-        picked = eo[topi, t_idx]                                  # [T, K, D]
-        moe_out = jnp.einsum("tk,tkd->td", w.astype(dtype), picked)
-        return x + moe_out
+        return x + _dense_moe_combine(lp["moe"], h2, cfg.moe.top_k, dtype)
 
     @staticmethod
     def unembed(params, x, cfg):
@@ -470,3 +473,55 @@ class GPT2Policy:
                        cfg.layer_norm_eps)
         return x.astype(jnp.float32) @ \
             m["embed"]["embedding"].astype(jnp.float32).T   # tied
+
+
+# ---------------------------------------------------------------------------
+# Qwen2-MoE (mixtral experts + gated shared expert, qwen2 attention bias)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.qwen2_moe import Qwen2MoEConfig  # noqa: E402
+
+
+@register_policy("qwen2_moe", Qwen2MoEConfig)
+class Qwen2MoEPolicy:
+    """reference: model_implementations/qwen_v2_moe — Mixtral serving plus a
+    dense shared expert whose output is scaled by a per-token sigmoid gate."""
+
+    @staticmethod
+    def cache_spec(cfg: Qwen2MoEConfig) -> KVCacheSpec:
+        b = cfg.base
+        return KVCacheSpec(b.num_layers, b.num_kv_heads, b.head_dim_,
+                           b.max_seq_len, b.dtype, b.sliding_window)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        return params["embed"]["embedding"].astype(cfg.base.dtype)[tokens]
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        base = cfg.base
+        dtype = base.dtype
+        lp = params[f"layer_{i}"]
+        cos, sin = _rope_tables(base.head_dim_, base.max_seq_len,
+                                base.rope_theta)
+        h = _rms(x, lp["attn_norm"]["scale"], base.rms_norm_eps)
+        q, k, v = _qkv({"attn": lp["attn"]}, h, dtype)
+        q = _rope_rows(q, cos, sin, positions)
+        k = _rope_rows(k, cos, sin, positions)
+        attn = attend(q, k, v)
+        x = x + jnp.einsum("thk,hkd->td", attn,
+                           lp["attn"]["wo"]["kernel"].astype(dtype))
+        h2 = _rms(x, lp["mlp_norm"]["scale"], base.rms_norm_eps)
+        moe_out = _dense_moe_combine(lp["moe"], h2, cfg.moe.top_k, dtype)
+        se = lp["shared_expert"]
+        g = jax.nn.silu(h2 @ se["w_gate"]["kernel"].astype(dtype))
+        u = h2 @ se["w_up"]["kernel"].astype(dtype)
+        shared = (g * u) @ se["w_down"]["kernel"].astype(dtype)
+        gate = jax.nn.sigmoid(
+            (h2 @ se["gate"]["kernel"].astype(dtype)).astype(jnp.float32))
+        return x + moe_out + shared * gate.astype(dtype)
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        x = _rms(x, params["final_norm"]["scale"], cfg.base.rms_norm_eps)
+        return x.astype(jnp.float32) @ \
+            params["lm_head"]["kernel"].astype(jnp.float32)

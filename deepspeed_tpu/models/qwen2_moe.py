@@ -1,0 +1,127 @@
+"""Qwen2-MoE causal LM (Mixtral-style experts + a gated shared expert).
+
+Reference analog: ``inference/v2/model_implementations/qwen_v2_moe`` — the
+arch is a qwen2 backbone (attention bias) whose MLP is top-k routed experts
+PLUS a dense "shared expert" applied to every token, scaled by a per-token
+sigmoid gate (``shared_expert_gate``). Built on the same expert-parallel
+MOELayer as Mixtral; the shared expert is an ordinary TP-sharded MLP.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.models.llama import (
+    BATCH_AXES, SEQ_AXIS, LlamaAttention, LlamaConfig, RMSNorm,
+    llama_tensor_rules, shard_activation)
+from deepspeed_tpu.moe.sharded_moe import MOELayer, MoEConfig, moe_tensor_rules
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen2MoEConfig:
+    base: LlamaConfig = LlamaConfig(
+        vocab_size=151936, hidden_size=2048, intermediate_size=5632,
+        num_layers=24, num_heads=16, num_kv_heads=16, attention_bias=True,
+        rope_theta=1000000.0)
+    moe: MoEConfig = MoEConfig(num_experts=60, top_k=4)
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+
+
+TINY_QWEN2_MOE = Qwen2MoEConfig(
+    base=LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                     num_layers=2, num_heads=4, num_kv_heads=4,
+                     attention_bias=True, max_seq_len=128),
+    moe=MoEConfig(num_experts=4, top_k=2, dtype=jnp.bfloat16),
+    moe_intermediate_size=32,
+    shared_expert_intermediate_size=128,
+)
+
+
+class _SharedExpert(nn.Module):
+    """Dense SwiGLU MLP over all tokens, output scaled by a per-token
+    sigmoid gate (HF Qwen2MoeSparseMoeBlock.shared_expert[_gate])."""
+    cfg: Qwen2MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        base = self.cfg.base
+        dense = lambda f, n: nn.Dense(f, use_bias=False, dtype=base.dtype,
+                                      param_dtype=jnp.float32, name=n)
+        g = jax.nn.silu(dense(self.cfg.shared_expert_intermediate_size,
+                              "w_gate")(x))
+        u = dense(self.cfg.shared_expert_intermediate_size, "w_up")(x)
+        out = dense(base.hidden_size, "w_down")(g * u)
+        gate = nn.Dense(1, use_bias=False, dtype=base.dtype,
+                        param_dtype=jnp.float32, name="gate")(x)
+        return out * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(out.dtype)
+
+
+class Qwen2MoEBlock(nn.Module):
+    cfg: Qwen2MoEConfig
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool = True):
+        base = self.cfg.base
+        h = x + LlamaAttention(base, name="attn")(
+            RMSNorm(base.rms_norm_eps, base.dtype, name="attn_norm")(x),
+            positions)
+        inp = RMSNorm(base.rms_norm_eps, base.dtype, name="mlp_norm")(h)
+        moe_out, aux = MOELayer(self.cfg.moe, base.hidden_size,
+                                self.cfg.moe_intermediate_size, name="moe")(
+            inp, train=train)
+        shared = _SharedExpert(self.cfg, name="shared_expert")(inp)
+        out = h + moe_out + shared
+        return shard_activation(out, (BATCH_AXES, SEQ_AXIS, None)), aux
+
+
+class Qwen2MoEForCausalLM(nn.Module):
+    """batch {"input_ids": [B,S]} -> LM loss + weighted MoE aux losses."""
+    cfg: Qwen2MoEConfig
+
+    @nn.compact
+    def _backbone(self, input_ids, train: bool = True):
+        base = self.cfg.base
+        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
+                                     input_ids.shape)
+        x = nn.Embed(base.vocab_size, base.hidden_size, dtype=base.dtype,
+                     param_dtype=jnp.float32, name="embed")(input_ids)
+        aux_total = jnp.float32(0.0)
+        for i in range(base.num_layers):
+            x, aux = Qwen2MoEBlock(self.cfg, name=f"layer_{i}")(
+                x, positions, train)
+            aux_total = aux_total + aux
+        x = RMSNorm(base.rms_norm_eps, base.dtype, name="final_norm")(x)
+        logits = nn.Dense(base.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits, aux_total
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def __call__(self, batch, train: bool = True):
+        input_ids = batch["input_ids"]
+        logits, aux_total = self._backbone(input_ids, train)
+        labels = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll) + aux_total
+
+    def logits(self, batch):
+        logits, _ = self._backbone(batch["input_ids"], train=False)
+        return logits
+
+
+def qwen2_moe_tensor_rules(path, leaf) -> Optional[PartitionSpec]:
+    """Expert rules + qwen2 attention/MLP rules. The shared expert's
+    w_gate/w_up/w_down fall through to llama's MLP substring rules (column/
+    column/row); its scalar sigmoid gate matches nothing and replicates."""
+    spec = moe_tensor_rules(path, leaf)
+    if spec is not None:
+        return spec
+    return llama_tensor_rules(path, leaf)

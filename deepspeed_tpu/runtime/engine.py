@@ -410,6 +410,8 @@ class DeepSpeedTPUEngine:
         """Drop every cached compiled step fn. The single authority for the set of
         jitted-fn caches — used at init and whenever static trace structure
         changes (e.g. a compression-schedule transition)."""
+        self.training = True            # module-mode parity (train()/eval())
+        self._compiled = False          # engine.compile() parity flag
         self._train_batch_fn = None     # gas microbatches fused via scan
         self._micro_fwd_bwd_fn = None   # compat path: per-microbatch grads
         self._apply_update_fn = None    # compat path: update at boundary
@@ -921,6 +923,26 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     # eval
     # ------------------------------------------------------------------
+    def compile(self, backend=None, **compile_kwargs):
+        """API parity with reference ``engine.compile()``
+        (runtime/compiler.py + engine.py compile method). jit is this
+        engine's native execution model — every step is already traced once
+        and compiled — so this records the request and returns."""
+        self._compiled = True
+        log_dist("engine.compile(): no-op — the fused train step is already "
+                 "jit-compiled (XLA is the native execution model)", ranks=[0])
+        return self
+
+    def train(self, mode: bool = True):
+        """Module-mode parity (reference nn.Module.train/eval): tracked for
+        API compatibility; functional models take determinism via batch/rng
+        inputs rather than global module state."""
+        self.training = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
     def eval_batch(self, batch) -> jnp.ndarray:
         if self._eval_fn is None:
             def ev(params, batch, rng):

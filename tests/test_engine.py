@@ -277,3 +277,20 @@ def test_debug_nans_mode_aborts_on_nan():
         assert "nan" in str(e.value).lower() or "NaN" in str(e.value)
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_engine_compile_train_eval_shims():
+    """API parity: engine.compile() (jit-native no-op), train()/eval() mode
+    tracking (reference engine.compile / module modes)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}},
+        example_batch=random_batch(4))
+    assert engine.compile() is engine and engine._compiled
+    assert engine.eval().training is False
+    assert engine.train().training is True
+    assert np.isfinite(float(engine.train_batch(batch=random_batch(8))))
