@@ -45,6 +45,7 @@ def test_qwen2_moe_trains_ep():
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
 
 
+@pytest.mark.slow
 def test_serve_qwen2_moe_paged_matches_full():
     from deepspeed_tpu.inference.v2.engine_v2 import (
         InferenceEngineV2, V2EngineConfig)
