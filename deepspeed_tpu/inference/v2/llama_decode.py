@@ -76,10 +76,16 @@ def _qkv(lp, x, dtype):
     return q, k, v
 
 
-def _mlp(lp, x, dtype):
+def _mlp(lp, x, dtype, act: str = "silu"):
     g = x @ lp["mlp"]["w_gate"]["kernel"].astype(dtype)
     u = x @ lp["mlp"]["w_up"]["kernel"].astype(dtype)
-    return (jax.nn.silu(g) * u) @ lp["mlp"]["w_down"]["kernel"].astype(dtype)
+    if act == "silu":
+        gated = jax.nn.silu(g)
+    elif act == "gelu_tanh":
+        gated = jax.nn.gelu(g, approximate=True)
+    else:
+        raise ValueError(f"unsupported hidden_act {act!r} (silu | gelu_tanh)")
+    return (gated * u) @ lp["mlp"]["w_down"]["kernel"].astype(dtype)
 
 
 def prefill_chunk(params, cache_data, tokens, start, block_table, true_len,

@@ -107,32 +107,48 @@ class LlamaPolicy:
                            cfg.max_seq_len, cfg.dtype, cfg.sliding_window)
 
     @staticmethod
+    def _norm_scale(scale, cfg):
+        # gemma stores norm weights as an offset from 1 (rms_scale_offset)
+        return scale + 1.0 if cfg.rms_scale_offset else scale
+
+    @staticmethod
     def embed(params, tokens, positions, cfg):
-        return params["model"]["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        x = params["model"]["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        if cfg.scale_embeddings:   # gemma normalizer
+            x = x * jnp.sqrt(jnp.asarray(cfg.hidden_size,
+                                         jnp.float32)).astype(x.dtype)
+        return x
 
     @staticmethod
     def block(params, i, x, attend, positions, cfg):
         lp = params["model"][f"layer_{i}"]
         dtype = cfg.dtype
+        ns = LlamaPolicy._norm_scale
         cos, sin = _rope_tables(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta)
-        h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
+        h = _rms(x, ns(lp["attn_norm"]["scale"], cfg), cfg.rms_norm_eps)
         q, k, v = _qkv(lp, h, dtype)
         q = _rope_rows(q, cos, sin, positions)
         k = _rope_rows(k, cos, sin, positions)
         attn = attend(q, k, v)
         x = x + jnp.einsum("thk,hkd->td", attn,
                            lp["attn"]["wo"]["kernel"].astype(dtype))
-        h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        return x + _mlp(lp, h2, dtype)
+        h2 = _rms(x, ns(lp["mlp_norm"]["scale"], cfg), cfg.rms_norm_eps)
+        return x + _mlp(lp, h2, dtype, act=cfg.hidden_act)
 
     @staticmethod
     def unembed(params, x, cfg):
-        x = _rms(x, params["model"]["final_norm"]["scale"], cfg.rms_norm_eps)
+        x = _rms(x, LlamaPolicy._norm_scale(
+            params["model"]["final_norm"]["scale"], cfg), cfg.rms_norm_eps)
         if cfg.tie_embeddings:
-            return x.astype(jnp.float32) @ \
+            logits = x.astype(jnp.float32) @ \
                 params["model"]["embed"]["embedding"].astype(jnp.float32).T
-        return x.astype(jnp.float32) @ \
-            params["model"]["lm_head"]["kernel"].astype(jnp.float32)
+        else:
+            logits = x.astype(jnp.float32) @ \
+                params["model"]["lm_head"]["kernel"].astype(jnp.float32)
+        if cfg.logits_soft_cap:   # gemma2 softcap, matching the training head
+            logits = cfg.logits_soft_cap * jnp.tanh(
+                logits / cfg.logits_soft_cap)
+        return logits
 
 
 # ---------------------------------------------------------------------------

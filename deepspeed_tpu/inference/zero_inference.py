@@ -158,6 +158,9 @@ class ZeROInferenceEngine:
 
         embed = dequantize_model_params(jax.device_put(m["embed"]), self.dtype)
         x = embed["embedding"][ids]
+        if getattr(cfg, "scale_embeddings", False):   # gemma normalizer
+            x = x * jnp.sqrt(jnp.asarray(cfg.hidden_size,
+                                         jnp.float32)).astype(x.dtype)
         positions = jnp.arange(ids.shape[1])[None, :]
         block_fn = self._block_fn()
 
@@ -182,7 +185,8 @@ class ZeROInferenceEngine:
                 from deepspeed_tpu.models.llama import _xla_attention
                 cos, sin = rope_freqs(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta)
                 from deepspeed_tpu.models.llama import apply_rope
-                h = _rms(x, lp["attn_norm"]["scale"], cfg.rms_norm_eps)
+                off = 1.0 if getattr(cfg, "rms_scale_offset", False) else 0.0
+                h = _rms(x, lp["attn_norm"]["scale"] + off, cfg.rms_norm_eps)
                 b, s, d = h.shape
                 q, k, v = _qkv(lp, h.reshape(b * s, d), self.dtype)
                 q = q.reshape(b, s, *q.shape[1:])
@@ -195,8 +199,9 @@ class ZeROInferenceEngine:
                 out = jnp.einsum("bshk,hkd->bsd", attn,
                                  lp["attn"]["wo"]["kernel"].astype(self.dtype))
                 x = x + out
-                h2 = _rms(x, lp["mlp_norm"]["scale"], cfg.rms_norm_eps)
-                return x + _mlp(lp, h2, self.dtype)
+                h2 = _rms(x, lp["mlp_norm"]["scale"] + off, cfg.rms_norm_eps)
+                return x + _mlp(lp, h2, self.dtype,
+                                act=getattr(cfg, "hidden_act", "silu"))
             self._block_jit = jax.jit(block)
         return self._block_jit
 
@@ -206,12 +211,18 @@ class ZeROInferenceEngine:
 
             def head(tail, embed, x):
                 from deepspeed_tpu.inference.v2.llama_decode import _rms
-                x = _rms(x, tail["final_norm"]["scale"], cfg.rms_norm_eps)
+                off = 1.0 if getattr(cfg, "rms_scale_offset", False) else 0.0
+                x = _rms(x, tail["final_norm"]["scale"] + off, cfg.rms_norm_eps)
                 if "lm_head" in tail:
-                    return x.astype(jnp.float32) @ \
+                    logits = x.astype(jnp.float32) @ \
                         tail["lm_head"]["kernel"].astype(jnp.float32)
-                return x.astype(jnp.float32) @ \
-                    embed["embedding"].astype(jnp.float32).T
+                else:
+                    logits = x.astype(jnp.float32) @ \
+                        embed["embedding"].astype(jnp.float32).T
+                cap = getattr(cfg, "logits_soft_cap", None)
+                if cap:
+                    logits = cap * jnp.tanh(logits / cap)
+                return logits
             self._head_jit = jax.jit(head)
         return self._head_jit
 

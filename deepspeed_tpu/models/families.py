@@ -42,12 +42,23 @@ PHI3_MINI = LlamaConfig(
     vocab_size=32064, hidden_size=3072, intermediate_size=8192, num_layers=32,
     num_heads=32, num_kv_heads=32, max_seq_len=4096, rope_theta=10000.0)
 
+# gemma (v1) is a llama variant: gelu_tanh gated MLP, (1+scale) norms, sqrt(d)
+# embedding normalizer, tied head, head_dim decoupled from hidden/heads.
+# gemma2 is NOT claimed: its extra residual norms (pre/post-feedforward),
+# per-layer sliding/global alternation, and attention-logit softcapping are a
+# different block shape.
+GEMMA_2B = LlamaConfig(
+    vocab_size=256000, hidden_size=2048, intermediate_size=16384, num_layers=18,
+    num_heads=8, num_kv_heads=1, head_dim=256, max_seq_len=8192,
+    rope_theta=10000.0, rms_norm_eps=1e-6, tie_embeddings=True,
+    hidden_act="gelu_tanh", rms_scale_offset=True, scale_embeddings=True)
+
 
 def config_from_hf(hf_config: Dict[str, Any]) -> LlamaConfig:
     """Build a LlamaConfig from a HF config dict for any llama-family arch
     (reference: engine_factory reads the HF config to pick a policy)."""
     mt = hf_config.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "qwen2", "phi3"):
+    if mt not in ("llama", "mistral", "qwen2", "phi3", "gemma"):
         raise ValueError(f"not a llama-family arch: {mt!r} "
                          "(falcon/opt have their own model classes)")
     return LlamaConfig(
@@ -65,6 +76,10 @@ def config_from_hf(hf_config: Dict[str, Any]) -> LlamaConfig:
         attention_bias=(mt == "qwen2") or hf_config.get("attention_bias", False),
         sliding_window=hf_config.get("sliding_window")
         if mt == "mistral" else None,
+        head_dim=hf_config.get("head_dim"),
+        hidden_act="gelu_tanh" if mt == "gemma" else "silu",
+        rms_scale_offset=(mt == "gemma"),
+        scale_embeddings=(mt == "gemma"),
     )
 
 

@@ -60,6 +60,10 @@ class LlamaConfig:
     # llama-family arch knobs (mistral/qwen2/phi3 are llama variants):
     attention_bias: bool = False          # qwen2: bias on q/k/v projections
     sliding_window: Optional[int] = None  # mistral: attend to last W tokens only
+    # gemma-family knobs (gemma/gemma2 are llama variants too):
+    hidden_act: str = "silu"              # gemma: "gelu_tanh" gated MLP
+    rms_scale_offset: bool = False        # gemma norm: y * (1 + scale)
+    scale_embeddings: bool = False        # gemma: embed output * sqrt(hidden)
 
     @property
     def head_dim_(self) -> int:
@@ -107,6 +111,9 @@ class RMSNorm(nn.Module):
     single XLA fusion)."""
     eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # gemma convention: weights stored as an offset from 1 (zero-init),
+    # applied as y * (1 + scale)
+    scale_offset: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -114,7 +121,10 @@ class RMSNorm(nn.Module):
         x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         y = x32 * jax.lax.rsqrt(var + self.eps)
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        init = nn.initializers.zeros if self.scale_offset else nn.initializers.ones
+        scale = self.param("scale", init, (x.shape[-1],), jnp.float32)
+        if self.scale_offset:
+            scale = scale + 1.0
         return (y * scale).astype(orig_dtype)
 
 
@@ -224,7 +234,14 @@ class LlamaMLP(nn.Module):
                         param_dtype=jnp.float32)
         gate = dense(cfg.intermediate_size, name="w_gate")(x)
         up = dense(cfg.intermediate_size, name="w_up")(x)
-        h = nn.silu(gate) * up
+        if cfg.hidden_act == "silu":
+            act = nn.silu
+        elif cfg.hidden_act == "gelu_tanh":            # gemma
+            act = lambda v: nn.gelu(v, approximate=True)
+        else:
+            raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r} "
+                             "(silu | gelu_tanh)")
+        h = act(gate) * up
         h = shard_activation(h, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS))
         return dense(cfg.hidden_size, name="w_down")(h)
 
@@ -236,10 +253,12 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
         h = x + LlamaAttention(cfg, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    scale_offset=cfg.rms_scale_offset, name="attn_norm")(x),
             positions, segment_ids)
         out = h + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(h))
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    scale_offset=cfg.rms_scale_offset, name="mlp_norm")(h))
         return shard_activation(out, (BATCH_AXES, SEQ_AXIS, None))
 
 
@@ -285,6 +304,8 @@ class LlamaModel(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="embed")
         x = embed(input_ids)
+        if cfg.scale_embeddings:          # gemma: normalizer on the embed output
+            x = x * jnp.sqrt(jnp.asarray(cfg.hidden_size, jnp.float32)).astype(x.dtype)
         x = shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
 
         block_cls = LlamaBlock
@@ -305,7 +326,8 @@ class LlamaModel(nn.Module):
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids)
 
-        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    scale_offset=cfg.rms_scale_offset, name="final_norm")(x)
         # head matmul in compute dtype (bf16 on the MXU, fp32 accumulation);
         # downstream softmax casts to fp32 — an fp32 head matmul is ~8x slower
         if cfg.tie_embeddings:
