@@ -339,6 +339,7 @@ def test_opt_hf_conversion_shapes_and_forward():
     assert jnp.isfinite(loss)
 
 
+@pytest.mark.slow
 def test_gemma_knobs_train_and_serve_parity():
     """Gemma = llama variant (gelu_tanh gated MLP, (1+scale) norms, sqrt(d)
     embedding normalizer, tied head): trains and paged-serves with the same
