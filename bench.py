@@ -17,9 +17,13 @@ REFERENCE_MFU = 0.54  # BASELINE.md: Ulysses sustained >54% of peak
 
 
 def main():
+    from bench_util import guard_device_discovery
+    disarm = guard_device_discovery("bench")
     import jax
     import jax.numpy as jnp
     import numpy as np
+    jax.devices()
+    disarm()
 
     import deepspeed_tpu
     from deepspeed_tpu.accelerator import get_accelerator

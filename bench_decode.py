@@ -98,7 +98,11 @@ def main():
     batch = int(os.environ.get("DSTPU_DECODE_BATCH", 16))
     prompt_len = int(os.environ.get("DSTPU_DECODE_PROMPT", 256))
     steps = int(os.environ.get("DSTPU_DECODE_STEPS", 64))
+    from bench_util import guard_device_discovery
+    disarm = guard_device_discovery("bench_decode")
     import jax
+    jax.devices()
+    disarm()
     on_tpu = jax.default_backend() == "tpu"
     impl = "kernel" if on_tpu else "gather"
     tps = run(impl, batch, prompt_len, steps)

@@ -1,0 +1,22 @@
+"""Shared bench-script utilities (stdlib only — imported before jax)."""
+
+import os
+import sys
+import threading
+
+
+def guard_device_discovery(name: str, timeout: float = 180.0):
+    """Fail fast if TPU device discovery hangs (wedged axon tunnel, observed
+    2026-07-30). A THREAD, not SIGALRM: the hang sits in native PJRT init
+    where a python signal handler never runs. Call the returned function
+    after ``jax.devices()`` succeeds to disarm."""
+    discovered = threading.Event()
+
+    def _watchdog():
+        if not discovered.wait(timeout):
+            print(f"{name}: TPU device discovery exceeded {timeout:.0f}s — "
+                  "tunnel wedged; aborting", file=sys.stderr)
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    return discovered.set
