@@ -12,7 +12,7 @@ Public API parity (reference: ``deepspeed/__init__.py``):
 
 from typing import Any, Callable, Optional
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
 from deepspeed_tpu.comm import mesh as _mesh_lib

@@ -1,0 +1,55 @@
+"""Packaging with optional AOT native build.
+
+Reference analog: ``setup.py:322`` (``ext_modules`` AOT path for the
+op-builder ops). The native components (cpu_adam, aio) JIT-compile on first
+use via ``ops/op_builder.py``; ``DSTPU_BUILD_OPS=1 pip install .``
+pre-compiles them at install time with the SAME flags as the JIT path and a
+source-hash sidecar the loader validates (stale or foreign artifacts fall
+back to JIT). Note: ``-march=native`` makes AOT artifacts host-specific —
+build wheels on the deployment ISA or leave AOT off.
+"""
+
+import hashlib
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "deepspeed_tpu", "ops", "csrc")
+# mirrors ops/op_builder.py DEFAULT_FLAGS (kept literal: setup.py must not
+# import the package it is building)
+_FLAGS = ["-O3", "-march=native", "-fopenmp", "-fPIC", "-shared", "-std=c++17"]
+
+
+def _src_hash(path):
+    return hashlib.sha256(open(path, "rb").read()).hexdigest()[:16]
+
+
+class BuildWithOps(build_py):
+    def run(self):
+        super().run()
+        if os.environ.get("DSTPU_BUILD_OPS") != "1":
+            return
+        out_dir = os.path.join(self.build_lib, "deepspeed_tpu", "ops", "csrc")
+        os.makedirs(out_dir, exist_ok=True)
+        for src in ("cpu_adam.cpp", "aio.cpp"):
+            path = os.path.join(_CSRC, src)
+            if not os.path.exists(path):
+                continue
+            name = src[:-4]
+            out = os.path.join(out_dir, name + ".so")
+            cmd = ["g++"] + _FLAGS + [path, "-o", out]
+            print("AOT:", " ".join(cmd))
+            subprocess.run(cmd, check=True)
+            with open(out + ".src", "w") as f:   # loader validates this
+                f.write(_src_hash(path))
+            # editable installs build into an ephemeral dir; also land the
+            # artifact next to the sources so the loader can find it
+            shutil.copy2(out, os.path.join(_CSRC, name + ".so"))
+            shutil.copy2(out + ".src", os.path.join(_CSRC, name + ".so.src"))
+
+
+setup(cmdclass={"build_py": BuildWithOps})
