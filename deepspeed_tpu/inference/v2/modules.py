@@ -256,13 +256,18 @@ class OPTPolicy:
             m["embed"]["embedding"].astype(jnp.float32).T   # tied
 
 
-def _dense_moe_combine(moe, h2, top_k, dtype):
-    """Dense all-expert compute + renormalized top-k combine (serving-side
-    MoE; equivalent to the training dispatch when no token drops)."""
+def _dense_moe_combine(moe, h2, top_k, dtype, norm_topk_prob=True):
+    """Dense all-expert compute + top-k combine (serving-side MoE;
+    equivalent to the training dispatch when no token drops). With
+    ``norm_topk_prob`` the kept probs are renormalized to sum to 1
+    (GShard/Mixtral); HF Qwen2-MoE runs with it off."""
     gate_logits = h2.astype(jnp.float32) @ moe["gate"]["wg"]["kernel"]
     probs = jax.nn.softmax(gate_logits, axis=-1)              # [T, E]
     topv, topi = jax.lax.top_k(probs, top_k)                  # [T, K]
-    w = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+    if norm_topk_prob:
+        w = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+    else:
+        w = topv
     ex = moe["experts"]
     g = jnp.einsum("td,edf->etf", h2, ex["w_gate"].astype(dtype))
     u = jnp.einsum("td,edf->etf", h2, ex["w_up"].astype(dtype))
@@ -311,7 +316,8 @@ class MixtralPolicy:
         x = x + jnp.einsum("thk,hkd->td", attn,
                            lp["attn"]["wo"]["kernel"].astype(dtype))
         h2 = _rms(x, lp["mlp_norm"]["scale"], base.rms_norm_eps)
-        return x + _dense_moe_combine(lp["moe"], h2, cfg.moe.top_k, dtype)
+        return x + _dense_moe_combine(lp["moe"], h2, cfg.moe.top_k, dtype,
+                                      cfg.moe.norm_topk_prob)
 
     @staticmethod
     def unembed(params, x, cfg):
@@ -527,7 +533,8 @@ class Qwen2MoEPolicy:
         x = x + jnp.einsum("thk,hkd->td", attn,
                            lp["attn"]["wo"]["kernel"].astype(dtype))
         h2 = _rms(x, lp["mlp_norm"]["scale"], base.rms_norm_eps)
-        moe_out = _dense_moe_combine(lp["moe"], h2, cfg.moe.top_k, dtype)
+        moe_out = _dense_moe_combine(lp["moe"], h2, cfg.moe.top_k, dtype,
+                                     cfg.moe.norm_topk_prob)
         se = lp["shared_expert"]
         g = jax.nn.silu(h2 @ se["w_gate"]["kernel"].astype(dtype))
         u = h2 @ se["w_up"]["kernel"].astype(dtype)

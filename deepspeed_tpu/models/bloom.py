@@ -66,9 +66,11 @@ def alibi_slopes(num_heads: int) -> np.ndarray:
 
 
 # pos = ALIBI_POS_SPLIT*hi + lo; hi and lo are small integers that stay exact
-# in bf16 (mantissa 8 bits), so the bias is bit-accurate to 32k context even
-# with a bf16 KV cache — a single absolute-position column would round above
-# position 256 in bf16
+# in bf16 (mantissa 8 bits), so the *position* columns carry no rounding to
+# 32k context even with a bf16 KV cache — a single absolute-position column
+# would round above position 256 in bf16. The query-side slope columns are
+# still cast to the compute dtype, so in bf16 the bias keeps the ~0.4%
+# relative rounding of the slope itself (position-independent, benign).
 ALIBI_POS_SPLIT = 128
 
 

@@ -27,7 +27,9 @@ class Qwen2MoEConfig:
         vocab_size=151936, hidden_size=2048, intermediate_size=5632,
         num_layers=24, num_heads=16, num_kv_heads=16, attention_bias=True,
         rope_theta=1000000.0)
-    moe: MoEConfig = MoEConfig(num_experts=60, top_k=4)
+    # norm_topk_prob=False: HF Qwen2MoeConfig defaults it off for
+    # Qwen1.5-MoE — combine weights are the raw softmax top-k probs
+    moe: MoEConfig = MoEConfig(num_experts=60, top_k=4, norm_topk_prob=False)
     moe_intermediate_size: int = 1408
     shared_expert_intermediate_size: int = 5632
 
@@ -36,7 +38,8 @@ TINY_QWEN2_MOE = Qwen2MoEConfig(
     base=LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
                      num_layers=2, num_heads=4, num_kv_heads=4,
                      attention_bias=True, max_seq_len=128),
-    moe=MoEConfig(num_experts=4, top_k=2, dtype=jnp.bfloat16),
+    moe=MoEConfig(num_experts=4, top_k=2, norm_topk_prob=False,
+                  dtype=jnp.bfloat16),
     moe_intermediate_size=32,
     shared_expert_intermediate_size=128,
 )

@@ -35,6 +35,10 @@ class MoEConfig:
     use_rts: bool = True                        # random token selection tie-break
     aux_loss_weight: float = 0.01
     router_z_loss_weight: float = 0.001
+    # renormalize the kept top-k gate probs to sum to 1 (GShard/Mixtral
+    # behavior). HF Qwen2-MoE defaults this OFF (norm_topk_prob=False in
+    # Qwen1.5-MoE configs) — raw softmax probs weight the combine directly.
+    norm_topk_prob: bool = True
     dtype: Any = jnp.bfloat16
 
 
@@ -77,10 +81,14 @@ def top_k_gating(logits, cfg: MoEConfig, capacity: int, rng=None,
     pos_in_expert = jnp.sum(pos * onehot, axis=-1)                 # [T, K]
     keep = pos_in_expert < capacity                                # drop overflow
 
-    # normalize kept top-k probs (reference: denom_s = gates1_s + gates2_s)
+    # normalize kept top-k probs (reference: denom_s = gates1_s + gates2_s);
+    # skipped when norm_topk_prob is off (HF Qwen2-MoE semantics)
     kept_probs = topk_probs * keep
-    denom = jnp.maximum(jnp.sum(kept_probs, axis=-1, keepdims=True), 1e-9)
-    norm_probs = kept_probs / denom
+    if cfg.norm_topk_prob:
+        denom = jnp.maximum(jnp.sum(kept_probs, axis=-1, keepdims=True), 1e-9)
+        norm_probs = kept_probs / denom
+    else:
+        norm_probs = kept_probs
 
     cap_onehot = jax.nn.one_hot(jnp.where(keep, pos_in_expert, capacity),
                                 capacity, dtype=jnp.float32)       # [T, K, C]

@@ -77,8 +77,13 @@ class OpBuilder:
         sidecar = aot + ".src"
         if os.path.exists(aot) and os.path.exists(sidecar):
             import hashlib
+            # hash ALL sources (registration order) plus the compile flags so
+            # a stale artifact is rejected when either changes — e.g. an op
+            # gaining a flag like -pthread must invalidate installs built
+            # without it. Must stay in sync with setup.py:_sidecar_hash.
             want = hashlib.sha256(
-                open(self.sources[0], "rb").read()).hexdigest()[:16]
+                b"".join(open(s, "rb").read() for s in self.sources) +
+                b"\0" + " ".join(self.flags).encode()).hexdigest()[:16]
             if open(sidecar).read().strip() == want:
                 self._lib = ctypes.CDLL(aot)
                 return self._lib

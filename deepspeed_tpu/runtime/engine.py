@@ -410,8 +410,12 @@ class DeepSpeedTPUEngine:
         """Drop every cached compiled step fn. The single authority for the set of
         jitted-fn caches — used at init and whenever static trace structure
         changes (e.g. a compression-schedule transition)."""
-        self.training = True            # module-mode parity (train()/eval())
-        self._compiled = False          # engine.compile() parity flag
+        if not hasattr(self, "training"):
+            # API-parity mode flags are set once: a cache reset (compression
+            # transition, checkpoint load) must not undo a user's eval() /
+            # compile() calls (round-2 advisor finding).
+            self.training = True        # module-mode parity (train()/eval())
+            self._compiled = False      # engine.compile() parity flag
         self._train_batch_fn = None     # gas microbatches fused via scan
         self._micro_fwd_bwd_fn = None   # compat path: per-microbatch grads
         self._apply_update_fn = None    # compat path: update at boundary

@@ -22,10 +22,17 @@ _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # mirrors ops/op_builder.py DEFAULT_FLAGS (kept literal: setup.py must not
 # import the package it is building)
 _FLAGS = ["-O3", "-march=native", "-fopenmp", "-fPIC", "-shared", "-std=c++17"]
+# per-source extra flags, mirroring each op's registration in op_builder.py
+# (aio registers extra_flags=['-pthread'] for pre-2.34 glibc dlopen safety)
+_EXTRA_FLAGS = {"aio.cpp": ["-pthread"]}
 
 
-def _src_hash(path):
-    return hashlib.sha256(open(path, "rb").read()).hexdigest()[:16]
+def _sidecar_hash(path, flags):
+    """Sources + compile flags; must stay in sync with the validator in
+    ops/op_builder.py (OpBuilder.load) — a flag change (e.g. adding
+    -pthread) must invalidate previously installed artifacts."""
+    return hashlib.sha256(open(path, "rb").read() + b"\0" +
+                          " ".join(flags).encode()).hexdigest()[:16]
 
 
 class BuildWithOps(build_py):
@@ -41,11 +48,12 @@ class BuildWithOps(build_py):
                 continue
             name = src[:-4]
             out = os.path.join(out_dir, name + ".so")
-            cmd = ["g++"] + _FLAGS + [path, "-o", out]
+            flags = _FLAGS + _EXTRA_FLAGS.get(src, [])
+            cmd = ["g++"] + flags + [path, "-o", out]
             print("AOT:", " ".join(cmd))
             subprocess.run(cmd, check=True)
             with open(out + ".src", "w") as f:   # loader validates this
-                f.write(_src_hash(path))
+                f.write(_sidecar_hash(path, flags))
             # editable installs build into an ephemeral dir; also land the
             # artifact next to the sources so the loader can find it
             shutil.copy2(out, os.path.join(_CSRC, name + ".so"))
