@@ -89,6 +89,13 @@ def shard_activation(x, spec: Tuple):
     mesh = mesh_lib.get_global_mesh()
     if mesh is not None:
         names = set(mesh.axis_names)
+        # inside a partial-manual shard_map (e.g. the qgZ int8-wire gradient
+        # phase) the manual axes are already local — a constraint naming them
+        # would be rejected; keep constraining the still-automatic axes
+        try:
+            names -= set(jax.sharding.get_abstract_mesh().manual_axes)
+        except AttributeError:  # older jax without AbstractMesh.manual_axes
+            pass
 
         def filt(entry):
             if isinstance(entry, (tuple, list)):

@@ -320,14 +320,29 @@ class DeepSpeedTPUEngine:
                      "secondary gather; set zero_hpz_partition_size > 1 — ignored",
                      ranks=[0])
             self._quantized_weights = False
-        # qgZ: int8 gradient quantization at the reduction boundary (reference
-        # all_to_all_quant_reduce, runtime/comm/coalesced_collectives.py:31 +
-        # csrc/quantization/quant_reduce.cu). On the SPMD path XLA owns the
-        # collective schedule, so the quantization numerics (per-microbatch
-        # int8 round-trip before the cross-device reduce) apply here; the
-        # explicit int8-wire collective for manual shard_map paths is
-        # ops.pallas.quant.all_to_all_quant_reduce.
+        # qgZ: quantized gradient reduction (reference all_to_all_quant_reduce,
+        # runtime/comm/coalesced_collectives.py:31 + csrc/quantization/
+        # quant_reduce.cu). When the mesh has replica batch axes (axes that
+        # shard the batch but no parameter — the pure-DP all-reduce hops), the
+        # gradient phase runs in a partial-manual shard_map and the reduction
+        # over those axes moves REAL int8 bytes on the wire
+        # (runtime/zero/qgz.py). Without replica axes (pure-fsdp ZeRO-3) the
+        # reduction is fused into XLA's backward and the flag falls back to
+        # the int8 round-trip numerics simulation in _grads_one_micro.
         self._quantized_gradients = bool(zc.zero_quantized_gradients)
+        self._qgz_axes = ()
+        if self._quantized_gradients:
+            from deepspeed_tpu.runtime.zero.qgz import replica_grad_axes
+            self._qgz_axes = replica_grad_axes(
+                self.mesh, self.batch_spec, self.param_shardings)
+            if self._qgz_axes:
+                log_dist("qgZ: int8-wire gradient reduction over replica "
+                         f"axes {self._qgz_axes} (hierarchical quantized "
+                         "reduce-scatter + regather)", ranks=[0])
+            else:
+                log_dist("qgZ: no replica batch axis on this mesh — gradient "
+                         "reduction stays fused in XLA's backward; applying "
+                         "int8 round-trip numerics only", ranks=[0])
 
         # --- compiled functions ----------------------------------------------
         self._reset_compiled_fns()
@@ -467,21 +482,25 @@ class DeepSpeedTPUEngine:
         return jnp.asarray(out, jnp.float32)
 
     def _grads_one_micro(self, params, batch, rng, scale):
-        """Value-and-grad of (scaled) loss for one microbatch. With qgZ on,
-        every microbatch gradient goes through an int8 round-trip before it is
+        """Value-and-grad of (scaled) loss for one microbatch. With qgZ on and
+        no replica axis to carry the real int8-wire collective, every
+        microbatch gradient goes through an int8 round-trip before it is
         accumulated/reduced — the fidelity contract of the reference's
-        quantized-gradient collectives."""
+        quantized-gradient collectives. With replica axes present the wire
+        quantization itself supplies the numerics (runtime/zero/qgz.py)."""
         def scaled_loss(p):
             return self._compute_loss(p, batch, rng) * scale
         loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
-        if self._quantized_gradients:
+        if self._quantized_gradients and not self._qgz_axes:
             from deepspeed_tpu.ops.pallas.quant import dequantize_int8, quantize_int8
+            from deepspeed_tpu.runtime.zero.qgz import MIN_QUANT_SIZE
 
             def qdq(g):
                 # tiny leaves (norm scales, biases) are bandwidth-irrelevant —
                 # the reference buckets them with everything else, but skipping
                 # them avoids int8 noise on the most sensitive parameters
-                if g.ndim < 1 or g.size < 2048:
+                # (same threshold as the wire path, qgz.MIN_QUANT_SIZE)
+                if g.ndim < 1 or g.size < MIN_QUANT_SIZE:
                     return g
                 q, s = quantize_int8(g)
                 return dequantize_int8(q, s, dtype=g.dtype)
@@ -491,6 +510,44 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     # fused train_batch: scan over gas microbatches + update, one jit
     # ------------------------------------------------------------------
+    def _make_grads_phase(self):
+        """Builds ``(params, stacked_batch [gas, ...], rngs [gas], scale) ->
+        (avg loss, per-micro-summed grads in grad_accum_dtype)``. When qgZ has
+        replica axes, the whole phase (fwd/bwd + gas scan) runs inside a
+        partial-manual shard_map: per-device partial grads, then an int8-wire
+        hierarchical reduce over the replica axes — real bandwidth compression,
+        not just the reference's numerics (runtime/zero/qgz.py). fsdp/tensor
+        axes stay XLA-automatic inside the region."""
+        gas = self.gradient_accumulation_steps
+        acc_dtype = self.config.grad_accum_dtype
+
+        def grads_phase(params, stacked_batch, rngs, scale):
+            if gas == 1:
+                # no accumulation buffer at all: one microbatch, grads go
+                # straight into the update (saves a full param-tree carry)
+                batch = jax.tree.map(lambda x: x[0], stacked_batch)
+                loss, grads = self._grads_one_micro(params, batch,
+                                                    rngs[0], scale)
+                return loss, jax.tree.map(lambda g: g.astype(acc_dtype), grads)
+
+            def micro(carry, xs):
+                grad_acc, loss_acc = carry
+                batch, r = xs
+                loss, grads = self._grads_one_micro(params, batch, r, scale)
+                grad_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(acc_dtype), grad_acc, grads)
+                return (grad_acc, loss_acc + loss), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, acc_dtype), params)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zero_grads, jnp.float32(0.0)), (stacked_batch, rngs))
+            return loss_sum / gas, grads
+
+        from deepspeed_tpu.runtime.zero.qgz import wrap_grads_phase
+        return wrap_grads_phase(grads_phase, self.mesh, self._qgz_axes,
+                                self.batch_spec, stacked=True)
+
     def _build_train_batch_fn(self):
         cfg = self.config
         gas = self.gradient_accumulation_steps
@@ -498,38 +555,12 @@ class DeepSpeedTPUEngine:
         fp16 = cfg.fp16
         tx = self.tx
         lr_schedule = self.lr_schedule
-
-        acc_dtype = cfg.grad_accum_dtype
+        grads_phase = self._make_grads_phase()
 
         def train_batch_step(state: EngineState, stacked_batch, rng) -> Tuple[EngineState, StepOutput]:
             scale = state.loss_scale.scale
             rngs = jax.random.split(rng, gas)
-
-            if gas == 1:
-                # no accumulation buffer at all: one microbatch, grads go
-                # straight into the update (saves a full param-tree carry)
-                batch = jax.tree.map(lambda x: x[0], stacked_batch)
-                loss, grads = self._grads_one_micro(state.params, batch,
-                                                    rngs[0], scale)
-                grads = jax.tree.map(
-                    lambda g: g.astype(jnp.float32) / scale, grads)
-                new_state, out = self._update(state, grads, tx, lr_schedule,
-                                              clip, fp16)
-                return new_state, out._replace(loss=loss)
-
-            def micro(carry, xs):
-                grad_acc, loss_acc = carry
-                batch, r = xs
-                loss, grads = self._grads_one_micro(state.params, batch, r, scale)
-                grad_acc = jax.tree.map(
-                    lambda a, g: a + g.astype(acc_dtype), grad_acc, grads)
-                return (grad_acc, loss_acc + loss), None
-
-            zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, acc_dtype), state.params)
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro, (zero_grads, jnp.float32(0.0)), (stacked_batch, rngs))
-            loss = loss_sum / gas
+            loss, grads = grads_phase(state.params, stacked_batch, rngs, scale)
             # unscale + average over gas in fp32 (reference scales loss by 1/gas
             # pre-bwd; accumulation dtype may be lower via data_types config).
             # No per-microbatch overflow check is needed (the reference checks
@@ -669,22 +700,12 @@ class DeepSpeedTPUEngine:
         if self._offload_grad_fn is None:
             gas = self.gradient_accumulation_steps
             fp16 = cfg.fp16
-            acc_dtype = cfg.grad_accum_dtype
+
+            grads_phase = self._make_grads_phase()
 
             def grad_step(params, stacked_batch, rng, scale):
                 rngs = jax.random.split(rng, gas)
-
-                def micro(carry, xs):
-                    grad_acc, loss_acc = carry
-                    b, r = xs
-                    loss, grads = self._grads_one_micro(params, b, r, scale)
-                    grads = jax.tree.map(lambda g: g.astype(acc_dtype), grads)
-                    return (jax.tree.map(jnp.add, grad_acc, grads),
-                            loss_acc + loss), None
-
-                zero = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
-                (grads, loss_sum), _ = jax.lax.scan(
-                    micro, (zero, jnp.float32(0.0)), (stacked_batch, rngs))
+                loss, grads = grads_phase(params, stacked_batch, rngs, scale)
                 grads = jax.tree.map(
                     lambda g: g.astype(jnp.float32) / (scale * gas), grads)
                 overflow = precision.has_inf_or_nan(grads) if fp16.enabled \
@@ -694,7 +715,7 @@ class DeepSpeedTPUEngine:
                         grads, cfg.gradient_clipping)
                 else:
                     norm = precision.global_grad_norm(grads)
-                return loss_sum / gas, grads, norm, overflow
+                return loss, grads, norm, overflow
 
             self._offload_grad_fn = jax.jit(grad_step)
 
@@ -825,11 +846,18 @@ class DeepSpeedTPUEngine:
 
         acc_dtype = cfg.grad_accum_dtype
 
-        def fwd_bwd(params, batch, rng, scale):
+        def fwd_bwd_local(params, batch, rng, scale):
             loss, grads = self._grads_one_micro(params, batch, rng, scale)
             # accumulate in the configured dtype (fp32 default) even when params
             # are compute-dtype shadows (offload mode)
             return loss, jax.tree.map(lambda g: g.astype(acc_dtype), grads)
+
+        # compat path reduces per-microbatch (the reference reduces at each
+        # backward when not accumulating); with qgZ replica axes the reduce is
+        # the int8-wire collective, one sync per forward/backward pair
+        from deepspeed_tpu.runtime.zero.qgz import wrap_grads_phase
+        fwd_bwd = wrap_grads_phase(fwd_bwd_local, self.mesh, self._qgz_axes,
+                                   self.batch_spec, stacked=False)
 
         self._micro_fwd_bwd_fn = jax.jit(
             fwd_bwd, out_shardings=(None, grad_shardings))
