@@ -39,6 +39,13 @@ class MoEConfig:
     # behavior). HF Qwen2-MoE defaults this OFF (norm_topk_prob=False in
     # Qwen1.5-MoE configs) — raw softmax probs weight the combine directly.
     norm_topk_prob: bool = True
+    # int8 wire format for the dispatch/combine collectives (EQuARX-style;
+    # cf. reference _AllToAll dispatch, sharded_moe.py:533 + ZeRO++ wire
+    # quantization): the token->expert reduction and the expert->token
+    # combine run in manual shard_map regions over the batch / expert axes
+    # with quantized_psum — 4x less ICI/DCN traffic than fp32 dispatch, 2x
+    # vs the default bf16 (plus fp32 per-row scales); straight-through grads
+    quantized_dispatch: bool = False
     dtype: Any = jnp.bfloat16
 
 
@@ -140,6 +147,74 @@ class Experts(nn.Module):
         return jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
 
 
+def _quantized_wire_axes(mesh):
+    """Axes for the int8 MoE collectives, filtered to what is still automatic
+    in the surrounding context (the qgZ gradient phase may already hold the
+    data axis manual): (token-reduction axes, expert axis active)."""
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    manual = set()
+    try:
+        manual = set(jax.sharding.get_abstract_mesh().manual_axes)
+    except AttributeError:
+        pass
+    tok = tuple(a for a in mesh_lib.batch_axes(mesh)
+                if mesh.shape.get(a, 1) > 1 and a not in manual)
+    ep = mesh.shape.get("expert", 1) > 1 and "expert" not in manual
+    return tok, ep
+
+
+def _region_mesh(mesh):
+    """Mesh to hand a nested shard_map: inside a partial-manual region
+    (e.g. the qgZ gradient phase) jax requires the *context* abstract mesh
+    (whose outer axes are already Manual), not the concrete one."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if getattr(am, "manual_axes", ()):
+            return am
+    except AttributeError:
+        pass
+    return mesh
+
+
+def _quantized_dispatch_sum(mesh, tok_axes, dispatch, tokens):
+    """Token->expert dispatch with int8 on the wire. The SPMD dispatch
+    einsum contracts over the token dim, whose shards live on the batch
+    axes — the cross-device sum of the per-shard [E,C,D] partials is the
+    dispatch collective (reference: _AllToAll before experts,
+    sharded_moe.py:533). Here each shard computes its partial locally in a
+    manual region and the partials reduce via ``quantized_psum``."""
+    from deepspeed_tpu.ops.pallas.quant import quantized_psum
+
+    def body(dm, tk):
+        part = jnp.einsum("tec,td->ecd", dm, tk)
+        e, c, dd = part.shape
+        flat = quantized_psum(part.reshape(e * c, dd), tok_axes)
+        return flat.reshape(e, c, dd)
+
+    return jax.shard_map(
+        body, mesh=_region_mesh(mesh),
+        in_specs=(PartitionSpec(tok_axes), PartitionSpec(tok_axes)),
+        out_specs=PartitionSpec(),
+        axis_names=frozenset(tok_axes), check_vma=False)(dispatch, tokens)
+
+
+def _quantized_combine_sum(mesh, combine, expert_out):
+    """Expert->token combine with int8 on the wire: each expert shard
+    computes its partial [T,D] from its local experts, partials reduce over
+    the expert axis via ``quantized_psum`` (the reverse _AllToAll)."""
+    from deepspeed_tpu.ops.pallas.quant import quantized_psum
+
+    def body(cm, eo):
+        part = jnp.einsum("tec,ecd->td", cm, eo)
+        return quantized_psum(part, ("expert",))
+
+    return jax.shard_map(
+        body, mesh=_region_mesh(mesh),
+        in_specs=(PartitionSpec(None, "expert"), PartitionSpec("expert")),
+        out_specs=PartitionSpec(),
+        axis_names=frozenset({"expert"}), check_vma=False)(combine, expert_out)
+
+
 class MOELayer(nn.Module):
     """Dispatch -> experts -> combine (reference: MOELayer sharded_moe.py:533)."""
     cfg: MoEConfig
@@ -153,15 +228,31 @@ class MOELayer(nn.Module):
         tokens = x.reshape(b * s, d)
         dispatch, combine, aux_loss, z_loss = TopKGate(self.cfg, name="gate")(
             tokens, train=train)
+        tok_axes, ep_on = (), False
+        if self.cfg.quantized_dispatch:
+            from deepspeed_tpu.comm import mesh as mesh_lib
+            mesh = mesh_lib.get_global_mesh()
+            if mesh is not None:
+                tok_axes, ep_on = _quantized_wire_axes(mesh)
         # [T,E,C] x [T,D] -> [E,C,D]; experts dim rides the expert mesh axis:
-        # XLA inserts the token all-to-all here (reference: _AllToAll before experts)
-        dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), tokens)
+        # XLA inserts the token collective here (reference: _AllToAll before
+        # experts) — int8-wire via the manual region when configured
+        if tok_axes:
+            dispatched = _quantized_dispatch_sum(
+                mesh, tok_axes, dispatch.astype(x.dtype), tokens)
+        else:
+            dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype),
+                                    tokens)
         dispatched = shard_activation(dispatched, ("expert", None, None))
         expert_out = Experts(self.cfg.num_experts, self.hidden_size,
                              self.intermediate_size, self.cfg.dtype,
                              name="experts")(dispatched)
         expert_out = shard_activation(expert_out, ("expert", None, None))
-        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+        if ep_on:
+            out = _quantized_combine_sum(mesh, combine.astype(x.dtype),
+                                         expert_out)
+        else:
+            out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
         return out.reshape(b, s, d), aux_loss + z_loss
 
 

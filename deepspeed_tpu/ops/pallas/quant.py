@@ -143,6 +143,58 @@ def all_to_all_quant_reduce(x, axis_name: str, outer_axis_name=None,
     return y
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def quantized_psum(x, axes, mean: bool = False):
+    """All-reduce with int8 on the wire: hierarchical quantized
+    reduce-scatter + int8 regather over ``axes`` (given outermost-first; the
+    scatter runs innermost-first so the full volume rides the fast/ICI hop
+    and only the reduced 1/w shard crosses the outer wire). x: [N, D]; the
+    result is replicated across ``axes``. Usable inside shard_map manual
+    over (at least) ``axes``. Shared core of the qgZ gradient sync
+    (runtime/zero/qgz.py) and the quantized MoE dispatch/combine.
+
+    Differentiable with a straight-through backward: a psum whose output is
+    replicated has identity (÷w for mean) as its exact vjp — each device's
+    cotangent IS the replicated downstream cotangent — so the backward costs
+    zero wire bytes and only the int8 rounding is straight-through'd (same
+    contract as qwZ's straight-through weight gather)."""
+    return _quantized_psum_core(x, axes, mean)
+
+
+def _quantized_psum_core(x, axes, mean):
+    rows = []
+    for ax in reversed(tuple(axes)):
+        rows.append(x.shape[0])
+        x = quantized_psum_scatter(x, ax, mean=mean)
+    for ax, r in zip(tuple(axes), reversed(rows)):
+        x = quantized_all_gather(x, ax)[:r]
+    return x
+
+
+def _quantized_psum_fwd(x, axes, mean):
+    return _quantized_psum_core(x, axes, mean), None
+
+
+def _quantized_psum_bwd(axes, mean, _, g):
+    # Convention calibration: with check_vma=False, shard_map's transpose
+    # hands a replicated (out_spec P()) output's cotangent to this bwd as
+    # dL/dy / w on each device (verified against lax.psum's own transpose —
+    # regression-tested in test_pallas_kernels.py::test_quantized_psum_grad
+    # so a jax convention change fails loudly). The true vjp of a
+    # sum-reduction with replicated output is identity (each device's
+    # partial receives the full dL/dy), hence *w here; for mean it is
+    # dL/dy / w, which is exactly the incoming value.
+    if not mean:
+        w = 1
+        for ax in axes:
+            w *= jax.lax.axis_size(ax)
+        g = g * w
+    return (g,)
+
+
+quantized_psum.defvjp(_quantized_psum_fwd, _quantized_psum_bwd)
+
+
 def quantized_all_to_all(x, axis_name: str, split_axis: int = 0,
                          concat_axis: int = 0):
     """MoE-dispatch collective with int8 wire format (cf. EQuARX): quantize

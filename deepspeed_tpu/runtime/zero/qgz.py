@@ -84,13 +84,13 @@ def quantized_grad_sync(grads, axes: Tuple[str, ...]):
     """Mean-reduce a gradient pytree over the manual ``axes`` with int8 on
     the wire. Must run inside a shard_map whose manual axes include ``axes``.
 
-    Per leaf: hierarchical int8 reduce-scatter (one ``quantized_psum_scatter``
-    hop per axis — the reference's intra-node then inter-node structure),
-    then int8 regather so the result is replicated across ``axes`` for the
-    auto-mode optimizer. Tiny leaves take a full-precision pmean.
+    Per leaf: hierarchical int8 reduce-scatter + int8 regather
+    (``ops.pallas.quant.quantized_psum`` — innermost/fast axis scattered
+    first, the reference's intra-node then inter-node structure) so the
+    result is replicated across ``axes`` for the auto-mode optimizer. Tiny
+    leaves take a full-precision pmean.
     """
-    from deepspeed_tpu.ops.pallas.quant import (quantized_all_gather,
-                                                quantized_psum_scatter)
+    from deepspeed_tpu.ops.pallas.quant import quantized_psum
 
     w_total = 1
     for ax in axes:
@@ -107,17 +107,7 @@ def quantized_grad_sync(grads, axes: Tuple[str, ...]):
         g2 = g.reshape(-1, shape[-1])
         if g2.shape[0] < w_total:
             return jax.lax.pmean(g, axes)
-        # scatter innermost (fast/ICI) axis FIRST so the full gradient
-        # volume rides the fast wire and only the already-reduced 1/w shard
-        # crosses the outer (DCN) hop — the reference's intra-node ->
-        # inter-node hierarchy. ``axes`` arrive outermost-first (batch-spec
-        # order), hence reversed here; the regather unwinds in scatter order.
-        rows = []
-        for ax in reversed(axes):
-            rows.append(g2.shape[0])
-            g2 = quantized_psum_scatter(g2, ax, mean=True)
-        for ax, r in zip(axes, reversed(rows)):
-            g2 = quantized_all_gather(g2, ax)[:r]
+        g2 = quantized_psum(g2, axes, mean=True)
         return g2.reshape(shape).astype(dt)
 
     return jax.tree.map(sync, grads)

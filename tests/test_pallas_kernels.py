@@ -301,3 +301,48 @@ def test_quantized_all_to_all(mesh_dp8):
     got, ref = run(body_q), run(body_f)
     rel = np.abs(got - ref).max() / np.abs(ref).max()
     assert rel < 0.02, rel
+
+
+def test_quantized_psum_grad(mesh_dp8):
+    """quantized_psum's straight-through vjp matches lax.psum's transpose —
+    convention regression guard for the calibration documented in
+    quant.py:_quantized_psum_bwd (check_vma=False hands dL/dy / w)."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.ops.pallas.quant import quantized_psum
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(16, 64)), jnp.float32)
+
+    def mk(body):
+        f = jax.shard_map(body, mesh=mesh_dp8, in_specs=P("data"),
+                          out_specs=P(), axis_names=frozenset({"data"}),
+                          check_vma=False)
+        return jax.grad(lambda v: jnp.sum(jax.jit(f)(v) ** 2))(x)
+
+    g_ref = mk(lambda xl: jax.lax.psum(xl, "data"))
+    g_q = mk(lambda xl: quantized_psum(xl, ("data",)))
+    rel = np.abs(np.asarray(g_q) - np.asarray(g_ref)).max() / \
+        np.abs(np.asarray(g_ref)).max()
+    assert rel < 0.03, rel   # identical up to int8 fwd rounding in g_ref's y
+
+
+def test_quantized_psum_grad_two_axes():
+    """Same convention guard over TWO manual axes (the MoE dispatch path
+    reduces over composed batch axes): bwd scaling must be 1/(w1*w2)."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.comm.mesh import create_mesh
+    from deepspeed_tpu.config.config import MeshConfig
+    from deepspeed_tpu.ops.pallas.quant import quantized_psum
+    mesh = create_mesh(MeshConfig(data=4, fsdp=2))
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(16, 64)), jnp.float32)
+
+    def mk(body):
+        f = jax.shard_map(body, mesh=mesh, in_specs=P(("data", "fsdp")),
+                          out_specs=P(),
+                          axis_names=frozenset({"data", "fsdp"}),
+                          check_vma=False)
+        return jax.grad(lambda v: jnp.sum(jax.jit(f)(v) ** 2))(x)
+
+    g_ref = mk(lambda xl: jax.lax.psum(xl, ("data", "fsdp")))
+    g_q = mk(lambda xl: quantized_psum(xl, ("data", "fsdp")))
+    rel = np.abs(np.asarray(g_q) - np.asarray(g_ref)).max() / \
+        np.abs(np.asarray(g_ref)).max()
+    assert rel < 0.03, rel
