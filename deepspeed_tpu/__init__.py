@@ -76,6 +76,32 @@ def initialize(args=None,
             sequence=_ws("get_sequence_parallel_world_size"),
             data=-1))
 
+    # pipeline dispatch (reference: deepspeed.initialize returns a
+    # PipelineEngine when model is a PipelineModule, deepspeed/__init__.py:69)
+    from deepspeed_tpu.runtime.pipe.engine import PipeModule, PipelineEngine
+    if isinstance(model, PipeModule):
+        pipe_engine = PipelineEngine(
+            model, config=ds_config, mesh=mesh,
+            client_optimizer=optimizer,
+            lr_scheduler=lr_scheduler if callable(lr_scheduler) else None)
+        pipe_loader = None
+        if training_data is not None:
+            if not pipe_engine.micro_batch_size:
+                raise ValueError(
+                    "initialize(model=PipeModule, training_data=...) needs "
+                    "train_micro_batch_size_per_gpu in the config to size "
+                    "the dataloader batches")
+            import jax as _jax
+            from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
+            pipe_loader = DeepSpeedTPUDataLoader(
+                training_data,
+                batch_size=pipe_engine.micro_batch_size *
+                pipe_engine.micro_batches,
+                collate_fn=collate_fn,
+                process_index=_jax.process_index(),
+                process_count=_jax.process_count())
+        return pipe_engine, pipe_engine.tx, pipe_loader, None
+
     engine_kwargs = dict(
         model=model,
         config=ds_config,

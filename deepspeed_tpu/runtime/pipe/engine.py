@@ -49,23 +49,44 @@ class PipelineEngine:
     engine.py:338)."""
 
     def __init__(self, module: PipeModule, config: Optional[Dict] = None,
-                 mesh=None):
-        cfg = config or {}
+                 mesh=None, client_optimizer=None, lr_scheduler=None):
+        from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+        dscfg = config if isinstance(config, DeepSpeedTPUConfig) \
+            else DeepSpeedTPUConfig(config or {})
+        cfg = dscfg.raw()
         self.module = module
         self.mesh = mesh or mesh_lib.get_global_mesh()
         if self.mesh is None:
             raise ValueError("PipelineEngine needs a mesh with a 'pipe' axis")
         self.num_stages = self.mesh.shape.get("pipe", 1)
-        self.micro_batches = int(cfg.get("gradient_accumulation_steps",
-                                         cfg.get("micro_batches", 2)))
+        # batch triple reconciliation, same rules as the main engine
+        # (reference _configure_train_batch_size); the legacy 'micro_batches'
+        # key takes precedence for direct construction
+        dscfg.resolve_batch_sizes(
+            mesh_lib.get_data_parallel_world_size(self.mesh))
+        self.micro_batches = int(cfg.get("micro_batches")
+                                 or dscfg.gradient_accumulation_steps)
+        self.micro_batch_size = dscfg.train_micro_batch_size_per_gpu
         opt_cfg = cfg.get("optimizer", {"type": "AdamW",
                                         "params": {"lr": 1e-3}})
         lr = float(opt_cfg.get("params", {}).get("lr", 1e-3))
         wd = float(opt_cfg.get("params", {}).get("weight_decay", 0.0))
         self.clip = float(cfg.get("gradient_clipping", 0.0))
-        self.tx = optax.adamw(lr, weight_decay=wd) \
-            if opt_cfg.get("type", "AdamW").lower() in ("adam", "adamw") \
-            else optax.sgd(lr)
+        if client_optimizer is not None:
+            # reference parity: initialize(optimizer=...) overrides the
+            # config-built optimizer (an optax GradientTransformation here)
+            if lr_scheduler is not None:
+                raise ValueError(
+                    "pipeline: a client optimizer and an lr_scheduler can't "
+                    "be combined (optax binds the schedule inside the "
+                    "optimizer) — pass the schedule as the optimizer's "
+                    "learning_rate instead")
+            self.tx = client_optimizer
+        else:
+            lr_arg = lr_scheduler if callable(lr_scheduler) else lr
+            self.tx = optax.adamw(lr_arg, weight_decay=wd) \
+                if opt_cfg.get("type", "AdamW").lower() in ("adam", "adamw") \
+                else optax.sgd(lr_arg)
 
         # stage-sharded layout: stacked leaves [P, L/P, ...] over pipe, tied
         # replicated (reference: per-stage parameter/optimizer ownership)
@@ -76,18 +97,27 @@ class PipelineEngine:
             lambda x: NamedSharding(self.mesh, P("pipe",
                                                  *([None] * (x.ndim - 1))))
             if self.num_stages > 1 else NamedSharding(self.mesh, P()), staged)
-        self.staged_params = jax.device_put(staged, self._staged_spec)
+        # host round-trip so the engine owns FRESH device buffers: the step
+        # fn donates params, and device_put can alias the caller's arrays —
+        # donating an alias would delete the user's params tree under them
+        self.staged_params = jax.device_put(
+            jax.tree.map(np.asarray, staged), self._staged_spec)
         self.tied_params = jax.device_put(
-            module.tied_params,
+            jax.tree.map(np.asarray, module.tied_params),
             jax.tree.map(lambda x: NamedSharding(self.mesh, P()),
                          module.tied_params))
         self.opt_state = self.tx.init((self.staged_params, self.tied_params))
         self.global_steps = 0
         self._step_fn = None
-        log_dist(f"pipeline engine: {self.num_stages} stages x "
-                 f"{self.micro_batches} microbatches "
-                 f"(bubble {(self.num_stages - 1) / (self.micro_batches + self.num_stages - 1):.2f})",
-                 ranks=[0])
+        from deepspeed_tpu.runtime.pipe.schedule import (
+            bubble_fraction, lockstep_bubble_fraction)
+        log_dist(
+            f"pipeline engine: {self.num_stages} stages x "
+            f"{self.micro_batches} microbatches (lockstep bubble "
+            f"{lockstep_bubble_fraction(self.micro_batches, self.num_stages):.2f}"
+            f", host-1F1B model "
+            f"{bubble_fraction(self.micro_batches, self.num_stages):.2f})",
+            ranks=[0])
 
     # ------------------------------------------------------------------
     def _build_step(self):

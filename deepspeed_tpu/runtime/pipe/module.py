@@ -69,3 +69,94 @@ def layer_param_counts(stacked_params: Any) -> List[float]:
     num_layers = leaves[0].shape[0]
     per_layer = sum(int(np.prod(l.shape[1:])) for l in leaves)
     return [float(per_layer)] * num_layers
+
+
+def llama_pipe_module(cfg, params):
+    """PipeModule adapter for the llama family — the ``PipelineModule``
+    analog for GPT-style stacks (reference: ``runtime/pipe/module.py:86``
+    builds stage partitions from LayerSpecs; here the flax ``scan_layers``
+    layout already stacks layer params [L, ...], so the adapter just splits
+    the tree into (stacked blocks, tied embed/norm/head) and binds the
+    stage functions).
+
+    ``cfg``: LlamaConfig with ``scan_layers=True``; ``params``: the
+    ``LlamaForCausalLM.init`` tree. Works for any llama-family variant that
+    shares the block structure (llama/mistral/qwen2/gemma configs).
+    """
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.llama import (REMAT_POLICIES, LlamaBlock,
+                                            RMSNorm)
+    from deepspeed_tpu.runtime.pipe.engine import PipeModule
+
+    p = params.get("params", params)
+    model = p["model"]
+    if not cfg.scan_layers:
+        raise ValueError("llama_pipe_module needs cfg.scan_layers=True "
+                         "([L, ...]-stacked layer params)")
+    stacked = model["layers"]
+    tied = {"embed": model["embed"], "final_norm": model["final_norm"]}
+    if not cfg.tie_embeddings:
+        tied["lm_head"] = model["lm_head"]
+
+    block = LlamaBlock(cfg)
+    norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                   scale_offset=cfg.rms_scale_offset)
+
+    def block_apply(layer_params, x, positions):
+        return block.apply({"params": layer_params}, x, positions)
+    if cfg.remat:
+        # same knob as LlamaModel: per-block rematerialization bounds the
+        # residual memory of the stage's vjp to one layer at a time (the
+        # executor already recomputes the stage forward from its saved
+        # input; remat further shrinks the recompute's own residual set).
+        # prevent_cse=False as in LlamaModel's scan_layers path — the scan
+        # makes the CSE barrier unnecessary and it only costs optimization
+        block_apply = jax.checkpoint(
+            block_apply, policy=REMAT_POLICIES[cfg.remat_policy],
+            prevent_cse=False)
+
+    def block_fn(layer_params, x):
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        return block_apply(layer_params, x, positions)
+
+    def first_fn(tied_p, tokens):
+        x = tied_p["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        if cfg.scale_embeddings:
+            x = x * jnp.sqrt(
+                jnp.asarray(cfg.hidden_size, jnp.float32)).astype(x.dtype)
+        return x
+
+    def last_fn(tied_p, y, tokens):
+        x = norm.apply({"params": tied_p["final_norm"]}, y)
+        if cfg.loss_chunk_size:
+            # same fused head-matmul + CE chunking as the dense model's
+            # _chunked_loss: fp32 logits never materialize at [B,S,V]
+            from deepspeed_tpu.sequence.cross_entropy import (
+                chunked_cross_entropy)
+            labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)))
+            mask = jnp.pad(jnp.ones_like(tokens[:, 1:]), ((0, 0), (0, 1)))
+            head = tied_p["embed"]["embedding"] if cfg.tie_embeddings \
+                else tied_p["lm_head"]["kernel"]
+            kw = {"embedding": head} if cfg.tie_embeddings \
+                else {"kernel": head}
+            return chunked_cross_entropy(
+                x, labels, mask, chunk_size=cfg.loss_chunk_size,
+                soft_cap=cfg.logits_soft_cap, compute_dtype=cfg.dtype, **kw)
+        if cfg.tie_embeddings:
+            logits = x.astype(cfg.dtype) @ \
+                tied_p["embed"]["embedding"].astype(cfg.dtype).T
+        else:
+            logits = x.astype(cfg.dtype) @ \
+                tied_p["lm_head"]["kernel"].astype(cfg.dtype)
+        logits = logits.astype(jnp.float32)
+        if cfg.logits_soft_cap:
+            logits = cfg.logits_soft_cap * jnp.tanh(
+                logits / cfg.logits_soft_cap)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        ll = jnp.take_along_axis(logp, tokens[:, 1:][..., None],
+                                 axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    return PipeModule(block_fn=block_fn, first_fn=first_fn, last_fn=last_fn,
+                      stacked_params=stacked, tied_params=tied)

@@ -22,7 +22,11 @@ not the microbatch count — holds: the ring buffer keeps at most
 ``min(M, 2(S - stage) - 1)`` stage inputs (the reference's alternating-slot
 schedule keeps ``S - stage``; the macro-step formulation pays ≤2x that bound in
 exchange for running fill+drain in ``2(S-1) + M`` fully-compiled steps). The
-bubble fraction matches the schedule's ``(S-1)/(M+S-1)`` analytical model.
+bubble fraction is the lockstep model's ``2(S-1)/(2(S-1)+M)`` — every
+macro-step costs one full stage fwd+bwd on every device, fill/drain steps
+included — vs the reference's host-asynchronous ``(S-1)/(M+S-1)``
+(``schedule.lockstep_bubble_fraction`` / ``bubble_fraction``; measured by
+``bin/dstpu_pipe_bench``).
 
 Tied weights (embedding used by ``first_fn`` at stage 0 and ``last_fn`` at the
 last stage) are replicated across ``pipe``; their gradients from both ends are
@@ -70,8 +74,14 @@ def pipeline_train_step_1f1b(block_fn: Callable, stacked_params: Any,
     staged = stack_to_stages(stacked_params, s)
     param_specs = jax.tree.map(lambda x: P("pipe", *([None] * (x.ndim - 1))),
                                staged)
-    bufs = min(m, 2 * s - 1)
-    total_steps = 2 * (s - 1) + m
+    # the schedule module drives the executor: macro-step count and ring
+    # depth come from the lockstep instruction stream; the in-scan fwd/bwd
+    # masks below implement exactly its ForwardPass/BackwardPass occupancy
+    # (asserted equal in test_pipeline.py::test_lockstep_masks_match_schedule)
+    from deepspeed_tpu.runtime.pipe.schedule import (LockstepSPMDSchedule,
+                                                     num_macro_steps)
+    bufs = LockstepSPMDSchedule(m, s, 0).num_pipe_buffers()
+    total_steps = num_macro_steps(m, s)
 
     def body(local_params, tied, toks):
         local_params = jax.tree.map(lambda x: x[0], local_params)

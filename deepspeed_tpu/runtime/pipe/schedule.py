@@ -138,6 +138,59 @@ class TrainSchedule(PipeSchedule):
         yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
 
 
+class LockstepSPMDSchedule(PipeSchedule):
+    """The timeline the SPMD 1F1B executor (``one_f_one_b.py``) actually
+    runs — and the module that DRIVES it: the executor derives its macro-step
+    count from this stream (``num_macro_steps``), and its in-scan fwd/bwd
+    occupancy masks are tested equal to the stream's
+    ForwardPass/BackwardPass instructions (test_pipeline.py).
+
+    Every stage steps in lockstep inside one compiled scan: macro-step ``t``
+    forwards microbatch ``t - stage`` and backwards microbatch
+    ``t - (2(S-1) - stage)``. Fill+drain is ``2(S-1)`` macro-steps — ≤2x the
+    host-asynchronous ``TrainSchedule``'s ``S-1``, the price of a single
+    fully-compiled lockstep program with no host round-trips."""
+
+    def num_pipe_buffers(self) -> int:
+        # ring buffer of stage inputs held for recompute-backward
+        return min(self.micro_batches, 2 * self.stages - 1)
+
+    def steps(self):
+        m, s, p = self.micro_batches, self.stages, self.stage_id
+        for t in range(2 * (s - 1) + m):
+            cmds: List[PipeInstruction] = []
+            f = t - p
+            if 0 <= f < m:
+                cmds.append(LoadMicroBatch(f) if p == 0 else RecvActivation(f))
+                cmds.append(ForwardPass(f))
+                if p != s - 1:
+                    cmds.append(SendActivation(f))
+            b = t - (2 * (s - 1) - p)
+            if 0 <= b < m:
+                if p != s - 1:
+                    cmds.append(RecvGrad(b))
+                cmds.append(BackwardPass(b))
+                if p != 0:
+                    cmds.append(SendGrad(b))
+            yield cmds
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
+def num_macro_steps(micro_batches: int, stages: int) -> int:
+    """Macro-step count of the lockstep SPMD executor, derived from the
+    instruction stream (the final reduce/step tail is outside the scan)."""
+    return sum(1 for _ in LockstepSPMDSchedule(
+        micro_batches, stages, 0).steps()) - 1
+
+
 def bubble_fraction(micro_batches: int, stages: int) -> float:
     """Pipeline bubble overhead of GPipe/1F1B: (s-1)/(m+s-1)."""
     return (stages - 1) / (micro_batches + stages - 1)
+
+
+def lockstep_bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Bubble of the lockstep SPMD executor: every macro-step costs one full
+    stage fwd+bwd on every device (fill/drain steps run masked dead compute),
+    so overhead = 2(s-1) dead macro-steps out of 2(s-1)+m."""
+    t = num_macro_steps(micro_batches, stages)
+    return (t - micro_batches) / t
