@@ -18,24 +18,28 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def run(attn_impl: str, batch: int, prompt_len: int, decode_steps: int):
+def _make_engine(attn_impl: str):
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2, V2EngineConfig
     from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, random_tokens
 
-    cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=4096,
-        dtype=jnp.bfloat16)
+    if os.environ.get("DSTPU_DECODE_TINY") == "1":   # CPU smoke config
+        cfg = LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=4, num_kv_heads=2,
+                          max_seq_len=1024, dtype=jnp.float32)
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=4096,
+            dtype=jnp.bfloat16)
     model = LlamaForCausalLM(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         random_tokens(1, 8, vocab_size=cfg.vocab_size))["params"]
     params = jax.device_put(jax.tree.map(
-        lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating)
+        lambda x: x.astype(cfg.dtype) if jnp.issubdtype(x.dtype, jnp.floating)
         else x, params))
 
     engine = InferenceEngineV2(params, cfg, V2EngineConfig(
@@ -43,6 +47,13 @@ def run(attn_impl: str, batch: int, prompt_len: int, decode_steps: int):
         scheduler=SchedulerConfig(max_tokens_per_step=2048,
                                   prefill_buckets=(256,)),
         attn_impl=attn_impl))
+    return engine, cfg
+
+
+def run(attn_impl: str, batch: int, prompt_len: int, decode_steps: int):
+    import numpy as np
+
+    engine, cfg = _make_engine(attn_impl)
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(0, cfg.vocab_size, prompt_len))
                for _ in range(batch)]
@@ -57,6 +68,59 @@ def run(attn_impl: str, batch: int, prompt_len: int, decode_steps: int):
     for uid in range(batch):
         engine.flush(uid)
     return batch * decode_steps / dt
+
+
+def mixed_load(attn_impl: str, initial: int, arrivals: int,
+               arrive_every: int, prompt_len: int, max_steps: int):
+    """Continuous-batching under MIXED prefill/decode load (the FastGen
+    serving scenario the attention-only number can't show): ``initial``
+    sequences arrive together, then one more every ``arrive_every`` steps —
+    each arrival's prompt chunks through the SplitFuse scheduler while the
+    resident sequences keep decoding. Reports overall emitted tokens/s and
+    TTFT (put -> first sampled token) p50/p95.
+    Reference analog: the FastGen latency/throughput benchmark
+    (mii/benchmarks), reference blogs' SplitFuse headline."""
+    import numpy as np
+
+    engine, cfg = _make_engine(attn_impl)
+    rng = np.random.default_rng(0)
+    total = initial + arrivals
+
+    def prompt():
+        return list(rng.integers(0, cfg.vocab_size, prompt_len))
+
+    put_time = {}
+    first_tok = {}
+    t0 = time.time()
+    engine.put(list(range(initial)), [prompt() for _ in range(initial)])
+    for u in range(initial):
+        put_time[u] = t0
+    emitted = 0
+    next_uid = initial
+    now = t0
+    for step_i in range(max_steps):
+        if next_uid < total and step_i and step_i % arrive_every == 0:
+            put_time[next_uid] = time.time()
+            engine.put([next_uid], [prompt()])
+            next_uid += 1
+        out = engine.step()
+        now = time.time()
+        for uid in out:
+            first_tok.setdefault(uid, now)
+        emitted += len(out)
+        # max_steps IS the measurement window: throughput is sustained mixed
+        # load over the whole window, TTFTs accrue as arrivals get served
+    for u in list(put_time):
+        engine.flush(u)
+    tps = emitted / max(now - t0, 1e-9)
+    ttfts = sorted(first_tok[u] - put_time[u] for u in first_tok)
+    pct = lambda p: ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))] \
+        if ttfts else float("nan")  # noqa: E731
+    return {"mixed_tokens_per_sec": round(tps, 1),
+            "ttft_p50_ms": round(pct(0.50) * 1e3, 1),
+            "ttft_p95_ms": round(pct(0.95) * 1e3, 1),
+            "sequences": total, "served_first_token": len(ttfts),
+            "arrive_every_steps": arrive_every}
 
 
 def attention_microbench(ctx: int = 2048, bs: int = 64):
@@ -106,6 +170,11 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     impl = "kernel" if on_tpu else "gather"
     tps = run(impl, batch, prompt_len, steps)
+    mixed = mixed_load(impl, initial=max(batch // 2, 1),
+                       arrivals=max(batch // 2, 1), arrive_every=4,
+                       prompt_len=prompt_len,
+                       max_steps=int(os.environ.get(
+                           "DSTPU_DECODE_MIXED_STEPS", 96)))
     if on_tpu:
         ms_k, ms_g = attention_microbench()
         speedup = ms_g / max(ms_k, 1e-9)
@@ -122,7 +191,7 @@ def main():
                   "decode_steps": steps, "attn_impl": impl,
                   "paged_attn_kernel_ms": round(ms_k, 2),
                   "paged_attn_gather_ms": round(ms_g, 2),
-                  "attn_ctx": 2048},
+                  "attn_ctx": 2048, **mixed},
     }))
 
 

@@ -80,17 +80,18 @@ def initialize(args=None,
     # PipelineEngine when model is a PipelineModule, deepspeed/__init__.py:69)
     from deepspeed_tpu.runtime.pipe.engine import PipeModule, PipelineEngine
     if isinstance(model, PipeModule):
+        if lr_scheduler is not None and not callable(lr_scheduler):
+            raise ValueError(
+                "pipeline: lr_scheduler must be a callable step -> lr "
+                f"(got {type(lr_scheduler).__name__}); stateful scheduler "
+                "objects are not supported on the pipeline path")
         pipe_engine = PipelineEngine(
             model, config=ds_config, mesh=mesh,
-            client_optimizer=optimizer,
-            lr_scheduler=lr_scheduler if callable(lr_scheduler) else None)
+            client_optimizer=optimizer, lr_scheduler=lr_scheduler)
         pipe_loader = None
         if training_data is not None:
-            if not pipe_engine.micro_batch_size:
-                raise ValueError(
-                    "initialize(model=PipeModule, training_data=...) needs "
-                    "train_micro_batch_size_per_gpu in the config to size "
-                    "the dataloader batches")
+            # resolve_batch_sizes guarantees micro_batch_size >= 1 (default 1
+            # when the config gives only the accumulation depth)
             import jax as _jax
             from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
             pipe_loader = DeepSpeedTPUDataLoader(

@@ -374,6 +374,13 @@ class DeepSpeedTPUConfig:
         elif mb is not None:
             gas = 1
             tb = mb * dp_world_size
+        elif gas is not None:
+            # gas alone (reference _set_batch_related_parameters: micro
+            # defaults to 1, train batch follows) — the pipeline engine
+            # leans on this branch when a config gives only the
+            # accumulation depth
+            mb = 1
+            tb = gas * dp_world_size
         else:
             mb, gas = 1, 1
             tb = dp_world_size

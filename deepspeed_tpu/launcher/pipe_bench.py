@@ -70,18 +70,20 @@ def main(argv=None):
     model = LlamaForCausalLM(cfg)
     rng = np.random.default_rng(0)
 
+    mesh = create_mesh(MeshConfig(pipe=s, data=n_dev // s))
+    set_global_mesh(mesh)
+    init_toks = rng.integers(0, 256, size=(2, args.seq)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.asarray(init_toks)})
     points = []
     for m in args.microbatches:
-        mesh = create_mesh(MeshConfig(pipe=s, data=n_dev // s))
-        set_global_mesh(mesh)
         b = m * args.micro_batch
         tokens = rng.integers(0, 256, size=(b, args.seq)).astype(np.int32)
-        params = model.init(jax.random.PRNGKey(0),
-                            {"input_ids": jnp.asarray(tokens)})
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=llama_pipe_module(cfg, params), mesh=mesh,
             config={"gradient_accumulation_steps": m,
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+        assert engine.micro_batches == m, (engine.micro_batches, m)
         engine.train_batch(tokens)                       # compile
         best = float("inf")
         for _ in range(args.reps):

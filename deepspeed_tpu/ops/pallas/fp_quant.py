@@ -94,3 +94,33 @@ def fp8_matmul(a, b_q, b_scales, preferred=jnp.float32):
     return jax.lax.dot_general(
         a_scaled.astype(jnp.bfloat16), b_q.astype(jnp.bfloat16),
         (((1,), (0,)), ((), ())), preferred_element_type=preferred)
+
+
+# ---------------------------------------------------------------------------
+# True fp8 GEMM: operands stay fp8 INTO dot_general, scales fused as a
+# rank-1 epilogue on the fp32 accumulator
+# ---------------------------------------------------------------------------
+def fp8_gemm_quantize(a, b, fmt: str = "e4m3"):
+    """Quantize a GEMM pair for :func:`fp8_gemm`: ``a`` [M, K] per-row
+    (per-M) scales, ``b`` [K, N] per-COLUMN (per-N) scales — both scale sets
+    then apply on the OUTPUT as the rank-1 epilogue ``s_m ⊗ s_n``, so the
+    dot itself runs entirely in fp8."""
+    a_q, s_m = quantize_fp8(a, fmt=fmt)
+    bt_q, s_n = quantize_fp8(b.T, fmt=fmt)       # per-column groups of b
+    return a_q, s_m, bt_q.T, s_n
+
+
+def fp8_gemm(a_q, s_m, b_q, s_n, out_dtype=jnp.bfloat16):
+    """y = dequant(a_q) @ dequant(b_q) with the operands staying fp8 through
+    ``dot_general`` (reference: ``ops/fp_quantizer/fp8_gemm.py`` — fp8
+    operands into the tensor-core GEMM with fused scales). The fp32
+    accumulator is scaled by the rank-1 outer product of the row/column
+    scales in the epilogue; XLA keeps native-fp8 dots where the hardware has
+    them and upcasts inside the fused op elsewhere — either way no
+    dequantized copy of the operands ever materializes in HBM.
+
+    a_q: [M, K] fp8; s_m: [M, 1] fp32; b_q: [K, N] fp8; s_n: [N, 1] fp32.
+    """
+    acc = jax.lax.dot_general(a_q, b_q, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return (acc * s_m.reshape(-1, 1) * s_n.reshape(1, -1)).astype(out_dtype)

@@ -25,6 +25,53 @@ from deepspeed_tpu.ops.flash_attention import NEG_INF, _repeat_kv
 
 
 
+def ring_attention_local(q_l, k_l, v_l, sp: int, causal: bool = True,
+                         axis_name: str = "sequence"):
+    """The per-device ring body — callable from any shard_map whose manual
+    axes include ``axis_name`` (ring_attention below, and the Ulysses
+    uneven-heads remainder path in ``ulysses.py``). q_l: [B, S_l, H_l, D]
+    local shards; returns [B, S_l, H_l, D]."""
+    b, s_l, h_l, d = q_l.shape
+    k_l, v_l = _repeat_kv(k_l, v_l, h_l)
+    idx = jax.lax.axis_index(axis_name)
+    qpos = idx * s_l + jnp.arange(s_l)
+    scale = 1.0 / np.sqrt(d)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, o = carry
+        src = (idx - t) % sp
+        kpos = src * s_l + jnp.arange(s_l)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_l, k_cur,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]
+            s = jnp.where(mask, s, NEG_INF)
+        else:
+            mask = jnp.bool_(True)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        # rotate KV one hop around the ring (overlaps with next step's compute)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h_l, s_l), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h_l, s_l), jnp.float32)
+    o0 = jnp.zeros((b, h_l, s_l, d), jnp.float32)
+    (_, _, m, l, o), _ = jax.lax.scan(step, (k_l, v_l, m0, l0, o0),
+                                      jnp.arange(sp))
+    out = o / jnp.maximum(l, 1e-30)[..., None]          # [B, H, S_l, D]
+    return out.transpose(0, 2, 1, 3).astype(q_l.dtype)  # [B, S_l, H, D]
+
+
 def ring_attention(q, k, v, causal: bool = True, mesh=None):
     """q,k,v: [B, S, H(kv), D] global, sequence-sharded. Returns [B, S, H, D]."""
     mesh = mesh or mesh_lib.get_global_mesh()
@@ -37,45 +84,7 @@ def ring_attention(q, k, v, causal: bool = True, mesh=None):
     spec_q = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
     def body(q_l, k_l, v_l):
-        b, s_l, h_l, d = q_l.shape
-        k_l, v_l = _repeat_kv(k_l, v_l, h_l)
-        idx = jax.lax.axis_index("sequence")
-        qpos = idx * s_l + jnp.arange(s_l)
-        scale = 1.0 / np.sqrt(d)
-        perm = [(j, (j + 1) % sp) for j in range(sp)]
-
-        def step(carry, t):
-            k_cur, v_cur, m, l, o = carry
-            src = (idx - t) % sp
-            kpos = src * s_l + jnp.arange(s_l)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q_l, k_cur,
-                           preferred_element_type=jnp.float32) * scale
-            if causal:
-                mask = (qpos[:, None] >= kpos[None, :])[None, None]
-                s = jnp.where(mask, s, NEG_INF)
-            else:
-                mask = jnp.bool_(True)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            p = jnp.exp(s - m_new[..., None])
-            if causal:
-                p = jnp.where(mask, p, 0.0)
-            alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=-1)
-            o_new = o * alpha[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
-                preferred_element_type=jnp.float32)
-            # rotate KV one hop around the ring (overlaps with next step's compute)
-            k_next = jax.lax.ppermute(k_cur, "sequence", perm)
-            v_next = jax.lax.ppermute(v_cur, "sequence", perm)
-            return (k_next, v_next, m_new, l_new, o_new), None
-
-        m0 = jnp.full((b, h_l, s_l), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, h_l, s_l), jnp.float32)
-        o0 = jnp.zeros((b, h_l, s_l, d), jnp.float32)
-        (_, _, m, l, o), _ = jax.lax.scan(step, (k_l, v_l, m0, l0, o0),
-                                          jnp.arange(sp))
-        out = o / jnp.maximum(l, 1e-30)[..., None]          # [B, H, S_l, D]
-        return out.transpose(0, 2, 1, 3).astype(q_l.dtype)  # [B, S_l, H, D]
+        return ring_attention_local(q_l, k_l, v_l, sp, causal=causal)
 
     return jax.shard_map(body, mesh=mesh, in_specs=(spec_q, spec_q, spec_q),
                          out_specs=spec_q, check_vma=False)(q, k, v)

@@ -11,11 +11,13 @@ TPU-native: one ``shard_map`` over the mesh with ``lax.all_to_all`` on the
 Composes with TP: heads are already split over ``tensor``; Ulysses further splits
 the local heads over ``sequence``. When heads/tp is not divisible by the
 sequence-parallel degree, the reference redistributes heads unevenly with an
-explicit padded all-to-all (``uneven_heads_all2all`` layer.py:43); here the head
-dimension is zero-padded up to the next multiple of sp (GQA KV heads densified
-first so q/kv pad identically), the same even all-to-all runs, and the pad heads
-are sliced off after the inverse all-to-all — identical comm pattern and
-numerics, with at most (sp-1)/H wasted head-compute on the corner case.
+explicit padded all-to-all (``uneven_heads_all2all`` layer.py:43) — which leaves
+the ranks holding ``ceil(H/sp)`` heads as stragglers. Here the uneven case is
+EXACT and balanced instead: the largest sp-divisible head group takes the
+normal head-scatter all-to-all, and the remainder ``H mod sp`` heads stay
+sequence-sharded and run ring attention over the same axis
+(``ring.ring_attention_local``) — every device computes exactly ``H/sp`` heads'
+worth of attention, no padded compute, no straggler rank.
 """
 
 from functools import partial
@@ -48,19 +50,7 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
 
     spec = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
-    def body(q_l, k_l, v_l):
-        h_local = q_l.shape[2]
-        if uneven:
-            # densify GQA so q/kv share a head count, then zero-pad heads to a
-            # multiple of sp (reference: uneven_heads_all2all layer.py:43)
-            rep = q_l.shape[2] // k_l.shape[2]
-            if rep > 1:
-                k_l = jnp.repeat(k_l, rep, axis=2)
-                v_l = jnp.repeat(v_l, rep, axis=2)
-            pad = (-h_local) % sp
-            if pad:
-                padw = ((0, 0), (0, 0), (0, pad), (0, 0))
-                q_l, k_l, v_l = (jnp.pad(a, padw) for a in (q_l, k_l, v_l))
+    def a2a_attention(q_l, k_l, v_l):
         # [B, S/sp, Hl, D] -> scatter heads / gather sequence -> [B, S, Hl/sp, D]
         a2a = partial(jax.lax.all_to_all, axis_name="sequence",
                       split_axis=2, concat_axis=1, tiled=True)
@@ -69,9 +59,34 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
         out = flash_attention_auto(qg, kg, vg, causal=causal) if use_flash else \
             _local_attn(qg, kg, vg, causal)
         # inverse: scatter sequence / gather heads
-        out = jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,
-                                 concat_axis=2, tiled=True)
-        return out[:, :, :h_local]
+        return jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def body(q_l, k_l, v_l):
+        if not uneven:
+            return a2a_attention(q_l, k_l, v_l)
+        # exact uneven-heads split: densify GQA so q/kv share a head count,
+        # route the sp-divisible head group through the normal all-to-all and
+        # the H mod sp remainder through ring attention on the same axis —
+        # exactly H/sp heads of compute per device, no padding, no straggler
+        # (improves on the reference's uneven redistribution, layer.py:43,
+        # whose ceil(H/sp) ranks bound the step)
+        from deepspeed_tpu.sequence.ring import ring_attention_local
+        h_local = q_l.shape[2]
+        rep = q_l.shape[2] // k_l.shape[2]
+        if rep > 1:
+            k_l = jnp.repeat(k_l, rep, axis=2)
+            v_l = jnp.repeat(v_l, rep, axis=2)
+        h_even = (h_local // sp) * sp
+        parts = []
+        if h_even:
+            parts.append(a2a_attention(q_l[:, :, :h_even], k_l[:, :, :h_even],
+                                       v_l[:, :, :h_even]))
+        if h_local - h_even:  # GQA-only unevenness can leave no remainder
+            parts.append(ring_attention_local(
+                q_l[:, :, h_even:], k_l[:, :, h_even:], v_l[:, :, h_even:],
+                sp, causal=causal))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=2)
 
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -108,3 +108,14 @@ def test_initialize_accepts_megatron_mpu():
     assert engine.mesh.shape["data"] == 4
     import numpy as np
     assert np.isfinite(float(engine.train_batch(batch=random_batch(8))))
+
+
+def test_batch_size_gas_only_preserved():
+    """gas alone must survive resolution (micro defaults to 1, train batch
+    follows) — regression: the missing branch used to clobber gas to 1,
+    silently degenerating the pipeline engine's 1F1B microbatching."""
+    cfg = DeepSpeedTPUConfig({"gradient_accumulation_steps": 4})
+    cfg.resolve_batch_sizes(2)
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.train_micro_batch_size_per_gpu == 1
+    assert cfg.train_batch_size == 8

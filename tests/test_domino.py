@@ -34,6 +34,7 @@ def test_chunking_is_exact():
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_domino_under_tp_mesh_matches_dense():
     mesh = create_mesh(MeshConfig(data=4, tensor=2))
     set_global_mesh(mesh)

@@ -155,6 +155,7 @@ def test_invalid_mics_split_raises():
         _engine({"stage": 3, "mics_shard_size": 3}, mesh_cfg={"data": 2, "fsdp": 4})
 
 
+@pytest.mark.slow
 def test_qgz_stage3_converges_to_parity():
     """zero_quantized_gradients: stage-3 training with int8 gradient
     quantization at the reduction boundary converges like fp gradients

@@ -136,6 +136,7 @@ def test_phi3_fused_weights_split():
 
 
 # ------------------------------------------------------------- paged decode
+@pytest.mark.slow
 def test_mistral_style_paged_decode_matches_full():
     cfg = _tiny_llama_variant(sliding_window=8, num_kv_heads=4,
                               attention_bias=True)

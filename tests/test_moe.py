@@ -176,6 +176,7 @@ def test_train_mixtral_ep_quantized_dispatch():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_quantized_dispatch_inside_qgz_region():
     """quantized_dispatch composes with the qgZ int8-wire gradient phase:
     inside the partial-manual region (data/fsdp manual) the dispatch falls

@@ -293,7 +293,8 @@ def test_lockstep_masks_match_schedule():
                 assert bwd == ([b] if 0 <= b < m else [])
 
 
-@pytest.mark.parametrize("flavor", ["llama", "gemma"])
+@pytest.mark.parametrize("flavor", [
+    "llama", pytest.param("gemma", marks=pytest.mark.slow)])
 def test_llama_pipe_module_via_initialize(flavor):
     """initialize(model=PipeModule) returns a PipelineEngine (reference:
     deepspeed.initialize dispatching on PipelineModule, __init__.py:69); the

@@ -57,6 +57,7 @@ def test_t5_trains(cfg):
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
 
 
+@pytest.mark.slow
 def test_encoder_mask_isolates_padding():
     model = T5ForConditionalGeneration(TINY_T5)
     b = _batch(2)

@@ -79,6 +79,7 @@ def test_serve_falcon_new_decoder_architecture():
         prompt)
 
 
+@pytest.mark.slow
 def test_serve_opt():
     cfg = dataclasses.replace(TINY_OPT, dtype=jnp.float32)
     model = OPTForCausalLM(cfg)
