@@ -330,11 +330,15 @@ class DeepSpeedTPUEngine:
         # reduction is fused into XLA's backward and the flag falls back to
         # the int8 round-trip numerics simulation in _grads_one_micro.
         self._quantized_gradients = bool(zc.zero_quantized_gradients)
+        # replica (pure-DP) batch axes — shared by every wire-compression
+        # feature that opens the partial-manual gradient phase (qgZ int8,
+        # sparse embedding grads)
+        from deepspeed_tpu.runtime.zero.qgz import replica_grad_axes
+        self._replica_axes = replica_grad_axes(
+            self.mesh, self.batch_spec, self.param_shardings)
         self._qgz_axes = ()
         if self._quantized_gradients:
-            from deepspeed_tpu.runtime.zero.qgz import replica_grad_axes
-            self._qgz_axes = replica_grad_axes(
-                self.mesh, self.batch_spec, self.param_shardings)
+            self._qgz_axes = self._replica_axes
             if self._qgz_axes:
                 log_dist("qgZ: int8-wire gradient reduction over replica "
                          f"axes {self._qgz_axes} (hierarchical quantized "
@@ -366,13 +370,45 @@ class DeepSpeedTPUEngine:
         if config.eigenvalue.enabled:
             from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
             self.eigenvalue = Eigenvalue(config.eigenvalue)
+        # sparse gradients (reference engine.py:2518 sparse_allreduce_bucket):
+        # embedding-like leaves reduce over the replica axes with the sparse
+        # (indices, values) wire format inside the partial-manual gradient
+        # phase — same seam as qgZ; the two compose (sparse leaves go sparse,
+        # the rest int8 when qgZ is also on)
         self.sparse_gradients_enabled = config.sparse_gradients_enabled
+        self._sparse_grad_axes = ()
+        self._sparse_grad_paths = ()
         if self.sparse_gradients_enabled:
-            log_dist(
-                "sparse_gradients: the SPMD path reduces gradients densely "
-                "(XLA collectives); runtime.sparse_tensor.SparseTensor/"
-                "sparse_all_gather provide the sparse wire format for manual "
-                "shard_map paths", ranks=[0])
+            from deepspeed_tpu.utils.tree import tree_path_str
+            # tied-embedding models get a DENSE head gradient over the whole
+            # vocab — top-k truncation would silently drop real mass, so the
+            # model's tie flag disables the path outright
+            mcfg = getattr(model, "cfg", None)
+            tied = bool(getattr(mcfg, "tie_embeddings", False) or
+                        getattr(mcfg, "tie_word_embeddings", False))
+            axes = self._replica_axes
+            paths = () if tied else tuple(
+                tree_path_str(p)
+                for p, leaf in jax.tree_util.tree_flatten_with_path(
+                    self.state.params)[0]
+                if hasattr(leaf, "ndim") and leaf.ndim == 2
+                and leaf.shape[0] >= 512
+                and "embed" in tree_path_str(p).lower())
+            if axes and paths:
+                self._sparse_grad_axes = axes
+                self._sparse_grad_paths = paths
+                log_dist(
+                    f"sparse_gradients: sparse wire reduction over {axes} "
+                    f"for {len(paths)} embedding leaves (top-k rows = batch "
+                    "tokens — exact for lookup-only embedding grads)",
+                    ranks=[0])
+            else:
+                log_dist(
+                    "sparse_gradients: "
+                    + ("model ties its embeddings (dense head grads) — "
+                       if tied else
+                       "no replica batch axis or no embedding-like leaf — ")
+                    + "gradients reduce densely", ranks=[0])
 
         # --- bookkeeping / observability -------------------------------------
         self.global_steps = 0
@@ -545,8 +581,56 @@ class DeepSpeedTPUEngine:
             return loss_sum / gas, grads
 
         from deepspeed_tpu.runtime.zero.qgz import wrap_grads_phase
-        return wrap_grads_phase(grads_phase, self.mesh, self._qgz_axes,
-                                self.batch_spec, stacked=True)
+        axes = self._qgz_axes or self._sparse_grad_axes
+        return wrap_grads_phase(grads_phase, self.mesh, axes,
+                                self.batch_spec, stacked=True,
+                                sync_fn=self._make_grad_sync(axes))
+
+    def _make_grad_sync(self, axes):
+        """Per-leaf wire policy for the manual-region gradient reduction:
+        embedding leaves (sparse_gradients) use the sparse (indices, values)
+        format, everything else int8 (qgZ) or plain fp pmean. Returns None
+        (the default quantized sync) when no sparse leaves are selected."""
+        if not self._sparse_grad_paths or not axes:
+            return None
+        from deepspeed_tpu.runtime.sparse_tensor import sparse_grad_sync
+        from deepspeed_tpu.runtime.zero.qgz import quantized_grad_sync
+        from deepspeed_tpu.utils.tree import tree_path_str
+        sparse_paths = set(self._sparse_grad_paths)
+        qgz_on = bool(self._qgz_axes)
+
+        world = 1
+        for ax in axes:
+            world *= self.mesh.shape[ax]
+
+        def sync_fn(grads, batch):
+            # k = batch tokens on this device: a pure-lookup embedding grad
+            # touches at most one row per token, so top-k keeps every
+            # touched row and the reduction is EXACT. Max over integer
+            # leaves — small int side fields (bucket ids, lengths) must not
+            # shrink k below the token count.
+            k_tokens = max((int(leaf.size) for leaf in jax.tree.leaves(batch)
+                            if jnp.issubdtype(leaf.dtype, jnp.integer)),
+                           default=0)
+
+            def leaf_sync(path, g):
+                p = tree_path_str(path)
+                if p in sparse_paths and k_tokens:
+                    v, d = g.shape
+                    k = min(v, k_tokens)
+                    # wire win vs dense: the gathered sparse representation
+                    # is O(k·(d+1)·world) rows across the replica group,
+                    # a dense all-reduce O(v·d) — sparse only pays when the
+                    # batch's token set is small relative to V/world
+                    if k * (d + 1) * world < v * d:
+                        return sparse_grad_sync(g, axes, k)
+                if qgz_on:
+                    return quantized_grad_sync(g, axes)
+                return jax.lax.pmean(g, axes)
+
+            return jax.tree_util.tree_map_with_path(leaf_sync, grads)
+
+        return sync_fn
 
     def _build_train_batch_fn(self):
         cfg = self.config
@@ -853,11 +937,13 @@ class DeepSpeedTPUEngine:
             return loss, jax.tree.map(lambda g: g.astype(acc_dtype), grads)
 
         # compat path reduces per-microbatch (the reference reduces at each
-        # backward when not accumulating); with qgZ replica axes the reduce is
-        # the int8-wire collective, one sync per forward/backward pair
+        # backward when not accumulating); with replica axes the reduce is
+        # the int8/sparse-wire collective, one sync per forward/backward pair
         from deepspeed_tpu.runtime.zero.qgz import wrap_grads_phase
-        fwd_bwd = wrap_grads_phase(fwd_bwd_local, self.mesh, self._qgz_axes,
-                                   self.batch_spec, stacked=False)
+        wire_axes = self._qgz_axes or self._sparse_grad_axes
+        fwd_bwd = wrap_grads_phase(fwd_bwd_local, self.mesh, wire_axes,
+                                   self.batch_spec, stacked=False,
+                                   sync_fn=self._make_grad_sync(wire_axes))
 
         self._micro_fwd_bwd_fn = jax.jit(
             fwd_bwd, out_shardings=(None, grad_shardings))

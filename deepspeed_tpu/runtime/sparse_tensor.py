@@ -69,3 +69,20 @@ def sparse_all_gather(st: SparseTensor, axis_name: str) -> SparseTensor:
     idx = jax.lax.all_gather(st.indices, axis_name, axis=0, tiled=True)
     vals = jax.lax.all_gather(st.values, axis_name, axis=0, tiled=True)
     return SparseTensor(idx, vals, st.dense_rows)
+
+
+def sparse_grad_sync(g, axes, k: int):
+    """Mean-reduce an embedding-style gradient leaf over the manual ``axes``
+    with the sparse wire format (the engine path of the reference's
+    ``sparse_allreduce_bucket``, engine.py:2518): each device keeps its top-k
+    rows by norm — exact when ``k`` ≥ the device's batch-token count, since a
+    pure-lookup embedding gradient touches at most one row per token — then
+    (indices, values) all_gather per axis and a scatter-add densify. Wire
+    bytes: O(k·D·world) vs O(N·D) dense. Must run inside a shard_map whose
+    manual axes include ``axes``."""
+    st = SparseTensor.from_dense(g, k)
+    w = 1
+    for ax in axes:
+        w *= jax.lax.axis_size(ax)
+        st = sparse_all_gather(st, ax)
+    return (st.to_dense() / w).astype(g.dtype)

@@ -114,20 +114,24 @@ def quantized_grad_sync(grads, axes: Tuple[str, ...]):
 
 
 def wrap_grads_phase(grads_phase, mesh: Mesh, axes: Tuple[str, ...],
-                     batch_spec, stacked: bool):
+                     batch_spec, stacked: bool, sync_fn=None):
     """Wrap ``grads_phase(params, batch, rngs, scale) -> (loss, grads)`` in a
     partial-manual shard_map over the replica ``axes``: inside, gradients are
     per-device partials (no XLA psum over the manual axes), the loss is
-    pmean'd and the gradients reduced by ``quantized_grad_sync``. Everything
-    else (fsdp parameter gathers, tensor collectives) stays XLA-auto.
+    pmean'd and the gradients reduced by ``sync_fn(grads, batch)`` (default:
+    ``quantized_grad_sync`` — the engine passes a composite that can also
+    route embedding leaves through the sparse wire format). Everything else
+    (fsdp parameter gathers, tensor collectives) stays XLA-auto.
 
     ``batch_spec`` is the per-microbatch sharding; ``stacked`` prepends the
     gas dimension. Returns a drop-in replacement for ``grads_phase`` whose
     outputs are replicated over ``axes`` (identical to the SPMD result,
-    modulo int8 wire quantization).
+    modulo the wire compression in use).
     """
     if not axes:
         return grads_phase
+    if sync_fn is None:
+        sync_fn = lambda grads, batch: quantized_grad_sync(grads, axes)  # noqa: E731
 
     def local_phase(params, batch, rngs, scale):
         # decorrelate dropout/noise across replicas: in auto-SPMD the random
@@ -143,7 +147,7 @@ def wrap_grads_phase(grads_phase, mesh: Mesh, axes: Tuple[str, ...],
             rngs = jax.random.fold_in(rngs, idx)
         loss, grads = grads_phase(params, batch, rngs, scale)
         loss = jax.lax.pmean(loss, axes)
-        grads = quantized_grad_sync(grads, axes)
+        grads = sync_fn(grads, batch)
         return loss, grads
 
     bspec = manual_part(batch_spec, axes)
