@@ -50,7 +50,13 @@ def main():
         # OFF by default: its TPU compile was in flight when the axon tunnel
         # wedged (2026-07-30) and is unproven on hardware — flip the default
         # only after DSTPU_BENCH_LOSS_CHUNK=2048 measures clean on a chip
-        loss_chunk_size=int(os.environ.get("DSTPU_BENCH_LOSS_CHUNK", 0)) or None,
+        # DSTPU_BENCH_LOSS_UNROLL=1 replaces the scan(checkpoint) chunk loop
+        # with an unrolled one (compile-time mitigation to try FIRST on
+        # chip); it implies a 2048 chunk size when LOSS_CHUNK is unset so the
+        # knob can't silently measure the dense path
+        loss_chunk_size=int(os.environ.get("DSTPU_BENCH_LOSS_CHUNK", 0)) or (
+            2048 if os.environ.get("DSTPU_BENCH_LOSS_UNROLL") == "1" else None),
+        loss_chunk_unroll=os.environ.get("DSTPU_BENCH_LOSS_UNROLL", "0") == "1",
         remat=os.environ.get("DSTPU_BENCH_REMAT", "1") == "1",
         remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
                                     "dots_with_no_batch_dims_saveable"))

@@ -57,6 +57,10 @@ class LlamaConfig:
     # [B*S, V] logits tensor never materializes (see
     # sequence/cross_entropy.py:chunked_cross_entropy). None = dense loss.
     loss_chunk_size: Optional[int] = None
+    # unroll the chunk loop instead of scan(checkpoint) — the scan structure
+    # is suspected of pathological XLA:TPU compile times when nested in the
+    # engine's gas scan
+    loss_chunk_unroll: bool = False
     # llama-family arch knobs (mistral/qwen2/phi3 are llama variants):
     attention_bias: bool = False          # qwen2: bias on q/k/v projections
     sliding_window: Optional[int] = None  # mistral: attend to last W tokens only
@@ -405,7 +409,7 @@ class LlamaForCausalLM(nn.Module):
         return chunked_cross_entropy(
             hidden, labels, mask, chunk_size=self.cfg.loss_chunk_size,
             soft_cap=self.cfg.logits_soft_cap, compute_dtype=self.cfg.dtype,
-            **kw)
+            unroll=self.cfg.loss_chunk_unroll, **kw)
 
     def logits(self, batch):
         return self.model(batch["input_ids"], positions=batch.get("positions"),

@@ -142,7 +142,8 @@ def llama_pipe_module(cfg, params):
                 else {"kernel": head}
             return chunked_cross_entropy(
                 x, labels, mask, chunk_size=cfg.loss_chunk_size,
-                soft_cap=cfg.logits_soft_cap, compute_dtype=cfg.dtype, **kw)
+                soft_cap=cfg.logits_soft_cap, compute_dtype=cfg.dtype,
+                unroll=getattr(cfg, "loss_chunk_unroll", False), **kw)
         if cfg.tie_embeddings:
             logits = x.astype(cfg.dtype) @ \
                 tied_p["embed"]["embedding"].astype(cfg.dtype).T

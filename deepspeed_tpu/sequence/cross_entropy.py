@@ -63,7 +63,8 @@ def vocab_parallel_cross_entropy(logits, labels, mesh=None,
 
 def chunked_cross_entropy(hidden, labels, mask, *, kernel=None, embedding=None,
                           chunk_size: int = 1024,
-                          soft_cap=None, compute_dtype=jnp.bfloat16):
+                          soft_cap=None, compute_dtype=jnp.bfloat16,
+                          unroll: bool = False):
     """Next-token CE from *hidden states* without materializing [B*S, V] fp32.
 
     The reference computes full logits and feeds them to torch CE (its fused
@@ -107,8 +108,20 @@ def chunked_cross_entropy(hidden, labels, mask, *, kernel=None, embedding=None,
         tgt = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
         return total + jnp.sum((lse - tgt) * mc), None
 
-    total, _ = jax.lax.scan(
-        jax.checkpoint(body),
-        jnp.zeros((), jnp.float32),
-        (xf.reshape(nc, c, h), lf.reshape(nc, c), mf.reshape(nc, c)))
+    xs = xf.reshape(nc, c, h)
+    ls = lf.reshape(nc, c)
+    ms = mf.reshape(nc, c)
+    ck = jax.checkpoint(body)
+    if unroll:
+        # unrolled chunk loop: nc is small and static (B*S/chunk ~ 4-16), so
+        # XLA sees nc copies of one fused matmul+CE block instead of a
+        # scan-of-checkpoint — the structure suspected of the pathological
+        # XLA:TPU compile time when this scan nests inside the engine's gas
+        # scan (>20 min observed; see VERDICT round 2). Same memory bound:
+        # each chunk's logits are rematerialized in the backward.
+        total = jnp.zeros((), jnp.float32)
+        for i in range(nc):
+            total, _ = ck(total, (xs[i], ls[i], ms[i]))
+    else:
+        total, _ = jax.lax.scan(ck, jnp.zeros((), jnp.float32), (xs, ls, ms))
     return total / jnp.maximum(jnp.sum(mf), 1.0)

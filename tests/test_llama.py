@@ -170,10 +170,10 @@ def test_chunked_cross_entropy_function_parity():
         ll = jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
         return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
-    def chunked(hid, k):
+    def chunked(hid, k, unroll=False):
         return chunked_cross_entropy(hid, labels, mask, kernel=k,
                                      chunk_size=6,  # uneven: pads 20 -> 24
-                                     compute_dtype=jnp.float32)
+                                     compute_dtype=jnp.float32, unroll=unroll)
 
     np.testing.assert_allclose(float(chunked(hidden, kernel)),
                                float(dense(hidden, kernel)), rtol=1e-6)
@@ -188,3 +188,11 @@ def test_chunked_cross_entropy_function_parity():
                compute_dtype=jnp.float32)
     np.testing.assert_allclose(float(tied), float(dense(hidden, kernel)),
                                rtol=1e-6)
+    # unrolled chunk loop: same value and grads as the scan formulation
+    np.testing.assert_allclose(float(chunked(hidden, kernel, unroll=True)),
+                               float(dense(hidden, kernel)), rtol=1e-6)
+    gu = jax.grad(lambda hh, kk: chunked(hh, kk, unroll=True),
+                  argnums=(0, 1))(hidden, kernel)
+    for a, c in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-5, atol=1e-6)
