@@ -14,6 +14,7 @@ parameters.
 """
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -109,6 +110,7 @@ class PipelineEngine:
         self.opt_state = self.tx.init((self.staged_params, self.tied_params))
         self.global_steps = 0
         self._step_fn = None
+        self._eval_fn = None
         from deepspeed_tpu.runtime.pipe.schedule import (
             bubble_fraction, lockstep_bubble_fraction)
         log_dist(
@@ -151,6 +153,86 @@ class PipelineEngine:
             return new_staged, new_tied, new_opt, loss
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def eval_batch(self, tokens) -> float:
+        """Forward-only pipelined loss (reference PipelineEngine.eval_batch,
+        engine.py:405 — the InferenceSchedule fill-drain executor)."""
+        from deepspeed_tpu.runtime.pipe.one_f_one_b import pipeline_eval_step
+        tokens = np.asarray(tokens)
+        b, s = tokens.shape
+        m = self.micro_batches
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by micro_batches {m}")
+        toks_mb = jnp.asarray(tokens.reshape(m, b // m, s), jnp.int32)
+        if self._eval_fn is None:
+            mod, mesh, stages = self.module, self.mesh, self.num_stages
+
+            def ev(staged, tied, toks):
+                flat = jax.tree.map(
+                    lambda x: x.reshape(x.shape[0] * x.shape[1],
+                                        *x.shape[2:]),
+                    staged) if stages > 1 else staged
+                return pipeline_eval_step(mod.block_fn, flat, tied, toks,
+                                          mod.first_fn, mod.last_fn,
+                                          mesh=mesh)
+            self._eval_fn = jax.jit(ev)
+        return float(self._eval_fn(self.staged_params, self.tied_params,
+                                   toks_mb))
+
+    def save_checkpoint(self, save_dir: str, tag=None) -> str:
+        """Orbax checkpoint of the stage-sharded state, committed with the
+        same ``latest``-tag protocol as the main engine (checkpoint/
+        engine.py: the tag file is the durability marker, written strictly
+        after the array write)."""
+        import orbax.checkpoint as ocp
+
+        from deepspeed_tpu.checkpoint.engine import LATEST_FILE, _ckpt_dir
+        tag = tag if tag is not None else f"global_step{self.global_steps}"
+        path = _ckpt_dir(save_dir, tag)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(
+            path, {"staged": self.staged_params, "tied": self.tied_params,
+                   "opt_state": self.opt_state,
+                   "scalars": {"global_steps": jnp.int32(self.global_steps)}},
+            force=True)
+        # synchronous contract: orbax saves async under the hood; finish
+        # before the latest-tag commit so a crash can't publish a partial
+        ckptr.wait_until_finished()
+        ckptr.close()
+        if jax.process_index() == 0:
+            with open(os.path.join(os.path.abspath(save_dir),
+                                   LATEST_FILE), "w") as f:
+                f.write(tag)
+        return path
+
+    def load_checkpoint(self, load_dir: str, tag=None) -> str:
+        import orbax.checkpoint as ocp
+
+        from deepspeed_tpu.checkpoint.engine import LATEST_FILE, _ckpt_dir
+        root = os.path.abspath(load_dir)
+        if tag is None:
+            latest = os.path.join(root, LATEST_FILE)
+            if not os.path.exists(latest):
+                raise FileNotFoundError(
+                    f"no '{LATEST_FILE}' tag file under {root}")
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = _ckpt_dir(root, tag)
+        tmpl = {"staged": self.staged_params, "tied": self.tied_params,
+                "opt_state": self.opt_state,
+                "scalars": {"global_steps": jnp.int32(self.global_steps)}}
+        ckptr = ocp.StandardCheckpointer()
+        try:
+            restored = ckptr.restore(
+                path, jax.tree.map(ocp.utils.to_shape_dtype_struct, tmpl))
+        finally:
+            ckptr.close()
+        self.staged_params = jax.device_put(restored["staged"],
+                                            self._staged_spec)
+        self.tied_params = restored["tied"]
+        self.opt_state = restored["opt_state"]
+        self.global_steps = int(restored["scalars"]["global_steps"])
+        return path
 
     def train_batch(self, tokens) -> float:
         """tokens: [B, S] int32 with B divisible by micro_batches (reference

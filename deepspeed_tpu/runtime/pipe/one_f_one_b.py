@@ -182,26 +182,89 @@ def pipeline_train_step_1f1b(block_fn: Callable, stacked_params: Any,
     return loss, gp, gt
 
 
+def pipeline_eval_step(block_fn: Callable, stacked_params: Any,
+                       tied_params: Any, tokens_mb, first_fn: Callable,
+                       last_fn: Callable, mesh=None):
+    """Forward-only fill-drain pipeline (the ``InferenceSchedule`` executor —
+    reference ``PipelineEngine.eval_batch``, engine.py:405, driving
+    schedule.py:135). Same lockstep formulation as the 1F1B executor minus
+    the backward: ``m + s - 1`` macro-steps, derived from the
+    InferenceSchedule instruction stream. Returns the mean loss."""
+    mesh = mesh or mesh_lib.get_global_mesh()
+    s = mesh.shape["pipe"]
+    m = tokens_mb.shape[0]
+    if s == 1:
+        return jnp.mean(jax.vmap(
+            lambda toks: _forward_one_mb(block_fn, stacked_params,
+                                         tied_params, toks, first_fn,
+                                         last_fn))(tokens_mb))
+
+    from deepspeed_tpu.runtime.pipe.schedule import InferenceSchedule
+    total_steps = sum(1 for _ in InferenceSchedule(m, s, 0).steps())
+
+    staged = stack_to_stages(stacked_params, s)
+    param_specs = jax.tree.map(lambda x: P("pipe", *([None] * (x.ndim - 1))),
+                               staged)
+
+    def body(local_params, tied, toks):
+        local_params = jax.tree.map(lambda x: x[0], local_params)
+        p = jax.lax.axis_index("pipe")
+
+        def apply_stage(x):
+            def layer(carry, lp):
+                return block_fn(lp, carry), None
+            y, _ = jax.lax.scan(layer, x, local_params)
+            return y
+
+        x_shape = jax.eval_shape(lambda td, t: first_fn(td, t), tied,
+                                 toks[0])
+        fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+
+        def step(carry, t):
+            cur, loss_acc = carry
+            f = t - p
+            active = jnp.logical_and(f >= 0, f < m)
+            f_clip = jnp.clip(f, 0, m - 1)
+            tok_f = jax.lax.dynamic_index_in_dim(toks, f_clip, 0,
+                                                 keepdims=False)
+            x_in = jnp.where(p == 0, first_fn(tied, tok_f), cur)
+            y = apply_stage(x_in)
+            lb = last_fn(tied, y, tok_f)
+            take = active.astype(jnp.float32) * (p == s - 1).astype(
+                jnp.float32)
+            return (jax.lax.ppermute(y, "pipe", fwd_perm),
+                    loss_acc + take * lb), None
+
+        zeros_x = jnp.zeros(x_shape.shape, x_shape.dtype)
+        (_, loss_sum), _ = jax.lax.scan(
+            step, (zeros_x, jnp.float32(0.0)), jnp.arange(total_steps))
+        return jax.lax.psum(loss_sum, "pipe") / m
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(param_specs, P(), P()),
+        out_specs=P(), check_vma=False)(staged, tied_params, tokens_mb)
+
+
+def _forward_one_mb(block_fn, stacked_params, tied_params, toks, first_fn,
+                    last_fn):
+    """Unpipelined forward of one microbatch: the single source of the
+    embed -> layer-scan -> head/loss contract shared by the eval executor's
+    s==1 path and the _no_pipe training oracle."""
+    x = first_fn(tied_params, toks)
+
+    def layer(carry, lp):
+        return block_fn(lp, carry), None
+    y, _ = jax.lax.scan(layer, x, stacked_params)
+    return last_fn(tied_params, y, toks)
+
+
 def _no_pipe(block_fn, stacked_params, tied_params, tokens_mb, first_fn,
              last_fn):
     """Single-stage reference semantics (also the parity oracle in tests)."""
-    def one_mb(toks):
-        x = first_fn(tied_params, toks)
-
-        def layer(carry, lp):
-            return block_fn(lp, carry), None
-        y, _ = jax.lax.scan(layer, x, stacked_params)
-        return last_fn(tied_params, y, toks)
-
     def loss_fn(sp, tp):
-        def mb_loss(toks):
-            x = first_fn(tp, toks)
-
-            def layer(carry, lp):
-                return block_fn(lp, carry), None
-            y, _ = jax.lax.scan(layer, x, sp)
-            return last_fn(tp, y, toks)
-        return jnp.mean(jax.vmap(mb_loss)(tokens_mb))
+        return jnp.mean(jax.vmap(
+            lambda toks: _forward_one_mb(block_fn, sp, tp, toks, first_fn,
+                                         last_fn))(tokens_mb))
 
     (loss), (gp, gt) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
         stacked_params, tied_params)
