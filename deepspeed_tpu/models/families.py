@@ -91,6 +91,25 @@ def _t(w) -> np.ndarray:
     return np.asarray(w).T
 
 
+def attn_tree_from_weights(wq, wk, wv, wo, d, h, hkv, dh,
+                           bq=None, bk=None, bv=None):
+    """HF [out, in] projection weights -> the LlamaAttention param subtree
+    (DenseGeneral kernels [D, heads, dh] / wo [h, dh, D], biases [heads, dh]).
+    Single source of the attention layout mapping, shared by every
+    llama-family converter (incl. qwen2-moe)."""
+    attn = {
+        "wq": {"kernel": _t(wq).reshape(d, h, dh)},
+        "wk": {"kernel": _t(wk).reshape(d, hkv, dh)},
+        "wv": {"kernel": _t(wv).reshape(d, hkv, dh)},
+        "wo": {"kernel": _t(wo).reshape(h, dh, d)},
+    }
+    if bq is not None:
+        attn["wq"]["bias"] = np.asarray(bq).reshape(h, dh)
+        attn["wk"]["bias"] = np.asarray(bk).reshape(hkv, dh)
+        attn["wv"]["bias"] = np.asarray(bv).reshape(hkv, dh)
+    return attn
+
+
 def convert_hf_state_dict(hf_state: Dict[str, Any], cfg: LlamaConfig,
                           model_type: str = "llama") -> Dict[str, Any]:
     """Map a HF state dict (numpy/torch tensors keyed 'model.layers.0.…') into
@@ -122,18 +141,14 @@ def convert_hf_state_dict(hf_state: Dict[str, Any], cfg: LlamaConfig,
             wq = get(p + "self_attn.q_proj.weight")
             wk = get(p + "self_attn.k_proj.weight")
             wv = get(p + "self_attn.v_proj.weight")
-        attn = {
-            "wq": {"kernel": _t(wq).reshape(d, h, dh)},
-            "wk": {"kernel": _t(wk).reshape(d, hkv, dh)},
-            "wv": {"kernel": _t(wv).reshape(d, hkv, dh)},
-            "wo": {"kernel": _t(get(p + "self_attn.o_proj.weight"))
-                   .reshape(h, dh, d)},
-        }
+        biases = {}
         if cfg.attention_bias:
-            attn["wq"]["bias"] = get(p + "self_attn.q_proj.bias").reshape(h, dh)
-            attn["wk"]["bias"] = get(p + "self_attn.k_proj.bias").reshape(hkv, dh)
-            attn["wv"]["bias"] = get(p + "self_attn.v_proj.bias").reshape(hkv, dh)
-        layer["attn"] = attn
+            biases = dict(bq=get(p + "self_attn.q_proj.bias"),
+                          bk=get(p + "self_attn.k_proj.bias"),
+                          bv=get(p + "self_attn.v_proj.bias"))
+        layer["attn"] = attn_tree_from_weights(
+            wq, wk, wv, get(p + "self_attn.o_proj.weight"),
+            d, h, hkv, dh, **biases)
 
         if model_type == "phi3":
             gu = get(p + "mlp.gate_up_proj.weight")        # [2I, D]

@@ -12,6 +12,7 @@ from typing import Any, Optional
 
 import flax.linen as nn
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
@@ -128,3 +129,100 @@ def qwen2_moe_tensor_rules(path, leaf) -> Optional[PartitionSpec]:
     if spec is not None:
         return spec
     return llama_tensor_rules(path, leaf)
+
+
+# ---------------------------------------------------------------------------
+# HF interop (reference: qwen_v2_moe container/policy — the engine loads HF
+# Qwen2Moe checkpoints; here config + state-dict mappers)
+# ---------------------------------------------------------------------------
+def qwen2_moe_config_from_hf(hf: dict) -> Qwen2MoEConfig:
+    """Build a Qwen2MoEConfig from an HF ``Qwen2MoeConfig`` dict. Only the
+    uniform-sparse layout is supported (every layer a sparse MoE block —
+    ``decoder_sparse_step=1``, no ``mlp_only_layers``)."""
+    if hf.get("decoder_sparse_step", 1) != 1 or hf.get("mlp_only_layers"):
+        raise ValueError("only uniformly sparse Qwen2-MoE layouts are "
+                         "supported (decoder_sparse_step=1, no "
+                         "mlp_only_layers)")
+    base = LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf.get("intermediate_size", 4 * hf["hidden_size"]),
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads",
+                            hf["num_attention_heads"]),
+        max_seq_len=hf.get("max_position_embeddings", 4096),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        attention_bias=True,
+    )
+    moe = MoEConfig(
+        num_experts=hf["num_experts"],
+        top_k=hf.get("num_experts_per_tok", 4),
+        norm_topk_prob=hf.get("norm_topk_prob", False),
+        aux_loss_weight=hf.get("router_aux_loss_coef", 0.001),
+    )
+    return Qwen2MoEConfig(
+        base=base, moe=moe,
+        moe_intermediate_size=hf.get("moe_intermediate_size", 1408),
+        shared_expert_intermediate_size=hf.get(
+            "shared_expert_intermediate_size", 5632))
+
+
+def convert_hf_qwen2_moe(hf_state, cfg: Qwen2MoEConfig):
+    """Map an HF Qwen2Moe state dict into the Qwen2MoEForCausalLM tree
+    (stacked expert weights [E, ...] for the expert-sharded Experts module).
+    Attention mapping is shared with the llama-family converter
+    (families.attn_tree_from_weights)."""
+    from deepspeed_tpu.models.families import _t as t
+    from deepspeed_tpu.models.families import attn_tree_from_weights
+
+    def get(name):
+        v = hf_state[name]
+        return np.asarray(v.detach().cpu().numpy()
+                          if hasattr(v, "detach") else v)
+
+    base = cfg.base
+    d, h, hkv, dh = (base.hidden_size, base.num_heads, base.num_kv_heads,
+                     base.head_dim_)
+    e = cfg.moe.num_experts
+    tree = {"embed": {"embedding": get("model.embed_tokens.weight")},
+            "final_norm": {"scale": get("model.norm.weight")},
+            "lm_head": {"kernel": t(get("lm_head.weight"))}}
+    for i in range(base.num_layers):
+        p = f"model.layers.{i}."
+        attn = attn_tree_from_weights(
+            get(p + "self_attn.q_proj.weight"),
+            get(p + "self_attn.k_proj.weight"),
+            get(p + "self_attn.v_proj.weight"),
+            get(p + "self_attn.o_proj.weight"),
+            d, h, hkv, dh,
+            bq=get(p + "self_attn.q_proj.bias"),
+            bk=get(p + "self_attn.k_proj.bias"),
+            bv=get(p + "self_attn.v_proj.bias"))
+        experts = {
+            "w_gate": np.stack([t(get(p + f"mlp.experts.{j}.gate_proj.weight"))
+                                for j in range(e)]),
+            "w_up": np.stack([t(get(p + f"mlp.experts.{j}.up_proj.weight"))
+                              for j in range(e)]),
+            "w_down": np.stack([t(get(p + f"mlp.experts.{j}.down_proj.weight"))
+                                for j in range(e)]),
+        }
+        tree[f"layer_{i}"] = {
+            "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+            "mlp_norm": {"scale": get(p + "post_attention_layernorm.weight")},
+            "attn": attn,
+            "moe": {"gate": {"wg": {"kernel": t(get(p + "mlp.gate.weight"))}},
+                    "experts": experts},
+            "shared_expert": {
+                "w_gate": {"kernel":
+                           t(get(p + "mlp.shared_expert.gate_proj.weight"))},
+                "w_up": {"kernel":
+                         t(get(p + "mlp.shared_expert.up_proj.weight"))},
+                "w_down": {"kernel":
+                           t(get(p + "mlp.shared_expert.down_proj.weight"))},
+                "gate": {"kernel":
+                         t(get(p + "mlp.shared_expert_gate.weight"))},
+            },
+        }
+    return tree

@@ -266,6 +266,7 @@ def test_engine_curriculum_integration():
     assert engine.random_ltd_reserved_length() == 16
 
 
+@pytest.mark.slow
 def test_curriculum_state_resyncs_on_checkpoint_load(tmp_path):
     config = {
         "train_batch_size": 8,

@@ -114,6 +114,7 @@ def test_onebit_adam_converges_through_freeze():
     assert float(loss(p)) < 0.02 * float(loss(p0))
 
 
+@pytest.mark.slow
 def test_onebit_adam_variance_frozen_after_freeze_step():
     loss, p0, _ = _quadratic_problem()
     tx = onebit_adam(0.05, freeze_step=5)

@@ -54,6 +54,7 @@ def test_pallas_flash_grad(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pallas_flash_grad_gqa_unaligned():
     # GQA (in-kernel group accumulation for dk/dv) + q/k padding in backward
     q, k, v = qkv(s=100, h=8, hkv=2)

@@ -244,6 +244,7 @@ def test_bubble_fraction_model():
     assert bubble_fraction(m, s) == (s - 1) / (m + s - 1)
 
 
+@pytest.mark.slow
 def test_pipeline_engine_trains():
     """PipelineEngine.train_batch analog: 1F1B + optimizer converges on a
     pipe=4 mesh, and matches single-stage training step-for-step."""
@@ -295,7 +296,7 @@ def test_lockstep_masks_match_schedule():
 
 @pytest.mark.parametrize("flavor", [
     "llama", pytest.param("gemma", marks=pytest.mark.slow)])
-def test_llama_pipe_module_via_initialize(flavor):
+def test_llama_pipe_module_via_initialize(flavor, tmp_path):
     """initialize(model=PipeModule) returns a PipelineEngine (reference:
     deepspeed.initialize dispatching on PipelineModule, __init__.py:69); the
     llama adapter's pipelined loss matches the full model bit-for-bit-ish
@@ -328,48 +329,30 @@ def test_llama_pipe_module_via_initialize(flavor):
     assert isinstance(engine, PipelineEngine)
 
     ref_loss = float(model.apply(params, {"input_ids": jnp.asarray(tokens)}))
+    if flavor == "llama":
+        # eval executor numerics: InferenceSchedule fill-drain == full model
+        assert abs(engine.eval_batch(tokens) - ref_loss) < 5e-3
     l0 = engine.train_batch(tokens)
     assert abs(l0 - ref_loss) < 5e-3, (l0, ref_loss)
     l1 = engine.train_batch(tokens)
     l2 = engine.train_batch(tokens)
     assert l2 < l0, (l0, l1, l2)
-
-
-def test_pipeline_eval_and_checkpoint_roundtrip(tmp_path):
-    """PipelineEngine.eval_batch (InferenceSchedule fill-drain executor,
-    reference engine.py:405) matches the full model, and save/load restores
-    the stage-sharded state into a fresh engine."""
-    import deepspeed_tpu
-    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from deepspeed_tpu.runtime.pipe.module import llama_pipe_module
-
-    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
-                      num_layers=4, num_heads=2, num_kv_heads=2,
-                      max_seq_len=32, scan_layers=True, dtype=jnp.float32)
-    model = LlamaForCausalLM(cfg)
-    tokens = np.random.default_rng(0).integers(
-        0, 128, size=(8, 16)).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0),
-                        {"input_ids": jnp.asarray(tokens)})
-    mesh = create_mesh(MeshConfig(pipe=4, data=2))
-    set_global_mesh(mesh)
-
-    def make():
-        e, _, _, _ = deepspeed_tpu.initialize(
-            model=llama_pipe_module(cfg, params), mesh=mesh,
-            config={"gradient_accumulation_steps": 4,
-                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
-        return e
-
-    eng = make()
-    ref = float(model.apply(params, {"input_ids": jnp.asarray(tokens)}))
-    assert abs(eng.eval_batch(tokens) - ref) < 5e-3
-    eng.train_batch(tokens)
-    eng.save_checkpoint(str(tmp_path))
-    eng.train_batch(tokens)                     # diverge past the checkpoint
-    eng.load_checkpoint(str(tmp_path))
-    e_after = eng.eval_batch(tokens)
-    fresh = make()
-    fresh.load_checkpoint(str(tmp_path))
+    if flavor != "llama":
+        return
+    # checkpoint roundtrip on the same engine/compile (reference
+    # PipelineEngine save/load through the latest-tag protocol)
+    ev = engine.eval_batch(tokens)
+    assert np.isfinite(ev) and ev < ref_loss    # trained -> lower loss
+    d = str(tmp_path)
+    engine.save_checkpoint(d)
+    engine.train_batch(tokens)              # diverge past the checkpoint
+    engine.load_checkpoint(d)
+    e_after = engine.eval_batch(tokens)
+    assert abs(e_after - ev) < 1e-5         # restore == pre-divergence state
+    fresh, _, _, _ = deepspeed_tpu.initialize(
+        model=llama_pipe_module(cfg, params), mesh=mesh,
+        config={"gradient_accumulation_steps": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}}})
+    fresh.load_checkpoint(d)
     assert abs(e_after - fresh.eval_batch(tokens)) < 1e-5
-    assert fresh.global_steps == 1
+    assert fresh.global_steps == 3

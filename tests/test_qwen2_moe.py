@@ -74,3 +74,65 @@ def test_serve_qwen2_moe_paged_matches_full():
                              method=Qwen2MoEForCausalLM.logits)
         ids.append(int(np.argmax(np.asarray(logits)[0, -1])))
     assert got == ids[len(prompt):], (got, ids[len(prompt):])
+
+
+@pytest.mark.slow
+def test_hf_qwen2_moe_torch_parity():
+    """Gold-standard interop check: convert a random torch-transformers
+    Qwen2Moe checkpoint and match its logits (no token drops at high
+    capacity; norm_topk_prob=False semantics)."""
+    import dataclasses
+
+    import torch
+    from transformers import Qwen2MoeConfig as HFConfig
+    from transformers import Qwen2MoeForCausalLM as HFModel
+
+    from deepspeed_tpu.models.qwen2_moe import (
+        Qwen2MoEForCausalLM, convert_hf_qwen2_moe, qwen2_moe_config_from_hf)
+
+    hf_cfg = HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        moe_intermediate_size=32, shared_expert_intermediate_size=64,
+        num_experts=4, num_experts_per_tok=2, decoder_sparse_step=1,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=10000.0,
+        norm_topk_prob=False, output_router_logits=False)
+    torch.manual_seed(0)
+    hf_model = HFModel(hf_cfg).eval()
+
+    cfg = qwen2_moe_config_from_hf(hf_cfg.to_dict())
+    # fp32 compute + generous eval capacity so no token drops and the
+    # GShard dispatch equals HF's dense per-token routing
+    cfg = dataclasses.replace(
+        cfg,
+        base=dataclasses.replace(cfg.base, dtype=jnp.float32),
+        moe=dataclasses.replace(cfg.moe, dtype=jnp.float32,
+                                eval_capacity_factor=float(
+                                    cfg.moe.num_experts)))
+    params = convert_hf_qwen2_moe(hf_model.state_dict(), cfg)
+
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = Qwen2MoEForCausalLM(cfg).apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids.astype(np.int32))},
+        method=Qwen2MoEForCausalLM.logits)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4, rtol=2e-3)
+
+
+def test_qwen2_moe_config_from_hf_fields():
+    from deepspeed_tpu.models.qwen2_moe import qwen2_moe_config_from_hf
+    hf = {"vocab_size": 151936, "hidden_size": 2048,
+          "num_hidden_layers": 24, "num_attention_heads": 16,
+          "num_key_value_heads": 16, "moe_intermediate_size": 1408,
+          "shared_expert_intermediate_size": 5632, "num_experts": 60,
+          "num_experts_per_tok": 4, "norm_topk_prob": False,
+          "rope_theta": 1000000.0, "router_aux_loss_coef": 0.001}
+    cfg = qwen2_moe_config_from_hf(hf)
+    assert cfg.moe.num_experts == 60 and cfg.moe.top_k == 4
+    assert cfg.moe.norm_topk_prob is False
+    assert cfg.base.attention_bias and cfg.base.rope_theta == 1000000.0
+    assert cfg.moe_intermediate_size == 1408
+    with pytest.raises(ValueError):
+        qwen2_moe_config_from_hf({**hf, "mlp_only_layers": [0]})

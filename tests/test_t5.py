@@ -74,6 +74,7 @@ def test_encoder_mask_isolates_padding():
     np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_ignore_index_and_decoder_shift():
     model = T5ForConditionalGeneration(TINY_T5)
     b = _batch(2)

@@ -93,6 +93,7 @@ def test_generate_resident_and_streamed_agree(tiny_model):
     assert out_r == out_s, (out_r, out_s)
 
 
+@pytest.mark.slow
 def test_streamed_forward_gemma_knobs_match_model():
     """The streamed layer-by-layer path must honor the gemma llama-variant
     knobs ((1+scale) norms, gelu_tanh, embed normalizer, logit softcap)."""
