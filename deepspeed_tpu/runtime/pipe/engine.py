@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.comm import mesh as mesh_lib
 from deepspeed_tpu.runtime.pipe.one_f_one_b import pipeline_train_step_1f1b
+from deepspeed_tpu.runtime.pipe.spmd import stack_to_stages, unstack_stages
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -91,7 +92,6 @@ class PipelineEngine:
 
         # stage-sharded layout: stacked leaves [P, L/P, ...] over pipe, tied
         # replicated (reference: per-stage parameter/optimizer ownership)
-        from deepspeed_tpu.runtime.pipe.spmd import stack_to_stages
         staged = stack_to_stages(module.stacked_params, self.num_stages) \
             if self.num_stages > 1 else module.stacked_params
         self._staged_spec = jax.tree.map(
@@ -130,13 +130,8 @@ class PipelineEngine:
         stages = self.num_stages
 
         def step(staged, tied, opt_state, toks_mb):
-            if stages > 1:
-                # executor expects [L, ...] stacking; re-fold the stage dim
-                flat = jax.tree.map(
-                    lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
-                    staged)
-            else:
-                flat = staged
+            # executor expects [L, ...] stacking; re-fold the stage dim
+            flat = unstack_stages(staged) if stages > 1 else staged
             loss, g_staged, g_tied = pipeline_train_step_1f1b(
                 mod.block_fn, flat, tied, toks_mb, mod.first_fn, mod.last_fn,
                 mesh=mesh)
@@ -168,10 +163,7 @@ class PipelineEngine:
             mod, mesh, stages = self.module, self.mesh, self.num_stages
 
             def ev(staged, tied, toks):
-                flat = jax.tree.map(
-                    lambda x: x.reshape(x.shape[0] * x.shape[1],
-                                        *x.shape[2:]),
-                    staged) if stages > 1 else staged
+                flat = unstack_stages(staged) if stages > 1 else staged
                 return pipeline_eval_step(mod.block_fn, flat, tied, toks,
                                           mod.first_fn, mod.last_fn,
                                           mesh=mesh)
@@ -233,6 +225,14 @@ class PipelineEngine:
         self.opt_state = restored["opt_state"]
         self.global_steps = int(restored["scalars"]["global_steps"])
         return path
+
+    def consolidated_module_params(self):
+        """(stacked [L, ...], tied) with the stage dim folded away — the
+        layout model adapters split from (e.g. ``llama_params_from_pipe``
+        rebuilds a dense model tree for cross-topology restore)."""
+        host = jax.tree.map(np.asarray, self.staged_params)
+        stacked = unstack_stages(host) if self.num_stages > 1 else host
+        return stacked, jax.tree.map(np.asarray, self.tied_params)
 
     def train_batch(self, tokens) -> float:
         """tokens: [B, S] int32 with B divisible by micro_batches (reference

@@ -161,3 +161,18 @@ def llama_pipe_module(cfg, params):
 
     return PipeModule(block_fn=block_fn, first_fn=first_fn, last_fn=last_fn,
                       stacked_params=stacked, tied_params=tied)
+
+
+def llama_params_from_pipe(cfg, stacked_params, tied_params):
+    """Inverse of :func:`llama_pipe_module`'s tree split: rebuild the
+    ``LlamaForCausalLM`` (scan_layers) param tree from a pipeline engine's
+    stacked + tied state — the cross-topology restore path (a PP training
+    run's weights load into a dense/ZeRO engine or the serving stack;
+    reference: the universal checkpoint consolidates pp-rank shards the
+    same way)."""
+    model = {"layers": stacked_params,
+             "embed": tied_params["embed"],
+             "final_norm": tied_params["final_norm"]}
+    if not cfg.tie_embeddings:
+        model["lm_head"] = tied_params["lm_head"]
+    return {"params": {"model": model}}

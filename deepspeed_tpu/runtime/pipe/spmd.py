@@ -37,6 +37,16 @@ def stack_to_stages(stacked_params: Any, num_stages: int) -> Any:
     return jax.tree.map(reshape, stacked_params)
 
 
+def unstack_stages(staged_params: Any) -> Any:
+    """Inverse of :func:`stack_to_stages`: [P, L/P, ...] -> [L, ...] per
+    leaf. The single source of the stage-refold used by the pipeline
+    engine's step/eval builders and checkpoint consolidation — any change
+    to the stage partitioning layout must update both functions together."""
+    return jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+        staged_params)
+
+
 def pipeline_apply(block_fn: Callable, stacked_params: Any, x_microbatches,
                    mesh=None, extra_args: tuple = ()):
     """Run microbatched activations through a layer pipeline.

@@ -356,3 +356,45 @@ def test_llama_pipe_module_via_initialize(flavor, tmp_path):
     fresh.load_checkpoint(d)
     assert abs(e_after - fresh.eval_batch(tokens)) < 1e-5
     assert fresh.global_steps == 3
+
+
+def test_pipe_to_dense_cross_topology_restore():
+    """A PP run's weights consolidate back into the dense model tree and
+    load into a ZeRO-3 engine with matching loss (the universal-checkpoint
+    pp-rank consolidation story: reference checkpoint/universal covering
+    pipeline-parallel topologies)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.runtime.pipe.module import (llama_params_from_pipe,
+                                                   llama_pipe_module)
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=4, num_heads=2, num_kv_heads=2,
+                      max_seq_len=32, scan_layers=True, dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    tokens = np.random.default_rng(0).integers(
+        0, 128, size=(8, 16)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.asarray(tokens)})
+    mesh = create_mesh(MeshConfig(pipe=4, data=2))
+    set_global_mesh(mesh)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama_pipe_module(cfg, params), mesh=mesh,
+        config={"gradient_accumulation_steps": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}}})
+    for _ in range(3):
+        eng.train_batch(tokens)
+    pipe_eval = eng.eval_batch(tokens)
+
+    stacked, tied = eng.consolidated_module_params()
+    dense = llama_params_from_pipe(cfg, stacked, tied)
+    z3_mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    set_global_mesh(z3_mesh)
+    e3, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=jax.tree.map(jnp.asarray, dense["params"]),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}},
+        mesh=z3_mesh, example_batch={"input_ids": tokens[:4]})
+    assert abs(float(e3.eval_batch({"input_ids": tokens})) - pipe_eval) < 5e-3
