@@ -35,20 +35,25 @@ from deepspeed_tpu.utils.logging import log_dist
 
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
-    """int8 codes + fp32 group scales; the original shape rides as *static*
-    pytree aux data so dequantization stays jit-friendly."""
+    """quantized codes + fp32 group scales; the original shape and the wire
+    format ("int8" | "fp6" | "fp8" | "fp12") ride as *static* pytree aux
+    data so dequantization stays jit-friendly. fp6/fp12 codes are the
+    densely bit-packed uint8 buffers of ops.fp_formats (0.75/1.5 B/elem);
+    fp8 codes are native float8_e4m3fn."""
 
-    def __init__(self, codes, scale, shape):
+    def __init__(self, codes, scale, shape, fmt: str = "int8"):
         self.codes = codes
         self.scale = scale
         self.shape = tuple(int(s) for s in shape)
+        self.fmt = fmt
 
     def tree_flatten(self):
-        return (self.codes, self.scale), self.shape
+        return (self.codes, self.scale), (self.shape, self.fmt)
 
     @classmethod
-    def tree_unflatten(cls, shape, children):
-        return cls(children[0], children[1], shape)
+    def tree_unflatten(cls, aux, children):
+        shape, fmt = aux
+        return cls(children[0], children[1], shape, fmt)
 
     @property
     def nbytes(self) -> int:
@@ -61,11 +66,25 @@ def _is_qrecord(node) -> bool:
 
 
 def quantize_model_params(params: Any, q_bits: int = 8, group_size: int = 64,
-                          modules: Optional[Sequence[str]] = None) -> Any:
+                          modules: Optional[Sequence[str]] = None,
+                          fmt: str = "int") -> Any:
     """Group-wise symmetric weight-only quantization of a params tree
     (reference: inference/quantization quantization.py _init_group_wise_weight_
-    quantization). ``modules``: regexes of leaf paths to quantize (default: every
-    floating leaf with ndim >= 2)."""
+    quantization + fp_quantizer FP_Quantize). ``modules``: regexes of leaf
+    paths to quantize (default: every floating leaf with ndim >= 2).
+    ``fmt="int"``: integer codes at any q_bits (int8 storage).
+    ``fmt="fp"``: minifloat codes — q_bits 6/12 use the packed software
+    formats (0.75/1.5 B per element), q_bits 8 native float8_e4m3fn."""
+    if fmt not in ("int", "fp"):
+        raise ValueError(f"fmt must be 'int' or 'fp', got {fmt!r}")
+    if fmt == "fp":
+        if q_bits not in (6, 8, 12):
+            raise ValueError("fp weight quantization supports q_bits 6, 8, 12")
+        pack_group = {6: 4, 8: 1, 12: 2}[q_bits]
+        if group_size % pack_group:
+            raise ValueError(
+                f"fp{q_bits} packs {pack_group} codes per unit: group_size "
+                f"{group_size} must be divisible by {pack_group}")
     pats = [re.compile(p) for p in (modules or [".*"])]
     qmax = 2.0 ** (q_bits - 1) - 1
 
@@ -78,6 +97,12 @@ def quantize_model_params(params: Any, q_bits: int = 8, group_size: int = 64,
         flat = arr.astype(np.float32).ravel()
         pad = (-flat.size) % group_size
         g = np.pad(flat, (0, pad)).reshape(-1, group_size)
+        if fmt == "fp":
+            from deepspeed_tpu.ops.fp_formats import FPQuantizer
+            codes, scale = FPQuantizer(q_bits).quantize(jnp.asarray(g))
+            return QuantizedTensor(np.asarray(codes),
+                                   np.asarray(scale, np.float32),
+                                   arr.shape, f"fp{q_bits}")
         scale = np.maximum(np.abs(g).max(axis=1, keepdims=True) / qmax, 1e-12)
         codes = np.clip(np.round(g / scale), -qmax - 1, qmax).astype(np.int8)
         return QuantizedTensor(codes, scale.astype(np.float32), arr.shape)
@@ -92,8 +117,16 @@ def dequantize_model_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
         if not _is_qrecord(node):
             return node
         n = int(np.prod(node.shape))
-        flat = (jnp.asarray(node.codes).astype(jnp.float32)
-                * jnp.asarray(node.scale)).ravel()
+        if node.fmt in ("fp6", "fp12"):
+            from deepspeed_tpu.ops.fp_formats import FPQuantizer
+            bits = int(node.fmt[2:])
+            d = node.codes.shape[-1] * 8 // bits
+            flat = FPQuantizer(bits).dequantize(
+                jnp.asarray(node.codes), jnp.asarray(node.scale), d=d,
+                dtype=jnp.float32).ravel()
+        else:   # int8 and fp8 codes both dequantize as codes * scale
+            flat = (jnp.asarray(node.codes).astype(jnp.float32)
+                    * jnp.asarray(node.scale)).ravel()
         return flat[:n].reshape(node.shape).astype(dtype)
     return jax.tree_util.tree_map(deq, qparams, is_leaf=_is_qrecord)
 
@@ -117,12 +150,13 @@ class ZeROInferenceEngine:
     def __init__(self, model, params, model_config: Optional[LlamaConfig] = None,
                  q_bits: int = 8, group_size: int = 64,
                  offload: str = "none", dtype=jnp.bfloat16,
-                 modules: Optional[Sequence[str]] = None):
+                 modules: Optional[Sequence[str]] = None, fmt: str = "int"):
         self.model = model
         self.cfg = model_config or getattr(model, "config", None)
         self.dtype = dtype
         self.offload = offload
-        self.qstore = quantize_model_params(params, q_bits, group_size, modules)
+        self.qstore = quantize_model_params(params, q_bits, group_size,
+                                            modules, fmt=fmt)
         if offload == "none":
             self.qstore = jax.device_put(self.qstore)
         orig = sum(np.asarray(x).nbytes for x in jax.tree.leaves(params))

@@ -230,7 +230,12 @@ class PipelineEngine:
         """(stacked [L, ...], tied) with the stage dim folded away — the
         layout model adapters split from (e.g. ``llama_params_from_pipe``
         rebuilds a dense model tree for cross-topology restore)."""
-        host = jax.tree.map(np.asarray, self.staged_params)
+        # replicate before the host copy: on multi-host meshes the staged
+        # leaves span non-addressable devices and np.asarray would raise
+        rep = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P()), self.staged_params)
+        gathered = jax.jit(lambda t: t, out_shardings=rep)(self.staged_params)
+        host = jax.tree.map(np.asarray, gathered)
         stacked = unstack_stages(host) if self.num_stages > 1 else host
         return stacked, jax.tree.map(np.asarray, self.tied_params)
 

@@ -119,3 +119,43 @@ def test_streamed_forward_gemma_knobs_match_model():
     # sides must agree on the gemma knobs for this to hold
     want = np.asarray(resident.forward(batch))
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("q_bits,max_rel,bytes_per_elem", [
+    (6, 0.14, 0.75), (8, 0.08, 1.0), (12, 0.01, 1.5)])
+def test_fp_weight_quantization_formats(tiny_model, q_bits, max_rel,
+                                        bytes_per_elem):
+    """fmt='fp' weight-only quantization (reference FP_Quantize breadth):
+    fp6/fp12 store densely bit-packed codes, fp8 native float8; dequant
+    error follows the mantissa width and storage matches the bit width."""
+    _, _, params = tiny_model
+    q = quantize_model_params(params, q_bits=q_bits, group_size=64, fmt="fp")
+    leaves = [x for x in jax.tree.leaves(
+        q, is_leaf=lambda n: isinstance(n, QuantizedTensor))
+        if isinstance(x, QuantizedTensor)]
+    assert leaves and all(
+        leaf.fmt == f"fp{q_bits}" for leaf in leaves)
+    # storage: codes bytes per quantized element (pad + scales excluded)
+    for leaf in leaves:
+        n_padded = int(np.ceil(np.prod(leaf.shape) / 64) * 64)
+        assert np.asarray(leaf.codes).nbytes == int(n_padded * bytes_per_elem)
+    back = dequantize_model_params(q, dtype=jnp.float32)
+    for orig, deq in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        if orig.ndim < 2:
+            continue
+        rel = float(jnp.abs(deq - orig).max() /
+                    (jnp.abs(orig).max() + 1e-12))
+        assert rel < max_rel, (q_bits, rel)
+
+
+def test_fp_weight_quantization_forward_close(tiny_model):
+    """fp12-quantized resident forward stays close to the fp model."""
+    cfg, model, params = tiny_model
+    eng = ZeROInferenceEngine(model, params, cfg, q_bits=12, fmt="fp",
+                              dtype=jnp.float32)
+    batch = random_tokens(2, 16, vocab_size=cfg.vocab_size)
+    ref = model.apply({"params": params}, batch,
+                      method=lambda m, b: m.model(b["input_ids"]))
+    out = eng.forward(batch)
+    rel = float(jnp.abs(out - ref).max() / (jnp.abs(ref).max() + 1e-9))
+    assert rel < 0.05, rel
