@@ -34,24 +34,33 @@ def test_chunking_is_exact():
                                    rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.slow
-def test_domino_under_tp_mesh_matches_dense():
+def _tp_setup():
+    """data=4 x tensor=2 mesh with AutoTP-sharded layer params; caller must
+    clear the global mesh (use try/finally) so later tests don't inherit it."""
+    from deepspeed_tpu.module_inject import AutoTP
+    from deepspeed_tpu.runtime.zero.partition import build_param_shardings
     mesh = create_mesh(MeshConfig(data=4, tensor=2))
     set_global_mesh(mesh)
     x = np.random.default_rng(1).normal(size=(4, 8, 32)).astype(np.float32)
     params = _layer(2).init(jax.random.PRNGKey(1), x)["params"]
-    dense = _layer(1).apply({"params": params}, x)
-
-    from deepspeed_tpu.module_inject import AutoTP
-    from deepspeed_tpu.runtime.zero.partition import build_param_shardings
     rules = AutoTP.infer_rules(params=params)
-    shardings = build_param_shardings(params, mesh, stage=0, tensor_rules=rules)
-    sharded = jax.device_put(params, shardings)
-    with mesh:
-        out = jax.jit(lambda p, b: _layer(2).apply({"params": p}, b))(sharded, x)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
-                               rtol=2e-4, atol=2e-4)
-    set_global_mesh(None)
+    shardings = build_param_shardings(params, mesh, stage=0,
+                                      tensor_rules=rules)
+    return mesh, x, params, jax.device_put(params, shardings)
+
+
+@pytest.mark.slow
+def test_domino_under_tp_mesh_matches_dense():
+    try:
+        mesh, x, params, sharded = _tp_setup()
+        dense = _layer(1).apply({"params": params}, x)
+        with mesh:
+            out = jax.jit(
+                lambda p, b: _layer(2).apply({"params": p}, b))(sharded, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                                   rtol=2e-4, atol=2e-4)
+    finally:
+        set_global_mesh(None)
 
 
 @pytest.mark.slow
@@ -78,3 +87,30 @@ def test_domino_overlap_wrapper_and_chunk_errors():
         raise AssertionError("expected ValueError")
     except ValueError:
         pass
+
+
+def test_domino_chunking_multiplies_schedulable_collectives():
+    """The overlap claim's structural half, checkable without hardware: the
+    n-chunk layer's lowered module carries n independent per-chunk
+    all-reduces per row-projection (each data-independent of later chunks'
+    matmuls — what XLA's latency-hiding scheduler needs), where the
+    unchunked layer has exactly one."""
+    try:
+        mesh, x, _, sharded = _tp_setup()
+
+        def count_allreduce(n_chunks):
+            with mesh:
+                txt = jax.jit(
+                    lambda p, b: _layer(n_chunks).apply({"params": p}, b)
+                ).lower(sharded, x).compile().as_text()
+            return sum(1 for ln in txt.splitlines()
+                       if "all-reduce" in ln and "f32" in ln and "= f32" in ln)
+
+        one = count_allreduce(1)
+        four = count_allreduce(4)
+        assert one >= 2, one               # attn + mlp row projections
+        # each of the 2 row projections must contribute one DISTINCT psum
+        # per extra chunk (no CSE back into one collective): +2*(n-1) at n=4
+        assert four - one >= 2 * 3, (one, four)
+    finally:
+        set_global_mesh(None)

@@ -104,3 +104,25 @@ def test_group_norm_matches_manual():
     ref = ((xr - mu) / np.sqrt(var + 1e-5)).reshape(x.shape) * \
         np.asarray(scale) + np.asarray(bias)
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4, rtol=1e-4)
+
+
+def test_evoformer_memory_scales_linearly_not_quadratically():
+    """The CUTLASS-memory-efficiency claim, measured: the blockwise scan's
+    compiled peak temp memory grows O(L), not O(L^2) — the [.., L, L]
+    attention matrix never materializes (XLA memory_analysis on the
+    compiled module; 4x sequence -> <6x temps, a full-logits version
+    would be ~16x)."""
+    from deepspeed_tpu.ops.evoformer_attn import evoformer_attention
+
+    def peak_temp(L):
+        rng = np.random.default_rng(0)
+        mk = lambda *s: jnp.asarray(rng.normal(size=s), jnp.float32)
+        q, k, v = (mk(1, 2, L, 4, 16) for _ in range(3))
+        b1 = mk(1, 1, 1, L, L)
+        f = jax.jit(lambda q, k, v, b: jnp.sum(
+            evoformer_attention(q, k, v, (b,), block_k=128)))
+        return f.lower(q, k, v, b1).compile().memory_analysis() \
+            .temp_size_in_bytes
+
+    t256, t1024 = peak_temp(256), peak_temp(1024)
+    assert t1024 < 6 * t256, (t256, t1024)
