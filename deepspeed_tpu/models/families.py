@@ -91,6 +91,19 @@ def _t(w) -> np.ndarray:
     return np.asarray(w).T
 
 
+def hf_get(state, name) -> np.ndarray:
+    """Fetch one tensor from an HF state dict as numpy (torch tensors are
+    detached/CPU'd; bf16 upcast to fp32 first since numpy has no bfloat16).
+    Shared by every family converter."""
+    v = state[name]
+    if hasattr(v, "detach"):
+        v = v.detach().cpu()
+        if str(v.dtype) == "torch.bfloat16":
+            v = v.float()
+        return v.numpy()
+    return np.asarray(v)
+
+
 def attn_tree_from_weights(wq, wk, wv, wo, d, h, hkv, dh,
                            bq=None, bk=None, bv=None):
     """HF [out, in] projection weights -> the LlamaAttention param subtree
@@ -117,8 +130,7 @@ def convert_hf_state_dict(hf_state: Dict[str, Any], cfg: LlamaConfig,
     ``gate_up_proj`` (reference: phi3 containers split fused tensors) and
     qwen2's qkv biases."""
     def get(name):
-        v = hf_state[name]
-        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+        return hf_get(hf_state, name)
 
     d, h, hkv, dh = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     tree: Dict[str, Any] = {"model": {}}

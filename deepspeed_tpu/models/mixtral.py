@@ -103,3 +103,86 @@ def mixtral_tensor_rules(path, leaf) -> Optional[PartitionSpec]:
     if spec is not None:
         return spec
     return llama_tensor_rules(path, leaf)
+
+
+# ---------------------------------------------------------------------------
+# HF interop (reference: inference v2 mixtral containers/policy load HF
+# Mixtral checkpoints; here the config + state-dict mappers)
+# ---------------------------------------------------------------------------
+def mixtral_config_from_hf(hf: dict) -> MixtralConfig:
+    """Build a MixtralConfig from an HF ``MixtralConfig`` dict. HF Mixtral
+    renormalizes the kept top-k routing weights (our ``norm_topk_prob=True``
+    default)."""
+    mt = hf.get("model_type", "mixtral")
+    if mt != "mixtral" or "num_local_experts" not in hf:
+        raise ValueError(f"not a Mixtral config (model_type={mt!r}); dense "
+                         "llama-family archs go through families."
+                         "config_from_hf")
+    base = LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads",
+                            hf["num_attention_heads"]),
+        max_seq_len=hf.get("max_position_embeddings", 4096),
+        rope_theta=hf.get("rope_theta", 1e6),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        sliding_window=hf.get("sliding_window"),
+        head_dim=hf.get("head_dim"),
+    )
+    moe = MoEConfig(
+        num_experts=hf["num_local_experts"],
+        top_k=hf.get("num_experts_per_tok", 2),
+        aux_loss_weight=hf.get("router_aux_loss_coef", 0.001),
+        router_z_loss_weight=0.0,   # HF Mixtral has no router z-loss
+    )
+    return MixtralConfig(base=base, moe=moe)
+
+
+def convert_hf_mixtral(hf_state, cfg: MixtralConfig):
+    """Map an HF Mixtral state dict into the MixtralForCausalLM tree.
+    HF expert naming: ``block_sparse_moe.experts.{e}.w1`` (gate, [I, D]),
+    ``w2`` (down, [D, I]), ``w3`` (up, [I, D]); router
+    ``block_sparse_moe.gate`` [E, D]. Attention mapping shared with the
+    llama-family converter (families.attn_tree_from_weights)."""
+    from deepspeed_tpu.models.families import _t as t
+    from deepspeed_tpu.models.families import hf_get
+    from deepspeed_tpu.models.families import attn_tree_from_weights
+
+    def get(name):
+        return hf_get(hf_state, name)
+
+    base = cfg.base
+    d, h, hkv, dh = (base.hidden_size, base.num_heads, base.num_kv_heads,
+                     base.head_dim_)
+    e = cfg.moe.num_experts
+    tree = {"embed": {"embedding": get("model.embed_tokens.weight")},
+            "final_norm": {"scale": get("model.norm.weight")},
+            "lm_head": {"kernel": t(get("lm_head.weight"))}}
+    for i in range(base.num_layers):
+        p = f"model.layers.{i}."
+        ep = p + "block_sparse_moe.experts."
+        tree[f"layer_{i}"] = {
+            "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+            "mlp_norm": {"scale": get(p + "post_attention_layernorm.weight")},
+            "attn": attn_tree_from_weights(
+                get(p + "self_attn.q_proj.weight"),
+                get(p + "self_attn.k_proj.weight"),
+                get(p + "self_attn.v_proj.weight"),
+                get(p + "self_attn.o_proj.weight"), d, h, hkv, dh),
+            "moe": {
+                "gate": {"wg": {"kernel":
+                                t(get(p + "block_sparse_moe.gate.weight"))}},
+                "experts": {
+                    "w_gate": np.stack([t(get(f"{ep}{j}.w1.weight"))
+                                        for j in range(e)]),
+                    "w_up": np.stack([t(get(f"{ep}{j}.w3.weight"))
+                                      for j in range(e)]),
+                    "w_down": np.stack([t(get(f"{ep}{j}.w2.weight"))
+                                        for j in range(e)]),
+                },
+            },
+        }
+    return tree

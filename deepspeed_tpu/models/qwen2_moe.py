@@ -175,12 +175,11 @@ def convert_hf_qwen2_moe(hf_state, cfg: Qwen2MoEConfig):
     Attention mapping is shared with the llama-family converter
     (families.attn_tree_from_weights)."""
     from deepspeed_tpu.models.families import _t as t
+    from deepspeed_tpu.models.families import hf_get
     from deepspeed_tpu.models.families import attn_tree_from_weights
 
     def get(name):
-        v = hf_state[name]
-        return np.asarray(v.detach().cpu().numpy()
-                          if hasattr(v, "detach") else v)
+        return hf_get(hf_state, name)
 
     base = cfg.base
     d, h, hkv, dh = (base.hidden_size, base.num_heads, base.num_kv_heads,

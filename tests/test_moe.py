@@ -202,3 +202,66 @@ def test_quantized_dispatch_inside_qgz_region():
     batch = random_tokens(8, 16, vocab_size=512, seed=0)
     losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
     assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
+
+
+@pytest.mark.slow
+def test_hf_mixtral_torch_parity():
+    """Convert a random torch-transformers Mixtral checkpoint and match its
+    logits (high eval capacity so no token drops; HF renormalizes kept
+    routing weights = our norm_topk_prob default)."""
+    import dataclasses
+
+    import torch
+    from transformers import MixtralConfig as HFConfig
+    from transformers import MixtralForCausalLM as HFModel
+
+    from deepspeed_tpu.models.mixtral import (convert_hf_mixtral,
+                                              mixtral_config_from_hf)
+
+    hf_cfg = HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        router_jitter_noise=0.0, output_router_logits=False)
+    torch.manual_seed(0)
+    hf_model = HFModel(hf_cfg).eval()
+
+    cfg = mixtral_config_from_hf(hf_cfg.to_dict())
+    cfg = dataclasses.replace(
+        cfg,
+        base=dataclasses.replace(cfg.base, dtype=jnp.float32),
+        moe=dataclasses.replace(cfg.moe, dtype=jnp.float32,
+                                eval_capacity_factor=float(
+                                    cfg.moe.num_experts)))
+    params = convert_hf_mixtral(hf_model.state_dict(), cfg)
+
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = MixtralForCausalLM(cfg).apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids.astype(np.int32))},
+        method=MixtralForCausalLM.logits)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4, rtol=2e-3)
+
+
+def test_mixtral_config_from_hf_fields():
+    from deepspeed_tpu.models.mixtral import mixtral_config_from_hf
+    hf = {"model_type": "mixtral", "vocab_size": 32000, "hidden_size": 4096,
+          "intermediate_size": 14336, "num_hidden_layers": 32,
+          "num_attention_heads": 32, "num_key_value_heads": 8,
+          "num_local_experts": 8, "num_experts_per_tok": 2,
+          "rope_theta": 1e6, "router_aux_loss_coef": 0.02,
+          "sliding_window": 4096}
+    cfg = mixtral_config_from_hf(hf)
+    assert cfg.moe.num_experts == 8 and cfg.moe.top_k == 2
+    assert cfg.moe.norm_topk_prob is True        # HF Mixtral renormalizes
+    assert cfg.moe.aux_loss_weight == 0.02
+    assert cfg.base.num_kv_heads == 8 and cfg.base.rope_theta == 1e6
+    assert cfg.base.sliding_window == 4096
+    with pytest.raises(ValueError):
+        mixtral_config_from_hf({**hf, "model_type": "mistral"})
+    with pytest.raises(ValueError):
+        mixtral_config_from_hf({k: v for k, v in hf.items()
+                                if k != "num_local_experts"})
