@@ -17,7 +17,9 @@ def parse_args(args=None):
     p = argparse.ArgumentParser(description="collective micro-benchmark sweep")
     p.add_argument("--op", default="all_reduce",
                    choices=["all_reduce", "all_gather", "reduce_scatter",
-                            "all_to_all", "ppermute"])
+                            "all_to_all", "ppermute",
+                            "quantized_psum", "quantized_all_gather",
+                            "quantized_all_to_all"])
     p.add_argument("--axis", default="data", help="mesh axis to benchmark over")
     p.add_argument("--minsize", type=int, default=1 << 12, help="min bytes")
     p.add_argument("--maxsize", type=int, default=1 << 26, help="max bytes")
@@ -35,6 +37,7 @@ def run_sweep(op: str, axis: str, minsize: int, maxsize: int, trials: int,
 
     from deepspeed_tpu.comm import comm
     from deepspeed_tpu.comm.comms_logging import calc_bw
+    from deepspeed_tpu.ops.pallas import quant as _quant
 
     devices = np.array(jax.devices())
     world = len(devices)
@@ -48,6 +51,16 @@ def run_sweep(op: str, axis: str, minsize: int, maxsize: int, trials: int,
         "all_to_all": lambda x: comm.all_to_all(x, axis, 0, 0),
         "ppermute": lambda x: comm.ppermute(
             x, axis, [(i, (i + 1) % world) for i in range(world)]),
+        # int8-wire collectives (ZeRO++ qgZ / MoE dispatch formats) — same
+        # logical reduction with ~4x fewer wire bytes than fp32; comparing
+        # these rows against their dense siblings measures the compression
+        # win on real ICI/DCN (ops/pallas/quant.py)
+        "quantized_psum": lambda x: _quant.quantized_psum(
+            x.reshape(world, -1), (axis,)).ravel(),
+        "quantized_all_gather": lambda x: _quant.quantized_all_gather(
+            x.reshape(world, -1), axis).ravel(),
+        "quantized_all_to_all": lambda x: _quant.quantized_all_to_all(
+            x.reshape(world, -1), axis).ravel(),
     }
     body = fns[op]
 
@@ -56,7 +69,8 @@ def run_sweep(op: str, axis: str, minsize: int, maxsize: int, trials: int,
         # out_specs is P(axis) for every op: all_gather's per-shard output is the
         # full gathered array, so its global result is simply world× larger.
         return jax.shard_map(
-            lambda v: body(v), mesh=mesh, in_specs=P(axis), out_specs=P(axis))(x)
+            lambda v: body(v), mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            check_vma=False)(x)   # pallas quant kernels need vma checks off
 
     results = []
     size = minsize
@@ -70,7 +84,10 @@ def run_sweep(op: str, axis: str, minsize: int, maxsize: int, trials: int,
         for _ in range(trials):
             step(x).block_until_ready()
         dt = (time.perf_counter() - t0) / trials
-        algbw, busbw = calc_bw(op, n_elem * jdtype.itemsize, dt, world)
+        base_op = {"quantized_psum": "all_reduce",
+                   "quantized_all_gather": "all_gather",
+                   "quantized_all_to_all": "all_to_all"}.get(op, op)
+        algbw, busbw = calc_bw(base_op, n_elem * jdtype.itemsize, dt, world)
         results.append({"op": op, "bytes": n_elem * jdtype.itemsize,
                         "latency_us": dt * 1e6,
                         "algbw_gbps": algbw * 8 / 1e9,
