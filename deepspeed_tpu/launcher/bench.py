@@ -29,6 +29,10 @@ def parse_args(args=None):
     return p.parse_args(args)
 
 
+QUANTIZED_OPS = ("quantized_psum", "quantized_all_gather",
+                 "quantized_all_to_all")
+
+
 def run_sweep(op: str, axis: str, minsize: int, maxsize: int, trials: int,
               warmups: int, dtype: str = "bfloat16"):
     import jax
@@ -70,13 +74,18 @@ def run_sweep(op: str, axis: str, minsize: int, maxsize: int, trials: int,
         # full gathered array, so its global result is simply world× larger.
         return jax.shard_map(
             lambda v: body(v), mesh=mesh, in_specs=P(axis), out_specs=P(axis),
-            check_vma=False)(x)   # pallas quant kernels need vma checks off
+            # pallas quant kernels need vma checks off; keep the guard for
+            # the dense collectives
+            check_vma=op not in QUANTIZED_OPS)(x)
 
     results = []
     size = minsize
     while size <= maxsize:
-        n_elem = max(world, size // jdtype.itemsize)
-        n_elem -= n_elem % world
+        # quantized ops reshape each local shard to (world, -1), so the
+        # global element count must divide by world^2
+        align = world * world if op in QUANTIZED_OPS else world
+        n_elem = max(align, size // jdtype.itemsize)
+        n_elem -= n_elem % align
         x = jnp.ones((n_elem,), jdtype)
         for _ in range(warmups):
             step(x).block_until_ready()
