@@ -111,6 +111,19 @@ class PipelineEngine:
         self.global_steps = 0
         self._step_fn = None
         self._eval_fn = None
+        # throughput + monitor parity with the main engine (reference
+        # PipelineEngine inherits both); the timer's batch size is corrected
+        # to the actual batch on the first train_batch
+        from deepspeed_tpu.utils.timer import ThroughputTimer
+        self.steps_per_print = dscfg.steps_per_print
+        self.tput_timer = ThroughputTimer(
+            batch_size=(self.micro_batch_size or 1) * self.micro_batches,
+            steps_per_output=self.steps_per_print)
+        self.monitor = None
+        if (dscfg.tensorboard.enabled or dscfg.csv_monitor.enabled
+                or dscfg.wandb.enabled or dscfg.comet.enabled):
+            from deepspeed_tpu.monitor.monitor import MonitorMaster
+            self.monitor = MonitorMaster(dscfg)
         from deepspeed_tpu.runtime.pipe.schedule import (
             bubble_fraction, lockstep_bubble_fraction)
         log_dist(
@@ -250,8 +263,18 @@ class PipelineEngine:
         toks_mb = jnp.asarray(tokens.reshape(m, b // m, s), jnp.int32)
         if self._step_fn is None:
             self._build_step()
+        self.tput_timer.batch_size = b        # actual batch, not config guess
+        self.tput_timer.start()
         self.staged_params, self.tied_params, self.opt_state, loss = \
             self._step_fn(self.staged_params, self.tied_params,
                           self.opt_state, toks_mb)
+        loss = float(loss)
+        self.tput_timer.stop(global_step=True)
         self.global_steps += 1
-        return float(loss)
+        if (self.monitor is not None
+                and self.global_steps % self.steps_per_print == 0):
+            # same cadence as the main engine's _record_metrics
+            self.monitor.write_events(
+                [("Train/Samples/train_loss", loss,
+                  self.global_steps * b)])
+        return loss
