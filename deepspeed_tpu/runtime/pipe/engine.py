@@ -109,6 +109,7 @@ class PipelineEngine:
                          module.tied_params))
         self.opt_state = self.tx.init((self.staged_params, self.tied_params))
         self.global_steps = 0
+        self.global_samples = 0
         self._step_fn = None
         self._eval_fn = None
         # throughput + monitor parity with the main engine (reference
@@ -271,10 +272,11 @@ class PipelineEngine:
         loss = float(loss)
         self.tput_timer.stop(global_step=True)
         self.global_steps += 1
-        if (self.monitor is not None
+        self.global_samples += b
+        if (self.monitor is not None and self.steps_per_print
                 and self.global_steps % self.steps_per_print == 0):
-            # same cadence as the main engine's _record_metrics
+            # same cadence + cumulative-samples x-axis as the main engine's
+            # _record_metrics
             self.monitor.write_events(
-                [("Train/Samples/train_loss", loss,
-                  self.global_steps * b)])
+                [("Train/Samples/train_loss", loss, self.global_samples)])
         return loss
