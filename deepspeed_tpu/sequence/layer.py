@@ -1,0 +1,39 @@
+"""API-compat sequence-parallel attention layer.
+
+Reference analog: ``deepspeed/sequence/layer.py:271`` —
+``DistributedAttention(local_attention, sequence_process_group)``: a module
+wrapping any local attention; sequence-sharded q/k/v are head-scattered via
+all-to-all, the wrapped attention runs on the full sequence with a head
+slice, and the inverse all-to-all restores sequence sharding. Here the
+process group is the mesh's ``sequence`` axis and the machinery is
+``ulysses_attention`` (incl. the exact uneven-heads hybrid).
+"""
+
+from typing import Callable, Optional
+
+from deepspeed_tpu.sequence.ulysses import ulysses_attention
+
+
+class DistributedAttention:
+    """Drop-in analog of the reference class: call with sequence-sharded
+    [B, S, H, D] q/k/v; extra positional/keyword args flow to the wrapped
+    ``local_attention(q, k, v, *args, **kwargs)`` which sees the gathered
+    sequence and its head slice (kv keep their GQA head count — densify
+    inside the fn if needed). ``local_attention=None`` uses the built-in
+    flash/reference attention (``causal`` applies only to the built-in).
+    Heads must divide the sequence degree when a custom fn is given (the
+    uneven-heads remainder runs ring attention, which can't wrap one)."""
+
+    def __init__(self, local_attention: Optional[Callable] = None,
+                 mesh=None, causal: bool = True):
+        self.local_attention = local_attention
+        self.mesh = mesh
+        self.causal = causal
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        attn_fn = None
+        if self.local_attention is not None:
+            attn_fn = lambda q, k, v: self.local_attention(  # noqa: E731
+                q, k, v, *args, **kwargs)
+        return ulysses_attention(query, key, value, causal=self.causal,
+                                 mesh=self.mesh, attn_fn=attn_fn)

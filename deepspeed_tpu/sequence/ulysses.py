@@ -33,20 +33,38 @@ from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_auto
 
 
 def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
-                      use_flash: bool = True):
+                      use_flash: bool = True, attn_fn=None):
     """q: [B, S, H, D] global (sequence-sharded on the mesh); returns same shape.
 
     Inside the shard_map each device holds [B, S/sp, H_local, D]; after the
     all-to-all it holds [B, S, H_local/sp, D] and runs full-sequence attention.
+    ``attn_fn(q, k, v)`` overrides the local attention computed on the
+    gathered sequence (the reference DistributedAttention's pluggable
+    ``local_attention``); default: flash kernel / reference attention.
     """
     mesh = mesh or mesh_lib.get_global_mesh()
     sp = mesh.shape["sequence"]
+
+    def local(qq, kk, vv):
+        if attn_fn is not None:
+            return attn_fn(qq, kk, vv)
+        return flash_attention_auto(qq, kk, vv, causal=causal) if use_flash \
+            else _local_attn(qq, kk, vv, causal)
+
     if sp == 1:
-        return flash_attention_auto(q, k, v, causal=causal) if use_flash else \
-            _local_attn(q, k, v, causal)
+        return local(q, k, v)
 
     tp = max(mesh.shape["tensor"], 1)
     uneven = (q.shape[2] // tp) % sp != 0 or (k.shape[2] // tp) % sp != 0
+    if uneven and attn_fn is not None:
+        # the remainder heads run ring attention, which cannot honor an
+        # arbitrary local_attention — refuse instead of silently applying
+        # the built-in softmax to part of the heads
+        raise ValueError(
+            "a custom local_attention requires heads divisible by the "
+            f"sequence degree (got {q.shape[2]}//{tp} heads over sp={sp}); "
+            "the uneven remainder runs ring attention, which cannot wrap a "
+            "user attention fn")
 
     spec = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
@@ -56,8 +74,7 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
                       split_axis=2, concat_axis=1, tiled=True)
         qg, kg, vg = a2a(q_l), a2a(k_l), a2a(v_l)
         # Pallas kernel on TPU (runs inside the shard_map), lax elsewhere
-        out = flash_attention_auto(qg, kg, vg, causal=causal) if use_flash else \
-            _local_attn(qg, kg, vg, causal)
+        out = local(qg, kg, vg)
         # inverse: scatter sequence / gather heads
         return jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,
                                   concat_axis=2, tiled=True)

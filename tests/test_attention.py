@@ -129,3 +129,37 @@ def test_train_llama_with_ring_attention():
     batch = random_tokens(4, 32, seed=0)
     losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
     assert losses[-1] < losses[0]
+
+
+def test_distributed_attention_api_compat(sp_mesh):
+    """DistributedAttention (reference sequence/layer.py:271): wraps a
+    user-supplied local attention; output matches full-sequence reference."""
+    from deepspeed_tpu.sequence.layer import DistributedAttention
+
+    q, k, v = make_qkv(s=64, h=8, hkv=8)
+    calls = []
+
+    def my_local_attention(qg, kg, vg, scale_note=None):
+        calls.append((qg.shape, scale_note))
+        return attention_reference(qg, kg, vg, causal=True)
+
+    dist_attn = DistributedAttention(my_local_attention, mesh=sp_mesh)
+    out = dist_attn(q, k, v, scale_note="hi")
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # the wrapped fn saw gathered-sequence shards: local batch, full S,
+    # H/sp heads, full head dim
+    (shape, note), = {(s, n) for s, n in calls}
+    dp = np.prod([sp_mesh.shape[a] for a in ("data", "fsdp")
+                  if a in sp_mesh.shape])
+    sp = sp_mesh.shape["sequence"]
+    assert shape == (q.shape[0] // dp, q.shape[1], q.shape[2] // sp,
+                     q.shape[3]) and note == "hi", (shape, note)
+
+
+def test_distributed_attention_uneven_heads_with_custom_fn_raises(sp_mesh):
+    from deepspeed_tpu.sequence.layer import DistributedAttention
+    q, k, v = make_qkv(s=64, h=6, hkv=6)   # 6 heads over sp=4: uneven
+    with pytest.raises(ValueError, match="local_attention"):
+        DistributedAttention(lambda *a: a[0], mesh=sp_mesh)(q, k, v)
