@@ -44,9 +44,8 @@ PHI3_MINI = LlamaConfig(
 
 # gemma (v1) is a llama variant: gelu_tanh gated MLP, (1+scale) norms, sqrt(d)
 # embedding normalizer, tied head, head_dim decoupled from hidden/heads.
-# gemma2 is NOT claimed: its extra residual norms (pre/post-feedforward),
-# per-layer sliding/global alternation, and attention-logit softcapping are a
-# different block shape.
+# gemma2 (sandwich norms, attention-logit softcapping, alternating
+# sliding/full windows) is its own family: models/gemma2.py.
 GEMMA_2B = LlamaConfig(
     vocab_size=256000, hidden_size=2048, intermediate_size=16384, num_layers=18,
     num_heads=8, num_kv_heads=1, head_dim=256, max_seq_len=8192,

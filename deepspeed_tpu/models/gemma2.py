@@ -1,0 +1,229 @@
+"""Gemma-2 causal LM — the sandwich-norm / alternating-window gemma family.
+
+Reference analog: the v2 engine's gemma coverage stops at gemma-1
+(``inference/v2/model_implementations``); gemma-2's block differs enough to
+be its own family (this was an explicitly-flagged gap): four RMS norms per
+block (post-attention and post-feedforward applied to the SUBLAYER OUTPUT
+before the residual add), attention-logit soft-capping, a decoupled
+``query_pre_attn_scalar`` attention scale, and alternating
+sliding/full-window attention per layer (even layers sliding). Shares the
+gemma conventions already in-tree: (1+scale) zero-centered RMS norms,
+sqrt(hidden) embedding normalizer, gelu-tanh gated MLP, tied head with
+final-logit soft-capping.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.models.llama import (BATCH_AXES, HEADS_AXIS, SEQ_AXIS,
+                                        RMSNorm, _xla_attention,
+                                        apply_rope, llama_tensor_rules,
+                                        rope_freqs, shard_activation)
+
+@dataclasses.dataclass(frozen=True)
+class Gemma2Config:
+    vocab_size: int = 256000
+    hidden_size: int = 2304
+    intermediate_size: int = 9216
+    num_layers: int = 26
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 256
+    max_seq_len: int = 8192
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    query_pre_attn_scalar: float = 256.0
+    attn_logit_softcap: Optional[float] = 50.0
+    final_logit_softcap: Optional[float] = 30.0
+    sliding_window: int = 4096
+    dtype: Any = jnp.bfloat16
+
+    def is_sliding(self, layer_idx: int) -> bool:
+        # HF layer_types: sliding_attention on even indices, full on odd
+        return layer_idx % 2 == 0
+
+
+TINY_GEMMA2 = Gemma2Config(
+    vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=4,
+    num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+    sliding_window=8, attn_logit_softcap=50.0, final_logit_softcap=30.0,
+    query_pre_attn_scalar=16.0)
+
+
+class Gemma2Attention(nn.Module):
+    cfg: Gemma2Config
+    sliding: bool
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        d = cfg.head_dim
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_heads, d), name="wq")(x)
+        k = dense(features=(cfg.num_kv_heads, d), name="wk")(x)
+        v = dense(features=(cfg.num_kv_heads, d), name="wv")(x)
+        q = shard_activation(q, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        k = shard_activation(k, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        v = shard_activation(v, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        cos, sin = rope_freqs(d, cfg.max_seq_len, cfg.rope_theta)
+        q = apply_rope(q, jnp.asarray(cos), jnp.asarray(sin), positions)
+        k = apply_rope(k, jnp.asarray(cos), jnp.asarray(sin), positions)
+        out = _xla_attention(
+            q, k, v, causal=True,
+            window=cfg.sliding_window if self.sliding else None,
+            scale=cfg.query_pre_attn_scalar ** -0.5,
+            softcap=cfg.attn_logit_softcap)
+        out = shard_activation(out, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS, None))
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                               use_bias=False, dtype=cfg.dtype,
+                               param_dtype=jnp.float32, name="wo")(out)
+
+
+class Gemma2MLP(nn.Module):
+    cfg: Gemma2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        g = nn.gelu(dense(cfg.intermediate_size, name="w_gate")(x),
+                    approximate=True)
+        u = dense(cfg.intermediate_size, name="w_up")(x)
+        h = shard_activation(g * u, (BATCH_AXES, SEQ_AXIS, HEADS_AXIS))
+        return dense(cfg.hidden_size, name="w_down")(h)
+
+
+class Gemma2Block(nn.Module):
+    """Sandwich norms: the post-attention / post-feedforward norms apply to
+    the sublayer OUTPUT before the residual add (gemma-2's signature)."""
+    cfg: Gemma2Config
+    layer_idx: int
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        norm = partial(RMSNorm, cfg.rms_norm_eps, cfg.dtype,
+                       scale_offset=True)
+        h = Gemma2Attention(cfg, cfg.is_sliding(self.layer_idx),
+                            name="attn")(norm(name="attn_norm")(x), positions)
+        x = x + norm(name="post_attn_norm")(h)
+        h2 = Gemma2MLP(cfg, name="mlp")(norm(name="pre_ffw_norm")(x))
+        x = x + norm(name="post_ffw_norm")(h2)
+        return shard_activation(x, (BATCH_AXES, SEQ_AXIS, None))
+
+
+class Gemma2ForCausalLM(nn.Module):
+    """batch {"input_ids": [B,S]} -> mean next-token CE (tied head with
+    final-logit soft-capping)."""
+    cfg: Gemma2Config
+
+    @nn.compact
+    def _backbone(self, input_ids):
+        cfg = self.cfg
+        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]),
+                                     input_ids.shape)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")
+        x = embed(input_ids)
+        x = x * jnp.sqrt(jnp.asarray(cfg.hidden_size,
+                                     jnp.float32)).astype(x.dtype)
+        for i in range(cfg.num_layers):
+            x = Gemma2Block(cfg, i, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, scale_offset=True,
+                    name="final_norm")(x)
+        logits = embed.attend(x).astype(jnp.float32)
+        if cfg.final_logit_softcap:
+            logits = cfg.final_logit_softcap * jnp.tanh(
+                logits / cfg.final_logit_softcap)
+        return logits
+
+    @property
+    def config(self):
+        return self.cfg
+
+    def __call__(self, batch):
+        input_ids = batch["input_ids"]
+        logits = self._backbone(input_ids)
+        labels = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    def logits(self, batch):
+        return self._backbone(batch["input_ids"])
+
+
+def gemma2_tensor_rules(path, leaf) -> Optional[PartitionSpec]:
+    """Same projection names as the llama family -> llama's TP rules apply."""
+    return llama_tensor_rules(path, leaf)
+
+
+# ---------------------------------------------------------------------------
+# HF interop
+# ---------------------------------------------------------------------------
+def gemma2_config_from_hf(hf: dict) -> Gemma2Config:
+    if hf.get("model_type", "gemma2") != "gemma2":
+        raise ValueError(f"not a gemma2 config: {hf.get('model_type')!r}")
+    return Gemma2Config(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads",
+                            hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim", 256),
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar", 256)),
+        attn_logit_softcap=hf.get("attn_logit_softcapping", 50.0),
+        final_logit_softcap=hf.get("final_logit_softcapping", 30.0),
+        sliding_window=hf.get("sliding_window", 4096))
+
+
+def convert_hf_gemma2(hf_state, cfg: Gemma2Config):
+    """Map an HF Gemma2 state dict into the Gemma2ForCausalLM tree (tied
+    head; HF stores gemma norm weights as the zero-centered offset, same as
+    our scale_offset convention, so norms map through directly)."""
+    from deepspeed_tpu.models.families import _t as t
+    from deepspeed_tpu.models.families import attn_tree_from_weights, hf_get
+
+    def get(name):
+        return hf_get(hf_state, name)
+
+    d, h, hkv, dh = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                     cfg.head_dim)
+    tree = {"embed": {"embedding": get("model.embed_tokens.weight")},
+            "final_norm": {"scale": get("model.norm.weight")}}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        tree[f"layer_{i}"] = {
+            "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+            "post_attn_norm": {"scale":
+                               get(p + "post_attention_layernorm.weight")},
+            "pre_ffw_norm": {"scale":
+                             get(p + "pre_feedforward_layernorm.weight")},
+            "post_ffw_norm": {"scale":
+                              get(p + "post_feedforward_layernorm.weight")},
+            "attn": attn_tree_from_weights(
+                get(p + "self_attn.q_proj.weight"),
+                get(p + "self_attn.k_proj.weight"),
+                get(p + "self_attn.v_proj.weight"),
+                get(p + "self_attn.o_proj.weight"), d, h, hkv, dh),
+            "mlp": {
+                "w_gate": {"kernel": t(get(p + "mlp.gate_proj.weight"))},
+                "w_up": {"kernel": t(get(p + "mlp.up_proj.weight"))},
+                "w_down": {"kernel": t(get(p + "mlp.down_proj.weight"))},
+            },
+        }
+    return tree

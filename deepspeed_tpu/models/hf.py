@@ -147,6 +147,8 @@ _REGISTRY = {
                               "GPTNeoXForCausalLM", "convert_hf_gpt_neox"),
     "t5": _family_entry("t5", _t5_config, "T5ForConditionalGeneration",
                         "convert_hf_t5"),
+    "gemma2": _family_entry("gemma2", "gemma2_config_from_hf",
+                            "Gemma2ForCausalLM", "convert_hf_gemma2"),
     **{mt: _llama_family_entry(mt) for mt in LLAMA_FAMILY},
 }
 

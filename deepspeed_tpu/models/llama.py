@@ -159,17 +159,23 @@ def apply_rope(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
-def _xla_attention(q, k, v, causal: bool = True, segment_ids=None, window=None):
+def _xla_attention(q, k, v, causal: bool = True, segment_ids=None, window=None,
+                   scale=None, softcap=None):
     """Plain attention; XLA fuses softmax chain. q,k,v: [B, S, H, D] / kv
     [B, S, Hkv, D]. ``window`` adds mistral-style sliding-window masking
-    (token t attends to (t-window, t])."""
+    (token t attends to (t-window, t]); ``scale`` overrides 1/sqrt(d)
+    (gemma2 query_pre_attn_scalar); ``softcap`` tanh-caps the raw logits
+    before masking (gemma2 attn_logit_softcapping)."""
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     if hkv != h:
         rep = h // hkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * \
+        (scale if scale is not None else 1.0 / np.sqrt(d))
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
     sk = k.shape[1]
     if causal or window is not None:
         qpos = jnp.arange(sq)[:, None] + (sk - sq)

@@ -1,0 +1,76 @@
+"""Gemma-2 family tests: sandwich norms, softcaps, alternating windows.
+
+Reference analog: gemma-2 was an explicitly-flagged coverage gap (the
+reference v2 engine covers gemma-1 only); parity is held against
+torch-transformers directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import create_mesh, set_global_mesh
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.models.gemma2 import (TINY_GEMMA2, Gemma2ForCausalLM,
+                                         gemma2_tensor_rules)
+from deepspeed_tpu.models.llama import random_tokens
+
+
+def test_gemma2_trains():
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    set_global_mesh(mesh)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Gemma2ForCausalLM(TINY_GEMMA2),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}},
+        mesh=mesh, example_batch=random_tokens(4, 32, vocab_size=512),
+        tensor_rules=gemma2_tensor_rules)
+    batch = random_tokens(8, 32, vocab_size=512, seed=0)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
+    assert losses[-1] < losses[0] and all(np.isfinite(losses)), losses
+
+
+def test_gemma2_sliding_layers_restrict_context():
+    """Even layers use the sliding window: with every layer sliding-w=8 the
+    receptive field per layer is bounded, so token t in a 4-layer model
+    (2 sliding + 2 full) still differs from full attention on long context;
+    here we check the per-layer masks directly via config."""
+    assert TINY_GEMMA2.is_sliding(0) and not TINY_GEMMA2.is_sliding(1)
+    assert TINY_GEMMA2.is_sliding(2) and not TINY_GEMMA2.is_sliding(3)
+
+
+@pytest.mark.slow
+def test_hf_gemma2_torch_parity():
+    import torch
+    from transformers import Gemma2Config as HFConfig
+    from transformers import Gemma2ForCausalLM as HFModel
+
+    from deepspeed_tpu.models.gemma2 import (convert_hf_gemma2,
+                                             gemma2_config_from_hf)
+
+    hf_cfg = HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=16,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=8, max_position_embeddings=128,
+        rms_norm_eps=1e-6, rope_theta=10000.0)
+    torch.manual_seed(0)
+    hf_model = HFModel(hf_cfg).eval()
+
+    import dataclasses
+    cfg = gemma2_config_from_hf(hf_cfg.to_dict())
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = convert_hf_gemma2(hf_model.state_dict(), cfg)
+
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 32))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = Gemma2ForCausalLM(cfg).apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids.astype(np.int32))},
+        method=Gemma2ForCausalLM.logits)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=3e-4, rtol=3e-3)

@@ -40,6 +40,10 @@ MINIMAL = {
                  "num_hidden_layers": 2, "num_attention_heads": 4},
     "t5": {"model_type": "t5", "vocab_size": 128, "d_model": 32,
            "d_ff": 64, "num_layers": 2, "num_heads": 4, "d_kv": 8},
+    "gemma2": {"model_type": "gemma2", "vocab_size": 128, "hidden_size": 32,
+               "intermediate_size": 64, "num_hidden_layers": 2,
+               "num_attention_heads": 4, "head_dim": 8,
+               "query_pre_attn_scalar": 8},
 }
 
 
