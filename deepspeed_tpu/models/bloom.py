@@ -174,6 +174,10 @@ class BloomForCausalLM(nn.Module):
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 
+    def logits(self, batch):
+        return self.model(batch["input_ids"],
+                          positions=batch.get("positions"))
+
 
 def bloom_tensor_rules(path, leaf):
     """TP sharding rules (reference container: qkv column-, dense row-parallel;

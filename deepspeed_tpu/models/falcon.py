@@ -132,6 +132,10 @@ class FalconForCausalLM(nn.Module):
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 
+    def logits(self, batch):
+        return self.model(batch["input_ids"],
+                          positions=batch.get("positions"))
+
 
 def falcon_tensor_rules(path, leaf):
     """TP sharding rules (AutoTP analog) for Falcon params."""

@@ -113,6 +113,10 @@ class GPT2ForCausalLM(nn.Module):
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 
+    def logits(self, batch):
+        return self.model(batch["input_ids"],
+                          positions=batch.get("positions"))
+
 
 def gpt2_tensor_rules(path, leaf):
     from jax.sharding import PartitionSpec
@@ -134,8 +138,13 @@ def convert_hf_gpt2(hf_state, cfg: GPT2Config):
     """HF GPT-2 naming -> our tree. c_attn fuses q|k|v COLUMNS of a Conv1D
     ``[D, 3D]`` (sequential split, not per-head interleave — the layout
     fusedqkv_utils calls 'glmtype' sequential)."""
+    # GPT2LMHeadModel prefixes the backbone with 'transformer.'; bare
+    # GPT2Model dicts don't — accept both
+    pfx = "transformer." if any(k.startswith("transformer.")
+                                for k in hf_state) else ""
+
     def get(name):
-        v = hf_state[name]
+        v = hf_state[pfx + name]
         return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
 
     dmodel, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim_

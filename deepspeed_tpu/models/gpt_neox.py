@@ -153,6 +153,10 @@ class GPTNeoXForCausalLM(nn.Module):
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 
+    def logits(self, batch):
+        return self.model(batch["input_ids"],
+                          positions=batch.get("positions"))
+
 
 def gpt_neox_tensor_rules(path, leaf):
     from jax.sharding import PartitionSpec

@@ -112,6 +112,10 @@ class OPTForCausalLM(nn.Module):
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 
+    def logits(self, batch):
+        return self.model(batch["input_ids"],
+                          positions=batch.get("positions"))
+
 
 def opt_tensor_rules(path, leaf):
     """TP sharding rules for OPT params."""

@@ -42,14 +42,32 @@ def test_gemma2_sliding_layers_restrict_context():
     assert TINY_GEMMA2.is_sliding(2) and not TINY_GEMMA2.is_sliding(3)
 
 
+def test_gemma2_sliding_window_masks_old_context():
+    """Behavioral window check (fast): with identical weights, logits at
+    positions inside the window match a full-attention run, positions past
+    it diverge — the masking path is live, not just the config flag."""
+    import dataclasses
+    cfg = dataclasses.replace(TINY_GEMMA2, dtype=jnp.float32, num_layers=1)
+    assert cfg.is_sliding(0)               # layer 0 slides (window 8)
+    full = dataclasses.replace(cfg, sliding_window=128)
+    model_w, model_f = Gemma2ForCausalLM(cfg), Gemma2ForCausalLM(full)
+    batch = random_tokens(1, 32, vocab_size=512, seed=3)
+    params = model_w.init(jax.random.PRNGKey(0), batch)
+    lw = np.asarray(model_w.apply(params, batch,
+                                  method=Gemma2ForCausalLM.logits))
+    lf = np.asarray(model_f.apply(params, batch,
+                                  method=Gemma2ForCausalLM.logits))
+    np.testing.assert_allclose(lw[:, :8], lf[:, :8], atol=1e-5, rtol=1e-5)
+    assert np.abs(lw[:, 16:] - lf[:, 16:]).max() > 1e-3
+
+
 @pytest.mark.slow
 def test_hf_gemma2_torch_parity():
     import torch
     from transformers import Gemma2Config as HFConfig
     from transformers import Gemma2ForCausalLM as HFModel
 
-    from deepspeed_tpu.models.gemma2 import (convert_hf_gemma2,
-                                             gemma2_config_from_hf)
+    from test_hf_torch_parity import _ids, _parity
 
     hf_cfg = HFConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128,
@@ -60,17 +78,4 @@ def test_hf_gemma2_torch_parity():
         rms_norm_eps=1e-6, rope_theta=10000.0)
     torch.manual_seed(0)
     hf_model = HFModel(hf_cfg).eval()
-
-    import dataclasses
-    cfg = gemma2_config_from_hf(hf_cfg.to_dict())
-    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
-    params = convert_hf_gemma2(hf_model.state_dict(), cfg)
-
-    ids = np.random.default_rng(0).integers(0, 256, size=(2, 32))
-    with torch.no_grad():
-        ref = hf_model(torch.tensor(ids)).logits.numpy()
-    ours = Gemma2ForCausalLM(cfg).apply(
-        {"params": jax.tree.map(jnp.asarray, params)},
-        {"input_ids": jnp.asarray(ids.astype(np.int32))},
-        method=Gemma2ForCausalLM.logits)
-    np.testing.assert_allclose(np.asarray(ours), ref, atol=3e-4, rtol=3e-3)
+    _parity(hf_model, hf_cfg.to_dict(), _ids(256, s=32))
