@@ -18,17 +18,22 @@ torch = pytest.importorskip("torch")
 from deepspeed_tpu.models.hf import from_hf_checkpoint  # noqa: E402
 
 
-def _parity(hf_model, hf_cfg_dict, ids, atol=3e-4, rtol=3e-3):
+def _parity(hf_model, hf_cfg_dict, ids, atol=3e-4, rtol=3e-3,
+            batch=None, ref_fn=None):
+    """Convert + compare logits vs torch. ``batch``/``ref_fn`` override the
+    decoder-only defaults (seq2seq models pass decoder inputs)."""
     model, cfg, params = from_hf_checkpoint(hf_cfg_dict,
                                             hf_model.state_dict())
     # fp32 compute for tight comparison; dtype is shape-preserving so the
     # converted params carry over
     model = type(model)(dataclasses.replace(cfg, dtype=jnp.float32))
     with torch.no_grad():
-        ref = hf_model(torch.tensor(ids)).logits.numpy()
+        ref = ref_fn(hf_model) if ref_fn else \
+            hf_model(torch.tensor(ids)).logits.numpy()
+    if batch is None:
+        batch = {"input_ids": jnp.asarray(ids.astype(np.int32))}
     ours = model.apply({"params": jax.tree.map(jnp.asarray, params)},
-                       {"input_ids": jnp.asarray(ids.astype(np.int32))},
-                       method=type(model).logits)
+                       batch, method=type(model).logits)
     np.testing.assert_allclose(np.asarray(ours), ref, atol=atol, rtol=rtol)
 
 
@@ -95,3 +100,53 @@ def test_hf_falcon_torch_parity():
     torch.manual_seed(0)
     hf_model = FalconForCausalLM(hf_cfg).eval()
     _parity(hf_model, hf_cfg.to_dict(), _ids(256))
+
+
+@pytest.mark.slow
+def test_hf_t5_torch_parity():
+    from transformers import T5Config, T5ForConditionalGeneration
+    hf_cfg = T5Config(vocab_size=256, d_model=64, d_kv=16, d_ff=128,
+                      num_layers=2, num_decoder_layers=2, num_heads=4,
+                      relative_attention_num_buckets=8,
+                      relative_attention_max_distance=32,
+                      dropout_rate=0.0, feed_forward_proj="relu",
+                      tie_word_embeddings=True, decoder_start_token_id=0)
+    torch.manual_seed(0)
+    hf_model = T5ForConditionalGeneration(hf_cfg).eval()
+
+    enc_ids = _ids(256, s=12)
+    dec_ids = _ids(256, s=8, seed=1)
+    _parity(
+        hf_model, hf_cfg.to_dict(), enc_ids,
+        batch={"input_ids": jnp.asarray(enc_ids.astype(np.int32)),
+               "labels": jnp.asarray(dec_ids.astype(np.int32)),
+               "decoder_input_ids": jnp.asarray(dec_ids.astype(np.int32))},
+        ref_fn=lambda m: m(
+            input_ids=torch.tensor(enc_ids),
+            decoder_input_ids=torch.tensor(dec_ids)).logits.numpy())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mt", ["llama", "mistral", "qwen2", "gemma"])
+def test_hf_llama_family_torch_parity(mt):
+    """The flagship families against REAL HF logits (the roundtrip test
+    only proves converter self-consistency). mistral exercises the sliding
+    window, qwen2 the qkv biases, gemma the scaled-embed/tied/gelu path."""
+    import transformers as tf
+    mk = {
+        "llama": (tf.LlamaConfig, tf.LlamaForCausalLM, {}),
+        "mistral": (tf.MistralConfig, tf.MistralForCausalLM,
+                    dict(sliding_window=8)),
+        "qwen2": (tf.Qwen2Config, tf.Qwen2ForCausalLM,
+                  dict(use_sliding_window=False)),
+        "gemma": (tf.GemmaConfig, tf.GemmaForCausalLM,
+                  dict(head_dim=16, hidden_activation="gelu_pytorch_tanh")),
+    }[mt]
+    cfg_cls, model_cls, extra = mk
+    hf_cfg = cfg_cls(vocab_size=256, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=64,
+                     rms_norm_eps=1e-6, attention_dropout=0.0, **extra)
+    torch.manual_seed(0)
+    hf_model = model_cls(hf_cfg).eval()
+    _parity(hf_model, hf_cfg.to_dict(), _ids(256, s=32))
