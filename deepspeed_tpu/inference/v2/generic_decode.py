@@ -36,13 +36,14 @@ def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
 
     cache = cache_data
     for i in range(spec.num_layers):
-        def attend(q, k, v, i=i):
+        def attend(q, k, v, i=i, window="spec", softcap=None):
             nonlocal cache
             cache = cache.at[i, 0, :, tok_block, tok_off].set(k)
             cache = cache.at[i, 1, :, tok_block, tok_off].set(v)
             return _paged_attn(q[None], cache, i, block_table[None],
-                               jnp.asarray(start).reshape(1), spec.window,
-                               attn_impl)[0]
+                               jnp.asarray(start).reshape(1),
+                               spec.window if window == "spec" else window,
+                               attn_impl, softcap=softcap)[0]
         x = policy.block(params, i, x, attend, safe_pos, cfg)
 
     last = x[jnp.maximum(true_len - 1, 0)]
@@ -71,12 +72,13 @@ def decode_step_g(params, cache_data, tokens, positions, block_tables, valid,
 
     cache = cache_data
     for i in range(spec.num_layers):
-        def attend(q, k, v, i=i):
+        def attend(q, k, v, i=i, window="spec", softcap=None):
             nonlocal cache
             cache = cache.at[i, 0, :, blk, off].set(k)
             cache = cache.at[i, 1, :, blk, off].set(v)
             return _paged_attn(q[:, None], cache, i, block_tables, safe_pos,
-                               spec.window, attn_impl)[:, 0]
+                               spec.window if window == "spec" else window,
+                               attn_impl, softcap=softcap)[:, 0]
         x = policy.block(params, i, x, attend, safe_pos, cfg)
 
     logits = policy.unembed(params, x, cfg)

@@ -145,10 +145,8 @@ class LlamaPolicy:
         else:
             logits = x.astype(jnp.float32) @ \
                 params["model"]["lm_head"]["kernel"].astype(jnp.float32)
-        if cfg.logits_soft_cap:   # gemma2 softcap, matching the training head
-            logits = cfg.logits_soft_cap * jnp.tanh(
-                logits / cfg.logits_soft_cap)
-        return logits
+        from deepspeed_tpu.models.llama import softcap_logits
+        return softcap_logits(logits, cfg.logits_soft_cap)
 
 
 # ---------------------------------------------------------------------------
@@ -548,3 +546,61 @@ class Qwen2MoEPolicy:
         x = _rms(x, params["final_norm"]["scale"], cfg.base.rms_norm_eps)
         return x.astype(jnp.float32) @ \
             params["lm_head"]["kernel"].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Gemma-2 (sandwich norms, logit softcaps, alternating sliding/full windows)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.models.gemma2 import Gemma2Config  # noqa: E402
+
+
+@register_policy("gemma2", Gemma2Config)
+class Gemma2Policy:
+    """models/gemma2.py's serving twin. The decoupled attention scale folds
+    into q (kernel and gather both divide by sqrt(d)); the attention-logit
+    softcap routes the per-layer attend through the gather path
+    (llama_decode._paged_attn falls back — in-kernel capping pending);
+    cache_spec keeps the FULL window since odd layers attend globally."""
+
+    @staticmethod
+    def cache_spec(cfg: Gemma2Config) -> KVCacheSpec:
+        return KVCacheSpec(cfg.num_layers, cfg.num_kv_heads, cfg.head_dim,
+                           cfg.max_seq_len, cfg.dtype, None)
+
+    @staticmethod
+    def embed(params, tokens, positions, cfg):
+        x = params["embed"]["embedding"].astype(cfg.dtype)[tokens]
+        return x * jnp.sqrt(jnp.asarray(cfg.hidden_size,
+                                        jnp.float32)).astype(x.dtype)
+
+    @staticmethod
+    def block(params, i, x, attend, positions, cfg):
+        lp = params[f"layer_{i}"]
+        dtype = cfg.dtype
+        eps = cfg.rms_norm_eps
+        cos, sin = _rope_tables(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        h = _rms(x, lp["attn_norm"]["scale"] + 1.0, eps)
+        q, k, v = _qkv(lp, h, dtype)
+        q = _rope_rows(q, cos, sin, positions)
+        k = _rope_rows(k, cos, sin, positions)
+        # fold the decoupled scale: attend divides by sqrt(d), so prescale
+        # by scale*sqrt(d) for a net query_pre_attn_scalar**-0.5
+        q = q * jnp.asarray(cfg.query_pre_attn_scalar ** -0.5 *
+                            np.sqrt(cfg.head_dim), dtype)
+        attn = attend(q, k, v,
+                      window=cfg.sliding_window if cfg.is_sliding(i) else None,
+                      softcap=cfg.attn_logit_softcap)
+        h = jnp.einsum("thk,hkd->td", attn,
+                       lp["attn"]["wo"]["kernel"].astype(dtype))
+        x = x + _rms(h, lp["post_attn_norm"]["scale"] + 1.0, eps)
+        h2 = _rms(x, lp["pre_ffw_norm"]["scale"] + 1.0, eps)
+        m = _mlp(lp, h2, dtype, act="gelu_tanh")
+        return x + _rms(m, lp["post_ffw_norm"]["scale"] + 1.0, eps)
+
+    @staticmethod
+    def unembed(params, x, cfg):
+        x = _rms(x, params["final_norm"]["scale"] + 1.0, cfg.rms_norm_eps)
+        from deepspeed_tpu.models.llama import softcap_logits
+        logits = x.astype(jnp.float32) @ \
+            params["embed"]["embedding"].astype(jnp.float32).T     # tied
+        return softcap_logits(logits, cfg.final_logit_softcap)

@@ -54,7 +54,8 @@ TINY_GEMMA2 = Gemma2Config(
     vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=4,
     num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
     sliding_window=8, attn_logit_softcap=50.0, final_logit_softcap=30.0,
-    query_pre_attn_scalar=16.0)
+    # != head_dim so the serving path's folded scale is a real factor
+    query_pre_attn_scalar=32.0)
 
 
 class Gemma2Attention(nn.Module):
@@ -140,11 +141,9 @@ class Gemma2ForCausalLM(nn.Module):
             x = Gemma2Block(cfg, i, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, scale_offset=True,
                     name="final_norm")(x)
-        logits = embed.attend(x).astype(jnp.float32)
-        if cfg.final_logit_softcap:
-            logits = cfg.final_logit_softcap * jnp.tanh(
-                logits / cfg.final_logit_softcap)
-        return logits
+        from deepspeed_tpu.models.llama import softcap_logits
+        return softcap_logits(embed.attend(x).astype(jnp.float32),
+                              cfg.final_logit_softcap)
 
     @property
     def config(self):

@@ -159,6 +159,12 @@ def apply_rope(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
+def softcap_logits(x, cap):
+    """tanh soft-capping (gemma2): identity when cap is falsy. The single
+    definition shared by training attention, serving paths, and heads."""
+    return cap * jnp.tanh(x / cap) if cap else x
+
+
 def _xla_attention(q, k, v, causal: bool = True, segment_ids=None, window=None,
                    scale=None, softcap=None):
     """Plain attention; XLA fuses softmax chain. q,k,v: [B, S, H, D] / kv
@@ -174,8 +180,7 @@ def _xla_attention(q, k, v, causal: bool = True, segment_ids=None, window=None,
         v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * \
         (scale if scale is not None else 1.0 / np.sqrt(d))
-    if softcap:
-        scores = softcap * jnp.tanh(scores / softcap)
+    scores = softcap_logits(scores, softcap)
     sk = k.shape[1]
     if causal or window is not None:
         qpos = jnp.arange(sq)[:, None] + (sk - sq)

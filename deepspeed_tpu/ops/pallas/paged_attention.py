@@ -34,7 +34,7 @@ NEG_INF = -1e30
 
 def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, block_size, num_pages, chunk, rep,
-                  window):
+                  window, softcap):
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -54,6 +54,8 @@ def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * (1.0 / np.sqrt(q.shape[-1]))
+        if softcap:                        # gemma2 attn_logit_softcapping
+            s = softcap * jnp.tanh(s / softcap)
         # row r of the fold is (q-head r // chunk, chunk token r % chunk)
         row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         qpos = start + row % chunk
@@ -84,7 +86,7 @@ def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, start_pos,
-                    window=None, interpret: bool = False):
+                    window=None, softcap=None, interpret: bool = False):
     """q: [B, T, H, d] (T=1 decode / B=1 prefill chunk);
     k_pages/v_pages: [Hkv, NB, block_size, d]; block_tables: [B, MB] int32
     (trash-padded); start_pos: [B] int32 — global position of q row t=0
@@ -127,7 +129,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, start_pos,
     )
     out = pl.pallas_call(
         functools.partial(_paged_kernel, block_size=bs, num_pages=mb,
-                          chunk=t, rep=rep, window=window),
+                          chunk=t, rep=rep, window=window, softcap=softcap),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
         interpret=interpret,
@@ -139,9 +141,10 @@ def paged_attention(q, k_pages, v_pages, block_tables, start_pos,
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, start_pos,
-                              window=None):
+                              window=None, softcap=None):
     """Gather-based jnp reference with identical semantics (numerics oracle for
-    kernel tests; also the CPU fallback path)."""
+    kernel tests; also the CPU fallback path). ``softcap`` tanh-caps the
+    scaled logits before masking (gemma2 attn_logit_softcapping)."""
     b, t, h, d = q.shape
     hkv, _, bs, _ = k_pages.shape
     rep = h // hkv
@@ -156,6 +159,8 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, start_pos,
         ctx_v = jnp.repeat(ctx_v, rep, axis=2)
     s = jnp.einsum("bthd,bkhd->bhtk", q, ctx_k,
                    preferred_element_type=jnp.float32) / np.sqrt(d)
+    from deepspeed_tpu.models.llama import softcap_logits
+    s = softcap_logits(s, softcap)
     qpos = start_pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
     kpos = jnp.arange(mb * bs)[None, None, :]
     mask = kpos <= qpos[..., None]

@@ -72,10 +72,32 @@ def test_hf_gemma2_torch_parity():
     hf_cfg = HFConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
-        head_dim=16, query_pre_attn_scalar=16,
+        head_dim=16, query_pre_attn_scalar=32,
         attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
         sliding_window=8, max_position_embeddings=128,
         rms_norm_eps=1e-6, rope_theta=10000.0)
     torch.manual_seed(0)
     hf_model = HFModel(hf_cfg).eval()
     _parity(hf_model, hf_cfg.to_dict(), _ids(256, s=32))
+
+
+@pytest.mark.slow
+def test_serve_gemma2():
+    """Paged serving parity for gemma2 (sandwich norms, folded attention
+    scale, per-layer windows + logit softcap through the generic loop)."""
+    import dataclasses
+
+    from test_v2_multiarch import _serve_and_reference
+
+    cfg = dataclasses.replace(TINY_GEMMA2, dtype=jnp.float32)
+    model = Gemma2ForCausalLM(cfg)
+    prompt = list(np.random.default_rng(4).integers(0, cfg.vocab_size, 12))
+    params = model.init(jax.random.PRNGKey(0),
+                        random_tokens(1, 8, vocab_size=cfg.vocab_size)
+                        )["params"]
+    _serve_and_reference(
+        model, params, cfg,
+        lambda b: model.apply({"params": params},
+                              {"input_ids": jnp.asarray(b["input_ids"])},
+                              method=Gemma2ForCausalLM.logits),
+        prompt)

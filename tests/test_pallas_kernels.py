@@ -151,8 +151,9 @@ def test_quantized_all_gather(mesh_dp8):
     assert rel.max() < 0.02  # int8 quantization error bound
 
 
-@pytest.mark.parametrize("window", [None, 24])
-def test_paged_attention_kernel(window):
+@pytest.mark.parametrize("window,softcap", [(None, None), (24, None),
+                                            (None, 20.0)])
+def test_paged_attention_kernel(window, softcap):
     """Paged decode/prefill kernel vs gather reference (GQA, ragged lengths,
     trash-padded tables, sliding window)."""
     from deepspeed_tpu.ops.pallas.paged_attention import (
@@ -166,8 +167,9 @@ def test_paged_attention_kernel(window):
     tables = jnp.asarray(rng.permutation(nb - 1)[:12].reshape(3, 4), jnp.int32)
     start = jnp.asarray([37, 5, 63], jnp.int32)
     out_k = paged_attention(q, kp, vp, tables, start, window=window,
-                            interpret=True)
-    out_r = paged_attention_reference(q, kp, vp, tables, start, window=window)
+                            softcap=softcap, interpret=True)
+    out_r = paged_attention_reference(q, kp, vp, tables, start, window=window,
+                                      softcap=softcap)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
                                atol=2e-5, rtol=2e-5)
     # prefill chunk: B=1, T=24 at offset 16
@@ -175,8 +177,9 @@ def test_paged_attention_kernel(window):
     tables = jnp.asarray([[3, 7, 1, 9]], jnp.int32)
     start = jnp.asarray([16], jnp.int32)
     out_k = paged_attention(q, kp, vp, tables, start, window=window,
-                            interpret=True)
-    out_r = paged_attention_reference(q, kp, vp, tables, start, window=window)
+                            softcap=softcap, interpret=True)
+    out_r = paged_attention_reference(q, kp, vp, tables, start, window=window,
+                                      softcap=softcap)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
                                atol=2e-5, rtol=2e-5)
 
