@@ -112,6 +112,7 @@ def test_ulysses_uneven_heads(sp_mesh, h, hkv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_train_llama_with_ring_attention():
     """End-to-end: Llama trains under sequence parallelism with ring attention."""
     import deepspeed_tpu
@@ -131,6 +132,7 @@ def test_train_llama_with_ring_attention():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_distributed_attention_api_compat(sp_mesh):
     """DistributedAttention (reference sequence/layer.py:271): wraps a
     user-supplied local attention; output matches full-sequence reference."""

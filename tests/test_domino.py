@@ -89,6 +89,7 @@ def test_domino_overlap_wrapper_and_chunk_errors():
         pass
 
 
+@pytest.mark.slow
 def test_domino_chunking_multiplies_schedulable_collectives():
     """The overlap claim's structural half, checkable without hardware: the
     n-chunk layer's lowered module carries n independent per-chunk

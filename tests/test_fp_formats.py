@@ -46,6 +46,7 @@ def test_group_quantize_roundtrip_and_packing(fmt, bound, bytes_per_256):
                                   np.asarray(y)[np.asarray(rows)])
 
 
+@pytest.mark.slow
 def test_fp_quantizer_dispatch_bits():
     """FP_Quantize-parity shim: q_bits 6/8/12 all roundtrip within their
     mantissa error bounds, tighter with more bits."""

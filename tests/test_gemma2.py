@@ -18,6 +18,7 @@ from deepspeed_tpu.models.gemma2 import (TINY_GEMMA2, Gemma2ForCausalLM,
 from deepspeed_tpu.models.llama import random_tokens
 
 
+@pytest.mark.slow
 def test_gemma2_trains():
     mesh = create_mesh(MeshConfig(data=2, fsdp=4))
     set_global_mesh(mesh)

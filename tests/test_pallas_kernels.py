@@ -328,6 +328,7 @@ def test_quantized_psum_grad(mesh_dp8):
     assert rel < 0.03, rel   # identical up to int8 fwd rounding in g_ref's y
 
 
+@pytest.mark.slow
 def test_quantized_psum_grad_two_axes():
     """Same convention guard over TWO manual axes (the MoE dispatch path
     reduces over composed batch axes): bwd scaling must be 1/(w1*w2)."""

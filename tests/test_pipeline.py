@@ -358,6 +358,7 @@ def test_llama_pipe_module_via_initialize(flavor, tmp_path):
     assert fresh.global_steps == 3
 
 
+@pytest.mark.slow
 def test_pipe_to_dense_cross_topology_restore():
     """A PP run's weights consolidate back into the dense model tree and
     load into a ZeRO-3 engine with matching loss (the universal-checkpoint
