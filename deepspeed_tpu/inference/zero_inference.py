@@ -212,13 +212,26 @@ class ZeROInferenceEngine:
 
     def _block_fn(self):
         if getattr(self, "_block_jit", None) is None:
+            kv_block = self._block_kv_fn()
+            self._block_jit = jax.jit(
+                lambda lp, x, positions: kv_block(lp, x, positions)[0])
+        return self._block_jit
+
+    def _block_kv_fn(self):
+        """Prefill block that also RETURNS the layer's K/V (host KV-offload
+        generation: reference ZeRO-Inference keeps the KV cache off-device
+        so decode is incremental instead of full-context recompute)."""
+        if getattr(self, "_block_kv_jit", None) is None:
             cfg = self.cfg
 
             def block(lp, x, positions):
-                from deepspeed_tpu.inference.v2.llama_decode import _mlp, _qkv, _rms
-                from deepspeed_tpu.models.llama import _xla_attention
-                cos, sin = rope_freqs(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta)
-                from deepspeed_tpu.models.llama import apply_rope
+                from deepspeed_tpu.inference.v2.llama_decode import (_mlp,
+                                                                     _qkv,
+                                                                     _rms)
+                from deepspeed_tpu.models.llama import (_xla_attention,
+                                                        apply_rope)
+                cos, sin = rope_freqs(cfg.head_dim_, cfg.max_seq_len,
+                                      cfg.rope_theta)
                 off = 1.0 if getattr(cfg, "rms_scale_offset", False) else 0.0
                 h = _rms(x, lp["attn_norm"]["scale"] + off, cfg.rms_norm_eps)
                 b, s, d = h.shape
@@ -226,18 +239,74 @@ class ZeROInferenceEngine:
                 q = q.reshape(b, s, *q.shape[1:])
                 k = k.reshape(b, s, *k.shape[1:])
                 v = v.reshape(b, s, *v.shape[1:])
-                q = apply_rope(q, jnp.asarray(cos), jnp.asarray(sin), positions)
-                k = apply_rope(k, jnp.asarray(cos), jnp.asarray(sin), positions)
+                q = apply_rope(q, jnp.asarray(cos), jnp.asarray(sin),
+                               positions)
+                k = apply_rope(k, jnp.asarray(cos), jnp.asarray(sin),
+                               positions)
                 attn = _xla_attention(q, k, v, causal=True,
                                       window=cfg.sliding_window)
                 out = jnp.einsum("bshk,hkd->bsd", attn,
                                  lp["attn"]["wo"]["kernel"].astype(self.dtype))
                 x = x + out
                 h2 = _rms(x, lp["mlp_norm"]["scale"] + off, cfg.rms_norm_eps)
-                return x + _mlp(lp, h2, self.dtype,
-                                act=getattr(cfg, "hidden_act", "silu"))
-            self._block_jit = jax.jit(block)
-        return self._block_jit
+                x = x + _mlp(lp, h2, self.dtype,
+                             act=getattr(cfg, "hidden_act", "silu"))
+                return x, k, v
+            self._block_kv_jit = jax.jit(block)
+        return self._block_kv_jit
+
+    def _block_decode_fn(self):
+        """Single-token block against a fixed-capacity KV buffer: writes the
+        new token's K/V at ``ctx_len`` and attends over positions
+        ``<= ctx_len``. Capacity-stable shapes mean ONE compile per bucket
+        size (the buffer doubles as the context grows), not one per step."""
+        if getattr(self, "_block_dec_jit", None) is None:
+            cfg = self.cfg
+
+            def block(lp, x, pos, k_buf, v_buf, ctx_len):
+                from deepspeed_tpu.inference.v2.llama_decode import (_mlp,
+                                                                     _qkv,
+                                                                     _rms)
+                from deepspeed_tpu.models.llama import apply_rope
+                cos, sin = rope_freqs(cfg.head_dim_, cfg.max_seq_len,
+                                      cfg.rope_theta)
+                off = 1.0 if getattr(cfg, "rms_scale_offset", False) else 0.0
+                h = _rms(x, lp["attn_norm"]["scale"] + off, cfg.rms_norm_eps)
+                b, s, d = h.shape                      # s == 1
+                q, k, v = _qkv(lp, h.reshape(b * s, d), self.dtype)
+                q = apply_rope(q.reshape(b, s, *q.shape[1:]),
+                               jnp.asarray(cos), jnp.asarray(sin), pos)
+                k = apply_rope(k.reshape(b, s, *k.shape[1:]),
+                               jnp.asarray(cos), jnp.asarray(sin), pos)
+                v = v.reshape(b, s, *v.shape[1:])
+                k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k,
+                                                            ctx_len, axis=1)
+                v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v,
+                                                            ctx_len, axis=1)
+                # buffer index == absolute position; visible iff <= ctx_len
+                hq, hkv = q.shape[2], k_buf.shape[2]
+                kq = jnp.repeat(k_buf, hq // hkv, 2) if hq != hkv else k_buf
+                vq = jnp.repeat(v_buf, hq // hkv, 2) if hq != hkv else v_buf
+                s_ = jnp.einsum("bqhd,bkhd->bhqk", q, kq,
+                                preferred_element_type=jnp.float32
+                                ) / np.sqrt(q.shape[-1])
+                kpos = jnp.arange(k_buf.shape[1])[None, None, None, :]
+                mask = kpos <= ctx_len
+                if cfg.sliding_window:
+                    mask = jnp.logical_and(
+                        mask, kpos > ctx_len - cfg.sliding_window)
+                s_ = jnp.where(mask, s_, -1e30)
+                p = jax.nn.softmax(s_, axis=-1).astype(vq.dtype)
+                attn = jnp.einsum("bhqk,bkhd->bqhd", p, vq)
+                out = jnp.einsum("bshk,hkd->bsd", attn,
+                                 lp["attn"]["wo"]["kernel"].astype(self.dtype))
+                x = x + out
+                h2 = _rms(x, lp["mlp_norm"]["scale"] + off, cfg.rms_norm_eps)
+                x = x + _mlp(lp, h2, self.dtype,
+                             act=getattr(cfg, "hidden_act", "silu"))
+                return x, k_buf, v_buf
+            self._block_dec_jit = jax.jit(block)
+        return self._block_dec_jit
 
     def _head_fn(self):
         if getattr(self, "_head_jit", None) is None:
@@ -264,19 +333,97 @@ class ZeROInferenceEngine:
     def generate(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32
                  ) -> List[int]:
         """Greedy generation. Resident mode uses the FastGen paged engine over
-        the dequantized-on-the-fly weights; offload mode re-forwards the full
-        context through the streamed path per token (throughput mode — the
-        reference's ZeRO-Inference similarly trades latency for fitting)."""
+        the dequantized-on-the-fly weights; offload mode streams layer
+        weights AND a host-offloaded KV cache per step (reference
+        ZeRO-Inference KV offload) so decode is incremental."""
         if self.offload == "none" and self.cfg is not None:
             from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
             deq = jax.jit(lambda q: dequantize_model_params(q, self.dtype))(self.qstore)
             return InferenceEngineV2(deq, self.cfg).generate(
                 list(prompt_tokens), max_new_tokens=max_new_tokens)
-        ids = list(prompt_tokens)
-        out = []
-        for _ in range(max_new_tokens):
-            logits = self._streamed_forward({"input_ids": np.asarray([ids])})
-            nxt = int(jnp.argmax(logits[0, -1]))
-            out.append(nxt)
-            ids.append(nxt)
+        return self._streamed_generate(list(prompt_tokens), max_new_tokens)
+
+    def _streamed_generate(self, ids: List[int], max_new_tokens: int
+                           ) -> List[int]:
+        """Layer-streamed generation with a HOST-offloaded KV cache
+        (reference: ZeRO-Inference's KV offload — the cache lives off the
+        accelerator and streams in per layer per step). KV buffers are
+        padded to power-of-2 buckets so the decode block compiles once per
+        bucket size, not once per step."""
+        cfg = self.cfg
+        if cfg is None:
+            raise ValueError("streamed generation needs a LlamaConfig-style "
+                             "model config")
+        if max_new_tokens <= 0:
+            return []
+        m = self.qstore["model"]
+        embed = dequantize_model_params(jax.device_put(m["embed"]),
+                                        self.dtype)
+        scale_emb = jnp.sqrt(jnp.asarray(cfg.hidden_size, jnp.float32)) \
+            if getattr(cfg, "scale_embeddings", False) else None
+
+        def embed_tokens(tok_ids):
+            x = embed["embedding"][jnp.asarray(tok_ids)]
+            return x * scale_emb.astype(x.dtype) if scale_emb is not None \
+                else x
+
+        def bucket(n):
+            return 1 << max(4, (n - 1).bit_length())
+
+        # prefill: stream layers once over the prompt, parking each layer's
+        # K/V on the host in bucket-padded buffers
+        block_kv = self._block_kv_fn()
+        x = embed_tokens(np.asarray([ids]))
+        positions = jnp.arange(len(ids))[None, :]
+        cap = bucket(len(ids) + max_new_tokens // 2)
+        host_kv = []
+        nxt_w = jax.device_put(m["layer_0"])
+        for i in range(cfg.num_layers):
+            cur = nxt_w
+            if i + 1 < cfg.num_layers:
+                nxt_w = jax.device_put(m[f"layer_{i + 1}"])
+            x, k, v = block_kv(dequantize_model_params(cur, self.dtype),
+                               x, positions)
+            k, v = np.asarray(k), np.asarray(v)
+            pad = ((0, 0), (0, cap - k.shape[1]), (0, 0), (0, 0))
+            host_kv.append((np.pad(k, pad), np.pad(v, pad)))
+
+        tail = dequantize_model_params(jax.device_put(
+            {"final_norm": m["final_norm"],
+             **({"lm_head": m["lm_head"]} if "lm_head" in m else {})}),
+            self.dtype)
+        head = self._head_fn()
+        logits = head(tail, embed, x)
+        out = [int(jnp.argmax(logits[0, -1]))]
+        ids = ids + out[-1:]
+
+        # decode: per token, per layer — stream the layer weights AND that
+        # layer's host KV buffer; the block writes the new K/V in place
+        block_dec = self._block_decode_fn()
+        for _ in range(max_new_tokens - 1):
+            ctx_len = len(ids) - 1             # new token's write index
+            if ctx_len + 1 > cap:              # grow the bucket
+                new_cap = bucket(ctx_len + 1)
+                host_kv = [(np.pad(k, ((0, 0), (0, new_cap - cap), (0, 0),
+                                       (0, 0))),
+                            np.pad(v, ((0, 0), (0, new_cap - cap), (0, 0),
+                                       (0, 0))))
+                           for k, v in host_kv]
+                cap = new_cap
+            pos = jnp.asarray([[ctx_len]])
+            x = embed_tokens(np.asarray([[ids[-1]]]))
+            nxt_w = jax.device_put(m["layer_0"])
+            for i in range(cfg.num_layers):
+                cur = nxt_w
+                if i + 1 < cfg.num_layers:
+                    nxt_w = jax.device_put(m[f"layer_{i + 1}"])
+                k_buf, v_buf = host_kv[i]
+                x, k_buf, v_buf = block_dec(
+                    dequantize_model_params(cur, self.dtype), x, pos,
+                    jax.device_put(k_buf), jax.device_put(v_buf),
+                    jnp.int32(ctx_len))
+                host_kv[i] = (np.asarray(k_buf), np.asarray(v_buf))
+            logits = head(tail, embed, x)
+            out.append(int(jnp.argmax(logits[0, -1])))
+            ids.append(out[-1])
         return out

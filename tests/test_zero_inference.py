@@ -159,3 +159,18 @@ def test_fp_weight_quantization_forward_close(tiny_model):
     out = eng.forward(batch)
     rel = float(jnp.abs(out - ref).max() / (jnp.abs(ref).max() + 1e-9))
     assert rel < 0.05, rel
+
+
+@pytest.mark.slow
+def test_streamed_generate_uses_host_kv_cache(tiny_model):
+    """Offload-mode generation decodes incrementally against the
+    host-offloaded KV cache (reference ZeRO-Inference KV offload) and
+    matches the resident paged engine's greedy ids exactly."""
+    cfg, model, params = tiny_model
+    prompt = list(np.random.default_rng(5).integers(0, cfg.vocab_size, 10))
+    res = ZeROInferenceEngine(model, params, cfg, dtype=jnp.float32)
+    off = ZeROInferenceEngine(model, params, cfg, offload="cpu",
+                              dtype=jnp.float32)
+    g_res = res.generate(prompt, max_new_tokens=6)
+    g_off = off.generate(prompt, max_new_tokens=6)
+    assert g_res == g_off, (g_res, g_off)
