@@ -198,16 +198,18 @@ def _xla_attention(q, k, v, causal: bool = True, segment_ids=None, window=None,
 
 def _dispatch_attention(backend: str, q, k, v, causal=True, segment_ids=None,
                         mesh=None, window=None):
-    if window is not None:
-        # sliding window: explicit mask on the XLA path (window support in the
-        # flash/SP kernels is a kernel-side TODO)
+    if window is not None and backend != "flash":
+        # sliding window: explicit mask on the XLA path (the SP backends
+        # don't support it; the flash kernel does, with block skipping)
         return _xla_attention(q, k, v, causal, segment_ids, window=window)
     if backend == "xla":
         return _xla_attention(q, k, v, causal, segment_ids)
     if backend == "flash":
-        # Pallas kernel on TPU, blockwise lax fallback elsewhere
+        if segment_ids is not None:
+            # packed-sequence masks are an XLA-path feature
+            return _xla_attention(q, k, v, causal, segment_ids, window=window)
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_auto
-        return flash_attention_auto(q, k, v, causal=causal)
+        return flash_attention_auto(q, k, v, causal=causal, window=window)
     if backend == "ulysses":
         from deepspeed_tpu.sequence.ulysses import ulysses_attention
         return ulysses_attention(q, k, v, causal=causal)

@@ -100,15 +100,20 @@ def flash_attention(q, k, v, causal: bool = True, segment_ids=None,
     return out[:, :sq].astype(q.dtype)
 
 
-def attention_reference(q, k, v, causal: bool = True):
+def attention_reference(q, k, v, causal: bool = True, window=None):
     """Naive O(S^2)-memory reference for kernel tests (analog of the torch
-    reference implementations in tests/unit/ops)."""
+    reference implementations in tests/unit/ops). ``window`` masks to the
+    band (t-window, t] — a window implies causal banding (mistral)."""
     b, sq, h, d = q.shape
     k, v = _repeat_kv(k, v, h)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
-    if causal:
+    if causal or window is not None:
         sk = k.shape[1]
         qpos = jnp.arange(sq)[:, None] + (sk - sq)
-        s = jnp.where((qpos >= jnp.arange(sk)[None, :])[None, None], s, NEG_INF)
+        kpos = jnp.arange(sk)[None, :]
+        mask = qpos >= kpos
+        if window is not None:
+            mask = jnp.logical_and(mask, kpos > qpos - window)
+        s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)

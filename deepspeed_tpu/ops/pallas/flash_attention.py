@@ -39,22 +39,41 @@ NEG_INF = -1e30
 
 
 def _masked_scores(q, k, qi, ki, *, sm_scale, causal, block_q, block_k,
-                   seq_len_k):
+                   seq_len_k, window=None):
     """Shared score-panel + mask construction for the forward and both backward
     kernels — keeps their masking numerically locked together. Returns
-    (s[bq,bk] fp32 scores, mask[bq,bk] bool: kv-padding AND causal)."""
+    (s[bq,bk] fp32 scores, mask[bq,bk] bool: kv-padding AND causal AND
+    mistral-style sliding ``window``: token t sees (t-window, t])."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
     qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     mask = kpos < seq_len_k
-    if causal:
+    if causal or window is not None:
+        # a window implies the causal band (t-window, t] — same contract as
+        # attention_reference/_xla_attention
         mask = jnp.logical_and(mask, qpos >= kpos)
+    if window is not None:
+        mask = jnp.logical_and(mask, kpos > qpos - window)
     return s, mask
 
 
+def _block_live(qi, ki, *, causal, block_q, block_k, window):
+    """Whether a [block_q, block_k] panel can contain any unmasked entry —
+    the pl.when skip shared by all three kernels: blocks entirely above the
+    causal diagonal AND blocks entirely below the sliding window are dead."""
+    live = None
+    if causal or window is not None:   # window implies the causal band
+        live = ki * block_k <= qi * block_q + block_q - 1
+    if window is not None:
+        w_live = (ki + 1) * block_k - 1 > qi * block_q - window
+        live = jnp.logical_and(live, w_live)
+    return live
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                  sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k):
+                  sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k,
+                  window=None):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -70,7 +89,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         v = v_ref[0]
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 seq_len_k=seq_len_k)
+                                 seq_len_k=seq_len_k, window=window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]                  # [block_q, 1]
@@ -85,11 +104,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = l_new
         acc_scr[:] = acc
 
-    if causal:
-        # skip blocks entirely above the diagonal (all-masked → no-op)
-        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
-    else:
+    live = _block_live(qi, ki, causal=causal, block_q=block_q,
+                       block_k=block_k, window=window)
+    if live is None:
         _compute()
+    else:
+        pl.when(live)(_compute)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
@@ -114,7 +134,7 @@ def _unfold(x, b, h, s):
 
 
 def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
-                           interpret: bool):
+                           interpret: bool, window=None):
     """q: [B, Sq, H, D]; k,v: [B, Sk, Hkv, D] -> (out, lse[B*H, Sq_padded])."""
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
@@ -131,7 +151,7 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len_k=sk),
+                          seq_len_k=sk, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -162,7 +182,8 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
-               sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k):
+               sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k,
+               window=None):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -176,7 +197,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
         delta = delta_ref[0]               # [block_q, 1]
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 seq_len_k=seq_len_k)
+                                 seq_len_k=seq_len_k, window=window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -185,10 +206,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
-    else:
+    live = _block_live(qi, ki, causal=causal, block_q=block_q,
+                       block_k=block_k, window=window)
+    if live is None:
         _compute()
+    else:
+        pl.when(live)(_compute)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
@@ -197,7 +220,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k,
-                num_q_blocks, num_q_steps, seq_len_k):
+                num_q_blocks, num_q_steps, seq_len_k, window=None):
     j = pl.program_id(2)                   # folded (group, q_block) index
     ki = pl.program_id(1)
     qi = j % num_q_blocks
@@ -213,7 +236,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         delta = delta_ref[0]
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 seq_len_k=seq_len_k)
+                                 seq_len_k=seq_len_k, window=window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -225,10 +248,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
-    else:
+    live = _block_live(qi, ki, causal=causal, block_q=block_q,
+                       block_k=block_k, window=window)
+    if live is None:
         _compute()
+    else:
+        pl.when(live)(_compute)
 
     @pl.when(j == num_q_steps - 1)
     def _finalize():
@@ -237,7 +262,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
-                           interpret):
+                           interpret, window=None):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     rep = h // hkv
@@ -257,7 +282,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len_k=sk),
+                          seq_len_k=sk, window=window),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -280,7 +305,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          num_q_steps=nsteps, seq_len_k=sk),
+                          num_q_steps=nsteps, seq_len_k=sk, window=window),
         grid=(b * hkv, nk, nsteps),
         in_specs=[
             pl.BlockSpec((1, block_q, d),
@@ -317,31 +342,35 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
             _unfold(dv, b, hkv, sk))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
-                           block_k: int = 256, interpret: bool = False):
+                           block_k: int = 256, interpret: bool = False,
+                           window=None):
     """Flash attention with Pallas forward and backward kernels.
-    ``interpret=True`` runs the kernels in interpreter mode (CPU CI)."""
-    out, _ = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    ``interpret=True`` runs the kernels in interpreter mode (CPU CI);
+    ``window`` adds mistral-style sliding-window masking with below-window
+    block skipping (long-context windowed cost is O(S*window))."""
+    out, _ = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                                    interpret, window)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
     out, lse = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k,
-                                      interpret)
+                                      interpret, window)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, window, res, g):
     q, k, v, out, lse = res
     return _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q,
-                                  block_k, interpret)
+                                  block_k, interpret, window)
 
 
 pallas_flash_attention.defvjp(_fwd, _bwd)
 
 
-def flash_attention_auto(q, k, v, causal: bool = True):
+def flash_attention_auto(q, k, v, causal: bool = True, window=None):
     """Dispatch: Pallas kernel on TPU, interpret/blockwise elsewhere."""
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -351,6 +380,11 @@ def flash_attention_auto(q, k, v, causal: bool = True):
         d = q.shape[-1]
         for blk in ((1024, 512, 256) if d <= 128 else (512, 256)):
             if q.shape[1] % blk == 0 and k.shape[1] % blk == 0:
-                return pallas_flash_attention(q, k, v, causal, blk, blk)
-        return pallas_flash_attention(q, k, v, causal, 256, 256)
+                return pallas_flash_attention(q, k, v, causal, blk, blk,
+                                              False, window)
+        return pallas_flash_attention(q, k, v, causal, 256, 256, False,
+                                      window)
+    if window is not None:
+        from deepspeed_tpu.ops.flash_attention import attention_reference
+        return attention_reference(q, k, v, causal=causal, window=window)
     return blockwise_reference(q, k, v, causal=causal)

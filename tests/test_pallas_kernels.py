@@ -351,3 +351,32 @@ def test_quantized_psum_grad_two_axes():
     rel = np.abs(np.asarray(g_q) - np.asarray(g_ref)).max() / \
         np.abs(np.asarray(g_ref)).max()
     assert rel < 0.03, rel
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("window", [16, 40])
+def test_pallas_flash_sliding_window(window):
+    """Sliding-window masking in the flash fwd + both backward kernels
+    (mistral-style training on the kernel path; below-window blocks are
+    skipped like above-diagonal ones). GQA + unaligned seq included."""
+    from deepspeed_tpu.models.llama import _xla_attention
+    q, k, v = qkv(s=100, h=8, hkv=2)
+
+    out = pallas_flash_attention(q, k, v, True, 32, 32, True, window)
+    ref = _xla_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def lp(q, k, v):
+        return jnp.sum(
+            pallas_flash_attention(q, k, v, True, 32, 32, True, window) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=True,
+                                      window=window) ** 2)
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
