@@ -43,7 +43,11 @@ def resolve_checkpoint_dir(path: str, tag: Optional[str] = None) -> str:
         if not os.path.isdir(tagged):
             raise FileNotFoundError(f"no checkpoint with tag {tag!r} under {path}")
         return tagged
-    if os.path.exists(os.path.join(path, "ds_meta.json")):
+    if (os.path.exists(os.path.join(path, "ds_meta.json"))
+            or os.path.exists(os.path.join(path, "_METADATA"))
+            or os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))):
+        # a checkpoint dir itself: engine saves carry ds_meta.json; bare
+        # orbax saves (e.g. PipelineEngine) are recognized by orbax markers
         return path
     latest = os.path.join(path, LATEST_FILE)
     if os.path.exists(latest):
@@ -59,6 +63,19 @@ def _restore_host(ckpt_dir: str) -> Dict[str, Any]:
     restored = ckptr.restore(ckpt_dir)
     ckptr.close()
     return restored
+
+
+def _module_subtree(tree: Any) -> Any:
+    """The module-parameter subtree of a composite checkpoint: the main
+    engine stores it under 'params'; PipelineEngine stores stage-stacked
+    'staged' + tied 'tied'."""
+    if not isinstance(tree, dict):
+        return {}
+    if "params" in tree:
+        return tree["params"]
+    if "staged" in tree or "tied" in tree:
+        return {k: tree[k] for k in ("staged", "tied") if k in tree}
+    return {}
 
 
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
@@ -91,8 +108,8 @@ def inspect_checkpoint(path: str, tag: Optional[str] = None) -> Dict[str, Any]:
     finally:
         ckptr.close()
     item = getattr(tree_meta, "item_metadata", tree_meta)
-    params_meta = _flatten_meta(item.get("params", {}) if isinstance(item, dict)
-                                else getattr(item, "tree", {}).get("params", {}))
+    tree = item if isinstance(item, dict) else getattr(item, "tree", {})
+    params_meta = _flatten_meta(_module_subtree(tree))
     total = int(sum(int(np.prod(m["shape"])) for m in params_meta.values()))
     return {
         "checkpoint": ckpt_dir,
@@ -130,7 +147,7 @@ def consolidate_to_fp32(path: str, output: str, tag: Optional[str] = None,
     restored = _restore_host(ckpt_dir)
     arrays = {f"params/{k}": v.astype(np.float32)
               if np.issubdtype(v.dtype, np.floating) else v
-              for k, v in _flatten(restored.get("params", {})).items()}
+              for k, v in _flatten(_module_subtree(restored)).items()}
     if include_optimizer:
         arrays.update({f"opt_state/{k}": v for k, v in
                        _flatten(restored.get("opt_state", {})).items()})
@@ -153,7 +170,7 @@ def extract_param(path: str, param_name: str, tag: Optional[str] = None) -> np.n
         close = [k for k in known if param_name in k]
         raise KeyError(f"param {param_name!r} not in checkpoint; "
                        f"closest: {close[:5]}")
-    return _flatten(_restore_host(ckpt_dir).get("params", {}))[param_name]
+    return _flatten(_module_subtree(_restore_host(ckpt_dir)))[param_name]
 
 
 def load_fp32_state(npz_path: str) -> Dict[str, np.ndarray]:
