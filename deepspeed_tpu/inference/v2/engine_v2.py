@@ -48,12 +48,25 @@ class V2EngineConfig:
     # attention implementation: auto (Pallas kernel on TPU, gather elsewhere),
     # kernel, kernel_interpret, gather — see llama_decode._paged_attn
     attn_impl: str = "auto"
+    # KV page dtype: "model" stores pages in the model compute dtype; "fp8"
+    # stores float8_e4m3 pages — HALF the KV memory vs bf16 (2x capacity:
+    # bigger batches / longer contexts), dequantized on load inside both
+    # attention paths
+    kv_cache_dtype: str = "model"
 
 
 class InferenceEngineV2:
     """Serves any registered arch (llama family incl. mistral/qwen2/phi3,
     falcon, opt, mixtral) — the policy registry picks the decode implementation
     from the model config type (reference: engine_factory + heuristics)."""
+
+    def _page_dtype(self, spec):
+        kinds = {"model": spec.dtype, "fp8": jnp.float8_e4m3fn}
+        kvd = self.config.kv_cache_dtype
+        if kvd not in kinds:
+            raise ValueError(f"unknown kv_cache_dtype {kvd!r}; one of "
+                             f"{sorted(kinds)}")
+        return kinds[kvd]
 
     def __init__(self, params, model_config,
                  config: Optional[V2EngineConfig] = None):
@@ -68,7 +81,7 @@ class InferenceEngineV2:
             head_dim=spec.head_dim,
             block_size=self.config.kv_block_size,
             num_blocks=self.config.kv_num_blocks,
-            dtype=spec.dtype))
+            dtype=self._page_dtype(spec)))
         self.state = StateManager(
             max_tracked_sequences=self.config.max_tracked_sequences,
             max_context_length=spec.max_seq_len)

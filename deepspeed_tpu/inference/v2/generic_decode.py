@@ -12,6 +12,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.inference.v2.kv_cache import cast_to_page_dtype
 from deepspeed_tpu.inference.v2.llama_decode import _paged_attn
 
 
@@ -38,8 +39,10 @@ def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
     for i in range(spec.num_layers):
         def attend(q, k, v, i=i, window="spec", softcap=None):
             nonlocal cache
-            cache = cache.at[i, 0, :, tok_block, tok_off].set(k)
-            cache = cache.at[i, 1, :, tok_block, tok_off].set(v)
+            cache = cache.at[i, 0, :, tok_block, tok_off].set(
+                cast_to_page_dtype(k, cache.dtype))
+            cache = cache.at[i, 1, :, tok_block, tok_off].set(
+                cast_to_page_dtype(v, cache.dtype))
             return _paged_attn(q[None], cache, i, block_table[None],
                                jnp.asarray(start).reshape(1),
                                spec.window if window == "spec" else window,
@@ -74,8 +77,10 @@ def decode_step_g(params, cache_data, tokens, positions, block_tables, valid,
     for i in range(spec.num_layers):
         def attend(q, k, v, i=i, window="spec", softcap=None):
             nonlocal cache
-            cache = cache.at[i, 0, :, blk, off].set(k)
-            cache = cache.at[i, 1, :, blk, off].set(v)
+            cache = cache.at[i, 0, :, blk, off].set(
+                cast_to_page_dtype(k, cache.dtype))
+            cache = cache.at[i, 1, :, blk, off].set(
+                cast_to_page_dtype(v, cache.dtype))
             return _paged_attn(q[:, None], cache, i, block_tables, safe_pos,
                                spec.window if window == "spec" else window,
                                attn_impl, softcap=softcap)[:, 0]

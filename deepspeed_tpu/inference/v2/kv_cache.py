@@ -57,6 +57,18 @@ class BlockedKVCache:
         self.allocator.free(blocks)
 
 
+FP8_MAX = 448.0     # float8_e4m3fn max finite; overflow casts become NaN
+
+
+def cast_to_page_dtype(x, dtype):
+    """Cast K/V to the page dtype; fp8 pages clamp to the finite e4m3 range
+    first (e4m3 has no inf — out-of-range casts would write NaN and poison
+    the page for the rest of the sequence)."""
+    if dtype == jnp.float8_e4m3fn:
+        x = jnp.clip(x, -FP8_MAX, FP8_MAX)
+    return x.astype(dtype)
+
+
 def write_kv_block_tokens(cache_data, layer: int, k_new, v_new, block_ids,
                           start_pos: int, block_size: int):
     """Scatter new K/V tokens into their blocks (jit-friendly building block).
@@ -70,6 +82,8 @@ def write_kv_block_tokens(cache_data, layer: int, k_new, v_new, block_ids,
     offsets = positions % block_size
     # head-major pages: advanced (block, offset) dims land first, so the
     # indexed view is [T, H, D] — matching k_new directly
-    cache_data = cache_data.at[layer, 0, :, block_ids, offsets].set(k_new)
-    cache_data = cache_data.at[layer, 1, :, block_ids, offsets].set(v_new)
+    cache_data = cache_data.at[layer, 0, :, block_ids, offsets].set(
+        cast_to_page_dtype(k_new, cache_data.dtype))
+    cache_data = cache_data.at[layer, 1, :, block_ids, offsets].set(
+        cast_to_page_dtype(v_new, cache_data.dtype))
     return cache_data

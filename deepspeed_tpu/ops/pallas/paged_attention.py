@@ -49,8 +49,8 @@ def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
 
     def _compute():
         q = q_ref[0, 0]                    # [Gp, d]
-        k = k_ref[0, 0]                    # [bs, d]
-        v = v_ref[0, 0]
+        k = k_ref[0, 0].astype(q.dtype)    # [bs, d] (fp8 pages dequantize
+        v = v_ref[0, 0].astype(q.dtype)    # on load; no-op otherwise)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * (1.0 / np.sqrt(q.shape[-1]))
@@ -157,6 +157,8 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, start_pos,
     if rep > 1:
         ctx_k = jnp.repeat(ctx_k, rep, axis=2)
         ctx_v = jnp.repeat(ctx_v, rep, axis=2)
+    ctx_k = ctx_k.astype(q.dtype)          # fp8 pages dequantize on load
+    ctx_v = ctx_v.astype(q.dtype)
     s = jnp.einsum("bthd,bkhd->bhtk", q, ctx_k,
                    preferred_element_type=jnp.float32) / np.sqrt(d)
     from deepspeed_tpu.models.llama import softcap_logits
