@@ -558,9 +558,10 @@ from deepspeed_tpu.models.gemma2 import Gemma2Config  # noqa: E402
 class Gemma2Policy:
     """models/gemma2.py's serving twin. The decoupled attention scale folds
     into q (kernel and gather both divide by sqrt(d)); the attention-logit
-    softcap routes the per-layer attend through the gather path
-    (llama_decode._paged_attn falls back — in-kernel capping pending);
-    cache_spec keeps the FULL window since odd layers attend globally."""
+    softcap is applied in-kernel on the paged Pallas path
+    (ops/pallas/paged_attention.py `softcap`) and mirrored by the gather
+    fallback; cache_spec keeps the FULL window since odd layers attend
+    globally."""
 
     @staticmethod
     def cache_spec(cfg: Gemma2Config) -> KVCacheSpec:

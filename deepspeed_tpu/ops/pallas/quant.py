@@ -176,20 +176,40 @@ def _quantized_psum_fwd(x, axes, mean):
 
 
 def _quantized_psum_bwd(axes, mean, _, g):
-    # Convention calibration: with check_vma=False, shard_map's transpose
-    # hands a replicated (out_spec P()) output's cotangent to this bwd as
-    # dL/dy / w on each device (verified against lax.psum's own transpose —
-    # regression-tested in test_pallas_kernels.py::test_quantized_psum_grad
-    # so a jax convention change fails loudly). The true vjp of a
-    # sum-reduction with replicated output is identity (each device's
-    # partial receives the full dL/dy), hence *w here; for mean it is
-    # dL/dy / w, which is exactly the incoming value.
-    if not mean:
-        w = 1
-        for ax in axes:
-            w *= jax.lax.axis_size(ax)
-        g = g * w
-    return (g,)
+    # Straight-through the int8 rounding; the backward of the underlying
+    # collective (psum / pmean with REPLICATED output) is a pure local
+    # rescale of the (replicated) cotangent — zero wire bytes. The scale
+    # factor depends on shard_map's cotangent convention: under
+    # check_vma=False JAX transposes psum to psum and hands this bwd
+    # dL/dy ÷ world, so the local equivalent of psum(replicated g) is g*w;
+    # under a VMA/identity-transpose convention it would be g unscaled.
+    # Rather than hard-code the convention (ADVICE r3), DERIVE it at trace
+    # time: build the jaxpr of lax.psum's own transpose in the current trace
+    # context and check whether it binds a psum — a JAX internals change
+    # flips the factor here in lockstep, and the final program still
+    # contains no collective (the probe jaxpr is inspected, never executed).
+    def _collective(x):
+        return jax.lax.psum(x, tuple(axes))
+
+    tiny = jax.ShapeDtypeStruct((1,), g.dtype)
+    probe = jax.make_jaxpr(
+        lambda t: jax.linear_transpose(_collective, tiny)(t))(
+            jnp.zeros((1,), g.dtype))
+    transposes_to_psum = any(
+        "psum" in eqn.primitive.name
+        for eqn in probe.jaxpr.eqns)
+
+    w = 1
+    for ax in axes:
+        w *= jax.lax.axis_size(ax)
+    if mean:
+        # forward = psum/w; psum-transpose convention makes the two rescales
+        # cancel (psum(g/w) over replicated g == g); identity convention
+        # leaves the ÷w
+        gx = g if transposes_to_psum else g / w
+    else:
+        gx = g * w if transposes_to_psum else g
+    return (gx,)
 
 
 quantized_psum.defvjp(_quantized_psum_fwd, _quantized_psum_bwd)

@@ -344,9 +344,19 @@ class DeepSpeedTPUEngine:
                          f"axes {self._qgz_axes} (hierarchical quantized "
                          "reduce-scatter + regather)", ranks=[0])
             else:
-                log_dist("qgZ: no replica batch axis on this mesh — gradient "
-                         "reduction stays fused in XLA's backward; applying "
-                         "int8 round-trip numerics only", ranks=[0])
+                import warnings
+                msg = ("zero_quantized_gradients=true but the mesh has NO "
+                       "replica batch axis (pure-fsdp ZeRO-3): there is no "
+                       "pure-DP all-reduce hop to compress, so NO bytes are "
+                       "saved on the wire. Gradients still pay the int8 "
+                       "round-trip quantization noise (reference-fidelity "
+                       "numerics). Either add a replica axis (a 'data' mesh "
+                       "axis, or split fsdp via mics_shard_size < world so "
+                       "'fsdp_out' replicates) or disable "
+                       "zero_quantized_gradients. See "
+                       "docs/parallelism.md#qgz.")
+                warnings.warn("qgZ: " + msg, UserWarning, stacklevel=3)
+                logger.warning("qgZ: %s", msg)
 
         # --- compiled functions ----------------------------------------------
         self._reset_compiled_fns()

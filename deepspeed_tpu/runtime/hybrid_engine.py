@@ -109,6 +109,12 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         # per-phase latency bookkeeping (reference hybrid_engine.py:54-60)
         self._generate_latency = 0.0
         self._training_latency = 0.0
+        # flip = train→generate view refresh (cast + LoRA fuse + engine swap);
+        # the reference instruments this per phase (_t_start/_t_gen family) —
+        # it is the RLHF phase-switch cost a user tunes release_inference_cache
+        # against
+        self._flip_latency = 0.0
+        self._flip_count = 0
         self._iters = 0
 
     # ------------------------------------------------------------------
@@ -137,8 +143,11 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         else:
             self._infer_engine = InferenceEngineV2(self._infer_params, cfg, v2cfg)
         self._weights_version = self.global_steps
+        dt = time.time() - t0
+        self._flip_latency += dt
+        self._flip_count += 1
         log_dist(f"hybrid: refreshed inference view at step {self.global_steps} "
-                 f"({time.time() - t0:.2f}s)", ranks=[0])
+                 f"({dt:.2f}s)", ranks=[0])
 
     # ------------------------------------------------------------------
     def generate(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
@@ -185,3 +194,23 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
     @property
     def training_latency(self) -> float:
         return self._training_latency
+
+    @property
+    def flip_latency(self) -> float:
+        """Cumulative train→generate view-refresh seconds."""
+        return self._flip_latency
+
+    @property
+    def flip_count(self) -> int:
+        return self._flip_count
+
+    def latency_report(self) -> Dict[str, float]:
+        """Per-phase totals + mean flip cost (reference per-phase printout)."""
+        return {
+            "train_s": self._training_latency,
+            "generate_s": self._generate_latency,
+            "flip_s": self._flip_latency,
+            "flips": float(self._flip_count),
+            "flip_mean_s": (self._flip_latency / self._flip_count
+                            if self._flip_count else 0.0),
+        }

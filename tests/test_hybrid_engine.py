@@ -63,6 +63,12 @@ def test_generate_reflects_training_updates():
     # training moved the weights; the inference view follows them (tokens may
     # or may not change on a tiny model — the version bump is the contract)
     assert engine.generate_latency > 0 and engine.training_latency > 0
+    # flip (train->generate view refresh) is instrumented per phase: two
+    # refreshes happened (initial + post-training), both timed
+    rep = engine.latency_report()
+    assert engine.flip_count == 2 and rep["flips"] == 2.0
+    assert rep["flip_s"] > 0 and rep["flip_mean_s"] > 0
+    assert rep["flip_s"] <= engine.generate_latency  # flips happen inside generate
 
 
 @pytest.mark.slow
