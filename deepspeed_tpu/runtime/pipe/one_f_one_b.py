@@ -21,12 +21,20 @@ The defining 1F1B property — activation memory bounded by the pipeline depth,
 not the microbatch count — holds: the ring buffer keeps at most
 ``min(M, 2(S - stage) - 1)`` stage inputs (the reference's alternating-slot
 schedule keeps ``S - stage``; the macro-step formulation pays ≤2x that bound in
-exchange for running fill+drain in ``2(S-1) + M`` fully-compiled steps). The
-bubble fraction is the lockstep model's ``2(S-1)/(2(S-1)+M)`` — every
-macro-step costs one full stage fwd+bwd on every device, fill/drain steps
-included — vs the reference's host-asynchronous ``(S-1)/(M+S-1)``
-(``schedule.lockstep_bubble_fraction`` / ``bubble_fraction``; measured by
-``bin/dstpu_pipe_bench``).
+exchange for running fill+drain in ``2(S-1) + M`` fully-compiled steps).
+
+**Bubble = true 1F1B ``(S-1)/(M+S-1)``** (``schedule.bubble_fraction``): the
+forward and backward halves of each macro-step are predicated with
+``lax.cond`` on their occupancy masks, so a stage whose forward (or backward)
+is inactive this macro-step SKIPS that compute at runtime — HLO conditionals
+branch per-device, and the ``ppermute`` handoffs stay outside the conds so
+the SPMD collective schedule is uniform. Per-step wall-clock is the max over
+stages of *active* work: the first ``S-1`` macro-steps cost a forward only,
+the last ``S-1`` a backward only, and the ``M`` in between cost fwd+bwd —
+total ``(M+S-1)(F+B)`` against ideal ``M(F+B)``, i.e. the reference
+``TrainSchedule``'s bubble exactly (the earlier all-masked formulation paid
+``2(S-1)/(2(S-1)+M)``, ``schedule.lockstep_bubble_fraction``, kept for
+comparison; measured by ``bin/dstpu_pipe_bench``).
 
 Tied weights (embedding used by ``first_fn`` at stage 0 and ``last_fn`` at the
 last stage) are replicated across ``pipe``; their gradients from both ends are
@@ -47,10 +55,20 @@ from deepspeed_tpu.comm import mesh as mesh_lib
 from deepspeed_tpu.runtime.pipe.spmd import stack_to_stages
 
 
+def _cond(pred, true_fn, false_fn, operand, predicate: bool):
+    """``lax.cond`` when ``predicate`` (runtime branch: inactive halves are
+    skipped — true 1F1B cost), else compute-both-and-mask (the all-masked
+    lockstep executor, kept as the A/B baseline for ``dstpu_pipe_bench``)."""
+    if predicate:
+        return jax.lax.cond(pred, true_fn, false_fn, operand)
+    tv, fv = true_fn(operand), false_fn(operand)
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), tv, fv)
+
+
 def pipeline_train_step_1f1b(block_fn: Callable, stacked_params: Any,
                              tied_params: Any, tokens_mb,
                              first_fn: Callable, last_fn: Callable,
-                             mesh=None):
+                             mesh=None, predicate: bool = True):
     """One pipelined forward+backward over all microbatches.
 
     block_fn(layer_params, x) -> x            — one transformer layer
@@ -60,6 +78,9 @@ def pipeline_train_step_1f1b(block_fn: Callable, stacked_params: Any,
     tokens_mb: [M, B, S] int32                — microbatched token ids
     first_fn(tied, tokens) -> x [B, S, D]     — stage-0 input embedding
     last_fn(tied, x, tokens) -> scalar loss   — last-stage head + loss
+    predicate                                 — skip inactive fwd/bwd halves
+                                                at runtime (False = masked
+                                                dead compute, bench baseline)
 
     Returns (mean_loss, grads_stacked [P, L/P, ...] sharded over ``pipe``,
     grads_tied replicated). Gradients are averaged over microbatches.
@@ -105,17 +126,24 @@ def pipeline_train_step_1f1b(block_fn: Callable, stacked_params: Any,
             cur_fwd, cur_bwd, buf, gp_acc, gt_acc, loss_acc = carry
 
             # ---------------- forward: mb f = t - p -----------------------
+            # predicated: fill/drain steps where this stage has no forward
+            # branch to the skip side at runtime (cost F only during fill)
             f = t - p
             fwd_active = jnp.logical_and(f >= 0, f < m)
             f_clip = jnp.clip(f, 0, m - 1)
             tok_f = jax.lax.dynamic_index_in_dim(toks, f_clip, 0,
                                                  keepdims=False)
-            x_in = jnp.where(p == 0, first_fn(tied, tok_f), cur_fwd)
-            slot_f = f_clip % bufs
-            old = jax.lax.dynamic_index_in_dim(buf, slot_f, 0, keepdims=False)
-            buf = jax.lax.dynamic_update_index_in_dim(
-                buf, jnp.where(fwd_active, x_in, old), slot_f, 0)
-            y = apply_stage(local_params, x_in)
+
+            def do_fwd(buf):
+                x_in = jnp.where(p == 0, first_fn(tied, tok_f), cur_fwd)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, x_in, f_clip % bufs, 0)
+                return apply_stage(local_params, x_in), buf
+
+            y, buf = _cond(
+                fwd_active, do_fwd,
+                lambda buf: (jnp.zeros(x_shape, x_dtype), buf), buf,
+                predicate)
 
             # ---------------- backward: mb b = t - (2(S-1) - p) -----------
             b = t - (2 * (s - 1) - p)
@@ -123,33 +151,55 @@ def pipeline_train_step_1f1b(block_fn: Callable, stacked_params: Any,
             b_clip = jnp.clip(b, 0, m - 1)
             tok_b = jax.lax.dynamic_index_in_dim(toks, b_clip, 0,
                                                  keepdims=False)
-            x_saved = jax.lax.dynamic_index_in_dim(buf, b_clip % bufs, 0,
-                                                   keepdims=False)
-            y_b, vjp = jax.vjp(apply_stage, local_params, x_saved)
-            # last stage seeds from the loss of the mb it forwarded this step
-            loss_b, (g_loss, dtied_last) = jax.value_and_grad(
-                lambda yy, td: last_fn(td, yy, tok_b), argnums=(0, 1))(y_b, tied)
-            g_in = jnp.where(p == s - 1, g_loss, cur_bwd)
-            dparams, dx = vjp(g_in)
 
-            act = bwd_active.astype(jnp.float32)
-            is_last = (p == s - 1).astype(jnp.float32)
-            is_first = (p == 0).astype(jnp.float32)
-            gp_acc = jax.tree.map(lambda a, g: a + act * g.astype(a.dtype),
-                                  gp_acc, dparams)
-            # tied grads: unembed side (last stage) ...
-            gt_acc = jax.tree.map(
-                lambda a, g: a + act * is_last * g.astype(a.dtype),
-                gt_acc, dtied_last)
-            # ... and embedding side (stage 0): pull dx through first_fn
-            _, vjp_first = jax.vjp(lambda td: first_fn(td, tok_b), tied)
-            (dtied_first,) = vjp_first(dx)
-            gt_acc = jax.tree.map(
-                lambda a, g: a + act * is_first * g.astype(a.dtype),
-                gt_acc, dtied_first)
-            loss_acc = loss_acc + act * is_last * loss_b
+            def do_bwd(accs):
+                gp_acc, gt_acc, loss_acc = accs
+                # for the last stage, buf was written THIS step (f == b there)
+                x_saved = jax.lax.dynamic_index_in_dim(buf, b_clip % bufs, 0,
+                                                       keepdims=False)
+                y_b, vjp = jax.vjp(apply_stage, local_params, x_saved)
+
+                # last stage seeds from the loss of the mb it forwarded this
+                # step (head + loss + unembed-side tied grads, skipped on all
+                # other stages)
+                def seed_from_loss(args):
+                    gt_acc, loss_acc = args
+                    loss_b, (g_loss, dtied_last) = jax.value_and_grad(
+                        lambda yy, td: last_fn(td, yy, tok_b),
+                        argnums=(0, 1))(y_b, tied)
+                    gt_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(a.dtype), gt_acc,
+                        dtied_last)
+                    return g_loss, gt_acc, loss_acc + loss_b
+
+                g_in, gt_acc, loss_acc = _cond(
+                    p == s - 1, seed_from_loss,
+                    lambda args: (cur_bwd, *args), (gt_acc, loss_acc),
+                    predicate)
+                dparams, dx = vjp(g_in)
+                gp_acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                                      gp_acc, dparams)
+
+                # embedding side (stage 0 only): pull dx through first_fn
+                def embed_grads(gt_acc):
+                    _, vjp_first = jax.vjp(lambda td: first_fn(td, tok_b),
+                                           tied)
+                    (dtied_first,) = vjp_first(dx)
+                    return jax.tree.map(
+                        lambda a, g: a + g.astype(a.dtype), gt_acc,
+                        dtied_first)
+
+                gt_acc = _cond(p == 0, embed_grads, lambda a: a, gt_acc,
+                               predicate)
+                return dx, gp_acc, gt_acc, loss_acc
+
+            dx, gp_acc, gt_acc, loss_acc = _cond(
+                bwd_active, do_bwd,
+                lambda accs: (jnp.zeros(x_shape, x_dtype), *accs),
+                (gp_acc, gt_acc, loss_acc), predicate)
 
             # ---------------- stage handoffs ------------------------------
+            # uniform across devices every step (outside the conds)
             nxt_fwd = jax.lax.ppermute(y, "pipe", fwd_perm)
             nxt_bwd = jax.lax.ppermute(dx, "pipe", bwd_perm)
             return (nxt_fwd, nxt_bwd, buf, gp_acc, gt_acc, loss_acc), None
@@ -227,13 +277,22 @@ def pipeline_eval_step(block_fn: Callable, stacked_params: Any,
             f_clip = jnp.clip(f, 0, m - 1)
             tok_f = jax.lax.dynamic_index_in_dim(toks, f_clip, 0,
                                                  keepdims=False)
-            x_in = jnp.where(p == 0, first_fn(tied, tok_f), cur)
-            y = apply_stage(x_in)
-            lb = last_fn(tied, y, tok_f)
-            take = active.astype(jnp.float32) * (p == s - 1).astype(
-                jnp.float32)
-            return (jax.lax.ppermute(y, "pipe", fwd_perm),
-                    loss_acc + take * lb), None
+
+            def do_fwd(loss_acc):
+                x_in = jnp.where(p == 0, first_fn(tied, tok_f), cur)
+                y = apply_stage(x_in)
+                # head + loss only on the last stage (skipped elsewhere)
+                loss_acc = jax.lax.cond(
+                    p == s - 1,
+                    lambda la: la + last_fn(tied, y, tok_f).astype(la.dtype),
+                    lambda la: la, loss_acc)
+                return y, loss_acc
+
+            y, loss_acc = jax.lax.cond(
+                active, do_fwd,
+                lambda la: (jnp.zeros(x_shape.shape, x_shape.dtype), la),
+                loss_acc)
+            return (jax.lax.ppermute(y, "pipe", fwd_perm), loss_acc), None
 
         zeros_x = jnp.zeros(x_shape.shape, x_shape.dtype)
         (_, loss_sum), _ = jax.lax.scan(

@@ -147,9 +147,12 @@ class LockstepSPMDSchedule(PipeSchedule):
 
     Every stage steps in lockstep inside one compiled scan: macro-step ``t``
     forwards microbatch ``t - stage`` and backwards microbatch
-    ``t - (2(S-1) - stage)``. Fill+drain is ``2(S-1)`` macro-steps — ≤2x the
-    host-asynchronous ``TrainSchedule``'s ``S-1``, the price of a single
-    fully-compiled lockstep program with no host round-trips."""
+    ``t - (2(S-1) - stage)``. Fill+drain spans ``2(S-1)`` macro-steps, but
+    the executor predicates each half with ``lax.cond`` so an inactive
+    forward/backward is skipped at runtime — wall-clock cost is the true
+    1F1B ``(S-1)(F+B)`` fill+drain (``bubble_fraction``), not the all-masked
+    ``2(S-1)(F+B)`` (``lockstep_bubble_fraction``, kept as the
+    no-predication comparison model)."""
 
     def num_pipe_buffers(self) -> int:
         # ring buffer of stage inputs held for recompute-backward
@@ -189,8 +192,11 @@ def bubble_fraction(micro_batches: int, stages: int) -> float:
 
 
 def lockstep_bubble_fraction(micro_batches: int, stages: int) -> float:
-    """Bubble of the lockstep SPMD executor: every macro-step costs one full
-    stage fwd+bwd on every device (fill/drain steps run masked dead compute),
-    so overhead = 2(s-1) dead macro-steps out of 2(s-1)+m."""
+    """Bubble of a *non-predicated* lockstep executor: every macro-step costs
+    one full stage fwd+bwd on every device (fill/drain steps run masked dead
+    compute), so overhead = 2(s-1) dead macro-steps out of 2(s-1)+m. The
+    shipping executor predicates fill/drain halves with ``lax.cond`` and pays
+    ``bubble_fraction`` instead; this model is kept as the comparison
+    baseline for ``dstpu_pipe_bench``."""
     t = num_macro_steps(micro_batches, stages)
     return (t - micro_batches) / t

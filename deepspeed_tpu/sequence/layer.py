@@ -21,8 +21,9 @@ class DistributedAttention:
     sequence and its head slice (kv keep their GQA head count — densify
     inside the fn if needed). ``local_attention=None`` uses the built-in
     flash/reference attention (``causal`` applies only to the built-in).
-    Heads must divide the sequence degree when a custom fn is given (the
-    uneven-heads remainder runs ring attention, which can't wrap one)."""
+    When heads don't divide the sequence degree, they are padded to the
+    next multiple and every head still runs through ``local_attention``
+    (``ceil(H/sp)`` per device; kv densified to q's head count first)."""
 
     def __init__(self, local_attention: Optional[Callable] = None,
                  mesh=None, causal: bool = True):

@@ -12,12 +12,15 @@ Composes with TP: heads are already split over ``tensor``; Ulysses further split
 the local heads over ``sequence``. When heads/tp is not divisible by the
 sequence-parallel degree, the reference redistributes heads unevenly with an
 explicit padded all-to-all (``uneven_heads_all2all`` layer.py:43) — which leaves
-the ranks holding ``ceil(H/sp)`` heads as stragglers. Here the uneven case is
-EXACT and balanced instead: the largest sp-divisible head group takes the
-normal head-scatter all-to-all, and the remainder ``H mod sp`` heads stay
-sequence-sharded and run ring attention over the same axis
-(``ring.ring_attention_local``) — every device computes exactly ``H/sp`` heads'
-worth of attention, no padded compute, no straggler rank.
+the ranks holding ``ceil(H/sp)`` heads as stragglers. Here, with the built-in
+attention, the uneven case is EXACT and balanced instead: the largest
+sp-divisible head group takes the normal head-scatter all-to-all, and the
+remainder ``H mod sp`` heads stay sequence-sharded and run ring attention over
+the same axis (``ring.ring_attention_local``) — every device computes exactly
+``H/sp`` heads' worth of attention, no padded compute, no straggler rank. With
+a custom ``attn_fn`` (whose semantics the ring remainder could not honor), the
+heads are instead padded to the next sp multiple and ALL run through the
+all-to-all + ``attn_fn`` — ``ceil(H/sp)`` heads per device, SPMD-uniform.
 """
 
 from functools import partial
@@ -56,15 +59,6 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
 
     tp = max(mesh.shape["tensor"], 1)
     uneven = (q.shape[2] // tp) % sp != 0 or (k.shape[2] // tp) % sp != 0
-    if uneven and attn_fn is not None:
-        # the remainder heads run ring attention, which cannot honor an
-        # arbitrary local_attention — refuse instead of silently applying
-        # the built-in softmax to part of the heads
-        raise ValueError(
-            "a custom local_attention requires heads divisible by the "
-            f"sequence degree (got {q.shape[2]}//{tp} heads over sp={sp}); "
-            "the uneven remainder runs ring attention, which cannot wrap a "
-            "user attention fn")
 
     spec = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
@@ -82,18 +76,37 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
     def body(q_l, k_l, v_l):
         if not uneven:
             return a2a_attention(q_l, k_l, v_l)
-        # exact uneven-heads split: densify GQA so q/kv share a head count,
-        # route the sp-divisible head group through the normal all-to-all and
-        # the H mod sp remainder through ring attention on the same axis —
-        # exactly H/sp heads of compute per device, no padding, no straggler
-        # (improves on the reference's uneven redistribution, layer.py:43,
-        # whose ceil(H/sp) ranks bound the step)
-        from deepspeed_tpu.sequence.ring import ring_attention_local
+        # uneven heads: densify GQA so q/kv share a head count, then
         h_local = q_l.shape[2]
         rep = q_l.shape[2] // k_l.shape[2]
         if rep > 1:
             k_l = jnp.repeat(k_l, rep, axis=2)
             v_l = jnp.repeat(v_l, rep, axis=2)
+        if attn_fn is not None:
+            # a custom local_attention must see EVERY head (it may not be
+            # plain softmax — softcap, sliding windows, a Pallas kernel with
+            # its own options), so pad heads to the next sp multiple and run
+            # them all through the normal head-scatter all-to-all: each
+            # device computes ceil(H/sp) heads under attn_fn semantics, the
+            # padded zero heads are sliced off after the inverse all-to-all.
+            # This is the reference's padded uneven redistribution
+            # (uneven_heads_all2all, layer.py:43) — but SPMD-uniform, so no
+            # straggler rank. Note kv are densified to q's head count above:
+            # proportional GQA padding cannot keep the q->kv group alignment
+            # through the scatter.
+            pad = (-h_local) % sp
+            def pz(x):
+                z = jnp.zeros((*x.shape[:2], pad, x.shape[3]), x.dtype)
+                return jnp.concatenate([x, z], axis=2)
+            out = a2a_attention(pz(q_l), pz(k_l), pz(v_l))
+            return out[:, :, :h_local]
+        # built-in attention: exact balanced split — the sp-divisible head
+        # group takes the normal all-to-all (flash kernel on the gathered
+        # sequence), the H mod sp remainder runs ring attention on the same
+        # axis — exactly H/sp heads of compute per device, no padding, no
+        # straggler (improves on the reference's uneven redistribution,
+        # layer.py:43, whose ceil(H/sp) ranks bound the step)
+        from deepspeed_tpu.sequence.ring import ring_attention_local
         h_even = (h_local // sp) * sp
         parts = []
         if h_even:

@@ -160,8 +160,26 @@ def test_distributed_attention_api_compat(sp_mesh):
                      q.shape[3]) and note == "hi", (shape, note)
 
 
-def test_distributed_attention_uneven_heads_with_custom_fn_raises(sp_mesh):
+@pytest.mark.parametrize("h,hkv", [(6, 6), (6, 2)])
+def test_distributed_attention_uneven_heads_with_custom_fn(sp_mesh, h, hkv):
+    """Uneven heads keep the custom/kernel attention path: heads are padded
+    to the next sp multiple and EVERY head runs through the wrapped local
+    attention (ceil(H/sp) per device, kv densified to q's head count) —
+    output still matches dense attention."""
     from deepspeed_tpu.sequence.layer import DistributedAttention
-    q, k, v = make_qkv(s=64, h=6, hkv=6)   # 6 heads over sp=4: uneven
-    with pytest.raises(ValueError, match="local_attention"):
-        DistributedAttention(lambda *a: a[0], mesh=sp_mesh)(q, k, v)
+    q, k, v = make_qkv(s=64, h=h, hkv=hkv)   # 6 heads over sp=4: uneven
+    shapes = []
+
+    def my_attn(qg, kg, vg):
+        shapes.append((qg.shape, kg.shape))
+        return attention_reference(qg, kg, vg, causal=True)
+
+    out = DistributedAttention(my_attn, mesh=sp_mesh)(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    sp = sp_mesh.shape["sequence"]
+    (qshape, kshape), = set(shapes)
+    assert qshape[2] == -(-h // sp), qshape       # ceil(H/sp) heads/device
+    assert kshape[2] == qshape[2], (kshape, qshape)  # kv densified to match
+    assert qshape[1] == q.shape[1], qshape        # full gathered sequence
