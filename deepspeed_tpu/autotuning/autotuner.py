@@ -9,7 +9,9 @@ TPU redesign: the knob space is (zero stage, micro-batch, remat) — bucket size
 overlap flags, and fetch thresholds don't exist because XLA schedules the collectives.
 Memory feasibility uses an analytic HBM model (params/grads/optimizer-state bytes per
 sharding stage) plus XLA's ``memory_analysis`` when a candidate compiles. Experiments
-run in-process (see scheduler.py).
+run in-process by default; ``isolation="process"`` runs each candidate in its own
+subprocess with a timeout so hard OOM kills and hung compiles are recorded as
+infeasible instead of killing the tune (see scheduler.py ProcessIsolatedRunner).
 """
 
 import json
@@ -79,7 +81,11 @@ class Autotuner:
                  warmup_steps: int = 1, measure_steps: int = 3,
                  n_trials: int = 50, early_stopping: int = 0,
                  results_dir: Optional[str] = None,
-                 hbm_bytes: Optional[int] = None):
+                 hbm_bytes: Optional[int] = None,
+                 isolation: str = "in_process",
+                 model_factory=None,
+                 experiment_timeout: float = 600.0,
+                 isolation_cpu_devices: Optional[int] = None):
         self.model = model
         self.base_config = dict(base_config)
         if metric not in ExperimentRunner.METRICS:
@@ -95,10 +101,38 @@ class Autotuner:
         self.early_stopping = early_stopping
         self.results_dir = results_dir
         self.hbm_bytes = hbm_bytes
-        self.runner = ExperimentRunner(
-            model, batch_fn, self.base_config, mesh=mesh, loss_fn=loss_fn,
-            warmup_steps=warmup_steps, measure_steps=measure_steps)
-        self._example_batch = example_batch if example_batch is not None else batch_fn(1)
+        self._prune_mesh = mesh   # stage-feasibility pruning (tune()) even
+        if isolation == "process":  # when experiments run in children
+            # each candidate in its own subprocess with a timeout — survives
+            # hard OOM kills and pathological compiles (reference:
+            # scheduler.py:414 _launch_exp); needs an importable factory
+            from deepspeed_tpu.autotuning.scheduler import (
+                ProcessIsolatedRunner)
+            if model_factory is None:
+                raise ValueError("isolation='process' requires model_factory "
+                                 "(importable 'module:qualname' rebuilding "
+                                 "the model in each child)")
+            if loss_fn is not None:
+                raise ValueError("isolation='process' ignores loss_fn — "
+                                 "return it from model_factory instead "
+                                 "(it cannot cross the process boundary)")
+            self.runner = ProcessIsolatedRunner(
+                model_factory, self.base_config,
+                warmup_steps=warmup_steps, measure_steps=measure_steps,
+                timeout=experiment_timeout,
+                cpu_devices=isolation_cpu_devices)
+        elif isolation == "in_process":
+            self.runner = ExperimentRunner(
+                model, batch_fn, self.base_config, mesh=mesh, loss_fn=loss_fn,
+                warmup_steps=warmup_steps, measure_steps=measure_steps)
+        else:
+            raise ValueError(f"unknown isolation {isolation!r}; "
+                             "'in_process' or 'process'")
+        # lazy: building an example batch may touch the device runtime, and
+        # with isolation='process' the parent must NOT claim the (exclusive)
+        # TPU before its experiment children do
+        self._example_batch = example_batch
+        self._batch_fn = batch_fn
         self.records: List[Experiment] = []
 
     # ------------------------------------------------------------------
@@ -107,6 +141,8 @@ class Autotuner:
         ``_generate_experiments`` model info probe)."""
         if not hasattr(self.model, "init"):
             return {"num_params": 0}
+        if self._example_batch is None:
+            self._example_batch = self._batch_fn(1)
         shapes = jax.eval_shape(
             lambda r: self.model.init(r, self._example_batch),
             jax.random.PRNGKey(0))
@@ -156,7 +192,7 @@ class Autotuner:
     # ------------------------------------------------------------------
     def tune(self) -> Tuple[Optional[Dict[str, Any]], Dict[str, float]]:
         fsdp = 1
-        mesh = self.runner.mesh
+        mesh = getattr(self.runner, "mesh", None) or self._prune_mesh
         if mesh is not None:
             fsdp = int(np.prod([mesh.shape.get(a, 1)
                                 for a in ("fsdp_out", "fsdp", "data")]))

@@ -1,17 +1,25 @@
-"""In-process experiment runner.
+"""Experiment runners: in-process (fast) and process-isolated (robust).
 
 Reference analog: ``deepspeed/autotuning/scheduler.py`` — ``ResourceManager`` launches
-each candidate config as a separate multi-node job via the launcher and scrapes metric
-files the exit hook writes.
+each candidate config as a separate multi-node job via the launcher
+(``scheduler.py:414 _launch_exp``) and scrapes metric files the exit hook writes; a
+candidate that OOMs or hangs dies in its own job without killing the tuner.
 
 TPU redesign: an experiment is a fresh engine built from (base config ⊕ overrides) and
-timed in-process — SPMD means one process sees the whole mesh, so there is no job
-launch / ssh layer to orchestrate. OOM (RESOURCE_EXHAUSTED) and compile failures are
-caught per-experiment and recorded, mirroring the reference's failed-experiment
-bookkeeping, so a failing candidate never kills the sweep.
+timed. ``ExperimentRunner`` does it in-process — SPMD means one process sees the whole
+mesh, so there is no job launch / ssh layer to orchestrate; catchable failures
+(RESOURCE_EXHAUSTED, compile errors) are recorded per-experiment. But the failures
+autotuning exists to find include UNcatchable ones — a hard device OOM that kills the
+process, a >20-minute XLA compile — so ``ProcessIsolatedRunner`` runs each candidate
+in a fresh subprocess with a timeout, like the reference's launched experiments: the
+child dies or is killed, the tuner records ``oom``/``timeout``/``failed`` and moves on.
 """
 
 import copy
+import json
+import os
+import subprocess
+import sys
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -90,4 +98,133 @@ class ExperimentRunner:
             exp.status = "oom" if oom else "failed"
             logger.warning(f"autotuning experiment {exp.name} {exp.status}: "
                            f"{msg.splitlines()[0] if msg else e!r}")
+        return exp
+
+
+_EXP_BOOTSTRAP = r"""
+import importlib, json, os, sys
+for p in os.environ.get("DSTPU_TUNE_PATH", "").split(os.pathsep):
+    if p and p not in sys.path:
+        sys.path.insert(0, p)
+if os.environ.get("DSTPU_TUNE_CPU_DEVICES"):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices",
+                      int(os.environ["DSTPU_TUNE_CPU_DEVICES"]))
+mod_name, _, qual = os.environ["DSTPU_TUNE_FACTORY"].partition(":")
+factory = importlib.import_module(mod_name)
+for part in qual.split("."):
+    factory = getattr(factory, part)
+spec = factory()
+from deepspeed_tpu.autotuning.scheduler import ExperimentRunner
+from deepspeed_tpu.autotuning.tuner import Experiment
+runner = ExperimentRunner(
+    spec["model"], spec["batch_fn"],
+    json.loads(os.environ["DSTPU_TUNE_BASE"]),
+    mesh=spec.get("mesh"), loss_fn=spec.get("loss_fn"),
+    warmup_steps=int(os.environ["DSTPU_TUNE_WARMUP"]),
+    measure_steps=int(os.environ["DSTPU_TUNE_MEASURE"]))
+exp = runner(Experiment(os.environ["DSTPU_TUNE_NAME"],
+                        json.loads(os.environ["DSTPU_TUNE_OVERRIDES"])))
+print("DSTPU_EXP_RESULT " + json.dumps(
+    {"status": exp.status, "metrics": exp.metrics, "error": exp.error}),
+    flush=True)
+"""
+
+
+class ProcessIsolatedRunner:
+    """Runs each candidate in a fresh subprocess with a timeout (reference:
+    ``scheduler.py:414 _launch_exp`` — experiments are separate jobs that can
+    die without killing the tuner).
+
+    ``model_factory``: importable ``"module:qualname"`` (or module-level
+    callable) returning ``{"model", "batch_fn", "loss_fn"?, "mesh"?}`` —
+    rebuilt inside each child so no live objects cross the process boundary.
+    The experiment name/overrides ride in env vars (``DSTPU_TUNE_NAME``/
+    ``DSTPU_TUNE_OVERRIDES``). A child that is killed by a hard device OOM
+    records ``oom``; one that exceeds ``timeout`` (e.g. a pathological XLA
+    compile) is killed and records ``timeout``; both are infeasible, the
+    sweep continues.
+    """
+
+    METRICS = ExperimentRunner.METRICS
+
+    def __init__(self, model_factory, base_config: Dict[str, Any],
+                 warmup_steps: int = 1, measure_steps: int = 3,
+                 timeout: float = 600.0, cpu_devices: Optional[int] = None,
+                 child_env: Optional[Dict[str, str]] = None):
+        self._extra_paths = []
+        if callable(model_factory):
+            mod = getattr(model_factory, "__module__", None)
+            qual = getattr(model_factory, "__qualname__", None)
+            if not mod or not qual or "<locals>" in qual:
+                raise ValueError("model_factory must be importable "
+                                 "(module-level) to run in a child process")
+            if "." not in mod:
+                # top-level module (e.g. a pytest-loaded test file): make its
+                # directory importable in the child (as testing.py does)
+                mod_file = getattr(sys.modules.get(mod), "__file__", None)
+                if mod_file:
+                    self._extra_paths.append(
+                        os.path.dirname(os.path.abspath(mod_file)))
+            model_factory = f"{mod}:{qual}"
+        self.model_factory = model_factory
+        self.base_config = base_config
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.timeout = timeout
+        self.cpu_devices = cpu_devices
+        self.child_env = child_env or {}
+        self.mesh = None   # no parent-side mesh; Autotuner falls back to the
+        # mesh it was constructed with for stage-feasibility pruning
+
+    def __call__(self, exp: Experiment) -> Experiment:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ,
+                   DSTPU_TUNE_FACTORY=self.model_factory,
+                   DSTPU_TUNE_BASE=json.dumps(self.base_config),
+                   DSTPU_TUNE_NAME=exp.name,
+                   DSTPU_TUNE_OVERRIDES=json.dumps(exp.overrides),
+                   DSTPU_TUNE_WARMUP=str(self.warmup_steps),
+                   DSTPU_TUNE_MEASURE=str(self.measure_steps),
+                   DSTPU_TUNE_PATH=os.pathsep.join(
+                       [repo_root, *self._extra_paths]),
+                   **self.child_env)
+        if self.cpu_devices:
+            env["DSTPU_TUNE_CPU_DEVICES"] = str(self.cpu_devices)
+        exp.status = "running"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _EXP_BOOTSTRAP], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                timeout=self.timeout, cwd=repo_root)
+            out = proc.stdout or ""
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"")
+            out = out.decode() if isinstance(out, bytes) else out
+            tail = "\n".join(out.splitlines()[-5:])
+            exp.status = "timeout"
+            exp.error = (f"experiment exceeded {self.timeout}s "
+                         "(hung compile or runaway candidate); child killed; "
+                         f"tail:\n{tail}")
+            logger.warning(f"autotuning experiment {exp.name} timed out "
+                           f"after {self.timeout}s — recorded infeasible")
+            return exp
+        for line in out.splitlines():
+            if line.startswith("DSTPU_EXP_RESULT "):
+                res = json.loads(line[len("DSTPU_EXP_RESULT "):])
+                exp.status = res["status"]
+                exp.metrics = res["metrics"]
+                exp.error = res["error"]
+                return exp
+        # child died before reporting (hard OOM kill, segfault, ...)
+        tail = "\n".join(out.splitlines()[-5:])
+        oom = ("RESOURCE_EXHAUSTED" in out or "out of memory" in out.lower()
+               or proc.returncode in (-9, 137))
+        exp.status = "oom" if oom else "failed"
+        exp.error = (f"child exited {proc.returncode} without reporting; "
+                     f"tail:\n{tail}")
+        logger.warning(f"autotuning experiment {exp.name} child died "
+                       f"(rc={proc.returncode}) — recorded {exp.status}")
         return exp

@@ -50,8 +50,10 @@ class V2EngineConfig:
     attn_impl: str = "auto"
     # KV page dtype: "model" stores pages in the model compute dtype; "fp8"
     # stores float8_e4m3 pages — HALF the KV memory vs bf16 (2x capacity:
-    # bigger batches / longer contexts), dequantized on load inside both
-    # attention paths
+    # bigger batches / longer contexts), with per-(head, page) fp32 scales
+    # (grown on outliers, page requantized — reference group-scaled fp
+    # quantizer, csrc/fp_quantizer) applied on load inside both attention
+    # paths
     kv_cache_dtype: str = "model"
 
 
@@ -167,7 +169,10 @@ class InferenceEngineV2:
         plan = plan_step(self.state.decoding(), self.state.prefilling(),
                          self.config.scheduler)
         out: Dict[int, int] = {}
-        cache = self.kv.data
+        # scaled fp8 pages carry their per-(head, page) scales through the
+        # jitted steps as a (pages, scales) tuple
+        cache = self.kv.data if self.kv.scales is None else \
+            (self.kv.data, self.kv.scales)
 
         # --- prefill chunks (SplitFuse) ---
         for chunk in plan.prefill_chunks:
@@ -234,7 +239,10 @@ class InferenceEngineV2:
                         tok == self.config.eos_token_id:
                     seq.done = True
 
-        self.kv.data = cache
+        if self.kv.scales is None:
+            self.kv.data = cache
+        else:
+            self.kv.data, self.kv.scales = cache
         return out
 
     def _sample_batch(self, logits) -> np.ndarray:

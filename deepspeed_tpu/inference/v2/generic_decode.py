@@ -12,7 +12,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.inference.v2.kv_cache import cast_to_page_dtype
+from deepspeed_tpu.inference.v2.kv_cache import (cast_to_page_dtype,
+                                                 write_kv_scaled)
 from deepspeed_tpu.inference.v2.llama_decode import _paged_attn
 
 
@@ -21,17 +22,30 @@ def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
                     policy, cfg, block_size: int, attn_impl: str = "auto"):
     """One sequence, one bucket-padded chunk; returns (last-token logits [V],
     updated cache_data). See llama_decode.prefill_chunk for the argument
-    contract — this is the arch-generic version."""
+    contract — this is the arch-generic version. ``cache_data`` may be the
+    plain page pool [L, 2, H, NB, bs, D] or a ``(pages, scales)`` tuple for
+    scaled fp8 pages (``BlockedKVCache.scales``); the same structure is
+    returned."""
     spec = policy.cache_spec(cfg)
     tb = tokens.shape[0]
     mb = block_table.shape[0]
+    scaled = isinstance(cache_data, tuple)
+    pool = cache_data[0] if scaled else cache_data
 
     positions = start + jnp.arange(tb)
     safe_pos = jnp.minimum(positions, spec.max_seq_len - 1)
     tok_block = jnp.where(jnp.arange(tb) < true_len,
                           block_table[jnp.minimum(safe_pos // block_size, mb - 1)],
-                          cache_data.shape[3] - 1)
+                          pool.shape[3] - 1)
     tok_off = safe_pos % block_size
+    touched = None
+    if scaled:
+        # pages the chunk's valid tokens can land on: a contiguous table
+        # slice (clamp duplicates repeat the same slot — identical updates,
+        # safe for write_kv_scaled's requantize scatter)
+        touch_idx = jnp.minimum(start // block_size +
+                                jnp.arange(tb // block_size + 1), mb - 1)
+        touched = block_table[touch_idx]
 
     x = policy.embed(params, tokens, safe_pos, cfg)
 
@@ -39,13 +53,24 @@ def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
     for i in range(spec.num_layers):
         def attend(q, k, v, i=i, window="spec", softcap=None):
             nonlocal cache
+            win = spec.window if window == "spec" else window
+            if scaled:
+                data, scales = cache
+                data, scales = write_kv_scaled(data, scales, i, 0, k,
+                                               tok_block, tok_off, touched)
+                data, scales = write_kv_scaled(data, scales, i, 1, v,
+                                               tok_block, tok_off, touched)
+                cache = (data, scales)
+                return _paged_attn(q[None], data, i, block_table[None],
+                                   jnp.asarray(start).reshape(1), win,
+                                   attn_impl, softcap=softcap,
+                                   scales=scales)[0]
             cache = cache.at[i, 0, :, tok_block, tok_off].set(
                 cast_to_page_dtype(k, cache.dtype))
             cache = cache.at[i, 1, :, tok_block, tok_off].set(
                 cast_to_page_dtype(v, cache.dtype))
             return _paged_attn(q[None], cache, i, block_table[None],
-                               jnp.asarray(start).reshape(1),
-                               spec.window if window == "spec" else window,
+                               jnp.asarray(start).reshape(1), win,
                                attn_impl, softcap=softcap)[0]
         x = policy.block(params, i, x, attend, safe_pos, cfg)
 
@@ -58,9 +83,12 @@ def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
 def decode_step_g(params, cache_data, tokens, positions, block_tables, valid,
                   policy, cfg, block_size: int, attn_impl: str = "auto"):
     """Batched single-token decode; returns (logits [B, V], updated
-    cache_data). See llama_decode.decode_step for the argument contract."""
+    cache_data). See llama_decode.decode_step for the argument contract.
+    ``cache_data``: plain pool or ``(pages, scales)`` like prefill_chunk_g."""
     spec = policy.cache_spec(cfg)
     mb = block_tables.shape[1]
+    scaled = isinstance(cache_data, tuple)
+    pool = cache_data[0] if scaled else cache_data
 
     safe_pos = jnp.minimum(positions, spec.max_seq_len - 1)
     blk = jnp.where(valid,
@@ -68,7 +96,7 @@ def decode_step_g(params, cache_data, tokens, positions, block_tables, valid,
                         block_tables,
                         jnp.minimum(safe_pos // block_size, mb - 1)[:, None],
                         axis=1)[:, 0],
-                    cache_data.shape[3] - 1)
+                    pool.shape[3] - 1)
     off = safe_pos % block_size
 
     x = policy.embed(params, tokens, safe_pos, cfg)
@@ -77,13 +105,25 @@ def decode_step_g(params, cache_data, tokens, positions, block_tables, valid,
     for i in range(spec.num_layers):
         def attend(q, k, v, i=i, window="spec", softcap=None):
             nonlocal cache
+            win = spec.window if window == "spec" else window
+            if scaled:
+                # each token touches exactly its own page (invalid rows all
+                # write the trash page with identical per-page updates)
+                data, scales = cache
+                data, scales = write_kv_scaled(data, scales, i, 0, k,
+                                               blk, off, blk)
+                data, scales = write_kv_scaled(data, scales, i, 1, v,
+                                               blk, off, blk)
+                cache = (data, scales)
+                return _paged_attn(q[:, None], data, i, block_tables,
+                                   safe_pos, win, attn_impl,
+                                   softcap=softcap, scales=scales)[:, 0]
             cache = cache.at[i, 0, :, blk, off].set(
                 cast_to_page_dtype(k, cache.dtype))
             cache = cache.at[i, 1, :, blk, off].set(
                 cast_to_page_dtype(v, cache.dtype))
             return _paged_attn(q[:, None], cache, i, block_tables, safe_pos,
-                               spec.window if window == "spec" else window,
-                               attn_impl, softcap=softcap)[:, 0]
+                               win, attn_impl, softcap=softcap)[:, 0]
         x = policy.block(params, i, x, attend, safe_pos, cfg)
 
     logits = policy.unembed(params, x, cfg)

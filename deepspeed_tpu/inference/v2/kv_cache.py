@@ -41,6 +41,14 @@ class BlockedKVCache:
         self.data = jnp.zeros(
             (cfg.num_layers, 2, cfg.num_kv_heads, cfg.num_blocks,
              cfg.block_size, cfg.head_dim), cfg.dtype)
+        # fp8 pages carry a per-(layer, k/v, head, page) fp32 scale: stored
+        # value = real / scale, grown monotonically as outliers arrive (the
+        # whole page is requantized under the new scale on growth). The
+        # reference fp quantizer is group-scaled the same way
+        # (csrc/fp_quantizer/fp_quantize.cu, group absmax).
+        self.scales = (jnp.ones(
+            (cfg.num_layers, 2, cfg.num_kv_heads, cfg.num_blocks),
+            jnp.float32) if cfg.dtype == jnp.float8_e4m3fn else None)
 
     @property
     def free_blocks(self) -> int:
@@ -55,6 +63,12 @@ class BlockedKVCache:
 
     def release(self, blocks: List[int]) -> None:
         self.allocator.free(blocks)
+        if self.scales is not None and blocks:
+            # reset released pages' scales: a page freed by a sequence with
+            # outlier K/V must not impose its grown scale (= lost precision)
+            # on the next sequence the allocator hands it to
+            self.scales = self.scales.at[
+                :, :, :, jnp.asarray(blocks)].set(1.0)
 
 
 FP8_MAX = 448.0     # float8_e4m3fn max finite; overflow casts become NaN
@@ -67,6 +81,50 @@ def cast_to_page_dtype(x, dtype):
     if dtype == jnp.float8_e4m3fn:
         x = jnp.clip(x, -FP8_MAX, FP8_MAX)
     return x.astype(dtype)
+
+
+def write_kv_scaled(cache_data, scales, layer: int, kv: int, vals,
+                    block_ids, offsets, touched_pages):
+    """Scatter new tokens into fp8 pages under per-(head, page) scales.
+
+    cache_data: [L, 2, H, NB, bs, D] fp8; scales: [L, 2, H, NB] fp32;
+    vals: [T, H, D] compute dtype; block_ids/offsets: [T] target slot per
+    token; touched_pages: [P] page ids covering ``set(block_ids)`` —
+    duplicates are allowed only if they carry identical updates (trash-padded
+    table slots / clamped slices satisfy this), because the requantize
+    scatter writes them all.
+
+    A new token whose |value| exceeds the page's committed range GROWS the
+    page scale (``new = max(old, absmax/448)``) and the whole page is
+    requantized under it (one small gather-scale-scatter — pages are
+    (bs, D) tiles); pages without outliers keep ratio 1.0 and the fp8→fp32→
+    fp8 round-trip is exact. Scales never shrink while a page is live; the
+    allocator resets them to 1.0 on release (``BlockedKVCache.release``).
+    """
+    f32 = jnp.float32
+    old_s = scales[layer, kv]                                   # [H, NB]
+    absmax = jnp.max(jnp.abs(vals.astype(f32)), axis=-1)        # [T, H]
+    page_max = jnp.zeros_like(old_s).at[:, block_ids].max(absmax.T)
+    new_s = jnp.maximum(old_s, page_max / FP8_MAX)              # [H, NB]
+
+    # requantize touched pages under the grown scale — predicated: in
+    # steady-state decode no scale grows and the full-page read-modify-write
+    # would be pure wasted HBM bandwidth in the hot path
+    def requant(data):
+        old_tile = data[layer, kv, :, touched_pages]            # [P, H, bs, D]
+        ratio = (old_s / new_s)[:, touched_pages].T             # [P, H]
+        tile = old_tile.astype(f32) * ratio[..., None, None]
+        return data.at[layer, kv, :, touched_pages].set(
+            tile.astype(data.dtype))
+
+    cache_data = jax.lax.cond(jnp.any(new_s > old_s), requant,
+                              lambda data: data, cache_data)
+    # write the new tokens under the new scale
+    tok_scale = new_s[:, block_ids].T                           # [T, H]
+    cache_data = cache_data.at[layer, kv, :, block_ids, offsets].set(
+        cast_to_page_dtype(vals.astype(f32) / tok_scale[..., None],
+                           cache_data.dtype))
+    return cache_data, scales.at[layer, kv].set(new_s)
 
 
 def write_kv_block_tokens(cache_data, layer: int, k_new, v_new, block_ids,

@@ -41,23 +41,27 @@ ATTN_IMPLS = ("auto", "kernel", "kernel_interpret", "gather")
 
 
 def _paged_attn(q, cache_data, layer, block_tables, start_pos, window,
-                attn_impl: str, softcap=None):
+                attn_impl: str, softcap=None, scales=None):
     """q: [B, T, H, d]; dispatch kernel vs gather reference over the head-major
     cache [L, 2, Hkv, NB, bs, d]. ``softcap`` (gemma2) is supported by both
-    the kernel and the gather path."""
+    the kernel and the gather path; ``scales`` ([L, 2, Hkv, NB] fp32, fp8
+    pages) dequantizes per (head, page) on load in both paths."""
     if attn_impl not in ATTN_IMPLS:
         raise ValueError(f"unknown attn_impl {attn_impl!r}; one of {ATTN_IMPLS}")
     k_pages, v_pages = cache_data[layer, 0], cache_data[layer, 1]
+    ks, vs = (scales[layer, 0], scales[layer, 1]) if scales is not None \
+        else (None, None)
     impl = attn_impl
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "gather":
         return paged_attention_reference(q, k_pages, v_pages, block_tables,
                                          start_pos, window=window,
-                                         softcap=softcap)
+                                         softcap=softcap, k_scales=ks,
+                                         v_scales=vs)
     return paged_attention(q, k_pages, v_pages, block_tables, start_pos,
-                           window=window, softcap=softcap,
-                           interpret=impl == "kernel_interpret")
+                           window=window, softcap=softcap, k_scales=ks,
+                           v_scales=vs, interpret=impl == "kernel_interpret")
 
 
 def _rms(x, scale, eps):

@@ -32,10 +32,17 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, block_size, num_pages, chunk, rep,
-                  window, softcap):
+def _paged_kernel(*refs, block_size, num_pages, chunk, rep,
+                  window, softcap, num_blocks=0):
+    if num_blocks:      # fp8 pages with per-(head, page) scales prefetched
+        (tables_ref, start_ref, kscale_ref, vscale_ref, q_ref, k_ref, v_ref,
+         o_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        (tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+         acc_scr) = refs
+        kscale_ref = vscale_ref = None
     b = pl.program_id(0)
+    hi = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -49,8 +56,15 @@ def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
 
     def _compute():
         q = q_ref[0, 0]                    # [Gp, d]
-        k = k_ref[0, 0].astype(q.dtype)    # [bs, d] (fp8 pages dequantize
-        v = v_ref[0, 0].astype(q.dtype)    # on load; no-op otherwise)
+        k = k_ref[0, 0]                    # [bs, d] (fp8 pages dequantize
+        v = v_ref[0, 0]                    # on load; no-op otherwise)
+        if kscale_ref is not None:
+            # per-(head, page) scale rides in SMEM next to the block table
+            page = tables_ref[b * num_pages + j]
+            k = k.astype(jnp.float32) * kscale_ref[hi * num_blocks + page]
+            v = v.astype(jnp.float32) * vscale_ref[hi * num_blocks + page]
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * (1.0 / np.sqrt(q.shape[-1]))
@@ -86,11 +100,14 @@ def _paged_kernel(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, start_pos,
-                    window=None, softcap=None, interpret: bool = False):
+                    window=None, softcap=None, k_scales=None, v_scales=None,
+                    interpret: bool = False):
     """q: [B, T, H, d] (T=1 decode / B=1 prefill chunk);
     k_pages/v_pages: [Hkv, NB, block_size, d]; block_tables: [B, MB] int32
     (trash-padded); start_pos: [B] int32 — global position of q row t=0
-    (row t attends kpos <= start+t). Returns [B, T, H, d].
+    (row t attends kpos <= start+t). ``k_scales``/``v_scales``: optional
+    [Hkv, NB] fp32 per-(head, page) dequant scales for fp8 pages (ride as
+    scalar prefetch; applied on load in-kernel). Returns [B, T, H, d].
 
     The KV written for q's own tokens must already be in the pages (the decode/
     prefill step scatters K/V before calling attention); causal masking then
@@ -98,28 +115,29 @@ def paged_attention(q, k_pages, v_pages, block_tables, start_pos,
     tail entries of the last page are never visible.
     """
     b, t, h, d = q.shape
-    hkv, _, bs, _ = k_pages.shape
+    hkv, nb, bs, _ = k_pages.shape
     rep = h // hkv
     g = rep * t
     gp = -(-g // 8) * 8                    # pad fold rows to sublane multiple
     mb = block_tables.shape[1]
+    scaled = k_scales is not None
 
     qf = q.transpose(0, 2, 1, 3).reshape(b, hkv, g, d)
     if gp != g:
         qf = jnp.pad(qf, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4 if scaled else 2,
         grid=(b, hkv, mb),
         in_specs=[
-            pl.BlockSpec((1, 1, gp, d), lambda bi, hi, j, tables, start:
+            pl.BlockSpec((1, 1, gp, d), lambda bi, hi, j, *pf:
                          (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, j, tables, start, mb=mb:
-                         (hi, tables[bi * mb + j], 0, 0)),
-            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, j, tables, start, mb=mb:
-                         (hi, tables[bi * mb + j], 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, j, *pf, mb=mb:
+                         (hi, pf[0][bi * mb + j], 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, j, *pf, mb=mb:
+                         (hi, pf[0][bi * mb + j], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, gp, d), lambda bi, hi, j, tables, start:
+        out_specs=pl.BlockSpec((1, 1, gp, d), lambda bi, hi, j, *pf:
                                (bi, hi, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((gp, 1), jnp.float32),
@@ -127,33 +145,43 @@ def paged_attention(q, k_pages, v_pages, block_tables, start_pos,
             pltpu.VMEM((gp, d), jnp.float32),
         ],
     )
+    prefetch = [block_tables.reshape(-1).astype(jnp.int32),
+                start_pos.astype(jnp.int32)]
+    if scaled:
+        prefetch += [k_scales.reshape(-1).astype(jnp.float32),
+                     v_scales.reshape(-1).astype(jnp.float32)]
     out = pl.pallas_call(
         functools.partial(_paged_kernel, block_size=bs, num_pages=mb,
-                          chunk=t, rep=rep, window=window, softcap=softcap),
+                          chunk=t, rep=rep, window=window, softcap=softcap,
+                          num_blocks=nb if scaled else 0),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
         interpret=interpret,
-    )(block_tables.reshape(-1).astype(jnp.int32),
-      start_pos.astype(jnp.int32), qf, k_pages, v_pages)
+    )(*prefetch, qf, k_pages, v_pages)
 
     out = out[:, :, :g].reshape(b, hkv, rep, t, d)
     return out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d)
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, start_pos,
-                              window=None, softcap=None):
+                              window=None, softcap=None, k_scales=None,
+                              v_scales=None):
     """Gather-based jnp reference with identical semantics (numerics oracle for
     kernel tests; also the CPU fallback path). ``softcap`` tanh-caps the
-    scaled logits before masking (gemma2 attn_logit_softcapping)."""
+    scaled logits before masking (gemma2 attn_logit_softcapping);
+    ``k_scales``/``v_scales``: [Hkv, NB] per-(head, page) fp8 dequant."""
     b, t, h, d = q.shape
     hkv, _, bs, _ = k_pages.shape
     rep = h // hkv
     mb = block_tables.shape[1]
     # [Hkv, B, MB, bs, d] -> [B, MB*bs, Hkv, d]
-    ctx_k = k_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
-        b, mb * bs, hkv, d)
-    ctx_v = v_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
-        b, mb * bs, hkv, d)
+    gk = k_pages[:, block_tables]
+    gv = v_pages[:, block_tables]
+    if k_scales is not None:               # dequant before the dtype fold
+        gk = gk.astype(jnp.float32) * k_scales[:, block_tables][..., None, None]
+        gv = gv.astype(jnp.float32) * v_scales[:, block_tables][..., None, None]
+    ctx_k = gk.transpose(1, 2, 3, 0, 4).reshape(b, mb * bs, hkv, d)
+    ctx_v = gv.transpose(1, 2, 3, 0, 4).reshape(b, mb * bs, hkv, d)
     if rep > 1:
         ctx_k = jnp.repeat(ctx_k, rep, axis=2)
         ctx_v = jnp.repeat(ctx_v, rep, axis=2)

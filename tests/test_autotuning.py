@@ -135,3 +135,63 @@ def test_feasible_stages_pruned_by_hbm():
                       zero_stages=[0, 1, 2, 3], hbm_bytes=1)  # nothing fits
     stages = tuner.feasible_stages(fsdp_size=8)
     assert stages == [3]  # falls back to the most-sharded stage
+
+
+# ---------------------------------------------------------------------------
+# process-isolated experiments (reference: scheduler.py:414 _launch_exp —
+# a candidate that dies or hangs must not kill the tune)
+# ---------------------------------------------------------------------------
+
+def _isolated_factory():
+    """Rebuilt inside each experiment child. The experiment name rides in
+    DSTPU_TUNE_NAME; 'killer' candidates hard-kill the child mid-step (a hard
+    OOM stand-in no try/except can catch), 'hang' candidates sleep past the
+    experiment timeout (a pathological-compile stand-in)."""
+    name = os.environ.get("DSTPU_TUNE_NAME", "")
+
+    def batch_fn(n):
+        if "kill" in name:
+            os._exit(137)          # simulated hard OOM kill
+        if "hang" in name:
+            import time
+            time.sleep(600)        # simulated hung compile
+        return random_batch(max(n, 1))
+
+    return {"model": SimpleModel(hidden_dim=32), "batch_fn": batch_fn}
+
+
+@pytest.mark.slow
+def test_process_isolated_tune_survives_kill_and_hang(mesh_dp8):
+    """One candidate hard-kills its child, one hangs past the timeout; the
+    tune records both infeasible and still returns the best feasible config
+    (reference: launched experiments die without killing the scheduler)."""
+    from deepspeed_tpu.autotuning.scheduler import ProcessIsolatedRunner
+    from deepspeed_tpu.autotuning.tuner import GridSearchTuner
+
+    runner = ProcessIsolatedRunner(
+        _isolated_factory, BASE, warmup_steps=1, measure_steps=1,
+        timeout=20.0, cpu_devices=1)
+    exps = [Experiment("z0_mbs1_kill",
+                       {"zero_optimization": {"stage": 0},
+                        "train_micro_batch_size_per_gpu": 1}),
+            Experiment("z0_mbs1_hang",
+                       {"zero_optimization": {"stage": 0},
+                        "train_micro_batch_size_per_gpu": 1}),
+            Experiment("z0_mbs2_ok",
+                       {"zero_optimization": {"stage": 0},
+                        "train_micro_batch_size_per_gpu": 2})]
+    tuner = GridSearchTuner(exps, runner, metric="throughput",
+                            higher_is_better=True)
+    best = tuner.tune()
+    by_name = {e.name: e for e in tuner.records}
+    assert by_name["z0_mbs1_kill"].status == "oom", by_name["z0_mbs1_kill"]
+    assert by_name["z0_mbs1_hang"].status == "timeout"
+    assert by_name["z0_mbs2_ok"].status == "done"
+    assert best is not None and best.name == "z0_mbs2_ok"
+    assert best.metrics["throughput"] > 0
+
+
+def test_autotuner_process_isolation_requires_factory():
+    with pytest.raises(ValueError, match="model_factory"):
+        Autotuner(SimpleModel(hidden_dim=32), BASE, batch_fn=random_batch,
+                  isolation="process")

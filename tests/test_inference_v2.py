@@ -208,3 +208,107 @@ def test_fp8_kv_cache_pages(model_and_params):
     g_fp8 = e8.generate(prompt, max_new_tokens=8)
     # fp8 rounding can flip a late token on near-ties; the prefix must hold
     assert g_fp8[:4] == g_full[:4], (g_fp8, g_full)
+
+
+def test_fp8_scaled_pages_outlier_accuracy():
+    """Per-(head, page) scales keep fp8 pages accurate under outlier K/V
+    magnitudes that the old scaleless clamp saturates (reference analog:
+    group-scaled fp quantizer, csrc/fp_quantizer/fp_quantize.cu). Covers the
+    write path (write_kv_scaled grow+requantize), the gather read path, and
+    the Pallas kernel's scalar-prefetch scale indexing (interpret mode)."""
+    from deepspeed_tpu.inference.v2.kv_cache import (cast_to_page_dtype,
+                                                     write_kv_scaled)
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_attention, paged_attention_reference)
+    rng = np.random.default_rng(0)
+    hkv, nb, bs, d, rep = 2, 8, 16, 32, 2
+    t = 64                                       # context length (4 pages)
+    k_ctx = rng.normal(size=(t, hkv, d)).astype(np.float32)
+    v_ctx = rng.normal(size=(t, hkv, d)).astype(np.float32)
+    k_ctx[10, 0] *= 2000.0                       # far beyond e4m3's 448
+    v_ctx[33, 1] *= 1500.0
+    block_ids = jnp.asarray(np.arange(t) // bs)
+    offsets = jnp.asarray(np.arange(t) % bs)
+    q = jnp.asarray(rng.normal(size=(1, 1, hkv * rep, d)), jnp.float32)
+    tables = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    start = jnp.asarray([t - 1], jnp.int32)
+
+    # oracle: exact f32 pages
+    f32p = jnp.zeros((2, hkv, nb, bs, d), jnp.float32)
+    f32p = f32p.at[0, :, block_ids, offsets].set(jnp.asarray(k_ctx))
+    f32p = f32p.at[1, :, block_ids, offsets].set(jnp.asarray(v_ctx))
+    oracle = paged_attention_reference(q, f32p[0], f32p[1], tables, start)
+
+    # scaled fp8 via the real write path (two calls exercise regrowth too)
+    data = jnp.zeros((1, 2, hkv, nb, bs, d), jnp.float8_e4m3fn)
+    scales = jnp.ones((1, 2, hkv, nb), jnp.float32)
+    half = t // 2
+    for kv, ctx in ((0, k_ctx), (1, v_ctx)):
+        data, scales = write_kv_scaled(
+            data, scales, 0, kv, jnp.asarray(ctx[:half]), block_ids[:half],
+            offsets[:half], jnp.asarray([0, 1]))
+        data, scales = write_kv_scaled(
+            data, scales, 0, kv, jnp.asarray(ctx[half:]), block_ids[half:],
+            offsets[half:], jnp.asarray([2, 3]))
+    assert float(scales[0, 0, 0, 0]) > 1.0       # k outlier page grew
+    assert float(scales[0, 1, 1, 2]) > 1.0       # v outlier page grew
+    out_scaled = paged_attention_reference(
+        q, data[0, 0], data[0, 1], tables, start,
+        k_scales=scales[0, 0], v_scales=scales[0, 1])
+
+    # old scaleless clamp
+    datac = jnp.zeros((2, hkv, nb, bs, d), jnp.float8_e4m3fn)
+    datac = datac.at[0, :, block_ids, offsets].set(
+        cast_to_page_dtype(jnp.asarray(k_ctx), jnp.float8_e4m3fn))
+    datac = datac.at[1, :, block_ids, offsets].set(
+        cast_to_page_dtype(jnp.asarray(v_ctx), jnp.float8_e4m3fn))
+    out_clamp = paged_attention_reference(q, datac[0], datac[1], tables, start)
+
+    denom = float(jnp.max(jnp.abs(oracle)))
+    err_scaled = float(jnp.max(jnp.abs(out_scaled - oracle))) / denom
+    err_clamp = float(jnp.max(jnp.abs(out_clamp - oracle))) / denom
+    assert err_scaled < 0.08, (err_scaled, err_clamp)
+    assert err_clamp > 4 * err_scaled, (err_scaled, err_clamp)
+
+    # Pallas kernel (interpret) with the scale prefetch == gather with scales
+    out_kernel = paged_attention(
+        q, data[0, 0], data[0, 1], tables, start,
+        k_scales=scales[0, 0], v_scales=scales[0, 1], interpret=True)
+    np.testing.assert_allclose(np.asarray(out_kernel), np.asarray(out_scaled),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_fp8_scaled_prefill_logit_error_bound(model_and_params):
+    """64+-token prefill with outlier-inflated K/V projections: scaled fp8
+    pages keep the last-token logits within a tight bound of the f32-cache
+    logits (the scaleless clamp would saturate every K/V row of layer 0)."""
+    from deepspeed_tpu.inference.v2.generic_decode import prefill_chunk_g
+    from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache, KVCacheConfig
+    from deepspeed_tpu.inference.v2.modules import LlamaPolicy
+    cfg, model, params = model_and_params
+    big = jax.tree.map(lambda x: x, params)      # shallow rebuild
+    for w in ("wk", "wv"):
+        big["model"]["layer_0"]["attn"][w] = jax.tree.map(
+            lambda x: x * 30.0, big["model"]["layer_0"]["attn"][w])
+
+    rngp = np.random.default_rng(7)
+    tokens = np.zeros(128, np.int32)
+    tokens[:80] = rngp.integers(0, cfg.vocab_size, 80)
+    table = jnp.asarray(np.arange(8), jnp.int32)
+
+    def run(dtype):
+        kv = BlockedKVCache(KVCacheConfig(
+            num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim_, block_size=16, num_blocks=32,
+            dtype=dtype))
+        cache = kv.data if kv.scales is None else (kv.data, kv.scales)
+        logits, _ = prefill_chunk_g(
+            big, cache, jnp.asarray(tokens), 0, table, 80,
+            policy=LlamaPolicy, cfg=cfg, block_size=16, attn_impl="gather")
+        return np.asarray(logits)
+
+    exact = run(jnp.float32)
+    fp8 = run(jnp.float8_e4m3fn)
+    err = float(np.max(np.abs(fp8 - exact)))
+    spread = float(np.max(exact) - np.min(exact))
+    assert err < 0.05 * spread, (err, spread)
