@@ -12,6 +12,8 @@ Public API parity (reference: ``deepspeed/__init__.py``):
 
 from typing import Any, Callable, Optional
 
+import jax
+
 __version__ = "0.2.0"
 
 from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
@@ -52,7 +54,20 @@ def initialize(args=None,
     ds_config = config if isinstance(config, DeepSpeedTPUConfig) \
         else DeepSpeedTPUConfig(config)
 
-    if dist_init_required:
+    if dist_init_required is None:
+        # auto (reference: deepspeed.initialize always ensures the process
+        # group, __init__.py:143): join the multi-process rendezvous when a
+        # launcher's env (DSTPU_*/torch-style) announces one and the user
+        # hasn't already initialized jax.distributed themselves. Mirrors
+        # init_distributed's own trigger (num_processes>1 OR a coordinator
+        # address alone — launchers may set a subset); discovery runs once
+        # and its kwargs are passed through
+        disc = _mesh_lib.discover_cluster_env()
+        if (not jax.distributed.is_initialized()
+                and (disc.get("num_processes", 1) > 1
+                     or disc.get("coordinator_address"))):
+            _mesh_lib.init_distributed(**disc)
+    elif dist_init_required:
         _mesh_lib.init_distributed()
 
     if mesh is None and mpu is not None:
@@ -126,7 +141,6 @@ def initialize(args=None,
 
     dataloader = None
     if training_data is not None:
-        import jax
         from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
         dataloader = DeepSpeedTPUDataLoader(
             training_data,

@@ -1,0 +1,81 @@
+"""Elastic end-to-end worker: train, checkpoint every step, resume on relaunch.
+
+Spawned by ``ElasticAgent`` in the kill-and-resume test
+(test_elasticity.py::test_elastic_kill_and_resume_end_to_end). Env contract:
+the agent's rendezvous vars (``DSTPU_COORDINATOR_ADDRESS`` / ``_NUM_PROCESSES``
+/ ``_PROCESS_ID``), ``DSTPU_ELASTIC_BATCH`` (the compatible global batch the
+agent computed for this generation — same across scales, the elastic
+invariant), ``DSTPU_ELASTIC_RESTART`` (generation), plus test knobs:
+``DSTPU_EW_DIR`` (checkpoint + loss-log dir), ``DSTPU_EW_TOTAL_STEPS``,
+``DSTPU_EW_KILL_RANK``/``DSTPU_EW_KILL_STEP`` (generation-0 fault injection:
+SIGKILL that rank right after that step's checkpoint commits — the
+uncatchable-death case a supervisor exists for).
+"""
+
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices",
+                  int(os.environ.get("DSTPU_EW_LOCAL_DEVICES", "2")))
+
+nproc = int(os.environ["DSTPU_NUM_PROCESSES"])
+rank = int(os.environ["DSTPU_PROCESS_ID"])
+if nproc > 1:
+    # rendezvous itself happens inside deepspeed_tpu.initialize() via the
+    # agent's DSTPU_* env (comm/mesh.py discover_cluster_env) — exactly the
+    # production worker flow; only the CPU collective impl needs configuring
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+    workdir = os.environ["DSTPU_EW_DIR"]
+    total_steps = int(os.environ["DSTPU_EW_TOTAL_STEPS"])
+    gen = int(os.environ["DSTPU_ELASTIC_RESTART"])
+    batch = int(os.environ["DSTPU_ELASTIC_BATCH"])
+    kill_rank = int(os.environ.get("DSTPU_EW_KILL_RANK", "-1"))
+    kill_step = int(os.environ.get("DSTPU_EW_KILL_STEP", "-1"))
+
+    # no mesh arg and no jax calls before initialize(): the rendezvous
+    # (jax.distributed) must run before anything touches the XLA backend;
+    # initialize() then builds the default data-parallel mesh over the
+    # global device set
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=64),
+        config={"train_batch_size": batch,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}},
+        example_batch=random_batch(2))
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    engine.load_checkpoint(ckpt_dir)   # no-op when no 'latest' yet (gen 0)
+    start = engine.global_steps
+
+    log = os.path.join(workdir, f"losses_gen{gen}_rank{rank}.jsonl")
+    local = batch // nproc
+    for step in range(start, total_steps):
+        # deterministic per-step GLOBAL batch, sliced to this process's
+        # distinct shard (engine._shard_batch assembles the global array from
+        # per-process locals) — the loss trajectory is comparable across
+        # generations/world sizes because the assembled batch is identical
+        full = random_batch(batch, seed=step)
+        shard = {k: v[rank * local:(rank + 1) * local] for k, v in full.items()}
+        loss = float(engine.train_batch(batch=shard))
+        engine.save_checkpoint(ckpt_dir)
+        with open(log, "a") as f:
+            f.write(json.dumps({"step": step, "loss": loss,
+                                "world": nproc}) + "\n")
+        if gen == 0 and rank == kill_rank and step + 1 >= kill_step:
+            os.kill(os.getpid(), signal.SIGKILL)   # simulated node loss
+
+
+if __name__ == "__main__":
+    main()
