@@ -2,22 +2,47 @@
 
 Reference analog: ``deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py``
 (``DataAnalyzer`` — maps metric functions over the dataset in worker shards,
-writes per-sample metric files, then merges). The reference persists into its
-custom mmap indexed-dataset format; we persist plain ``.npy`` arrays per metric
-(hosts have plenty of RAM for index arrays; the token data itself stays in
-``indexed_dataset.py`` files).
+writes per-sample metric files, then merges; 880 LoC with mmap-backed metric
+files and a distributed multi-node map/reduce). Mirrored here:
+
+- **map**: each worker computes its contiguous shard of every metric and
+  persists it as an ``.npy`` shard file (written via ``open_memmap`` so a
+  shard larger than RAM streams to disk in ``batch_size`` chunks).
+- **reduce**: shards stream into one mmap-backed ``sample_values.npy`` —
+  the merged values never materialize in RAM (reference: the mmap
+  indexed-dataset merge); only the int64 sort index is in-memory (same
+  lower bound as the reference's ``index_to_sample`` build).
+- **metric types** (reference ``metric_type`` knob):
+  ``single_value_per_sample`` (difficulty per sample, default) and
+  ``accumulate_value_over_samples`` (one running vector summed across the
+  dataset, e.g. vocabulary counts — workers write partials, reduce sums).
+- **metric→sample map** (reference ``metric_to_sample_dict``): for discrete
+  metrics, a CSR-style index (``unique_values / offsets / sample_ids``) so
+  curriculum binning can look up all samples at a difficulty level without
+  scanning.
+- **distributed**: ``run_map_reduce`` runs map on every jax process and
+  reduce on process 0, with a cross-host barrier between (the reference
+  drives this with torch.distributed barriers; here any barrier callable —
+  default ``jax.experimental.multihost_utils.sync_global_devices`` when
+  jax.distributed is live).
 
 Output layout per metric under ``save_path``::
 
-    <metric>/sample_values.npy        float64[num_samples] difficulty per sample
+    <metric>/sample_values.npy        float64[num_samples] difficulty/sample
     <metric>/index_to_sample.npy      int64[num_samples] argsort by value
+    <metric>/unique_values.npy        CSR map (discrete metrics)
+    <metric>/offsets.npy              int64[n_unique + 1]
+    <metric>/sample_ids.npy           int64[num_samples] grouped by value
     <metric>/worker_<i>_<n>.npy       partial shards before merge
 """
 
 import os
-from typing import Callable, Dict, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
+
+SINGLE_VALUE = "single_value_per_sample"
+ACCUMULATE = "accumulate_value_over_samples"
 
 
 class DataAnalyzer:
@@ -28,52 +53,181 @@ class DataAnalyzer:
                  save_path: str,
                  worker_id: int = 0,
                  num_workers: int = 1,
-                 batch_size: int = 1024):
+                 batch_size: int = 1024,
+                 metric_types: Optional[Dict[str, str]] = None,
+                 build_value_map: bool = True):
         self.dataset = dataset
         self.metric_functions = dict(metric_functions)
         self.save_path = save_path
         self.worker_id = worker_id
         self.num_workers = num_workers
         self.batch_size = batch_size
+        self.metric_types = dict(metric_types or {})
+        for name, t in self.metric_types.items():
+            if t not in (SINGLE_VALUE, ACCUMULATE):
+                raise ValueError(f"metric '{name}': unknown metric_type {t!r}")
+        self.build_value_map = build_value_map
+
+    def _type(self, name: str) -> str:
+        return self.metric_types.get(name, SINGLE_VALUE)
 
     def _worker_range(self):
         n = len(self.dataset)
         per = (n + self.num_workers - 1) // self.num_workers
-        lo = self.worker_id * per
+        lo = min(n, self.worker_id * per)   # trailing workers may be empty
         return lo, min(n, lo + per)
 
+    def _shard_path(self, name: str, worker: int) -> str:
+        return os.path.join(self.save_path, name,
+                            f"worker_{worker}_{self.num_workers}.npy")
+
     def run_map(self) -> None:
-        """Compute this worker's shard of every metric and persist it."""
+        """Compute this worker's shard of every metric and persist it.
+
+        single-value shards are written through an ``open_memmap`` in
+        ``batch_size`` chunks, so a shard bigger than RAM never lives in
+        memory; accumulate metrics keep one running vector."""
         lo, hi = self._worker_range()
-        results = {name: [] for name in self.metric_functions}
+        for name in self.metric_functions:
+            os.makedirs(os.path.join(self.save_path, name), exist_ok=True)
+        single = [n for n in self.metric_functions
+                  if self._type(n) == SINGLE_VALUE]
+        accum = {n: None for n in self.metric_functions
+                 if self._type(n) == ACCUMULATE}
+        shards = {name: np.lib.format.open_memmap(
+            self._shard_path(name, self.worker_id), mode="w+",
+            dtype=np.float64, shape=(hi - lo,)) for name in single}
         for start in range(lo, hi, self.batch_size):
-            chunk = [self.dataset[i] for i in range(start, min(hi, start + self.batch_size))]
-            for name, fn in self.metric_functions.items():
-                vals = np.asarray([fn(sample) for sample in chunk], dtype=np.float64)
-                results[name].append(vals)
-        for name, parts in results.items():
-            mdir = os.path.join(self.save_path, name)
-            os.makedirs(mdir, exist_ok=True)
-            shard = np.concatenate(parts) if parts else np.empty(0, dtype=np.float64)
-            np.save(os.path.join(
-                mdir, f"worker_{self.worker_id}_{self.num_workers}.npy"), shard)
+            end = min(hi, start + self.batch_size)
+            chunk = [self.dataset[i] for i in range(start, end)]
+            for name in single:
+                fn = self.metric_functions[name]
+                shards[name][start - lo:end - lo] = np.asarray(
+                    [fn(s) for s in chunk], dtype=np.float64)
+            for name in accum:
+                fn = self.metric_functions[name]
+                for s in chunk:
+                    v = np.asarray(fn(s), dtype=np.float64)
+                    accum[name] = v if accum[name] is None else accum[name] + v
+        for name, mm in shards.items():
+            mm.flush()
+            del mm
+        for name, total in accum.items():
+            if total is None:
+                total = np.zeros(0, np.float64)
+            np.save(self._shard_path(name, self.worker_id), total)
 
     def run_reduce(self) -> None:
-        """Merge all worker shards into sample_values + index_to_sample."""
+        """Merge all worker shards.
+
+        single-value: stream shards into one mmap-backed ``sample_values.npy``
+        (no in-RAM concatenation), then build ``index_to_sample`` (int64 sort
+        index — the only O(n) RAM) and, for discrete metrics, the CSR
+        metric→sample map. accumulate: sum the partial vectors."""
+        n = len(self.dataset)
         for name in self.metric_functions:
             mdir = os.path.join(self.save_path, name)
-            parts = []
-            for w in range(self.num_workers):
-                path = os.path.join(mdir, f"worker_{w}_{self.num_workers}.npy")
+            paths = [self._shard_path(name, w) for w in range(self.num_workers)]
+            for w, path in enumerate(paths):
                 if not os.path.exists(path):
                     raise FileNotFoundError(
                         f"metric '{name}': missing shard from worker {w} ({path})")
-                parts.append(np.load(path))
-            values = np.concatenate(parts)
-            np.save(os.path.join(mdir, "sample_values.npy"), values)
-            np.save(os.path.join(mdir, "index_to_sample.npy"),
-                    np.argsort(values, kind="stable").astype(np.int64))
+            if self._type(name) == ACCUMULATE:
+                total = None
+                for path in paths:
+                    part = np.load(path)
+                    if part.size == 0:   # empty-range worker's placeholder
+                        continue
+                    total = part if total is None else total + part
+                np.save(os.path.join(mdir, "sample_values.npy"),
+                        total if total is not None else np.zeros(0))
+                continue
+            out = np.lib.format.open_memmap(
+                os.path.join(mdir, "sample_values.npy"), mode="w+",
+                dtype=np.float64, shape=(n,))
+            pos = 0
+            for path in paths:
+                shard = np.load(path, mmap_mode="r")
+                for start in range(0, shard.shape[0], self.batch_size):
+                    end = min(shard.shape[0], start + self.batch_size)
+                    out[pos + start:pos + end] = shard[start:end]
+                pos += shard.shape[0]
+            assert pos == n, (pos, n)
+            out.flush()
+            order = np.argsort(out, kind="stable").astype(np.int64)
+            np.save(os.path.join(mdir, "index_to_sample.npy"), order)
+            if self.build_value_map:
+                # CSR metric->sample map (reference metric_to_sample_dict):
+                # out[order] is sorted, so its run-lengths give the bucket
+                # boundaries and `order` itself is sample_ids grouped by
+                # value. Run-lengths stream in batch_size chunks so the
+                # sorted values never materialize in RAM either
+                uniq, counts = [], []
+                cur, cnt = None, 0
+                for start in range(0, n, self.batch_size):
+                    chunk = np.asarray(out)[order[start:start +
+                                                  self.batch_size]]
+                    for v, c in zip(*np.unique(chunk, return_counts=True)):
+                        if cur is not None and v == cur:
+                            cnt += int(c)
+                        else:
+                            if cur is not None:
+                                uniq.append(cur)
+                                counts.append(cnt)
+                            cur, cnt = v, int(c)
+                if cur is not None:
+                    uniq.append(cur)
+                    counts.append(cnt)
+                offsets = np.zeros(len(uniq) + 1, np.int64)
+                np.cumsum(np.asarray(counts, np.int64), out=offsets[1:])
+                np.save(os.path.join(mdir, "unique_values.npy"),
+                        np.asarray(uniq, np.float64))
+                np.save(os.path.join(mdir, "offsets.npy"), offsets)
+                np.save(os.path.join(mdir, "sample_ids.npy"), order)
+            del out
+
+    # ------------------------------------------------------------------
+    def run_map_reduce(self, barrier: Optional[Callable] = None) -> None:
+        """Distributed map/reduce over jax processes (reference:
+        run_map_reduce with torch.distributed barriers): every process maps
+        its shard (worker_id = process_index), a cross-host barrier commits
+        the shard files, process 0 reduces, and a final barrier releases the
+        readers."""
+        import jax
+        nproc = jax.process_count()
+        if nproc > 1:
+            self.worker_id = jax.process_index()
+            self.num_workers = nproc
+        if barrier is None and nproc > 1:
+            from jax.experimental import multihost_utils
+
+            def barrier(tag):
+                multihost_utils.sync_global_devices(tag)
+        self.run_map()
+        if barrier is not None:
+            barrier("dstpu_data_analyzer_map")
+        if self.worker_id == 0:
+            self.run_reduce()
+        if barrier is not None:
+            barrier("dstpu_data_analyzer_reduce")
 
     @staticmethod
-    def load_metric(save_path: str, metric_name: str) -> np.ndarray:
-        return np.load(os.path.join(save_path, metric_name, "sample_values.npy"))
+    def load_metric(save_path: str, metric_name: str,
+                    mmap: bool = False) -> np.ndarray:
+        return np.load(os.path.join(save_path, metric_name,
+                                    "sample_values.npy"),
+                       mmap_mode="r" if mmap else None)
+
+    @staticmethod
+    def samples_with_value(save_path: str, metric_name: str,
+                           value: float) -> np.ndarray:
+        """All sample ids whose metric equals ``value`` (CSR lookup —
+        reference metric_to_sample_dict access for curriculum binning)."""
+        mdir = os.path.join(save_path, metric_name)
+        uniq = np.load(os.path.join(mdir, "unique_values.npy"))
+        i = np.searchsorted(uniq, value)
+        if i >= len(uniq) or uniq[i] != value:
+            return np.empty(0, np.int64)
+        offsets = np.load(os.path.join(mdir, "offsets.npy"))
+        ids = np.load(os.path.join(mdir, "sample_ids.npy"), mmap_mode="r")
+        return np.asarray(ids[offsets[i]:offsets[i + 1]])

@@ -304,3 +304,104 @@ def test_curriculum_state_resyncs_on_checkpoint_load(tmp_path):
     assert fresh.curriculum_seqlen() == 8
     assert fresh.random_ltd_reserved_length() == 16
     assert fresh.random_ltd_scheduler.consumed_layer_tokens == consumed
+
+
+def test_data_analyzer_mmap_merge_and_value_map(tmp_path):
+    """Reduce streams shards into an mmap-backed sample_values (no in-RAM
+    concat) and builds the CSR metric->sample map (reference
+    metric_to_sample_dict, data_analyzer.py)."""
+    rng = np.random.default_rng(0)
+    lens = rng.integers(3, 8, size=101)
+    data = [list(range(n)) for n in lens]
+    for w in range(3):
+        DataAnalyzer(data, {"seqlen": len}, str(tmp_path), worker_id=w,
+                     num_workers=3, batch_size=7).run_map()
+    DataAnalyzer(data, {"seqlen": len}, str(tmp_path), num_workers=3,
+                 batch_size=7).run_reduce()
+    vals = DataAnalyzer.load_metric(str(tmp_path), "seqlen", mmap=True)
+    assert isinstance(vals, np.memmap)
+    np.testing.assert_array_equal(np.asarray(vals), lens.astype(np.float64))
+    order = np.load(tmp_path / "seqlen" / "index_to_sample.npy")
+    assert np.all(np.diff(np.asarray(vals)[order]) >= 0)
+    for v in (3, 5, 7):
+        ids = DataAnalyzer.samples_with_value(str(tmp_path), "seqlen", v)
+        np.testing.assert_array_equal(np.sort(ids), np.flatnonzero(lens == v))
+    assert DataAnalyzer.samples_with_value(
+        str(tmp_path), "seqlen", 99).size == 0
+
+
+def test_data_analyzer_accumulate_metric(tmp_path):
+    """accumulate_value_over_samples: workers write partial vectors, reduce
+    sums them (reference metric_type, e.g. vocabulary counts)."""
+    data = [[t] * (i % 4 + 1) for i, t in
+            enumerate([1, 0, 2, 1, 1, 0, 2, 2, 2, 0])]
+
+    def vocab_counts(sample):
+        c = np.zeros(3)
+        for t in sample:
+            c[t] += 1
+        return c
+
+    kw = dict(metric_functions={"counts": vocab_counts},
+              metric_types={"counts": "accumulate_value_over_samples"},
+              save_path=str(tmp_path))
+    for w in range(2):
+        DataAnalyzer(data, worker_id=w, num_workers=2, **kw).run_map()
+    DataAnalyzer(data, num_workers=2, **kw).run_reduce()
+    got = DataAnalyzer.load_metric(str(tmp_path), "counts")
+    want = np.zeros(3)
+    for s in data:
+        want += vocab_counts(s)
+    np.testing.assert_array_equal(got, want)
+
+
+def _analyzer_distributed_body():
+    """2-process run_map_reduce with the cross-host barrier (reference:
+    distributed map/reduce over torch.distributed)."""
+    import os
+
+    import numpy as np
+
+    from deepspeed_tpu.data_pipeline import DataAnalyzer
+
+    data = [list(range(n)) for n in (np.arange(40) % 6 + 2)]
+    an = DataAnalyzer(data, {"seqlen": len},
+                      os.environ["DSTPU_TEST_ANALYZER_DIR"], batch_size=7)
+    an.run_map_reduce()
+    vals = DataAnalyzer.load_metric(os.environ["DSTPU_TEST_ANALYZER_DIR"],
+                                    "seqlen")
+    np.testing.assert_array_equal(vals, (np.arange(40) % 6 + 2).astype(float))
+    print("analyzer distributed ok")
+
+
+@pytest.mark.slow
+def test_data_analyzer_distributed_map_reduce(tmp_path):
+    from deepspeed_tpu.testing import run_distributed
+    outs = run_distributed(_analyzer_distributed_body, world_size=2,
+                           devices_per_process=1,
+                           env={"DSTPU_TEST_ANALYZER_DIR": str(tmp_path)})
+    assert all("analyzer distributed ok" in o for o in outs)
+
+
+def test_data_analyzer_empty_trailing_worker(tmp_path):
+    """num_workers whose ceil-division overshoots the dataset: trailing
+    workers have empty ranges and must produce valid (empty) shards for
+    both metric types — reduce still merges correctly."""
+    data = [[0] * n for n in (3, 4, 5, 6, 7)]   # n=5, 4 workers -> per=2
+
+    def counts(sample):
+        c = np.zeros(2)
+        c[len(sample) % 2] += 1
+        return c
+
+    kw = dict(metric_functions={"seqlen": len, "counts": counts},
+              metric_types={"counts": "accumulate_value_over_samples"},
+              save_path=str(tmp_path))
+    for w in range(4):
+        DataAnalyzer(data, worker_id=w, num_workers=4, **kw).run_map()
+    DataAnalyzer(data, num_workers=4, **kw).run_reduce()
+    np.testing.assert_array_equal(
+        DataAnalyzer.load_metric(str(tmp_path), "seqlen"),
+        [3.0, 4.0, 5.0, 6.0, 7.0])
+    np.testing.assert_array_equal(
+        DataAnalyzer.load_metric(str(tmp_path), "counts"), [2.0, 3.0])
