@@ -18,7 +18,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _make_engine(attn_impl: str):
+_PARAM_CACHE = {}
+
+
+def _make_engine(attn_impl: str, kv_dtype: str = "model"):
     import jax
     import jax.numpy as jnp
 
@@ -26,7 +29,8 @@ def _make_engine(attn_impl: str):
     from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, random_tokens
 
-    if os.environ.get("DSTPU_DECODE_TINY") == "1":   # CPU smoke config
+    tiny = os.environ.get("DSTPU_DECODE_TINY") == "1"
+    if tiny:                                          # CPU smoke config
         cfg = LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
                           num_layers=2, num_heads=4, num_kv_heads=2,
                           max_seq_len=1024, dtype=jnp.float32)
@@ -35,25 +39,29 @@ def _make_engine(attn_impl: str):
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=4096,
             dtype=jnp.bfloat16)
-    model = LlamaForCausalLM(cfg)
-    params = model.init(jax.random.PRNGKey(0),
-                        random_tokens(1, 8, vocab_size=cfg.vocab_size))["params"]
-    params = jax.device_put(jax.tree.map(
-        lambda x: x.astype(cfg.dtype) if jnp.issubdtype(x.dtype, jnp.floating)
-        else x, params))
+    if tiny not in _PARAM_CACHE:   # one init + upload across all table rows
+        model = LlamaForCausalLM(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            random_tokens(1, 8, vocab_size=cfg.vocab_size))["params"]
+        _PARAM_CACHE[tiny] = jax.device_put(jax.tree.map(
+            lambda x: x.astype(cfg.dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params))
+    params = _PARAM_CACHE[tiny]
 
     engine = InferenceEngineV2(params, cfg, V2EngineConfig(
         kv_block_size=64, kv_num_blocks=1024,
         scheduler=SchedulerConfig(max_tokens_per_step=2048,
                                   prefill_buckets=(256,)),
-        attn_impl=attn_impl))
+        attn_impl=attn_impl, kv_cache_dtype=kv_dtype))
     return engine, cfg
 
 
-def run(attn_impl: str, batch: int, prompt_len: int, decode_steps: int):
+def run(attn_impl: str, batch: int, prompt_len: int, decode_steps: int,
+        kv_dtype: str = "model"):
     import numpy as np
 
-    engine, cfg = _make_engine(attn_impl)
+    engine, cfg = _make_engine(attn_impl, kv_dtype)
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(0, cfg.vocab_size, prompt_len))
                for _ in range(batch)]
@@ -70,8 +78,37 @@ def run(attn_impl: str, batch: int, prompt_len: int, decode_steps: int):
     return batch * decode_steps / dt
 
 
+def serving_table(attn_impl: str, prompt_len: int, decode_steps: int):
+    """The FastGen-comparison table (reference:
+    blogs/deepspeed-fastgen/README.md:28,163,168 — tokens/s + TTFT p50/p95
+    across load points): 3 batch mixes x {model-dtype, fp8-scaled} KV pages.
+    Enabled by DSTPU_DECODE_TABLE=1 (adds several engine compiles of chip
+    time); rows land in the JSON line's extra.serving_table."""
+    rows = []
+    for kv_dtype in ("model", "fp8"):
+        for batch in (4, 16, 32):
+            tps = run(attn_impl, batch, prompt_len, decode_steps,
+                      kv_dtype=kv_dtype)
+            arrivals = max(batch // 2, 1)
+            # window must admit every arrival (steps 4..4*arrivals) plus a
+            # steady tail so the heaviest row measures its labeled load
+            mixed = mixed_load(attn_impl, initial=max(batch // 2, 1),
+                               arrivals=arrivals, arrive_every=4,
+                               prompt_len=prompt_len,
+                               max_steps=4 * arrivals + 32,
+                               kv_dtype=kv_dtype)
+            rows.append({"kv": kv_dtype, "batch": batch,
+                         "decode_tokens_per_sec": round(tps, 1),
+                         "mixed_tokens_per_sec":
+                             mixed["mixed_tokens_per_sec"],
+                         "ttft_p50_ms": mixed["ttft_p50_ms"],
+                         "ttft_p95_ms": mixed["ttft_p95_ms"]})
+    return rows
+
+
 def mixed_load(attn_impl: str, initial: int, arrivals: int,
-               arrive_every: int, prompt_len: int, max_steps: int):
+               arrive_every: int, prompt_len: int, max_steps: int,
+               kv_dtype: str = "model"):
     """Continuous-batching under MIXED prefill/decode load (the FastGen
     serving scenario the attention-only number can't show): ``initial``
     sequences arrive together, then one more every ``arrive_every`` steps —
@@ -82,7 +119,7 @@ def mixed_load(attn_impl: str, initial: int, arrivals: int,
     (mii/benchmarks), reference blogs' SplitFuse headline."""
     import numpy as np
 
-    engine, cfg = _make_engine(attn_impl)
+    engine, cfg = _make_engine(attn_impl, kv_dtype)
     rng = np.random.default_rng(0)
     total = initial + arrivals
 
@@ -162,6 +199,12 @@ def main():
     batch = int(os.environ.get("DSTPU_DECODE_BATCH", 16))
     prompt_len = int(os.environ.get("DSTPU_DECODE_PROMPT", 256))
     steps = int(os.environ.get("DSTPU_DECODE_STEPS", 64))
+    if os.environ.get("DSTPU_FORCE_CPU"):
+        # CPU smoke (jax is pre-imported on axon hosts; env vars are too
+        # late, config updates still work pre-backend-init)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 1)
     from bench_util import guard_device_discovery
     disarm = guard_device_discovery("bench_decode")
     import jax
@@ -181,17 +224,20 @@ def main():
     else:
         ms_k = ms_g = 0.0
         speedup = 1.0
+    extra = {"batch": batch, "prompt_len": prompt_len,
+             "decode_steps": steps, "attn_impl": impl,
+             "paged_attn_kernel_ms": round(ms_k, 2),
+             "paged_attn_gather_ms": round(ms_g, 2),
+             "attn_ctx": 2048, **mixed}
+    if os.environ.get("DSTPU_DECODE_TABLE") == "1":
+        extra["serving_table"] = serving_table(impl, prompt_len, steps)
 
     print(json.dumps({
         "metric": "llama_decode_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(speedup, 3),
-        "extra": {"batch": batch, "prompt_len": prompt_len,
-                  "decode_steps": steps, "attn_impl": impl,
-                  "paged_attn_kernel_ms": round(ms_k, 2),
-                  "paged_attn_gather_ms": round(ms_g, 2),
-                  "attn_ctx": 2048, **mixed},
+        "extra": extra,
     }))
 
 
