@@ -61,7 +61,11 @@ def main():
         loss = engine.train_batch(batch={"input_ids": ids})
         print(f"iter {it}: rollout lens {[len(r) for r in rollouts]}, "
               f"train loss {float(loss):.4f}")
-    print("rlhf hybrid flip OK")
+    # per-phase flip instrumentation (reference hybrid_engine.py:30
+    # _t_start/_t_gen family): train->generate view refresh cost
+    print(f"rlhf hybrid flip OK: {engine.flip_count} flips, "
+          f"mean flip latency "
+          f"{engine.latency_report()['flip_mean_s'] * 1e3:.1f} ms")
 
 
 if __name__ == "__main__":

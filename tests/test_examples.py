@@ -46,3 +46,10 @@ def test_serve_example_two_archs():
 
 def test_rlhf_example():
     assert "rlhf hybrid flip OK" in _run("rlhf_hybrid.py", "--iters", "2")
+
+
+def test_long_context_example():
+    for backend in ("ring", "ulysses"):
+        out = _run("long_context.py", "--backend", backend, "--seq", "256",
+                   "--steps", "3")
+        assert f"{backend} sp=4 seq=256" in out

@@ -399,3 +399,24 @@ def test_pipe_to_dense_cross_topology_restore():
                 "zero_optimization": {"stage": 3}},
         mesh=z3_mesh, example_batch={"input_ids": tokens[:4]})
     assert abs(float(e3.eval_batch({"input_ids": tokens})) - pipe_eval) < 5e-3
+
+
+def test_1f1b_masked_mode_matches_predicated():
+    """predicate=False (the dstpu_pipe_bench A/B baseline: compute-both-and-
+    mask) is numerically identical to the predicated executor — the bench's
+    speedup comparison is apples-to-apples."""
+    from deepspeed_tpu.runtime.pipe.one_f_one_b import pipeline_train_step_1f1b
+    stacked, tied, toks, block_fn, first_fn, last_fn = _toy_setup()
+    mesh = create_mesh(MeshConfig(pipe=4, data=2))
+    set_global_mesh(mesh)
+
+    loss_p, gp_p, gt_p = pipeline_train_step_1f1b(
+        block_fn, stacked, tied, toks, first_fn, last_fn, mesh=mesh)
+    loss_m, gp_m, gt_m = pipeline_train_step_1f1b(
+        block_fn, stacked, tied, toks, first_fn, last_fn, mesh=mesh,
+        predicate=False)
+    np.testing.assert_allclose(float(loss_p), float(loss_m), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves((gp_p, gt_p)),
+                    jax.tree.leaves((gp_m, gt_m))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
