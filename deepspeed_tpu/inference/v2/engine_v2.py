@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.inference.v2.generic_decode import decode_step_g, prefill_chunk_g
+from deepspeed_tpu.inference.v2.generic_decode import (decode_step_g,
+                                                       prefill_chunk_g,
+                                                       verify_chunk_g)
 from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache, KVCacheConfig
 from deepspeed_tpu.inference.v2.modules import policy_for
 from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor, StateManager
@@ -55,6 +57,14 @@ class V2EngineConfig:
     # quantizer, csrc/fp_quantizer) applied on load inside both attention
     # paths
     kv_cache_dtype: str = "model"
+    # draft-free speculative decoding (prompt-lookup): propose the k tokens
+    # that followed the last occurrence of the trailing n-gram, verify them
+    # in ONE chunk forward, accept the longest argmax-matching prefix + one
+    # bonus token — 1..k+1 tokens per step, EXACT greedy equivalence
+    # (beyond-reference: FastGen has no speculative decoding). 0 = off;
+    # greedy-only (engine.generate raises under sampling)
+    speculative_k: int = 0
+    speculative_ngram: int = 3
 
 
 class InferenceEngineV2:
@@ -103,6 +113,10 @@ class InferenceEngineV2:
         # tokens/positions are [B] ints and always refresh)
         self._table_sig = None
         self._dev_tables = None
+        # speculative-decoding counters (speculative_stats)
+        self._spec_steps = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     # ------------------------------------------------------------------
     # admission control (reference: engine_v2.py:158 query, :184 can_schedule)
@@ -261,9 +275,109 @@ class InferenceEngineV2:
 
     def generate(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
                  uid: int = 0) -> List[int]:
-        """Convenience serial generation loop over the continuous-batching step."""
+        """Convenience serial generation loop over the continuous-batching
+        step; with ``speculative_k > 0`` each step verifies a prompt-lookup
+        proposal in one chunk forward (1..k+1 tokens/step, exact greedy)."""
         self.put([uid], [list(prompt_tokens)])
         seq = self.state.get(uid)
         while len(seq.generated) < max_new_tokens and not seq.done:
+            if self.config.speculative_k > 0 and not seq.in_prefill:
+                self._speculative_step(seq)
+            else:
+                self.step()
+        # a fully-accepted verify step can overshoot the budget by up to k
+        return self.flush(uid)[:max_new_tokens]
+
+    # ------------------------------------------------------------------
+    # speculative decoding (draft-free prompt-lookup; no reference analog)
+    # ------------------------------------------------------------------
+    def _propose(self, seq: SequenceDescriptor) -> List[int]:
+        """Prompt-lookup proposal: the k tokens that followed the previous
+        occurrence of the context's trailing n-gram (exact match, most
+        recent occurrence wins). Empty when the tail never repeats."""
+        k, n = self.config.speculative_k, self.config.speculative_ngram
+        ctx = np.concatenate([seq.prompt_tokens,
+                              np.asarray(seq.generated, np.int32)])
+        if len(ctx) < n + 1:
+            return []
+        tail = ctx[-n:]
+        # vectorized scan over earlier n-gram positions; windows over
+        # ctx[:-1] exclude the tail itself, so any hit has a nonempty
+        # continuation — take the most recent
+        windows = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+        hits = np.flatnonzero((windows == tail).all(axis=1))
+        if not hits.size:
+            return []
+        i = int(hits[-1])
+        return [int(t) for t in ctx[i + n:i + n + k]]
+
+    def _speculative_step(self, seq: SequenceDescriptor) -> None:
+        """Verify [last_token, p1..pk] in one chunk forward: row i's argmax
+        predicts position ctx+i, so accept p_{i+1} while it matches, then
+        emit the first mismatch's argmax as the bonus/corrected token.
+        Rejected rows' stale K/V sits beyond the accepted context (invisible
+        under causal masking) and is overwritten by the next step. fp8
+        caveat: a rejected row's K/V can still GROW its page's scale
+        (monotone until release) — a precision effect on that page, same as
+        any outlier write, not a correctness hole."""
+        if not self.config.greedy:
+            raise ValueError("speculative decoding is greedy-only: "
+                             "proposal acceptance compares argmax chains")
+        proposed = self._propose(seq)[:31]   # bucket ladder caps rows at 32
+        if not proposed:
+            # no lookup hit: the 1-row decode path is ~bucket x cheaper than
+            # an empty verify chunk
             self.step()
-        return self.flush(uid)
+            return
+        last = seq.generated[-1] if seq.generated else \
+            int(seq.prompt_tokens[-1])
+        ctx = seq.total_tokens                    # last's position is ctx-1
+        true_len = 1 + len(proposed)
+        bucket = snap_bucket(true_len, (8, 16, 32))
+        self._ensure_blocks(seq, ctx + true_len)
+        mb = self._ctx_bucket_blocks(ctx + true_len)
+        tokens = np.zeros((bucket,), np.int32)
+        tokens[0] = last
+        tokens[1:true_len] = proposed
+        cache = self.kv.data if self.kv.scales is None else \
+            (self.kv.data, self.kv.scales)
+        logits, cache = verify_chunk_g(
+            self.params, cache, jnp.asarray(tokens), ctx - 1,
+            jnp.asarray(self._block_table(seq, mb)), true_len,
+            policy=self.policy, cfg=self.model_config,
+            block_size=self.kv.cfg.block_size,
+            attn_impl=self.config.attn_impl)
+        if self.kv.scales is None:
+            self.kv.data = cache
+        else:
+            self.kv.data, self.kv.scales = cache
+        preds = np.asarray(jnp.argmax(logits[:true_len], axis=-1))
+        emitted = []
+        for i, p in enumerate(proposed):
+            if int(preds[i]) == p:
+                emitted.append(p)               # accepted proposal token
+            else:
+                break
+        emitted.append(int(preds[len(emitted)]))  # bonus / corrected token
+        appended = 0
+        for tok in emitted:
+            seq.generated.append(tok)
+            appended += 1
+            if self.config.eos_token_id is not None and \
+                    tok == self.config.eos_token_id:
+                seq.done = True
+                break
+        # count what actually landed (EOS may truncate the step); the last
+        # entry of `emitted` is the bonus token, the rest were proposals
+        self._spec_proposed += len(proposed)
+        self._spec_accepted += min(appended, len(emitted) - 1)
+        self._spec_steps += 1
+        seq.seen_tokens = seq.total_tokens - 1    # last emitted has no KV yet
+
+    def speculative_stats(self) -> Dict[str, float]:
+        """{steps, proposed, accepted, tokens_per_step} over this engine's
+        speculative steps (acceptance rate drives the speedup)."""
+        return {"steps": self._spec_steps, "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "tokens_per_step": (self._spec_accepted + self._spec_steps)
+                / max(self._spec_steps, 1)}

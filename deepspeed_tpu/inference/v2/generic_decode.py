@@ -2,7 +2,7 @@
 
 Reference analog: ``inference/v2/model_implementations/inference_transformer_base.py``
 — the shared ragged forward skeleton that per-arch containers plug into. Here the
-skeleton is two jitted pure functions over (policy, config) static args; the
+skeleton is jitted pure functions over (policy, config) static args; the
 policy (``modules.py``) contributes embed/block/unembed and the loop owns KV
 cache writes + the Pallas paged attention (``llama_decode._paged_attn``).
 """
@@ -17,15 +17,13 @@ from deepspeed_tpu.inference.v2.kv_cache import (cast_to_page_dtype,
 from deepspeed_tpu.inference.v2.llama_decode import _paged_attn
 
 
-@partial(jax.jit, static_argnames=("policy", "cfg", "block_size", "attn_impl"))
-def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
-                    policy, cfg, block_size: int, attn_impl: str = "auto"):
-    """One sequence, one bucket-padded chunk; returns (last-token logits [V],
-    updated cache_data). See llama_decode.prefill_chunk for the argument
-    contract — this is the arch-generic version. ``cache_data`` may be the
-    plain page pool [L, 2, H, NB, bs, D] or a ``(pages, scales)`` tuple for
-    scaled fp8 pages (``BlockedKVCache.scales``); the same structure is
-    returned."""
+def _chunk_states(params, cache_data, tokens, start, block_table, true_len,
+                  policy, cfg, block_size: int, attn_impl: str):
+    """Shared chunk forward: embeds a bucket-padded token chunk, scatters
+    each layer's K/V into the pages, attends over the paged context, and
+    returns (per-row hidden states [Tb, D], updated cache). ``cache_data``
+    may be the plain page pool [L, 2, H, NB, bs, D] or a ``(pages, scales)``
+    tuple for scaled fp8 pages (``BlockedKVCache.scales``)."""
     spec = policy.cache_spec(cfg)
     tb = tokens.shape[0]
     mb = block_table.shape[0]
@@ -73,10 +71,37 @@ def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
                                jnp.asarray(start).reshape(1), win,
                                attn_impl, softcap=softcap)[0]
         x = policy.block(params, i, x, attend, safe_pos, cfg)
+    return x, cache
 
+
+@partial(jax.jit, static_argnames=("policy", "cfg", "block_size", "attn_impl"))
+def prefill_chunk_g(params, cache_data, tokens, start, block_table, true_len,
+                    policy, cfg, block_size: int, attn_impl: str = "auto"):
+    """One sequence, one bucket-padded chunk; returns (last-token logits [V],
+    updated cache_data). See llama_decode.prefill_chunk for the argument
+    contract — this is the arch-generic version; cache structure in ==
+    structure out (plain pool or (pages, scales))."""
+    x, cache = _chunk_states(params, cache_data, tokens, start, block_table,
+                             true_len, policy, cfg, block_size, attn_impl)
     last = x[jnp.maximum(true_len - 1, 0)]
     logits = policy.unembed(params, last[None], cfg)[0]
     return logits, cache
+
+
+@partial(jax.jit, static_argnames=("policy", "cfg", "block_size", "attn_impl"))
+def verify_chunk_g(params, cache_data, tokens, start, block_table, true_len,
+                   policy, cfg, block_size: int, attn_impl: str = "auto"):
+    """Speculative-decoding verifier: the same cache-writing chunk forward
+    as ``prefill_chunk_g`` but returns logits for EVERY row ([Tb, V]) — row
+    i holds the model's prediction for position ``start + i + 1``, so the
+    host accepts the longest proposal prefix whose tokens match the argmax
+    chain (draft-free prompt-lookup speculation; no reference analog —
+    FastGen has no speculative decoding). Rejected rows' K/V writes land at
+    positions beyond the accepted context and are invisible (causal masking
+    doubles as the context-length mask) until a later step overwrites them."""
+    x, cache = _chunk_states(params, cache_data, tokens, start, block_table,
+                             true_len, policy, cfg, block_size, attn_impl)
+    return policy.unembed(params, x, cfg), cache
 
 
 @partial(jax.jit, static_argnames=("policy", "cfg", "block_size", "attn_impl"))

@@ -312,3 +312,119 @@ def test_fp8_scaled_prefill_logit_error_bound(model_and_params):
     err = float(np.max(np.abs(fp8 - exact)))
     spread = float(np.max(exact) - np.min(exact))
     assert err < 0.05 * spread, (err, spread)
+
+
+def test_speculative_decode_fast_oracle(model_and_params):
+    """Fast stand-in: oracle proposals are fully accepted and the emitted
+    chain is exactly the plain greedy chain (full hit/miss/lookup matrix in
+    the slow test below)."""
+    cfg, model, params = model_and_params
+    mk = lambda k: InferenceEngineV2(params, cfg, V2EngineConfig(
+        kv_block_size=16, kv_num_blocks=64,
+        scheduler=SchedulerConfig(max_tokens_per_step=64,
+                                  prefill_buckets=(16, 32, 64)),
+        speculative_k=k))
+    prompt = list(np.random.default_rng(11).integers(0, cfg.vocab_size, 16))
+    plain = mk(0).generate(prompt, max_new_tokens=8)
+    eng = mk(4)
+    eng._propose = lambda seq: plain[len(seq.generated):
+                                     len(seq.generated) + 4]
+    spec = eng.generate(prompt, max_new_tokens=8)
+    assert spec == plain, (spec, plain)
+    st = eng.speculative_stats()
+    assert st["accepted"] == st["proposed"] > 0 and st["tokens_per_step"] > 2
+
+
+@pytest.mark.slow
+def test_speculative_decode_exact_greedy_equivalence(model_and_params):
+    """Speculative decoding (speculative_k>0): generation is EXACTLY the
+    plain greedy chain whether proposals all hit (oracle), all miss
+    (adversarial), or come from real prompt-lookup. Beyond-reference:
+    FastGen has no speculative decoding."""
+    cfg, model, params = model_and_params
+
+    def make(spec_k):
+        return InferenceEngineV2(params, cfg, V2EngineConfig(
+            kv_block_size=16, kv_num_blocks=64,
+            scheduler=SchedulerConfig(max_tokens_per_step=64,
+                                      prefill_buckets=(16, 32, 64)),
+            speculative_k=spec_k))
+
+    prompt = list(np.random.default_rng(11).integers(0, cfg.vocab_size, 20))
+    plain = make(0).generate(prompt, max_new_tokens=24)
+
+    # oracle proposals (the true continuation): every proposal accepted,
+    # ~k+1 tokens per verify step, output identical
+    eng = make(4)
+    eng._propose = lambda seq: plain[len(seq.generated):
+                                     len(seq.generated) + 4]
+    spec = eng.generate(prompt, max_new_tokens=24)
+    assert spec[:len(plain)] == plain, (spec, plain)
+    stats = eng.speculative_stats()
+    assert stats["accepted"] == stats["proposed"] > 0, stats
+    assert stats["tokens_per_step"] > 2.0, stats
+
+    # adversarial proposals (always wrong): every proposal rejected, the
+    # bonus/corrected token keeps the chain exact
+    eng_bad = make(4)
+    eng_bad._propose = lambda seq: [
+        (plain[len(seq.generated) + i] + 1 + i) % cfg.vocab_size
+        if len(seq.generated) + i < len(plain) else 1 for i in range(4)]
+    spec_bad = eng_bad.generate(prompt, max_new_tokens=24)
+    assert spec_bad[:len(plain)] == plain, (spec_bad, plain)
+    assert eng_bad.speculative_stats()["accepted"] == 0
+
+    # real prompt-lookup path end-to-end (proposals may or may not hit on a
+    # random model — output must stay exact either way)
+    spec_real = make(4).generate(prompt, max_new_tokens=24)
+    assert spec_real[:len(plain)] == plain, (spec_real, plain)
+
+    # sampling configs refuse (acceptance compares argmax chains)
+    eng_s = InferenceEngineV2(params, cfg, V2EngineConfig(
+        kv_block_size=16, kv_num_blocks=64, greedy=False,
+        speculative_k=4))
+    with pytest.raises(ValueError, match="greedy"):
+        eng_s.generate(prompt, max_new_tokens=4)
+
+
+def test_speculative_propose_prompt_lookup(model_and_params):
+    """_propose finds the continuation of the most recent earlier occurrence
+    of the trailing n-gram (prompt-lookup decoding)."""
+    cfg, model, params = model_and_params
+    eng = InferenceEngineV2(params, cfg, V2EngineConfig(
+        kv_block_size=16, kv_num_blocks=64, speculative_k=4,
+        speculative_ngram=3))
+    from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor
+    seq = SequenceDescriptor(
+        uid=0, prompt_tokens=np.asarray(
+            [5, 6, 7, 9, 9, 1, 2, 3, 8, 8, 8, 8, 1, 2, 3], np.int32))
+    # tail [1, 2, 3] occurred at index 5; continuation is [8, 8, 8, 8]
+    assert eng._propose(seq) == [8, 8, 8, 8]
+    # generated tokens extend the lookup context
+    seq2 = SequenceDescriptor(
+        uid=1, prompt_tokens=np.asarray([4, 1, 2, 3, 7, 7], np.int32))
+    seq2.generated = [1, 2, 3]
+    assert eng._propose(seq2) == [7, 7, 1, 2]     # continuation at index 1
+    # no earlier occurrence -> no proposal
+    seq3 = SequenceDescriptor(
+        uid=2, prompt_tokens=np.asarray([1, 2, 3, 4, 5, 6], np.int32))
+    assert eng._propose(seq3) == []
+
+
+def test_speculative_decode_with_fp8_kv(model_and_params):
+    """Speculation composes with scaled fp8 pages (the verifier chunk runs
+    the scaled write path); greedy prefix still matches plain fp8 decode."""
+    cfg, model, params = model_and_params
+    base = list(np.random.default_rng(13).integers(0, cfg.vocab_size, 5))
+    prompt = base * 4
+
+    def make(spec_k):
+        return InferenceEngineV2(params, cfg, V2EngineConfig(
+            kv_block_size=16, kv_num_blocks=64,
+            scheduler=SchedulerConfig(max_tokens_per_step=64,
+                                      prefill_buckets=(16, 32, 64)),
+            kv_cache_dtype="fp8", speculative_k=spec_k))
+
+    plain = make(0).generate(prompt, max_new_tokens=12)
+    spec = make(4).generate(prompt, max_new_tokens=12)
+    assert spec[:4] == plain[:4], (spec, plain)   # fp8 near-tie tolerance
