@@ -278,6 +278,7 @@ def test_fp8_scaled_pages_outlier_accuracy():
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_fp8_scaled_prefill_logit_error_bound(model_and_params):
     """64+-token prefill with outlier-inflated K/V projections: scaled fp8
     pages keep the last-token logits within a tight bound of the f32-cache
@@ -428,3 +429,27 @@ def test_speculative_decode_with_fp8_kv(model_and_params):
     plain = make(0).generate(prompt, max_new_tokens=12)
     spec = make(4).generate(prompt, max_new_tokens=12)
     assert spec[:4] == plain[:4], (spec, plain)   # fp8 near-tie tolerance
+
+
+def test_fp8_scaled_cache_tuple_fast(model_and_params):
+    """Fast stand-in: the (pages, scales) tuple cache flows through
+    prefill_chunk_g — fp8 pool stays fp8, scales array round-trips, logits
+    finite (the 80-token logit-error bound lives in the slow test)."""
+    from deepspeed_tpu.inference.v2.generic_decode import prefill_chunk_g
+    from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache, KVCacheConfig
+    from deepspeed_tpu.inference.v2.modules import LlamaPolicy
+    cfg, model, params = model_and_params
+    kv = BlockedKVCache(KVCacheConfig(
+        num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim_, block_size=16, num_blocks=16,
+        dtype=jnp.float8_e4m3fn))
+    assert kv.scales is not None
+    tokens = np.zeros(16, np.int32)
+    tokens[:10] = np.random.default_rng(2).integers(0, cfg.vocab_size, 10)
+    logits, (data, scales) = prefill_chunk_g(
+        params, (kv.data, kv.scales), jnp.asarray(tokens), 0,
+        jnp.asarray(np.arange(4), np.int32), 10, policy=LlamaPolicy,
+        cfg=cfg, block_size=16, attn_impl="gather")
+    assert np.isfinite(np.asarray(logits)).all()
+    assert data.dtype == jnp.float8_e4m3fn
+    assert scales.shape == kv.scales.shape and bool((scales >= 1.0).all())
