@@ -18,8 +18,12 @@ REFERENCE_MFU = 0.54  # BASELINE.md: Ulysses sustained >54% of peak
 
 def main():
     from bench_util import guard_device_discovery
-    disarm = guard_device_discovery(
-        "bench", stale_metric="llama_train_tokens_per_sec_per_chip")
+    # per-preset metric names: a wedged 8b run must NOT replay the banked
+    # 697m headline as its own (cross-measurement substitution)
+    _preset = os.environ.get("DSTPU_BENCH_MODEL", "697m")
+    metric_name = "llama_train_tokens_per_sec_per_chip" if _preset == "697m" \
+        else f"llama_{_preset}_train_tokens_per_sec_per_chip"
+    disarm = guard_device_discovery("bench", stale_metric=metric_name)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -32,18 +36,34 @@ def main():
 
     n_devices = len(jax.devices())
     seq_len = 2048
-    # micro_batch=4/gas=2 reaches ~0.68 MFU but sits within ~260MB of the HBM
-    # ceiling (flaky OOM depending on allocator state); 2/4 is the safe default
-    micro_batch = int(os.environ.get("DSTPU_BENCH_MICRO_BATCH", 2))
-    gas = int(os.environ.get("DSTPU_BENCH_GAS", 4))
+
+    # --- model-size ladder (BASELINE north star is 8B; VERDICT r4 task 2) ----
+    # Each preset picks the memory tier a v5e chip (16GB HBM) needs at that
+    # size: 697m fits whole; 1b/3b keep fp32 masters+moments on host
+    # (ZeRO-Offload, host fused Adam); 8b streams the WEIGHTS themselves
+    # (ZeRO-Infinity param offload) since 16.1GB bf16 alone exceeds HBM.
+    #          hidden inter  layers heads kv  mb gas  offload
+    presets = {
+        "697m": (2048,  5632, 12,   16,   8,  2,  4,  "none"),
+        "1b":   (2048,  5632, 24,   16,   8,  1,  4,  "optimizer"),
+        "3b":   (3072,  8192, 28,   24,   8,  1,  4,  "optimizer"),
+        "8b":   (4096, 14336, 32,   32,   8,  1,  2,  "param"),
+    }
+    preset = os.environ.get("DSTPU_BENCH_MODEL", "697m")
+    if preset not in presets:
+        raise SystemExit(f"DSTPU_BENCH_MODEL must be one of {sorted(presets)}")
+    hidden, inter, layers, heads, kv, mb_default, gas_default, tier = presets[preset]
+    # micro_batch=4/gas=2 reaches ~0.68 MFU on 697m but sits within ~260MB of
+    # the HBM ceiling (flaky OOM depending on allocator state); the preset
+    # defaults are the safe configs
+    micro_batch = int(os.environ.get("DSTPU_BENCH_MICRO_BATCH", mb_default))
+    gas = int(os.environ.get("DSTPU_BENCH_GAS", gas_default))
     batch = micro_batch * gas * n_devices
 
-    # Fits one v5e chip (16GB HBM): remat recomputes activations, bf16 grad
-    # accumulation halves the gas scan carry, fp32 masters + adam moments for
-    # the 0.7B model are ~8.4GB.
     cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=seq_len,
+        vocab_size=32000, hidden_size=hidden, intermediate_size=inter,
+        num_layers=layers, num_heads=heads, num_kv_heads=kv,
+        max_seq_len=seq_len,
         dtype=jnp.bfloat16,
         attention_backend=os.environ.get("DSTPU_BENCH_ATTN", "flash"),
         # chunked head+CE fusion: the fp32 [B*S,V] logits (1GB at mb=4) never
@@ -61,13 +81,21 @@ def main():
         remat=os.environ.get("DSTPU_BENCH_REMAT", "1") == "1",
         remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
                                     "dots_with_no_batch_dims_saveable"))
+    zero = {"stage": 0 if n_devices == 1 else 3}
+    if tier == "optimizer":
+        zero["offload_optimizer"] = {"device": "cpu", "ratio": 0.0}
+    elif tier == "param":
+        zero["offload_optimizer"] = {"device": "cpu", "ratio": 0.0}
+        zero["offload_param"] = {
+            "device": "cpu",
+            "layers_per_group": int(os.environ.get("DSTPU_BENCH_LPG", 4))}
     config = {
         "train_batch_size": batch,
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
         "bf16": {"enabled": True},
         "data_types": {"grad_accum_dtype": "bf16"},
-        "zero_optimization": {"stage": 0 if n_devices == 1 else 3},
+        "zero_optimization": zero,
         "steps_per_print": 1000000,
     }
     model = LlamaForCausalLM(cfg)
@@ -94,18 +122,21 @@ def main():
 
     tokens_per_sec = steps * batch * seq_len / dt
     tokens_per_sec_chip = tokens_per_sec / n_devices
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(engine.state.params))
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree.leaves(engine.get_params()))
     flops_per_token = 6 * n_params  # fwd+bwd dense FLOPs (attention excluded → lower bound)
     achieved_tflops = tokens_per_sec_chip * flops_per_token / 1e12
     peak = get_accelerator().peak_tflops("bf16") or 197.0
     mfu = achieved_tflops / peak
 
     record = {
-        "metric": "llama_train_tokens_per_sec_per_chip",
+        "metric": metric_name,
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu / REFERENCE_MFU, 3),
         "extra": {
+            "model": preset,
+            "memory_tier": tier,
             "n_devices": n_devices,
             "params_millions": round(n_params / 1e6, 1),
             "seq_len": seq_len,
@@ -115,8 +146,11 @@ def main():
         },
     }
     print(json.dumps(record))
-    from bench_util import bank_headline
-    bank_headline(record)
+    if not any(k.startswith("DSTPU_BENCH_") for k in os.environ):
+        # only the all-defaults config banks the canonical stale-fallback
+        # headline — an A/B knob run must never become the replayed record
+        from bench_util import bank_headline
+        bank_headline(record)
 
 
 if __name__ == "__main__":

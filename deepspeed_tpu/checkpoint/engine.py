@@ -197,16 +197,25 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     state = engine.state
     offload = getattr(engine, "_offload", None)
+    param_offload = getattr(engine, "_param_offload", None)
     # Restore with the *current* engine shardings — a mesh/world-size change between
     # save and load reshapes automatically (the UCP capability, built in).
     # Checkpointed params are always fp32 (masters); under offload the live
     # device params are compute-dtype, so the target dtype is forced to fp32.
-    target = {
-        "params": jax.tree.map(
+    if param_offload is not None:
+        # params never materialize on device: restore straight to host arrays
+        # (no sharding in the target -> orbax returns numpy)
+        params_target = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, np.float32),
+            param_offload.masters_tree())
+    else:
+        params_target = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(
                 x.shape, np.float32 if offload is not None else x.dtype,
                 sharding=s),
-            state.params, engine.param_shardings),
+            state.params, engine.param_shardings)
+    target = {
+        "params": params_target,
         "opt_state": jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             state.opt_state, engine.opt_state_shardings),
@@ -256,10 +265,16 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 log_dist("offload: checkpoint has no host optimizer state; "
                          "moments reset to zero", ranks=[0])
             offload.set_masters(masters, reset_moments=True)
-        shadow = offload.shadows(np.dtype(engine.compute_dtype).name)
-        restored_params = jax.device_put(
-            jax.tree_util.tree_unflatten(engine._params_treedef, shadow),
-            engine.param_shardings)
+        if param_offload is not None:
+            # streamed params: refresh the host compute store (+ nvme files)
+            # from the restored masters; device params stay empty
+            param_offload.sync_store()
+            restored_params = state.params
+        else:
+            shadow = offload.shadows(np.dtype(engine.compute_dtype).name)
+            restored_params = jax.device_put(
+                jax.tree_util.tree_unflatten(engine._params_treedef, shadow),
+                engine.param_shardings)
 
     engine.state = EngineState(
         step=sc["step"],

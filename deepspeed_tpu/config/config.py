@@ -50,6 +50,9 @@ class OffloadConfig(DeepSpeedTPUConfigModel):
     pipeline_read: bool = True
     pipeline_write: bool = True
     ratio: float = 1.0  # Twin-Flow partial offload (engine.py:757 zero_partial_offload)
+    # offload_param streaming granularity: transformer blocks per streamed
+    # group (larger = fewer, bigger H2D transfers but more HBM per group)
+    layers_per_group: int = 1
 
 
 class ZeroConfig(DeepSpeedTPUConfigModel):

@@ -222,8 +222,60 @@ class DeepSpeedTPUEngine:
             opt_params = dict(config.optimizer.params) if config.optimizer else {}
             self.tx = build_optimizer(opt_type, opt_params, lr_schedule=self.lr_schedule)
 
+        # batch sharding: leading dim over (data, fsdp) unless caller overrides
+        self.batch_spec = batch_spec if batch_spec is not None \
+            else PartitionSpec(mesh_lib.batch_axes(self.mesh))
+        self.batch_sharding = NamedSharding(self.mesh, self.batch_spec)
+
+        # --- ZeRO-Infinity parameter offload ---------------------------------
+        # Params live on host/NVMe and stream through HBM layer-group by
+        # layer-group (runtime/param_offload.py; reference
+        # partitioned_param_swapper.py:37). A non-"none" offload_param either
+        # takes effect here or RAISES — never parses-and-ignores.
+        self._param_offload = None
+        _pcfg = config.zero_config.offload_param
+        if _pcfg.device != "none":
+            from deepspeed_tpu.runtime.param_offload import (
+                ParamOffloadTrainer, validate_param_offload)
+            # fail fast BEFORE host param init (which may allocate tens of GB)
+            validate_param_offload(config, model)
+            if client_optimizer is not None:
+                raise ValueError(
+                    "offload_param requires a config-typed optimizer (the "
+                    "update runs in the fused host kernel, not optax)")
+            if params is None:
+                if example_batch is None:
+                    raise ValueError("example_batch required to init a flax "
+                                     "Module")
+                self._rng, init_rng = jax.random.split(self._rng)
+                params = self._host_init_params(model, example_batch, init_rng)
+            params = jax.tree.map(lambda x: np.asarray(x), params)
+            scalar_sharding = NamedSharding(self.mesh, PartitionSpec())
+            self.param_shardings = None
+            self.opt_state_shardings = ()
+            self.state = EngineState(
+                step=jax.device_put(jnp.int32(0), scalar_sharding),
+                params=(),
+                opt_state=(),
+                loss_scale=jax.device_put(
+                    precision.init_loss_scale(config.fp16), scalar_sharding),
+                skipped_steps=jax.device_put(jnp.int32(0), scalar_sharding),
+            )
+            self.state_shardings = None
+            self._param_offload = ParamOffloadTrainer(
+                model, config, params, self.mesh, self.batch_sharding,
+                self.lr_schedule)
+            params = None      # host copy now owned by the trainer's masters
+            # checkpoint interop: host masters are the authoritative weights
+            self._offload = self._param_offload.opt
+            self._offload_grad_fn = None
+            self._offload_apply_fn = None
+            self._params_treedef = self._param_offload.treedef
+
         # --- parameter init + sharding --------------------------------------
-        if params is None:
+        if self._param_offload is not None:
+            pass
+        elif params is None:
             if not hasattr(model, "init"):
                 raise ValueError("pass `params` or a flax Module with .init")
             if example_batch is None:
@@ -245,66 +297,64 @@ class DeepSpeedTPUEngine:
             params = jax.device_put(
                 jax.tree.map(lambda x: np.asarray(x), params), self.param_shardings)
 
-        # fp32 master weights (reference: FP16_Optimizer / BF16_Optimizer)
-        params = jax.tree.map(
-            lambda x: x.astype(jnp.float32)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        if self._param_offload is None:
+            # fp32 master weights (reference: FP16_Optimizer / BF16_Optimizer)
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
 
-        # --- optimizer-state offload tier (ZeRO-Offload / Infinity) ----------
-        # Constructed BEFORE device state: under offload the device holds only
-        # compute-dtype param shadows — no fp32 masters, no optimizer moments in
-        # HBM (that is the point of the tier; reference keeps fp16 shards on
-        # device and fp32 masters + moments on host).
-        self._offload = None
-        self._offload_grad_fn = None
-        self._offload_apply_fn = None
-        offload_cfg = config.zero_config.offload_optimizer
-        if offload_cfg.device in ("cpu", "nvme"):
-            from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
-            host_leaves = [np.asarray(jax.device_get(p), np.float32)
-                           for p in jax.tree.leaves(params)]
-            opt_type = config.optimizer.type if config.optimizer else "adamw"
-            self._offload = HostOffloadOptimizer(
-                host_leaves, opt_type,
-                dict(config.optimizer.params) if config.optimizer else {},
-                offload_cfg)
-            self._params_treedef = jax.tree_util.tree_structure(params)
-            params = jax.jit(
-                lambda p: precision.cast_to_compute(p, self.compute_dtype),
-                out_shardings=self.param_shardings)(params)
-            self.opt_state_shardings = ()
-            opt_state = ()
-        else:
-            param_specs = jax.tree.map(lambda s: s.spec, self.param_shardings,
-                                       is_leaf=lambda x: isinstance(x, NamedSharding))
-            opt_state_shape = jax.eval_shape(self.tx.init, params)
-            self.opt_state_shardings = build_opt_state_shardings(
-                opt_state_shape, params, param_specs, self.mesh,
-                max(self.zero_stage, 0), mics=self._mics)
-            opt_state = jax.jit(self.tx.init,
-                                out_shardings=self.opt_state_shardings)(params)
+            # --- optimizer-state offload tier (ZeRO-Offload / Infinity) ------
+            # Constructed BEFORE device state: under offload the device holds
+            # only compute-dtype param shadows — no fp32 masters, no optimizer
+            # moments in HBM (that is the point of the tier; reference keeps
+            # fp16 shards on device and fp32 masters + moments on host).
+            self._offload = None
+            self._offload_grad_fn = None
+            self._offload_apply_fn = None
+            offload_cfg = config.zero_config.offload_optimizer
+            if offload_cfg.device in ("cpu", "nvme"):
+                from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
+                host_leaves = [np.asarray(jax.device_get(p), np.float32)
+                               for p in jax.tree.leaves(params)]
+                opt_type = config.optimizer.type if config.optimizer else "adamw"
+                self._offload = HostOffloadOptimizer(
+                    host_leaves, opt_type,
+                    dict(config.optimizer.params) if config.optimizer else {},
+                    offload_cfg)
+                self._params_treedef = jax.tree_util.tree_structure(params)
+                params = jax.jit(
+                    lambda p: precision.cast_to_compute(p, self.compute_dtype),
+                    out_shardings=self.param_shardings)(params)
+                self.opt_state_shardings = ()
+                opt_state = ()
+            else:
+                param_specs = jax.tree.map(
+                    lambda s: s.spec, self.param_shardings,
+                    is_leaf=lambda x: isinstance(x, NamedSharding))
+                opt_state_shape = jax.eval_shape(self.tx.init, params)
+                self.opt_state_shardings = build_opt_state_shardings(
+                    opt_state_shape, params, param_specs, self.mesh,
+                    max(self.zero_stage, 0), mics=self._mics)
+                opt_state = jax.jit(self.tx.init,
+                                    out_shardings=self.opt_state_shardings)(params)
 
-        scalar_sharding = NamedSharding(self.mesh, PartitionSpec())
-        self.state = EngineState(
-            step=jax.device_put(jnp.int32(0), scalar_sharding),
-            params=params,
-            opt_state=opt_state,
-            loss_scale=jax.device_put(precision.init_loss_scale(config.fp16),
-                                      scalar_sharding),
-            skipped_steps=jax.device_put(jnp.int32(0), scalar_sharding),
-        )
-        self.state_shardings = EngineState(
-            step=scalar_sharding,
-            params=self.param_shardings,
-            opt_state=self.opt_state_shardings,
-            loss_scale=jax.tree.map(lambda _: scalar_sharding, self.state.loss_scale),
-            skipped_steps=scalar_sharding,
-        )
-
-        # batch sharding: leading dim over (data, fsdp) unless caller overrides
-        self.batch_spec = batch_spec if batch_spec is not None \
-            else PartitionSpec(mesh_lib.batch_axes(self.mesh))
-        self.batch_sharding = NamedSharding(self.mesh, self.batch_spec)
+            scalar_sharding = NamedSharding(self.mesh, PartitionSpec())
+            self.state = EngineState(
+                step=jax.device_put(jnp.int32(0), scalar_sharding),
+                params=params,
+                opt_state=opt_state,
+                loss_scale=jax.device_put(precision.init_loss_scale(config.fp16),
+                                          scalar_sharding),
+                skipped_steps=jax.device_put(jnp.int32(0), scalar_sharding),
+            )
+            self.state_shardings = EngineState(
+                step=scalar_sharding,
+                params=self.param_shardings,
+                opt_state=self.opt_state_shardings,
+                loss_scale=jax.tree.map(lambda _: scalar_sharding,
+                                        self.state.loss_scale),
+                skipped_steps=scalar_sharding,
+            )
 
         # hpZ secondary compute-copy shardings (stage 3 only; with the hpZ split
         # active, compute params are constrained to the inner fsdp sub-axis so
@@ -335,7 +385,8 @@ class DeepSpeedTPUEngine:
         # sparse embedding grads)
         from deepspeed_tpu.runtime.zero.qgz import replica_grad_axes
         self._replica_axes = replica_grad_axes(
-            self.mesh, self.batch_spec, self.param_shardings)
+            self.mesh, self.batch_spec, self.param_shardings) \
+            if self._param_offload is None else ()
         self._qgz_axes = ()
         if self._quantized_gradients:
             self._qgz_axes = self._replica_axes
@@ -466,6 +517,28 @@ class DeepSpeedTPUEngine:
                 {"compression_training": config.compression_config})
             self.compressor.maybe_freeze_masks(self.state.params)
             self._compression_key = self.compressor.schedule_key()
+
+    @staticmethod
+    def _host_init_params(model, example_batch, init_rng):
+        """Initialize params in HOST memory (CPU backend): under offload_param
+        the model may not fit device HBM, so device-side init is not an
+        option. Falls back to default-device init + fetch when no CPU backend
+        exists (then the model must fit HBM once; pass ``params`` to avoid)."""
+        if not hasattr(model, "init"):
+            raise ValueError("pass `params` or a flax Module with .init")
+
+        def _init(r):
+            return model.init(r, example_batch)["params"]
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            log_dist("offload_param: no CPU backend for host init — "
+                     "initializing on the default device (model must fit HBM "
+                     "once; pass `params` for weights-bigger-than-HBM runs)",
+                     ranks=[0])
+            return jax.device_get(jax.jit(_init)(init_rng))
+        with jax.default_device(cpu):
+            return jax.device_get(jax.jit(_init)(jax.device_put(init_rng, cpu)))
 
     def _reset_compiled_fns(self):
         """Drop every cached compiled step fn. The single authority for the set of
@@ -752,6 +825,8 @@ class DeepSpeedTPUEngine:
         if (self.config.flops_profiler.enabled
                 and self.global_steps == self.config.flops_profiler.profile_step):
             self._run_flops_profile(batch)
+        if self._param_offload is not None:
+            return self._train_batch_param_offload(batch)
         if self._offload is not None:
             return self._train_batch_offloaded(batch)
         if self._train_batch_fn is None:
@@ -783,6 +858,27 @@ class DeepSpeedTPUEngine:
         self._advance_data_schedules()
         self._record_metrics(out)
         return out.loss
+
+    def _train_batch_param_offload(self, batch) -> jnp.ndarray:
+        """ZeRO-Infinity parameter-offload step: the streamed layer-group
+        fwd/bwd + fused host optimizer in runtime/param_offload.py."""
+        batch_host = {k: np.asarray(v) for k, v in batch.items()}
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        loss, norm = self._param_offload.train_batch(
+            batch_host, step=self.global_steps)
+        self.timers(TRAIN_BATCH_TIMER).stop()
+        self.tput_timer.stop(global_step=True)
+        self.state = self.state._replace(step=self.state.step + 1)
+        self.global_steps += 1
+        self.micro_steps += self.gradient_accumulation_steps
+        self.global_samples += self.train_batch_size
+        self._advance_data_schedules()
+        lr = float(jax.device_get(self.lr_schedule(self.state.step)))
+        self._record_metrics(StepOutput(
+            loss=jnp.float32(loss), grad_norm=jnp.float32(norm),
+            lr=jnp.float32(lr), overflow=jnp.bool_(False)))
+        return jnp.float32(loss)
 
     def _train_batch_offloaded(self, batch) -> jnp.ndarray:
         """ZeRO-Offload step: device grads under jit, fused C++ host optimizer on
@@ -975,9 +1071,17 @@ class DeepSpeedTPUEngine:
             apply_update, donate_argnums=(0, 1),
             out_shardings=(self.state_shardings, None))
 
+    def _reject_param_offload(self, api: str):
+        if self._param_offload is not None:
+            raise NotImplementedError(
+                f"{api} is not supported with offload_param: the streamed "
+                "step cannot keep per-microbatch grads device-resident "
+                "between calls — use train_batch()")
+
     def forward(self, batch) -> jnp.ndarray:
         """Compat shim (reference engine.forward:1838): computes loss AND caches
         grads for the subsequent backward()."""
+        self._reject_param_offload("forward()")
         if self._micro_fwd_bwd_fn is None:
             self._build_micro_fns()
         self.timers(FORWARD_GLOBAL_TIMER).start()
@@ -1012,6 +1116,7 @@ class DeepSpeedTPUEngine:
         """Compat shim (reference engine.step:2176): applies the update at the
         gradient-accumulation boundary; otherwise a no-op. Routes through the
         host offload optimizer when configured (same path as train_batch)."""
+        self._reject_param_offload("step()")
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(STEP_GLOBAL_TIMER).start()
@@ -1072,6 +1177,7 @@ class DeepSpeedTPUEngine:
         return self.train(False)
 
     def eval_batch(self, batch) -> jnp.ndarray:
+        self._reject_param_offload("eval_batch()")
         if self._eval_fn is None:
             def ev(params, batch, rng):
                 return self._compute_loss(params, batch, rng)
@@ -1108,9 +1214,13 @@ class DeepSpeedTPUEngine:
         return self.micro_batch_size
 
     def get_params(self):
+        if self._param_offload is not None:
+            return self._param_offload.masters_tree()
         return self.state.params
 
     def module_state_dict(self):
+        if self._param_offload is not None:
+            return self._param_offload.masters_tree()
         return jax.device_get(self.state.params)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,551 @@
+"""ZeRO-Infinity training-side PARAMETER offload: train models whose compute
+weights exceed device HBM on a small slice.
+
+Reference analogs:
+- ``runtime/swap_tensor/partitioned_param_swapper.py:37``
+  (AsyncPartitionedParameterSwapper): fp16 params on NVMe, fetched into pinned
+  buffers around each module's fwd/bwd, wired via
+  ``partition_parameters.py:1100`` and ``parameter_offload.py:85`` module hooks.
+- ``pipelined_optimizer_swapper.py``: double-buffered swap (prefetch sub-group
+  *i+1* while sub-group *i* computes).
+
+TPU-native shape: instead of per-``nn.Module`` hooks patched into a mutable
+module tree, the model is partitioned into LAYER GROUPS (embed | N transformer
+blocks per group | final-norm+head) and the train step becomes a host-driven
+stream over jitted per-group functions:
+
+  fwd:  for g in 0..G-1:   H2D(params[g+1]) overlaps  x = fwd_g(params[g], x)
+        (boundary activations x_g stay in HBM — [B,S,H] each, tiny next to
+        the weights being streamed)
+  loss: tail_grad() returns (loss, dx, tail grads) in one jit
+  bwd:  for g in G-1..0:   H2D(params[g-1]) overlaps
+        (dx, grads_g) = bwd_g(params[g], x_g, dx)   # recompute-in-group (remat)
+        grads_g stream D2H into fp32 host accumulators and leave HBM
+  step: fused C++ host optimizer (CPUAdam/Adagrad/Lion) updates fp32 masters
+        (``HostOffloadOptimizer`` — host or NVMe moment tier), then the
+        compute-dtype store is refreshed from the masters.
+
+Peak HBM = 2 layer groups (double buffer) + boundary activations + one group's
+grads — independent of model size. ``offload_param.device: cpu`` keeps the
+compute-dtype store in host RAM; ``nvme`` keeps layer groups in per-group files
+streamed through the aio engine (embed/tail stay in RAM: they are touched
+twice per microbatch). ``offload_param.ratio`` (Twin-Flow, reference
+engine.py:757) pins the first ``1-ratio`` fraction of layer groups in RAM.
+
+Supported model family: the in-repo Llama tree layout (``model/embed``,
+``model/layer_i``, ``model/final_norm``[, ``model/lm_head``]) with
+``scan_layers=False`` — the same layout the ZeRO-Inference streamed path uses.
+Unsupported configs RAISE at engine init (a parsed-and-ignored ``offload_param``
+was the round-4 correctness trap).
+"""
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.ops.cpu_adam import to_bf16
+from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def validate_param_offload(config: DeepSpeedTPUConfig, model) -> None:
+    """Raise (never silently ignore) when ``offload_param`` cannot be honored."""
+    pcfg = config.zero_config.offload_param
+    if pcfg.device not in ("cpu", "nvme"):
+        raise ValueError(
+            f"offload_param.device must be none|cpu|nvme, got {pcfg.device!r}")
+    cfg = getattr(model, "cfg", None)
+    if cfg is None or not hasattr(cfg, "num_layers"):
+        raise ValueError(
+            "offload_param needs a layered model exposing .cfg.num_layers "
+            "(the in-repo Llama family); got "
+            f"{type(model).__name__} — either drop offload_param or use a "
+            "LlamaForCausalLM-style model")
+    if getattr(cfg, "scan_layers", False):
+        raise ValueError(
+            "offload_param requires scan_layers=False: layer streaming "
+            "addresses per-layer subtrees (model/layer_i), which nn.scan "
+            "stacks into one leaf")
+    if config.fp16.enabled:
+        raise ValueError(
+            "offload_param supports bf16/fp32 only (TPU-native precisions); "
+            "fp16 dynamic loss scaling is not wired through the streamed "
+            "step — use bf16")
+    if config.compression_config or config.eigenvalue.enabled:
+        raise ValueError(
+            "offload_param is incompatible with compression/eigenvalue "
+            "(both address device-resident params)")
+    if config.sparse_gradients_enabled:
+        raise ValueError(
+            "offload_param accumulates grads on host; sparse_gradients' "
+            "wire reduction does not apply — disable it")
+    if config.flops_profiler.enabled:
+        raise ValueError(
+            "offload_param is incompatible with flops_profiler (it traces "
+            "the whole-model step, which never exists under streaming)")
+    zc = config.zero_config
+    if (zc.zero_hpz_partition_size or 1) > 1 or (zc.mics_shard_size or 0) > 0 \
+            or zc.zero_quantized_weights or zc.zero_quantized_gradients:
+        raise ValueError(
+            "offload_param is incompatible with hpZ/MiCS/qwZ/qgZ: those "
+            "shard or compress device-resident params; offloaded params "
+            "stream from host instead")
+    if pcfg.device == "nvme" and not pcfg.nvme_path:
+        raise ValueError("offload_param.device=nvme requires nvme_path")
+
+
+class _BlockStack(nn.Module):
+    """``n`` LlamaBlocks under local names layer_0..layer_{n-1} (the group's
+    host subtree is re-keyed from global layer indices)."""
+    cfg: Any
+    n: int
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        from deepspeed_tpu.models.llama import REMAT_POLICIES, LlamaBlock
+        block_cls = LlamaBlock
+        if self.cfg.remat:
+            block_cls = nn.remat(LlamaBlock,
+                                 policy=REMAT_POLICIES[self.cfg.remat_policy],
+                                 prevent_cse=True, static_argnums=())
+        for i in range(self.n):
+            x = block_cls(self.cfg, name=f"layer_{i}")(x, positions, segment_ids)
+        return x
+
+
+class _TailLoss(nn.Module):
+    """final_norm + unembed + masked mean CE over all S positions (labels are
+    pre-shifted/padded host-side so shapes stay static — same formulation as
+    LlamaForCausalLM._chunked_loss, numerically equal to the dense loss)."""
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x, embedding, labels, mask):
+        from deepspeed_tpu.models.llama import LMHead, RMSNorm, softcap_logits
+        cfg = self.cfg
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    scale_offset=cfg.rms_scale_offset, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            # flax Embed.attend: promote both to cfg.dtype, contract over H
+            logits = jnp.dot(x.astype(cfg.dtype),
+                             embedding.astype(cfg.dtype).T)
+        else:
+            logits = LMHead(cfg.hidden_size, cfg.vocab_size, cfg.dtype,
+                            name="lm_head")(x)
+        logits = logits.astype(jnp.float32)
+        logits = softcap_logits(logits, cfg.logits_soft_cap)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        m = mask.astype(jnp.float32)
+        return -jnp.sum(ll * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def _path_str(path) -> str:
+    return "/".join(getattr(e, "key", getattr(e, "name", str(e)))
+                    for e in path)
+
+
+class ParamOffloadTrainer:
+    """Streamed train step over a host-resident parameter store."""
+
+    def __init__(self, model, config: DeepSpeedTPUConfig, params_host,
+                 mesh, batch_sharding, lr_schedule):
+        validate_param_offload(config, model)
+        self.cfg = model.cfg
+        self.config = config
+        self.mesh = mesh
+        self.batch_sharding = batch_sharding
+        self.lr_schedule = lr_schedule
+        self.compute_dtype = config.precision_dtype
+        pcfg = config.zero_config.offload_param
+
+        # --- flat host masters + fused host optimizer -----------------------
+        # offload_param implies host masters+moments: if weights don't fit HBM,
+        # fp32 states certainly don't. offload_optimizer.device selects the
+        # moment tier (cpu default; nvme = full ZeRO-Infinity).
+        ocfg = config.zero_config.offload_optimizer
+        if ocfg.device == "none":
+            ocfg = ocfg.model_copy(update={"device": "cpu"})
+            log_dist("offload_param: optimizer states implicitly offloaded "
+                     "to cpu (device weights are streamed; fp32 states "
+                     "cannot be device-resident)", ranks=[0])
+        flat, self._treedef = jax.tree_util.tree_flatten(params_host)
+        paths = jax.tree_util.tree_flatten_with_path(params_host)[0]
+        self._paths = [_path_str(p) for p, _ in paths]
+        self._path_idx = {p: i for i, p in enumerate(self._paths)}
+        host_leaves = [np.asarray(x, np.float32) for x in flat]
+        opt_type = config.optimizer.type if config.optimizer else "adamw"
+        opt_params = dict(config.optimizer.params) if config.optimizer else {}
+        self.opt = HostOffloadOptimizer(host_leaves, opt_type, opt_params, ocfg)
+
+        # --- compute-dtype store (the streamed weights) ---------------------
+        self._store: List[np.ndarray] = [None] * len(host_leaves)
+        self._refresh_store()
+
+        # --- layer groups ----------------------------------------------------
+        L = self.cfg.num_layers
+        per = max(1, int(getattr(pcfg, "layers_per_group", 1) or 1))
+        self._layer_groups: List[List[int]] = [
+            list(range(a, min(a + per, L))) for a in range(0, L, per)]
+        self._embed_idx = self._subtree_idx([("embed", "model/embed")])
+        tail_map = [("final_norm", "model/final_norm")]
+        if not self.cfg.tie_embeddings:
+            tail_map.append(("lm_head", "model/lm_head"))
+        self._tail_idx = self._subtree_idx(tail_map)
+        self._group_idx: List[Any] = [
+            self._subtree_idx([(f"layer_{j}", f"model/layer_{i}")
+                               for j, i in enumerate(g)])
+            for g in self._layer_groups]
+
+        # --- NVMe tier for layer groups --------------------------------------
+        self._nvme = pcfg.device == "nvme"
+        self._nvme_groups: List[bool] = [False] * len(self._layer_groups)
+        if self._nvme:
+            from deepspeed_tpu.ops.async_io import AsyncIOHandle
+            self._aio = AsyncIOHandle(num_threads=max(2, pcfg.buffer_count))
+            self._nvme_dir = os.path.join(
+                pcfg.nvme_path, f"params_proc{jax.process_index()}")
+            os.makedirs(self._nvme_dir, exist_ok=True)
+            G = len(self._layer_groups)
+            # Twin-Flow: first (1-ratio) fraction of groups pinned in RAM
+            self._nvme_groups = [gi >= (1.0 - pcfg.ratio) * G for gi in range(G)]
+            self._bufs = [np.empty(max(self._group_nbytes(gi)
+                                       for gi in range(G)), np.uint8)
+                          for _ in range(2)]
+            self._buf_group = [None, None]     # which group each buffer holds
+            self._pending_req: Dict[int, Tuple[int, int]] = {}
+            # initial param files; RAM copies of nvme groups drop (masters
+            # remain authoritative)
+            self._writeback_nvme()
+
+        self._replicated = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+        self._accum: List[Optional[np.ndarray]] = [None] * len(host_leaves)
+        self._stack_fwd: Dict[int, Any] = {}
+        self._stack_bwd: Dict[int, Any] = {}
+        self._embed_fwd_fn = None
+        self._embed_bwd_fn = None
+        self._tail_fn = None
+        self.bytes_streamed = 0            # per-step H2D stream volume
+        self.skipped_steps = 0
+        log_dist(
+            f"param offload: device={pcfg.device} groups={len(self._layer_groups)}"
+            f" x{per} layers, store="
+            f"{sum(s.nbytes for s in self._store if s is not None) / 1e6:.0f}MB"
+            " RAM" + (f" + nvme@{self._nvme_dir}" if self._nvme else ""),
+            ranks=[0])
+
+    # --- host store plumbing -------------------------------------------------
+    def _subtree_idx(self, name_map: List[Tuple[str, str]]):
+        """Local-name tree of GLOBAL flat-leaf indices for one group."""
+        tree = {}
+        for local, global_prefix in name_map:
+            sub = {}
+            for p, i in self._path_idx.items():
+                if p == global_prefix or p.startswith(global_prefix + "/"):
+                    rel = p[len(global_prefix) + 1:] if p != global_prefix else ""
+                    node = sub
+                    parts = rel.split("/") if rel else []
+                    for k in parts[:-1]:
+                        node = node.setdefault(k, {})
+                    if parts:
+                        node[parts[-1]] = i
+                    else:
+                        sub = i
+            if sub == {}:
+                raise ValueError(
+                    f"offload_param: param subtree {global_prefix!r} not found "
+                    "(expected the Llama tree layout model/embed, "
+                    "model/layer_i, model/final_norm[, model/lm_head])")
+            tree[local] = sub
+        return tree
+
+    def _refresh_store(self):
+        """Compute-dtype store <- fp32 masters (after each optimizer step)."""
+        cast = to_bf16 if self.compute_dtype == jnp.bfloat16 else \
+            (lambda a: np.asarray(a, np.dtype(self.compute_dtype)))
+        for i, m in enumerate(self.opt.masters()):
+            self._store[i] = cast(m)
+
+    def _group_file(self, gi: int) -> str:
+        return os.path.join(self._nvme_dir, f"group{gi}.bin")
+
+    def _write_group_file(self, gi: int):
+        idxs = jax.tree_util.tree_leaves(self._group_idx[gi])
+        blob = np.concatenate([
+            np.ascontiguousarray(self._store[i]).view(np.uint8).ravel()
+            for i in idxs])
+        self._group_blobs = getattr(self, "_group_blobs", {})
+        self._group_blobs[gi] = blob           # keepalive until drain
+        self._aio.async_pwrite(blob, self._group_file(gi))
+
+    def _leaf_nbytes(self, i: int) -> int:
+        m = self.opt.masters()[i]
+        return m.size * np.dtype(self.compute_dtype).itemsize
+
+    def _group_nbytes(self, gi: int) -> int:
+        return sum(self._leaf_nbytes(i)
+                   for i in jax.tree_util.tree_leaves(self._group_idx[gi]))
+
+    def _prefetch_group(self, gi: Optional[int]):
+        """Issue the aio read for group ``gi`` into its rotating buffer slot.
+        Access order is strictly sequential (fwd 0..G-1, bwd G-1..0), so
+        ``slot = gi % 2`` never collides: only the current and next groups are
+        live, and the current group was already COPIED out of its buffer by
+        ``_device_group`` before the next prefetch lands in it."""
+        if gi is None or not self._nvme or not self._nvme_groups[gi]:
+            return
+        if self._buf_group[gi % 2] == gi or gi in self._pending_req:
+            return
+        slot = gi % 2
+        self._buf_group[slot] = None
+        req = self._aio.async_pread(self._bufs[slot][:self._group_nbytes(gi)],
+                                    self._group_file(gi))
+        self._pending_req[gi] = (slot, req)
+
+    def _host_group_tree(self, idx_tree, gi: Optional[int] = None):
+        """Materialize one group's host arrays (RAM store or nvme buffer).
+        NVMe leaves are COPIED out of the rotating buffer: on the CPU backend
+        ``device_put`` can alias host memory, and the buffer is overwritten by
+        the next prefetch."""
+        if gi is not None and self._nvme and self._nvme_groups[gi]:
+            slot = gi % 2
+            if gi in self._pending_req:
+                slot, req = self._pending_req.pop(gi)
+                if self._aio.wait(req):
+                    raise RuntimeError(
+                        f"offload_param: nvme read failed (group {gi})")
+                self._buf_group[slot] = gi
+            if self._buf_group[slot] != gi:   # first touch: synchronous read
+                if self._aio.wait(self._aio.async_pread(
+                        self._bufs[slot][:self._group_nbytes(gi)],
+                        self._group_file(gi))):
+                    raise RuntimeError(
+                        f"offload_param: nvme read failed (group {gi})")
+                self._buf_group[slot] = gi
+            buf = self._bufs[slot]
+            masters = self.opt.masters()
+            off = [0]
+
+            def take(i):
+                n = self._leaf_nbytes(i)
+                view = buf[off[0]:off[0] + n].view(
+                    np.dtype(self.compute_dtype)).reshape(masters[i].shape)
+                off[0] += n
+                return view.copy()
+            return jax.tree.map(take, idx_tree)
+        return jax.tree.map(lambda i: self._store[i], idx_tree)
+
+    def _device_group(self, idx_tree, gi: Optional[int] = None):
+        tree = self._host_group_tree(idx_tree, gi)
+        self.bytes_streamed += sum(a.nbytes for a in jax.tree.leaves(tree))
+        return jax.device_put(tree, self._replicated)
+
+    def _accumulate(self, idx_tree, grad_tree):
+        for i, g in zip(jax.tree.leaves(idx_tree), jax.tree.leaves(grad_tree)):
+            g = np.asarray(jax.device_get(g), np.float32)
+            if self._accum[i] is None:
+                self._accum[i] = g.copy()
+            else:
+                self._accum[i] += g
+
+    # --- jitted per-group functions ------------------------------------------
+    def _fwd_fn(self, n: int):
+        if n not in self._stack_fwd:
+            stack = _BlockStack(self.cfg, n)
+            self._stack_fwd[n] = jax.jit(
+                lambda p, x, pos, seg: stack.apply({"params": p}, x, pos, seg))
+        return self._stack_fwd[n]
+
+    def _bwd_fn(self, n: int):
+        if n not in self._stack_bwd:
+            stack = _BlockStack(self.cfg, n)
+
+            def bwd(p, x, pos, seg, g):
+                _, vjp = jax.vjp(
+                    lambda p_, x_: stack.apply({"params": p_}, x_, pos, seg),
+                    p, x)
+                gp, gx = vjp(g)
+                return gx, gp
+            self._stack_bwd[n] = jax.jit(bwd)
+        return self._stack_bwd[n]
+
+    def _embed_fns(self):
+        if self._embed_fwd_fn is None:
+            cfg = self.cfg
+
+            def embed_fwd(emb, ids):
+                x = jnp.take(emb["embed"]["embedding"].astype(cfg.dtype),
+                             ids, axis=0)
+                if cfg.scale_embeddings:
+                    x = x * jnp.sqrt(jnp.asarray(
+                        cfg.hidden_size, jnp.float32)).astype(x.dtype)
+                return x
+
+            def embed_bwd(emb, ids, g):
+                _, vjp = jax.vjp(lambda e: embed_fwd(e, ids), emb)
+                return vjp(g)[0]
+            self._embed_fwd_fn = jax.jit(embed_fwd)
+            self._embed_bwd_fn = jax.jit(embed_bwd)
+        return self._embed_fwd_fn, self._embed_bwd_fn
+
+    def _tail_grad_fn(self):
+        """Tied: grads flow to (tail, embedding, x). Untied: the embedding is
+        not an input at all (a [V,H] zero cotangent would cost real HBM)."""
+        if self._tail_fn is None:
+            tail_mod = _TailLoss(self.cfg)
+            tied = self.cfg.tie_embeddings
+
+            def tail_grad(tail_p, embedding, x, labels, mask):
+                if tied:
+                    loss, vjp = jax.vjp(
+                        lambda tp, emb, x_: tail_mod.apply(
+                            {"params": tp}, x_, emb, labels, mask),
+                        tail_p, embedding, x)
+                    gt, gemb, gx = vjp(jnp.float32(1.0))
+                else:
+                    loss, vjp = jax.vjp(
+                        lambda tp, x_: tail_mod.apply(
+                            {"params": tp}, x_, None, labels, mask),
+                        tail_p, x)
+                    gt, gx = vjp(jnp.float32(1.0))
+                    gemb = None
+                return loss, gx, gt, gemb
+            self._tail_fn = jax.jit(tail_grad)
+        return self._tail_fn
+
+    # --- the streamed step ----------------------------------------------------
+    def _micro_grads(self, micro: Dict[str, np.ndarray]):
+        cfg = self.cfg
+        ids = jax.device_put(np.asarray(micro["input_ids"]),
+                             self.batch_sharding)
+        positions = micro.get("positions")
+        positions = jnp.asarray(positions) if positions is not None else \
+            jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+        seg = micro.get("segment_ids")
+        seg = jnp.asarray(seg) if seg is not None else None
+
+        # labels over all S (mask kills the shifted-out position) — equal to
+        # the dense shifted loss, static shapes (LlamaForCausalLM._chunked_loss)
+        labels = micro.get("labels")
+        if labels is None:
+            host_ids = np.asarray(micro["input_ids"])
+            labels = np.pad(host_ids[:, 1:], ((0, 0), (0, 1)))
+            mask = micro.get("loss_mask")
+            mask = np.asarray(mask)[:, 1:] if mask is not None else \
+                np.ones_like(host_ids[:, 1:])
+            mask = np.pad(mask, ((0, 0), (0, 1)))
+        else:
+            labels = np.asarray(labels)
+            mask = np.asarray(micro.get("loss_mask", np.ones_like(labels)))
+        labels = jax.device_put(labels, self.batch_sharding)
+        mask = jax.device_put(mask, self.batch_sharding)
+
+        embed_fwd, embed_bwd = self._embed_fns()
+        G = len(self._layer_groups)
+
+        # ---- forward stream (prefetch g+1 while g computes) ----
+        embed_dev = self._device_group(self._embed_idx)
+        x = embed_fwd(embed_dev, ids)
+        acts = []
+        self._prefetch_group(0)
+        nxt = self._device_group(self._group_idx[0], 0) if G else None
+        for gi in range(G):
+            cur = nxt
+            self._prefetch_group(gi + 1 if gi + 1 < G else None)
+            if gi + 1 < G:
+                nxt = self._device_group(self._group_idx[gi + 1], gi + 1)
+            acts.append(x)
+            x = self._fwd_fn(len(self._layer_groups[gi]))(cur, x, positions, seg)
+
+        # ---- loss + head/embed-tie grads ----
+        tail_dev = self._device_group(self._tail_idx)
+        loss, gx, g_tail, g_emb_tie = self._tail_grad_fn()(
+            tail_dev, embed_dev["embed"]["embedding"], x, labels, mask)
+        self._accumulate(self._tail_idx, g_tail)
+        if cfg.tie_embeddings:
+            self._accumulate(self._embed_idx,
+                             {"embed": {"embedding": g_emb_tie}})
+        del tail_dev, x
+
+        # ---- backward stream (prefetch g-1 while g computes) ----
+        self._prefetch_group(G - 1 if G else None)
+        nxt = self._device_group(self._group_idx[G - 1], G - 1) if G else None
+        for gi in range(G - 1, -1, -1):
+            cur = nxt
+            self._prefetch_group(gi - 1 if gi - 1 >= 0 else None)
+            if gi - 1 >= 0:
+                nxt = self._device_group(self._group_idx[gi - 1], gi - 1)
+            gx, gp = self._bwd_fn(len(self._layer_groups[gi]))(
+                cur, acts[gi], positions, seg, gx)
+            self._accumulate(self._group_idx[gi], gp)
+            del cur
+        g_embed = embed_bwd(embed_dev, ids, gx)
+        self._accumulate(self._embed_idx, g_embed)
+        return loss
+
+    def train_batch(self, stacked_batch, step: int) -> Tuple[float, float]:
+        """One full batch: gas streamed microbatches + host optimizer update.
+        Returns (loss, grad_norm) as host floats."""
+        gas = self.config.gradient_accumulation_steps
+        self._accum = [None] * len(self._accum)
+        self.bytes_streamed = 0
+        losses = []
+        for g in range(gas):
+            micro = {k: np.asarray(v)[g] for k, v in stacked_batch.items()}
+            losses.append(self._micro_grads(micro))
+        loss = float(np.mean([jax.device_get(l) for l in losses]))
+
+        grads = [a / gas if a is not None else
+                 np.zeros_like(self.opt.masters()[i])
+                 for i, a in enumerate(self._accum)]
+        sq = sum(float(np.vdot(g, g)) for g in grads)
+        norm = float(np.sqrt(sq))
+        clip = self.config.gradient_clipping
+        if clip and clip > 0 and norm > clip:
+            scale = clip / norm
+            for g in grads:
+                g *= scale
+        lr = float(jax.device_get(self.lr_schedule(jnp.int32(step))))
+        self.opt.step(grads, lr=lr)
+        self.sync_store()
+        return loss, norm
+
+    def sync_store(self):
+        """Compute-dtype store <- masters, then NVMe write-back (called after
+        every optimizer update and after a checkpoint restore)."""
+        self._refresh_store()
+        if self._nvme:
+            self._writeback_nvme()
+
+    def _writeback_nvme(self):
+        for gi in range(len(self._layer_groups)):
+            if self._nvme_groups[gi]:
+                self._write_group_file(gi)
+        if self._aio.drain():
+            raise RuntimeError("offload_param: nvme write-back failed")
+        self._group_blobs = {}
+        self._buf_group = [None, None]       # buffers now hold stale weights
+        self._pending_req = {}
+        for gi in range(len(self._layer_groups)):
+            if self._nvme_groups[gi]:
+                for i in jax.tree_util.tree_leaves(self._group_idx[gi]):
+                    self._store[i] = None
+
+    # --- checkpoint interop ----------------------------------------------------
+    @property
+    def treedef(self):
+        return self._treedef
+
+    def masters_tree(self):
+        return jax.tree_util.tree_unflatten(self._treedef, self.opt.masters())
+
+    def load_masters(self, params_tree, reset_moments: bool = False):
+        self.opt.set_masters(jax.tree_util.tree_flatten(params_tree)[0],
+                             reset_moments=reset_moments)
+        self.sync_store()

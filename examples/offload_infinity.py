@@ -40,12 +40,20 @@ def main():
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--device", default="cpu", choices=["cpu", "nvme"])
     p.add_argument("--nvme_path", default="/tmp/dstpu_nvme")
-    p.add_argument("--model", default="tiny", choices=["tiny", "1b"],
+    p.add_argument("--model", default="tiny",
+                   choices=["tiny", "1b", "3b", "8b"],
                    help="'1b': ~1.3B params — total training state exceeds "
-                        "one v5e chip's 16 GB HBM (the ZeRO-Infinity case)")
+                        "one v5e chip's 16 GB HBM (the ZeRO-Infinity case). "
+                        "'8b': ~8B params — bf16 WEIGHTS alone exceed HBM "
+                        "(requires --offload_param)")
     p.add_argument("--seq", type=int, default=0,
                    help="override sequence length (default: 32 tiny/1024 1b)")
     p.add_argument("--micro_batch", type=int, default=0)
+    p.add_argument("--offload_param", action="store_true",
+                   help="ZeRO-Infinity PARAMETER offload: weights live on "
+                        "host and stream through HBM layer-group by "
+                        "layer-group (runtime/param_offload.py)")
+    p.add_argument("--layers_per_group", type=int, default=2)
     p.add_argument("--measure", action="store_true",
                    help="print one JSON line: step time + swap bandwidth")
     args = p.parse_args()
@@ -58,12 +66,20 @@ def main():
     from deepspeed_tpu.models.llama import (
         TINY_LLAMA, LlamaConfig, LlamaForCausalLM, random_tokens)
 
-    if args.model == "1b":
+    sizes = {
+        # hidden, intermediate, layers, heads, kv_heads
+        "1b": (2048, 5632, 24, 16, 8),
+        "3b": (3072, 8192, 28, 24, 8),
+        "8b": (4096, 14336, 32, 32, 8),   # llama-3-8B geometry, 32k vocab
+    }
+    if args.model in sizes:
+        h, inter, layers, heads, kv = sizes[args.model]
         seq = args.seq or 1024
         mb = args.micro_batch or 1
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_layers=24, num_heads=16, num_kv_heads=8, max_seq_len=seq,
+            vocab_size=32000, hidden_size=h, intermediate_size=inter,
+            num_layers=layers, num_heads=heads, num_kv_heads=kv,
+            max_seq_len=seq,
             dtype=jnp.bfloat16, attention_backend="flash", remat=True,
             remat_policy="dots_with_no_batch_dims_saveable")
         gas = 2
@@ -75,19 +91,26 @@ def main():
     if args.device == "nvme":
         os.makedirs(args.nvme_path, exist_ok=True)
         offload["nvme_path"] = args.nvme_path
+    zero = {"stage": 2, "offload_optimizer": offload}
+    if args.offload_param:
+        zero["offload_param"] = {"device": args.device,
+                                 "layers_per_group": args.layers_per_group}
+        if args.device == "nvme":
+            zero["offload_param"]["nvme_path"] = args.nvme_path
+        zero["stage"] = 0
     config = {
         "train_batch_size": mb * gas,
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2, "offload_optimizer": offload},
+        "zero_optimization": zero,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=LlamaForCausalLM(cfg), config=config,
         example_batch=random_tokens(1, seq, vocab_size=cfg.vocab_size))
     assert engine._offload is not None
-    n_params = sum(int(np.prod(x.shape))
-                   for x in jax.tree.leaves(engine.state.params))
+    n_params = sum(int(np.prod(np.shape(x)))
+                   for x in jax.tree.leaves(engine.get_params()))
     state_gib = n_params * (2 + 4 + 12) / 2**30  # bf16 + grads + fp32 m/v/mst
     print(f"{n_params / 1e9:.2f}B params; total training state "
           f"{state_gib:.1f} GiB (device keeps ~{n_params * 6 / 2**30:.1f})")
@@ -105,11 +128,19 @@ def main():
     print(f"offload={args.device}: loss {losses[0]:.4f} -> {losses[-1]:.4f}")
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
     if args.measure:
-        swap_bytes = 6 * n_params            # fp32 grads D2H + bf16 H2D
+        if args.offload_param:
+            # measured H2D param stream + fp32 grads D2H (once per microbatch)
+            po = engine._param_offload
+            swap_bytes = po.bytes_streamed + 4 * n_params * gas
+            metric = "zero_infinity_param_offload_step_time"
+        else:
+            swap_bytes = 6 * n_params        # fp32 grads D2H + bf16 H2D
+            metric = "zero_infinity_step_time"
         print(json.dumps({
-            "metric": "zero_infinity_step_time", "value": round(dt, 3),
+            "metric": metric, "value": round(dt, 3),
             "unit": "s/step", "model_params_b": round(n_params / 1e9, 3),
             "state_gib": round(state_gib, 1), "offload_device": args.device,
+            "offload_param": bool(args.offload_param),
             "swap_gib_per_step": round(swap_bytes / 2**30, 2),
             "effective_swap_gibps": round(swap_bytes / 2**30 / dt, 2),
             "seq_len": seq, "tokens_per_sec": round(mb * gas * seq / dt, 1),
