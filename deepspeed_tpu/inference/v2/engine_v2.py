@@ -60,9 +60,12 @@ class V2EngineConfig:
     # draft-free speculative decoding (prompt-lookup): propose the k tokens
     # that followed the last occurrence of the trailing n-gram, verify them
     # in ONE chunk forward, accept the longest argmax-matching prefix + one
-    # bonus token — 1..k+1 tokens per step, EXACT greedy equivalence
-    # (beyond-reference: FastGen has no speculative decoding). 0 = off;
-    # greedy-only (engine.generate raises under sampling)
+    # bonus token — 1..k+1 tokens per step, greedy-equivalent up to batching
+    # numerics (verified bitwise on CPU f32; on TPU bf16 the [bucket, D]
+    # verify matmul can reorder reductions vs the 1-row decode and flip
+    # argmax on near-ties). Beyond-reference: FastGen has no speculative
+    # decoding. 0 = off; greedy-only (rejected at construction under
+    # sampling)
     speculative_k: int = 0
     speculative_ngram: int = 3
 
@@ -85,6 +88,13 @@ class InferenceEngineV2:
         self.params = params
         self.model_config = model_config
         self.config = config or V2EngineConfig()
+        if self.config.speculative_k > 0 and not self.config.greedy:
+            # reject BEFORE any sequence state exists: failing inside
+            # _speculative_step would leave a half-processed sequence whose
+            # prefill already consumed KV blocks
+            raise ValueError(
+                "speculative_k > 0 requires greedy=True: proposal "
+                "acceptance compares argmax chains, which sampling breaks")
         self.policy = policy_for(model_config)
         spec = self.policy.cache_spec(model_config)
         self.kv = BlockedKVCache(KVCacheConfig(

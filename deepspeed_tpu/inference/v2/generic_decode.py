@@ -40,9 +40,13 @@ def _chunk_states(params, cache_data, tokens, start, block_table, true_len,
     if scaled:
         # pages the chunk's valid tokens can land on: a contiguous table
         # slice (clamp duplicates repeat the same slot — identical updates,
-        # safe for write_kv_scaled's requantize scatter)
-        touch_idx = jnp.minimum(start // block_size +
-                                jnp.arange(tb // block_size + 1), mb - 1)
+        # safe for write_kv_scaled's requantize scatter). Static worst-case
+        # page count: offsets start%bs .. start%bs+tb-1 span up to
+        # (tb + bs - 2)//bs + 1 pages — a chunk smaller than a page that
+        # crosses a boundary still touches TWO pages (tb//bs+1 missed that)
+        touch_idx = jnp.minimum(
+            start // block_size +
+            jnp.arange((tb + block_size - 2) // block_size + 1), mb - 1)
         touched = block_table[touch_idx]
 
     x = policy.embed(params, tokens, safe_pos, cfg)

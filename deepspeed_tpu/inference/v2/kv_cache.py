@@ -105,6 +105,11 @@ def write_kv_scaled(cache_data, scales, layer: int, kv: int, vals,
     old_s = scales[layer, kv]                                   # [H, NB]
     absmax = jnp.max(jnp.abs(vals.astype(f32)), axis=-1)        # [T, H]
     page_max = jnp.zeros_like(old_s).at[:, block_ids].max(absmax.T)
+    # the trash page (num_blocks-1, where bucket-padding rows land) is never
+    # allocated or released, so letting it join the scatter-max would grow
+    # its scale monotonically for the cache's lifetime — silent state drift
+    # with no output effect (trash slots are always causally masked)
+    page_max = page_max.at[:, -1].set(0.0)
     new_s = jnp.maximum(old_s, page_max / FP8_MAX)              # [H, NB]
 
     # requantize touched pages under the grown scale — predicated: in

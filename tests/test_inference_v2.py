@@ -336,6 +336,16 @@ def test_speculative_decode_fast_oracle(model_and_params):
     assert st["accepted"] == st["proposed"] > 0 and st["tokens_per_step"] > 2
 
 
+def test_speculative_with_sampling_rejected_at_construction(model_and_params):
+    """speculative_k + sampling must fail BEFORE any sequence state exists —
+    failing inside the step would leave a half-processed sequence whose
+    prefill already consumed KV blocks (round-4 advisor finding)."""
+    cfg, _, params = model_and_params
+    with pytest.raises(ValueError, match="greedy"):
+        InferenceEngineV2(params, cfg, V2EngineConfig(
+            greedy=False, speculative_k=4))
+
+
 @pytest.mark.slow
 def test_speculative_decode_exact_greedy_equivalence(model_and_params):
     """Speculative decoding (speculative_k>0): generation is EXACTLY the
