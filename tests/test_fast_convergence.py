@@ -1,0 +1,115 @@
+"""Fast-suite convergence teeth (round-4 verdict weak #5 / task #6).
+
+Every major parallelism / feature mode asserts an ACTUAL 3-step loss
+decrease in the DEFAULT suite — the deeper step-for-step parity and
+long-convergence runs stay behind @pytest.mark.slow, but the fast suite
+alone must prove each mode trains, not merely that one step is finite.
+Modes already fast-covered elsewhere (hpZ in test_mics_zeropp, offload in
+test_offload, param offload in test_param_offload, dense in test_engine,
+paged decode correctness in test_inference_v2) are not repeated here.
+
+Reference analog: tests/unit/runtime/zero (17 files of per-mode training
+assertions run in default CI).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import create_mesh, set_global_mesh
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+
+def _losses(engine, batch, steps=3):
+    return [float(jax.device_get(engine.train_batch(batch=batch)))
+            for _ in range(steps)]
+
+
+def test_qgz_int8_wire_gradients_train():
+    """qgZ (zero_quantized_gradients) over a replica axis: int8-wire grad
+    reduction still decreases the loss (slow suite has the 40-step parity)."""
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    set_global_mesh(mesh)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=64),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 3,
+                                      "zero_quantized_gradients": True}},
+        mesh=mesh, example_batch=random_batch(4), seed=0)
+    assert engine._qgz_axes, "expected a replica axis for the int8 wire"
+    losses = _losses(engine, random_batch(8, seed=0))
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_engine_1f1b_trains():
+    """PipelineEngine 1F1B on a pipe=4 mesh decreases the loss (slow suite
+    has the 8-step single-stage parity)."""
+    from tests.test_pipeline import _toy_setup
+    from deepspeed_tpu.runtime.pipe.engine import PipeModule, PipelineEngine
+
+    stacked, tied, toks, block_fn, first_fn, last_fn = _toy_setup()
+    tokens = np.asarray(toks.reshape(-1, toks.shape[-1]))
+    mesh = create_mesh(MeshConfig(pipe=4, data=2))
+    set_global_mesh(mesh)
+    mod = PipeModule(block_fn, first_fn, last_fn,
+                     jax.tree.map(jnp.copy, stacked),
+                     jax.tree.map(jnp.copy, tied))
+    eng = PipelineEngine(mod, {"gradient_accumulation_steps": 8,
+                               "optimizer": {"type": "AdamW",
+                                             "params": {"lr": 5e-3}},
+                               "gradient_clipping": 1.0}, mesh=mesh)
+    losses = [float(eng.train_batch(tokens)) for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_expert_parallel_trains():
+    """Mixtral EP over the expert axis decreases the loss (slow suite has
+    the 8-step run + quantized-dispatch parity)."""
+    from deepspeed_tpu.models.mixtral import (TINY_MIXTRAL,
+                                              MixtralForCausalLM,
+                                              mixtral_tensor_rules)
+    from deepspeed_tpu.models.llama import random_tokens
+
+    mesh = create_mesh(MeshConfig(data=2, expert=4))
+    set_global_mesh(mesh)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=MixtralForCausalLM(TINY_MIXTRAL),
+        config={"train_batch_size": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "zero_optimization": {"stage": 1}},
+        mesh=mesh, example_batch=random_tokens(2, 16, vocab_size=512),
+        tensor_rules=mixtral_tensor_rules)
+    losses = _losses(engine, random_tokens(4, 16, vocab_size=512, seed=0))
+    assert losses[-1] < losses[0], losses
+
+
+def _llama_sp_losses(backend):
+    from deepspeed_tpu.models.llama import (TINY_LLAMA, LlamaConfig,
+                                            LlamaForCausalLM, random_tokens)
+    mesh = create_mesh(MeshConfig(data=2, sequence=4))
+    set_global_mesh(mesh)
+    cfg = LlamaConfig(**{**TINY_LLAMA.__dict__, "attention_backend": backend,
+                         "dtype": jnp.float32})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=LlamaForCausalLM(cfg),
+        config={"train_batch_size": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}}},
+        mesh=mesh, example_batch=random_tokens(2, 32))
+    return _losses(engine, random_tokens(4, 32, seed=0))
+
+
+def test_ring_attention_sp_trains():
+    """Ring-attention context parallelism (the TPU long-context must-add)
+    decreases the loss on a sequence=4 mesh."""
+    losses = _llama_sp_losses("ring")
+    assert losses[-1] < losses[0], losses
+
+
+def test_ulysses_sp_trains():
+    """Ulysses head-scatter all-to-all SP decreases the loss on a
+    sequence=4 mesh (reference sequence/layer.py:271)."""
+    losses = _llama_sp_losses("ulysses")
+    assert losses[-1] < losses[0], losses
