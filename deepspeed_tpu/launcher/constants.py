@@ -6,6 +6,7 @@ GCLOUD_LAUNCHER = "gcloud"
 SLURM_LAUNCHER = "slurm"
 MPICH_LAUNCHER = "mpich"
 OPENMPI_LAUNCHER = "openmpi"
+XPK_LAUNCHER = "xpk"
 
 PDSH_MAX_FAN_OUT = 1024
 

@@ -166,6 +166,38 @@ class SlurmRunner(MultiNodeRunner):
         return srun_cmd + launch
 
 
+class XpkRunner(MultiNodeRunner):
+    """GKE TPU-pod dispatch via ``xpk workload create`` (the batch-scheduler
+    path for Cloud TPU multislice — the TPU-pod analog of the reference's
+    SLURM runner, multinode_runner.py:303).
+
+    Like GcloudTPURunner, no world_info/node_rank is injected: every worker
+    of every slice runs the same command and JAX discovers peers from TPU
+    metadata (plus MEGASCALE env for multislice, which xpk sets).
+    """
+
+    def backend_exists(self) -> bool:
+        return shutil.which("xpk") is not None
+
+    def get_cmd(self, environment, active_resources):
+        self.export_envs_from_environ(environment)
+        exports = "".join(f"export {k}={v}; " for k, v in self.exports.items())
+        remote = (exports + f"{sys.executable} -u "
+                  + quote(self.user_script) + " "
+                  + " ".join(map(quote, self.user_arguments))).strip()
+        cmd = ["xpk", "workload", "create",
+               f"--cluster={self.args.xpk_cluster}",
+               f"--workload={self.args.xpk_workload}",
+               f"--tpu-type={self.args.tpu_type}",
+               f"--num-slices={self.args.num_slices}"]
+        if self.args.xpk_docker_image:
+            cmd.append(f"--docker-image={self.args.xpk_docker_image}")
+        if self.args.tpu_zone:
+            cmd.append(f"--zone={self.args.tpu_zone}")
+        cmd.append(f"--command={remote}")
+        return cmd
+
+
 class MPIRunner(MultiNodeRunner):
     """mpirun dispatch (reference multinode_runner.py:124 OpenMPIRunner).
 

@@ -22,7 +22,8 @@ from deepspeed_tpu.launcher import multinode_runner as mnr
 from deepspeed_tpu.launcher.constants import (DEFAULT_COORDINATOR_PORT,
                                               GCLOUD_LAUNCHER, MPICH_LAUNCHER,
                                               OPENMPI_LAUNCHER, PDSH_LAUNCHER,
-                                              SLURM_LAUNCHER, SSH_LAUNCHER)
+                                              SLURM_LAUNCHER, SSH_LAUNCHER,
+                                              XPK_LAUNCHER)
 from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
@@ -47,7 +48,17 @@ def parse_args(args=None):
                         default=DEFAULT_COORDINATOR_PORT)
     parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
                         choices=[PDSH_LAUNCHER, SSH_LAUNCHER, GCLOUD_LAUNCHER,
-                                 SLURM_LAUNCHER, OPENMPI_LAUNCHER, MPICH_LAUNCHER])
+                                 SLURM_LAUNCHER, OPENMPI_LAUNCHER,
+                                 MPICH_LAUNCHER, XPK_LAUNCHER])
+    parser.add_argument("--xpk_cluster", type=str, default=None,
+                        help="GKE cluster name: selects the xpk launcher "
+                             "(xpk workload create multislice dispatch)")
+    parser.add_argument("--xpk_workload", type=str, default="dstpu-job")
+    parser.add_argument("--xpk_docker_image", type=str, default=None)
+    parser.add_argument("--tpu_type", type=str, default=None,
+                        help="xpk: accelerator type, e.g. v5litepod-256")
+    parser.add_argument("--num_slices", type=int, default=1,
+                        help="xpk: multislice slice count")
     parser.add_argument("--tpu_name", type=str, default=None,
                         help="TPU-VM pod name (switches to the gcloud runner)")
     parser.add_argument("--tpu_zone", type=str, default=None)
@@ -142,9 +153,15 @@ def main(args=None):
 
     if args.tpu_name:
         args.launcher = GCLOUD_LAUNCHER
+    if args.xpk_cluster:
+        args.launcher = XPK_LAUNCHER
+        if not args.tpu_type:
+            raise ValueError("--xpk_cluster requires --tpu_type "
+                             "(e.g. v5litepod-256)")
 
     resource_pool = fetch_hostfile(args.hostfile)
-    if not resource_pool and args.launcher != GCLOUD_LAUNCHER:
+    if not resource_pool and args.launcher not in (GCLOUD_LAUNCHER,
+                                                   XPK_LAUNCHER):
         # Single-node: run launch.py locally, one process (JAX owns local chips).
         world_info = {"localhost": [0]}
         cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
@@ -180,6 +197,7 @@ def main(args=None):
         SLURM_LAUNCHER: mnr.SlurmRunner,
         OPENMPI_LAUNCHER: mnr.MPIRunner,
         MPICH_LAUNCHER: mnr.MPIRunner,
+        XPK_LAUNCHER: mnr.XpkRunner,
     }[args.launcher]
     runner = runner_cls(args, world_info_b64)
     if not runner.backend_exists():

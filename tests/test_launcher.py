@@ -142,6 +142,33 @@ def test_slurm_runner_cmd():
     assert cmd[0] == "srun" and "-N" in cmd and "2" in cmd
 
 
+def test_xpk_runner_cmd():
+    """GKE multislice dispatch via xpk workload create (the TPU-pod analog
+    of the reference SLURM runner; pure command construction)."""
+    args = _Args(xpk_cluster="my-cluster", xpk_workload="job1",
+                 xpk_docker_image="gcr.io/p/img:latest",
+                 tpu_type="v5litepod-256", num_slices=2)
+    r = mnr.XpkRunner(args, runner.encode_world_info({}))
+    cmd = r.get_cmd({"XLA_FLAGS": "--bar"}, {})
+    assert cmd[:3] == ["xpk", "workload", "create"]
+    assert "--cluster=my-cluster" in cmd
+    assert "--workload=job1" in cmd
+    assert "--tpu-type=v5litepod-256" in cmd
+    assert "--num-slices=2" in cmd
+    assert "--docker-image=gcr.io/p/img:latest" in cmd
+    command = [c for c in cmd if c.startswith("--command=")][0]
+    assert "train.py" in command and "export XLA_FLAGS=" in command
+
+
+def test_xpk_cluster_arg_selects_and_validates():
+    a = runner.parse_args(["--xpk_cluster", "c1", "--tpu_type",
+                           "v5litepod-16", "train.py"])
+    assert a.xpk_cluster == "c1" and a.num_slices == 1
+    import pytest
+    with pytest.raises(ValueError, match="tpu_type"):
+        runner.main(["--xpk_cluster", "c1", "train.py"])
+
+
 def test_mpi_runner_cmd():
     args = _Args()
     world = runner.encode_world_info({"n0": [0], "n1": [0]})
