@@ -95,11 +95,22 @@ def evoformer_attention(q, k, v, biases: Sequence = (), block_k: int = 512):
 
 def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = ()):  # noqa: N802
     """Reference-named entry point (evoformer_attn.py
-    DS4Sci_EvoformerAttention): q/k/v [*, L, H, D], biases list of <= 2."""
+    DS4Sci_EvoformerAttention): q/k/v [*, L, H, D], biases list of <= 2.
+    On TPU this dispatches to the fused Pallas kernel set (fwd + bwd incl.
+    bias gradients — the analog of csrc/deepspeed4science/evoformer_attn);
+    elsewhere the blockwise-scan jnp path (same O(L) working set, XLA
+    autodiff bwd)."""
     if len(biases) > 2:
         raise ValueError("DS4Sci_EvoformerAttention supports at most 2 biases")
-    return evoformer_attention(q, k, v, tuple(b for b in biases
-                                              if b is not None))
+    biases = tuple(b for b in biases if b is not None)
+    if jax.default_backend() == "tpu":
+        from deepspeed_tpu.ops.pallas.evoformer import (
+            UnsupportedBiasLayout, pallas_evoformer_attention)
+        try:
+            return pallas_evoformer_attention(q, k, v, biases)
+        except UnsupportedBiasLayout:
+            pass      # bias layout outside the kernel contract -> jnp path
+    return evoformer_attention(q, k, v, biases)
 
 
 def evoformer_attention_reference(q, k, v, biases: Sequence = ()):

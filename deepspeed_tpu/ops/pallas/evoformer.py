@@ -1,0 +1,538 @@
+"""Pallas evoformer (DS4Science) attention — fused biased attention kernels.
+
+Reference analog: ``csrc/deepspeed4science/evoformer_attn/`` (14.9k LoC of
+CUTLASS kernels: ``attention_cu.cu`` forward, ``attention_back.cu`` backward
+incl. bias gradients). Semantics (``DS4Sci_EvoformerAttention``):
+
+    softmax(q k^T / sqrt(d) + bias1 + bias2) v
+
+with q/k/v ``[B, N, L, H, D]`` (AlphaFold MSA/pair stacks: B batch, N rows),
+``bias1`` broadcastable ``[B, N, 1, 1, L]`` (row mask, per-key additive) and
+``bias2`` ``[B, 1, H, L, L]`` (pair bias, shared across rows).
+
+Kernel set (mirrors the flash-attention family in flash_attention.py):
+- fwd: online-softmax over key blocks; biases stream per block (the [L, L]
+  panel never materializes in HBM).
+- bwd dq / dkv: flash-style recompute-from-(q,k,v,lse) with the bias terms
+  re-added; note ``s = qk*scale + b`` so dq/dk carry ``scale`` while the
+  bias gradient is the raw ``dS``.
+- bwd dbias2: accumulates ``dS`` over the N rows that share a pair-bias
+  panel — N is the innermost grid dim so output-block revisits are
+  CONSECUTIVE (TPU pallas keeps the block resident between consecutive
+  same-index iterations; non-consecutive revisits would be undefined).
+- bwd dbias1: per-key column sum of ``dS`` over heads and query blocks.
+
+Gradients flow to q, k, v and both biases (the reference computes dbias1/2
+too). GQA is not a thing here (H == Hkv).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _key_mask(ki, block_k, seq_len_k):
+    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    return kpos < seq_len_k
+
+
+def _scores(q, k, b1, b2, ki, *, sm_scale, block_k, seq_len_k):
+    """s = q k^T * scale + bias1 + bias2, padding keys masked to NEG_INF.
+    b1: [1, block_k] or None; b2: [block_q, block_k] or None."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    if b1 is not None:
+        s = s + b1.astype(jnp.float32)
+    if b2 is not None:
+        s = s + b2.astype(jnp.float32)
+    mask = _key_mask(ki, block_k, seq_len_k)
+    return jnp.where(mask, s, NEG_INF), mask
+
+
+def _evo_fwd_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, lse_ref,
+                    m_scr, l_scr, acc_scr, *, sm_scale, block_k,
+                    num_k_blocks, seq_len_k, has_b1, has_b2):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q, k, v = q_ref[0], k_ref[0], v_ref[0]
+    b1 = b1_ref[0] if has_b1 else None               # [1, block_k]
+    b2 = b2_ref[0] if has_b2 else None               # [block_q, block_k]
+    s, mask = _scores(q, k, b1, b2, ki, sm_scale=sm_scale, block_k=block_k,
+                      seq_len_k=seq_len_k)
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _evo_dq_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scr, *, sm_scale, block_k,
+                   num_k_blocks, seq_len_k, has_b1, has_b2):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    b1 = b1_ref[0] if has_b1 else None
+    b2 = b2_ref[0] if has_b2 else None
+    s, mask = _scores(q, k, b1, b2, ki, sm_scale=sm_scale, block_k=block_k,
+                      seq_len_k=seq_len_k)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])                     # raw dS (bias grad units)
+    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+        (ds * sm_scale).astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _evo_dkv_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale,
+                    block_k, num_q_blocks, seq_len_k, has_b1, has_b2):
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    b1 = b1_ref[0] if has_b1 else None
+    b2 = b2_ref[0] if has_b2 else None
+    s, mask = _scores(q, k, b1, b2, ki, sm_scale=sm_scale, block_k=block_k,
+                      seq_len_k=seq_len_k)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)  # [bq, bk]
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])
+    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+        (ds * sm_scale).astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _evo_dbias2_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, do_ref, lse_ref,
+                       delta_ref, db2_ref, db2_scr, *, sm_scale, block_k,
+                       num_rows, seq_len_k, has_b1, has_b2):
+    """Grid (B*H, nq, nk, N): N innermost -> the (bh, qi, ki) output block is
+    revisited on consecutive iterations and accumulates dS over rows."""
+    n = pl.program_id(3)
+    ki = pl.program_id(2)
+
+    @pl.when(n == 0)
+    def _init():
+        db2_scr[:] = jnp.zeros_like(db2_scr)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    b1 = b1_ref[0] if has_b1 else None
+    b2 = b2_ref[0] if has_b2 else None
+    s, mask = _scores(q, k, b1, b2, ki, sm_scale=sm_scale, block_k=block_k,
+                      seq_len_k=seq_len_k)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    db2_scr[:] = db2_scr[:] + p * (dp - delta_ref[0])
+
+    @pl.when(n == num_rows - 1)
+    def _finalize():
+        db2_ref[0] = db2_scr[:].astype(db2_ref.dtype)
+
+
+def _evo_dbias1_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, do_ref, lse_ref,
+                       delta_ref, db1_ref, db1_scr, *, sm_scale, block_k,
+                       num_hq_steps, seq_len_k, has_b1, has_b2):
+    """Grid (B*N, nk, H*nq): per-key column sum of dS over heads + q blocks."""
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        db1_scr[:] = jnp.zeros_like(db1_scr)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    b1 = b1_ref[0] if has_b1 else None
+    b2 = b2_ref[0] if has_b2 else None
+    s, mask = _scores(q, k, b1, b2, ki=pl.program_id(1), sm_scale=sm_scale,
+                      block_k=block_k, seq_len_k=seq_len_k)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])
+    db1_scr[:] = db1_scr[:] + jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(j == num_hq_steps - 1)
+    def _finalize():
+        db1_ref[0] = db1_scr[:].astype(db1_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side plumbing
+# ---------------------------------------------------------------------------
+
+class UnsupportedBiasLayout(ValueError):
+    """Bias shape outside the kernel contract — the caller may fall back to
+    the jnp blockwise path (which handles any broadcastable bias)."""
+
+
+def _bcast(bias, target):
+    try:
+        return jnp.broadcast_to(bias.astype(jnp.float32), target)
+    except ValueError as e:         # folded lead dims the bias can't match
+        raise UnsupportedBiasLayout(str(e)) from e
+
+
+def _canon(q, k, v, biases):
+    """[*, L, H, D] -> (q,k,v [B, N, L, H, D], bias1 [B, N, Lk] | None,
+    bias2 [B, H, Lq, Lk] | None). Leading dims beyond two fold into B."""
+    if q.ndim < 4:
+        raise UnsupportedBiasLayout(
+            f"evoformer q must be [*, L, H, D], got {q.shape}")
+    lead = q.shape[:-3]
+    if len(lead) == 1:
+        b, n = lead[0], 1
+    else:
+        b, n = int(np.prod(lead[:-1])), lead[-1]
+    l_q, h, d = q.shape[-3:]
+    l_k = k.shape[-3]
+    q5 = q.reshape(b, n, l_q, h, d)
+    k5 = k.reshape(b, n, l_k, h, d)
+    v5 = v.reshape(b, n, l_k, h, d)
+
+    b1 = b2 = None
+    for bias in biases:
+        if bias is None:
+            continue
+        # classify by broadcast pattern against [B, N, H, Lq, Lk]
+        shape = bias.shape
+        if bias.ndim >= 1 and shape[-1] not in (l_k, 1):
+            raise UnsupportedBiasLayout(
+                f"bias last dim {shape[-1]} != key length {l_k}")
+        if bias.ndim < 2 or (shape[-2] == 1
+                             and (bias.ndim < 3 or shape[-3] == 1)):
+            # per-key additive (mask): [B, N, 1, 1, Lk]-like (or 0/1-d)
+            if b1 is not None:
+                raise UnsupportedBiasLayout("two mask-like biases given")
+            b1 = _bcast(bias, (b, n, 1, 1, l_k)).reshape(b, n, l_k)
+        else:
+            # pair bias: [B, 1, H, Lq, Lk]-like (shared across the N rows —
+            # the kernel streams ONE panel per (b, h); a bias that varies by
+            # row is outside the reference's contract too)
+            if b2 is not None:
+                raise UnsupportedBiasLayout("two pair-like biases given")
+            if bias.ndim >= 3 and shape[-3] not in (1, h):
+                raise UnsupportedBiasLayout(
+                    f"bias head dim {shape[-3]} != heads {h}")
+            if bias.ndim >= 4 and shape[-4] != 1 and n > 1:
+                raise UnsupportedBiasLayout(
+                    "pair bias varying over the row (N) dim is unsupported "
+                    f"(got row dim {shape[-4]} with N={n})")
+            b2 = _bcast(bias, (b, 1, h, l_q, l_k)).reshape(b, h, l_q, l_k)
+    return q5, k5, v5, b1, b2
+
+
+def _fold_bnh(x):
+    """[B, N, L, H, D] -> [B*N*H, L, D]."""
+    b, n, l, h, d = x.shape
+    return x.transpose(0, 1, 3, 2, 4).reshape(b * n * h, l, d)
+
+
+def _pad_axis(x, block, axis):
+    pad = (-x.shape[axis]) % block
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _bias_operands(b1, b2, b, n, h, block_q, block_k, lq_p, lk_p):
+    """Padded/folded bias arrays + (q-major) grid index maps, shared by the
+    fwd and bwd pallas_calls so their block addressing can never diverge.
+    Index maps take grid coords (bh=B*N*H row, i=q block, j=k block); the
+    dummy zero operands keep the arg list static when a bias is absent."""
+    has_b1, has_b2 = b1 is not None, b2 is not None
+    b1a = _pad_axis(b1, block_k, 2).reshape(b * n, 1, lk_p) if has_b1 else \
+        jnp.zeros((1, 1, block_k), jnp.float32)
+    b2a = _pad_axis(_pad_axis(b2, block_k, 3), block_q, 2) \
+        .reshape(b * h, lq_p, lk_p) if has_b2 else \
+        jnp.zeros((1, block_q, block_k), jnp.float32)
+
+    def b1_idx(bh, i, j):
+        return (bh // h, 0, j) if has_b1 else (0, 0, 0)
+
+    def b2_idx(bh, i, j):
+        return ((bh // (n * h)) * h + bh % h, i, j) if has_b2 else (0, 0, 0)
+    return b1a, b2a, b1_idx, b2_idx, has_b1, has_b2
+
+
+def _evo_fwd_impl(q, k, v, b1, b2, block_q, block_k, interpret):
+    b, n, l_q, h, d = q.shape
+    l_k = k.shape[2]
+    sm_scale = 1.0 / np.sqrt(d)
+    qf = _fold_bnh(_pad_axis(q, block_q, 2))
+    kf = _fold_bnh(_pad_axis(k, block_k, 2))
+    vf = _fold_bnh(_pad_axis(v, block_k, 2))
+    lq_p, lk_p = qf.shape[1], kf.shape[1]
+    nq, nk = lq_p // block_q, lk_p // block_k
+    g = b * n * h
+    b1a, b2a, b1_idx, b2_idx, has_b1, has_b2 = _bias_operands(
+        b1, b2, b, n, h, block_q, block_k, lq_p, lk_p)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_evo_fwd_kernel, sm_scale=sm_scale, block_k=block_k,
+                          num_k_blocks=nk, seq_len_k=l_k,
+                          has_b1=has_b1, has_b2=has_b2),
+        grid=(g, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), b1_idx),
+            pl.BlockSpec((1, block_q, block_k), b2_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, lq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((g, lq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, b1a, b2a)
+    o5 = out.reshape(b, n, h, lq_p, d).transpose(0, 1, 3, 2, 4)[:, :, :l_q]
+    return o5, (lse, lq_p, lk_p)
+
+
+def _evo_bwd_impl(q, k, v, b1, b2, out, lse, g_out, block_q, block_k,
+                  interpret):
+    b, n, l_q, h, d = q.shape
+    l_k = k.shape[2]
+    sm_scale = 1.0 / np.sqrt(d)
+    qf = _fold_bnh(_pad_axis(q, block_q, 2))
+    kf = _fold_bnh(_pad_axis(k, block_k, 2))
+    vf = _fold_bnh(_pad_axis(v, block_k, 2))
+    dof = _fold_bnh(_pad_axis(g_out, block_q, 2))
+    of = _fold_bnh(_pad_axis(out, block_q, 2))
+    lq_p, lk_p = qf.shape[1], kf.shape[1]
+    nq, nk = lq_p // block_q, lk_p // block_k
+    gdim = b * n * h
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    b1a, b2a, b1_idx, b2_idx, has_b1, has_b2 = _bias_operands(
+        b1, b2, b, n, h, block_q, block_k, lq_p, lk_p)
+
+    common = dict(sm_scale=sm_scale, block_k=block_k, seq_len_k=l_k,
+                  has_b1=has_b1, has_b2=has_b2)
+    row_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),   # v
+        pl.BlockSpec((1, 1, block_k), b1_idx),
+        pl.BlockSpec((1, block_q, block_k), b2_idx),
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),   # do
+        pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),   # lse
+        pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),   # delta
+    ]
+    args = (qf, kf, vf, b1a, b2a, dof, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_evo_dq_kernel, num_k_blocks=nk, **common),
+        grid=(gdim, nq, nk),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((gdim, lq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+
+    kv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, j, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),   # v
+        # grid here is (g, nk, nq): swap (i, j) into the shared q-major maps
+        pl.BlockSpec((1, 1, block_k), lambda bh, i, j: b1_idx(bh, j, i)),
+        pl.BlockSpec((1, block_q, block_k),
+                     lambda bh, i, j: b2_idx(bh, j, i)),
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, j, 0)),   # do
+        pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, j, 0)),   # lse
+        pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, j, 0)),   # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_evo_dkv_kernel, num_q_blocks=nq, **common),
+        grid=(gdim, nk, nq),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((gdim, lk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((gdim, lk_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+    db1 = db2 = None
+    if has_b2:
+        # grid (B*H, nq, nk, N): q/k/v row index from (bh, n) pair
+        def row_of(bh, nn):
+            return (bh // h) * (n * h) + nn * h + bh % h
+
+        b2_specs = [
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, i, j, nn: (row_of(bh, nn), i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j, nn: (row_of(bh, nn), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j, nn: (row_of(bh, nn), j, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         (lambda bh, i, j, nn: ((row_of(bh, nn)) // h, 0, j))
+                         if has_b1 else (lambda bh, i, j, nn: (0, 0, 0))),
+            pl.BlockSpec((1, block_q, block_k),
+                         lambda bh, i, j, nn: (bh, i, j)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, i, j, nn: (row_of(bh, nn), i, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, i, j, nn: (row_of(bh, nn), i, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, i, j, nn: (row_of(bh, nn), i, 0)),
+        ]
+        db2 = pl.pallas_call(
+            functools.partial(_evo_dbias2_kernel, num_rows=n, **common),
+            grid=(b * h, nq, nk, n),
+            in_specs=b2_specs,
+            out_specs=pl.BlockSpec((1, block_q, block_k),
+                                   lambda bh, i, j, nn: (bh, i, j)),
+            out_shape=jax.ShapeDtypeStruct((b * h, lq_p, lk_p), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+            interpret=interpret,
+        )(*args)
+        db2 = db2.reshape(b, h, lq_p, lk_p)[:, :, :l_q, :l_k]
+    if has_b1:
+        # grid (B*N, nk, H*nq): row index bn*h + (j // nq), q block j % nq
+        def g_of(bn, j):
+            return bn * h + j // nq
+
+        b1_specs = [
+            pl.BlockSpec((1, block_q, d),
+                         lambda bn, i, j: (g_of(bn, j), j % nq, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bn, i, j: (g_of(bn, j), i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bn, i, j: (g_of(bn, j), i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bn, i, j: (bn, 0, i)),
+            pl.BlockSpec((1, block_q, block_k),
+                         (lambda bn, i, j: ((bn // n) * h + j // nq,
+                                            j % nq, i))
+                         if has_b2 else (lambda bn, i, j: (0, 0, 0))),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bn, i, j: (g_of(bn, j), j % nq, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bn, i, j: (g_of(bn, j), j % nq, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bn, i, j: (g_of(bn, j), j % nq, 0)),
+        ]
+        db1 = pl.pallas_call(
+            functools.partial(_evo_dbias1_kernel, num_hq_steps=h * nq,
+                              **common),
+            grid=(b * n, nk, h * nq),
+            in_specs=b1_specs,
+            out_specs=pl.BlockSpec((1, 1, block_k),
+                                   lambda bn, i, j: (bn, 0, i)),
+            out_shape=jax.ShapeDtypeStruct((b * n, 1, lk_p), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((1, block_k), jnp.float32)],
+            interpret=interpret,
+        )(*args)
+        db1 = db1.reshape(b, n, lk_p)[:, :, :l_k]
+
+    def unfold(x, l):
+        return x.reshape(b, n, h, -1, d).transpose(0, 1, 3, 2, 4)[:, :, :l]
+    return unfold(dq, l_q), unfold(dk, l_k), unfold(dv, l_k), db1, db2
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp core (canonical 5D shapes) + public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _evo_core(q5, k5, v5, b1, b2, block_q, block_k, interpret):
+    out, _ = _evo_fwd_impl(q5, k5, v5, b1, b2, block_q, block_k, interpret)
+    return out
+
+
+def _evo_core_fwd(q5, k5, v5, b1, b2, block_q, block_k, interpret):
+    out, (lse, _, _) = _evo_fwd_impl(q5, k5, v5, b1, b2, block_q, block_k,
+                                     interpret)
+    return out, (q5, k5, v5, b1, b2, out, lse)
+
+
+def _evo_core_bwd(block_q, block_k, interpret, res, g):
+    q5, k5, v5, b1, b2, out, lse = res
+    dq, dk, dv, db1, db2 = _evo_bwd_impl(q5, k5, v5, b1, b2, out, lse, g,
+                                         block_q, block_k, interpret)
+    return (dq, dk, dv,
+            db1 if b1 is not None else None,
+            db2 if b2 is not None else None)
+
+
+_evo_core.defvjp(_evo_core_fwd, _evo_core_bwd)
+
+
+def pallas_evoformer_attention(q, k, v, biases=(), block_q: int = 128,
+                               block_k: int = 128, interpret: bool = False):
+    """Fused evoformer attention (Pallas): q/k/v ``[*, L, H, D]``, biases
+    per the module docstring. Differentiable in q/k/v and both biases (the
+    bias canonicalization is plain jnp broadcasting, so autodiff sums the
+    cotangent back over any broadcast dims of the caller's original shape).
+    """
+    lead = q.shape[:-3]                      # non-empty: _canon raises on <4d
+    q5, k5, v5, b1, b2 = _canon(q, k, v, biases)
+    out = _evo_core(q5, k5, v5, b1, b2, block_q, block_k, interpret)
+    return out.reshape(*lead, *out.shape[-3:])
