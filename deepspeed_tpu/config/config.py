@@ -53,6 +53,10 @@ class OffloadConfig(DeepSpeedTPUConfigModel):
     # offload_param streaming granularity: transformer blocks per streamed
     # group (larger = fewer, bigger H2D transfers but more HBM per group)
     layers_per_group: int = 1
+    # nvme tier: swap the fp32 MASTERS too (full ZeRO-Infinity — reference
+    # swaps the flat fp32 param shard alongside the moments); False keeps
+    # masters pinned in host RAM (moments-only swap)
+    swap_masters: bool = True
 
 
 class ZeroConfig(DeepSpeedTPUConfigModel):

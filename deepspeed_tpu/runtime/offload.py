@@ -38,19 +38,27 @@ _HOST_OPTIMIZERS = {
 
 
 class _LeafState:
-    """Host state for one parameter leaf: fp32 master + n_states moment buffers."""
+    """Host state for one parameter leaf: fp32 master + n_states moment
+    buffers. On the nvme tier with ``swap_masters`` the master itself also
+    lives in a file (full ZeRO-Infinity — reference swaps the flat fp32
+    param shard too) and ``master`` is None while swapped out."""
 
     def __init__(self, idx: int, master: np.ndarray, n_states: int,
-                 nvme_dir: Optional[str]):
+                 nvme_dir: Optional[str], swap_master: bool):
         self.idx = idx
-        self.master = master                       # fp32, host-resident always
+        self.shape = master.shape
+        self.size = master.size
         self.nvme = nvme_dir is not None
+        self.master_path = None
         if self.nvme:
             self.paths = [os.path.join(nvme_dir, f"state{s}_{idx}.bin")
                           for s in range(n_states)]
             self.states: List[Optional[np.ndarray]] = [None] * n_states
+            if swap_master:
+                self.master_path = os.path.join(nvme_dir, f"master_{idx}.bin")
         else:
             self.states = [np.zeros_like(master) for _ in range(n_states)]
+        self.master: Optional[np.ndarray] = master
         self._pending_drop = False
 
 
@@ -90,26 +98,34 @@ class HostOffloadOptimizer:
                                     f"proc{jax.process_index()}")
             os.makedirs(nvme_dir, exist_ok=True)
             self.aio = AsyncIOHandle(num_threads=offload.buffer_count * 2)
+        self._swap_masters = bool(getattr(offload, "swap_masters", True))
         self.leaves = [
             # np.array(copy=True): device_get arrays can be read-only views
             _LeafState(i, np.array(p, dtype=np.float32, copy=True), self.n_states,
                        # Twin-Flow partial offload: first (1-ratio) leaves pinned in RAM
                        nvme_dir if (nvme_dir and i >= (1.0 - offload.ratio) *
-                                    len(params_host)) else None)
+                                    len(params_host)) else None,
+                       swap_master=self._swap_masters)
             for i, p in enumerate(params_host)]
         if nvme_dir:
-            # initialize moment files; buffers must outlive the async writes
+            # initialize moment (+ master) files; buffers must outlive the
+            # async writes
             keepalive = []
             for leaf in self.leaves:
                 if leaf.nvme:
-                    zeros = np.zeros_like(leaf.master)
+                    zeros = np.zeros(leaf.shape, np.float32)
                     keepalive.append(zeros)
                     for path in leaf.paths:
                         self.aio.async_pwrite(zeros, path)
+                    if leaf.master_path:
+                        self.aio.async_pwrite(leaf.master, leaf.master_path)
             errors = self.aio.drain()
             if errors:
-                raise RuntimeError(f"nvme moment-file init failed ({errors} errors)")
+                raise RuntimeError(f"nvme state-file init failed ({errors} errors)")
             del keepalive
+            for leaf in self.leaves:
+                if leaf.master_path:
+                    leaf.master = None        # authoritative copy is the file
         self.sub_group_size = max(1, sub_group_size)
         log_dist(f"host offload optimizer: kernel={kernel_cls.__name__} "
                  f"device={offload.device} leaves={len(self.leaves)} "
@@ -121,8 +137,11 @@ class HostOffloadOptimizer:
         for leaf in group:
             if leaf.nvme and leaf.states[0] is None:
                 for s in range(self.n_states):
-                    leaf.states[s] = np.empty_like(leaf.master)
+                    leaf.states[s] = np.empty(leaf.shape, np.float32)
                     reqs.append(self.aio.async_pread(leaf.states[s], leaf.paths[s]))
+            if leaf.master_path and leaf.master is None:
+                leaf.master = np.empty(leaf.shape, np.float32)
+                reqs.append(self.aio.async_pread(leaf.master, leaf.master_path))
         return reqs
 
     def _swap_out(self, group: List[_LeafState]):
@@ -130,6 +149,8 @@ class HostOffloadOptimizer:
             if leaf.nvme:
                 for s in range(self.n_states):
                     self.aio.async_pwrite(leaf.states[s], leaf.paths[s])
+                if leaf.master_path:
+                    self.aio.async_pwrite(leaf.master, leaf.master_path)
                 # buffers dropped only after the writes drain WITHOUT error
                 leaf._pending_drop = True
 
@@ -167,18 +188,40 @@ class HostOffloadOptimizer:
             for leaf in self.leaves:
                 if leaf._pending_drop:
                     leaf.states = [None] * self.n_states
+                    if leaf.master_path:
+                        leaf.master = None
                     leaf._pending_drop = False
         self.kernel.step_count = step_shared
 
     # --- views ---------------------------------------------------------------
+    def _load_master(self, leaf: _LeafState) -> np.ndarray:
+        if leaf.master is not None:
+            return leaf.master
+        buf = np.empty(leaf.shape, np.float32)
+        if self.aio.wait(self.aio.async_pread(buf, leaf.master_path)):
+            raise RuntimeError("nvme master swap-in failed")
+        return buf
+
+    def iter_masters(self):
+        """Yield (idx, fp32 master) one leaf at a time — NVMe masters stream
+        through a transient buffer instead of all materializing at once (the
+        point of swap_masters for weights-bigger-than-RAM-budget runs)."""
+        for leaf in self.leaves:
+            yield leaf.idx, self._load_master(leaf)
+
     def masters(self) -> List[np.ndarray]:
-        return [l.master for l in self.leaves]
+        """All masters materialized (checkpoint-save path: transient RAM cost
+        of the full fp32 set when masters live on NVMe)."""
+        return [self._load_master(l) for l in self.leaves]
+
+    def leaf_shapes(self) -> List[tuple]:
+        return [l.shape for l in self.leaves]
 
     def shadows(self, dtype: str = "bfloat16") -> List[np.ndarray]:
         """Compute-dtype shadow copies for the host→device transfer."""
-        if dtype in ("bfloat16", "bf16"):
-            return [to_bf16(l.master) for l in self.leaves]
-        return [l.master.astype(dtype) for l in self.leaves]
+        cast = to_bf16 if dtype in ("bfloat16", "bf16") else \
+            (lambda a: a.astype(dtype))
+        return [cast(m) for _, m in self.iter_masters()]
 
     # --- persistence (consumed by checkpoint/engine.py) ----------------------
     def _materialized_states(self, leaf: _LeafState) -> List[np.ndarray]:
@@ -189,19 +232,29 @@ class HostOffloadOptimizer:
                     raise RuntimeError("nvme swap-in failed during state export")
         return [np.asarray(s) for s in leaf.states]
 
+    def _store_master(self, leaf: _LeafState, value: np.ndarray):
+        value = np.ascontiguousarray(value, np.float32).reshape(leaf.shape)
+        if leaf.master_path:
+            if self.aio.wait(self.aio.async_pwrite(value, leaf.master_path)):
+                raise RuntimeError("nvme master swap-out failed")
+            leaf.master = None
+        elif leaf.master is not None:
+            np.copyto(leaf.master, value)
+        else:
+            leaf.master = value.copy()
+
     def state_dict(self) -> Dict[str, Any]:
         return {
             "step_count": int(self.kernel.step_count),
-            "masters": [l.master for l in self.leaves],
+            "masters": self.masters(),
             "states": [self._materialized_states(l) for l in self.leaves],
         }
 
     def load_state_dict(self, sd: Dict[str, Any]):
         self.kernel.step_count = int(sd["step_count"])
         for leaf, master, states in zip(self.leaves, sd["masters"], sd["states"]):
-            np.copyto(leaf.master, np.asarray(master, np.float32).reshape(
-                leaf.master.shape))
-            buffers = [np.ascontiguousarray(s, np.float32).reshape(leaf.master.shape)
+            self._store_master(leaf, np.asarray(master, np.float32))
+            buffers = [np.ascontiguousarray(s, np.float32).reshape(leaf.shape)
                        for s in states]
             if leaf.nvme:
                 for s, buf in enumerate(buffers):
@@ -216,16 +269,15 @@ class HostOffloadOptimizer:
         """Overwrite masters (checkpoint-load resync). ``reset_moments`` zeroes
         the moments when the checkpoint carried none."""
         for leaf, m in zip(self.leaves, new_masters):
-            np.copyto(leaf.master, np.asarray(m, np.float32).reshape(
-                leaf.master.shape))
+            self._store_master(leaf, np.asarray(m, np.float32))
             if reset_moments:
                 if leaf.nvme:
-                    zeros = np.zeros_like(leaf.master)
+                    zeros = np.zeros(leaf.shape, np.float32)
                     for path in leaf.paths:
                         self.aio.async_pwrite(zeros, path)
                     if self.aio.drain():
                         raise RuntimeError("nvme moment reset failed")
                     leaf.states = [None] * self.n_states
                 else:
-                    leaf.states = [np.zeros_like(leaf.master)
+                    leaf.states = [np.zeros(leaf.shape, np.float32)
                                    for _ in range(self.n_states)]

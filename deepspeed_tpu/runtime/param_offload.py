@@ -266,10 +266,12 @@ class ParamOffloadTrainer:
         return tree
 
     def _refresh_store(self):
-        """Compute-dtype store <- fp32 masters (after each optimizer step)."""
+        """Compute-dtype store <- fp32 masters (after each optimizer step).
+        Streams one master at a time so NVMe-swapped masters never all
+        materialize in RAM."""
         cast = to_bf16 if self.compute_dtype == jnp.bfloat16 else \
             (lambda a: np.asarray(a, np.dtype(self.compute_dtype)))
-        for i, m in enumerate(self.opt.masters()):
+        for i, m in self.opt.iter_masters():
             self._store[i] = cast(m)
 
     def _group_file(self, gi: int) -> str:
@@ -285,8 +287,8 @@ class ParamOffloadTrainer:
         self._aio.async_pwrite(blob, self._group_file(gi))
 
     def _leaf_nbytes(self, i: int) -> int:
-        m = self.opt.masters()[i]
-        return m.size * np.dtype(self.compute_dtype).itemsize
+        return int(np.prod(self.opt.leaf_shapes()[i])) * \
+            np.dtype(self.compute_dtype).itemsize
 
     def _group_nbytes(self, gi: int) -> int:
         return sum(self._leaf_nbytes(i)
@@ -329,13 +331,13 @@ class ParamOffloadTrainer:
                         f"offload_param: nvme read failed (group {gi})")
                 self._buf_group[slot] = gi
             buf = self._bufs[slot]
-            masters = self.opt.masters()
+            shapes = self.opt.leaf_shapes()
             off = [0]
 
             def take(i):
                 n = self._leaf_nbytes(i)
                 view = buf[off[0]:off[0] + n].view(
-                    np.dtype(self.compute_dtype)).reshape(masters[i].shape)
+                    np.dtype(self.compute_dtype)).reshape(shapes[i])
                 off[0] += n
                 return view.copy()
             return jax.tree.map(take, idx_tree)
@@ -502,7 +504,7 @@ class ParamOffloadTrainer:
         loss = float(np.mean([jax.device_get(l) for l in losses]))
 
         grads = [a / gas if a is not None else
-                 np.zeros_like(self.opt.masters()[i])
+                 np.zeros(self.opt.leaf_shapes()[i], np.float32)
                  for i, a in enumerate(self._accum)]
         sq = sum(float(np.vdot(g, g)) for g in grads)
         norm = float(np.sqrt(sq))
