@@ -264,7 +264,7 @@ class DeepSpeedTPUEngine:
             self.state_shardings = None
             self._param_offload = ParamOffloadTrainer(
                 model, config, params, self.mesh, self.batch_sharding,
-                self.lr_schedule)
+                self.lr_schedule, tensor_rules=tensor_rules)
             params = None      # host copy now owned by the trainer's masters
             # checkpoint interop: host masters are the authoritative weights
             self._offload = self._param_offload.opt

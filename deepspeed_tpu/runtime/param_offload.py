@@ -154,7 +154,7 @@ class ParamOffloadTrainer:
     """Streamed train step over a host-resident parameter store."""
 
     def __init__(self, model, config: DeepSpeedTPUConfig, params_host,
-                 mesh, batch_sharding, lr_schedule):
+                 mesh, batch_sharding, lr_schedule, tensor_rules=None):
         validate_param_offload(config, model)
         self.cfg = model.cfg
         self.config = config
@@ -162,6 +162,7 @@ class ParamOffloadTrainer:
         self.batch_sharding = batch_sharding
         self.lr_schedule = lr_schedule
         self.compute_dtype = config.precision_dtype
+        self._tensor_rules = tensor_rules
         pcfg = config.zero_config.offload_param
 
         # --- flat host masters + fused host optimizer -----------------------
@@ -225,6 +226,43 @@ class ParamOffloadTrainer:
 
         self._replicated = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec())
+        # TP-sharded streaming: when tensor_rules are given, each streamed
+        # leaf lands on device already sharded over the mesh's tensor axes —
+        # 1/tp of the H2D bytes and HBM per chip vs replicated streaming
+        # (AutoTP composed with ZeRO-Infinity). Axes absent from the mesh
+        # are filtered out of the rule's spec (same policy as
+        # shard_activation).
+        self._leaf_sharding: List[Any] = [self._replicated] * len(host_leaves)
+        if tensor_rules is not None:
+            from jax.tree_util import DictKey
+            axes = set(mesh.shape)
+            def keep(entry):
+                if isinstance(entry, (tuple, list)):
+                    sub = tuple(a for a in entry if a in axes)
+                    return sub if sub else None
+                return entry if entry in axes else None
+
+            for i, p in enumerate(self._paths):
+                spec = tensor_rules(
+                    tuple(DictKey(part) for part in p.split("/")),
+                    jax.ShapeDtypeStruct(self.opt.leaf_shapes()[i],
+                                         jnp.float32))
+                if spec is None:
+                    continue
+                shape = self.opt.leaf_shapes()[i]
+                kept = []
+                for d, e in enumerate(tuple(spec)):
+                    e = keep(e)
+                    if e is not None:
+                        size = int(np.prod([mesh.shape[a] for a in
+                                            (e if isinstance(e, tuple)
+                                             else (e,))]))
+                        if d >= len(shape) or shape[d] % size:
+                            e = None     # indivisible dim: replicate it
+                    kept.append(e)
+                if any(e is not None for e in kept):
+                    self._leaf_sharding[i] = jax.sharding.NamedSharding(
+                        mesh, jax.sharding.PartitionSpec(*kept))
         self._accum: List[Optional[np.ndarray]] = [None] * len(host_leaves)
         self._stack_fwd: Dict[int, Any] = {}
         self._stack_bwd: Dict[int, Any] = {}
@@ -346,7 +384,8 @@ class ParamOffloadTrainer:
     def _device_group(self, idx_tree, gi: Optional[int] = None):
         tree = self._host_group_tree(idx_tree, gi)
         self.bytes_streamed += sum(a.nbytes for a in jax.tree.leaves(tree))
-        return jax.device_put(tree, self._replicated)
+        shardings = jax.tree.map(lambda i: self._leaf_sharding[i], idx_tree)
+        return jax.device_put(tree, shardings)
 
     def _accumulate(self, idx_tree, grad_tree):
         for i, g in zip(jax.tree.leaves(idx_tree), jax.tree.leaves(grad_tree)):
