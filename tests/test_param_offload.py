@@ -239,3 +239,16 @@ def test_param_offload_tp_sharded_streaming():
         batch=random_tokens(4, 32, vocab_size=VOCAB, seed=i, gas=1),
         stacked=True))) for i in range(3)]
     np.testing.assert_allclose(losses, l2, rtol=1e-4)
+
+
+def test_param_offload_mistral_style_sliding_window():
+    """Param offload covers the whole LlamaConfig family — a mistral-style
+    config (sliding window, GQA) streams and matches its dense engine."""
+    model = LlamaForCausalLM(tiny_cfg(sliding_window=16, num_kv_heads=2))
+    e1 = make_engine(model)
+    l1 = run_steps(e1)
+    e2 = make_engine(model, zero={"stage": 0,
+                                  "offload_param": {"device": "cpu"}})
+    l2 = run_steps(e2)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    assert l2[-1] < l2[0]
