@@ -12,6 +12,7 @@ Causality is handled with *global* positions: device i holds queries
 (i - t) mod P, masked by qpos >= kpos.
 """
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -72,19 +73,177 @@ def ring_attention_local(q_l, k_l, v_l, sp: int, causal: bool = True,
     return out.transpose(0, 2, 1, 3).astype(q_l.dtype)  # [B, S_l, H, D]
 
 
-def ring_attention(q, k, v, causal: bool = True, mesh=None):
-    """q,k,v: [B, S, H(kv), D] global, sequence-sharded. Returns [B, S, H, D]."""
+# ---------------------------------------------------------------------------
+# flash-kernel ring: the per-step [S_l, S_l] score panel never materializes
+# ---------------------------------------------------------------------------
+
+_SKIP_LSE = -1e30     # finite "no contribution" lse (a true -inf NaNs combine)
+
+
+def _combine(o1, lse1, o2, lse2):
+    """Merge two normalized partial attentions (o [B,S,H,D] f32,
+    lse [B,H,S]) — the flash multi-block stitch."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    lse = m + jnp.log(w1 + w2)
+    w1q = w1.transpose(0, 2, 1)[..., None]     # [B,S,H,1]
+    w2q = w2.transpose(0, 2, 1)[..., None]
+    o = (w1q * o1 + w2q * o2) / (w1q + w2q)
+    return o, lse
+
+
+def _ring_blocks(s_l: int):
+    blk = 256
+    while blk > s_l and blk > 8:
+        blk //= 2
+    return blk
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention_local_flash(q_l, k_l, v_l, sp: int, causal: bool,
+                               axis_name: str, interpret: bool):
+    """Ring attention whose per-step block attention is the Pallas flash
+    kernel: fwd stitches the blocks' (o, lse) online; bwd re-rotates KV and
+    runs the flash backward per block against the FINAL lse (the standard
+    multi-block decomposition — per-block probabilities under the global
+    softmax), with dk/dv accumulators riding the ring home. q_l [B,S_l,H,D],
+    k_l/v_l [B,S_l,Hkv,D] (GQA handled inside the kernel)."""
+    out, _ = _ring_flash_fwd(q_l, k_l, v_l, sp, causal, axis_name, interpret)
+    return out
+
+
+def _ring_flash_fwd(q_l, k_l, v_l, sp, causal, axis_name, interpret):
+    from deepspeed_tpu.ops.pallas.flash_attention import _pallas_flash_fwd_impl
+    b, s_l, h, d = q_l.shape
+    blk = _ring_blocks(s_l)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def block(kv_causal, k_cur, v_cur):
+        o, lse = _pallas_flash_fwd_impl(q_l, k_cur, v_cur, kv_causal,
+                                        blk, blk, interpret)
+        lse3 = lse[:, :s_l, 0].reshape(b, h, s_l)
+        return o.astype(jnp.float32), lse3
+
+    def step(carry, t):
+        k_cur, v_cur, o_acc, lse_acc = carry
+        src = (idx - t) % sp
+        if causal:
+            o_t, lse_t = jax.lax.cond(
+                src == idx,
+                lambda kc, vc: block(True, kc, vc),
+                lambda kc, vc: jax.lax.cond(
+                    src < idx,
+                    lambda kc2, vc2: block(False, kc2, vc2),
+                    lambda kc2, vc2: (jnp.zeros((b, s_l, h, d), jnp.float32),
+                                      jnp.full((b, h, s_l), _SKIP_LSE,
+                                               jnp.float32)),
+                    kc, vc),
+                k_cur, v_cur)
+        else:
+            o_t, lse_t = block(False, k_cur, v_cur)
+        o_acc, lse_acc = _combine(o_acc, lse_acc, o_t, lse_t)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, o_acc, lse_acc), None
+
+    o0 = jnp.zeros((b, s_l, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_l), _SKIP_LSE, jnp.float32)
+    (_, _, o, lse), _ = jax.lax.scan(step, (k_l, v_l, o0, lse0),
+                                     jnp.arange(sp))
+    return o.astype(q_l.dtype), lse
+
+
+def _ring_flash_fwd_vjp(q_l, k_l, v_l, sp, causal, axis_name, interpret):
+    out, lse = _ring_flash_fwd(q_l, k_l, v_l, sp, causal, axis_name, interpret)
+    return out, (q_l, k_l, v_l, out, lse)
+
+
+def _ring_flash_bwd(sp, causal, axis_name, interpret, res, g):
+    from deepspeed_tpu.ops.pallas.flash_attention import _pallas_flash_bwd_impl
+    q_l, k_l, v_l, out, lse = res
+    b, s_l, h, d = q_l.shape
+    blk = _ring_blocks(s_l)
+    # the bwd impl consumes lse in its folded padded layout [B*H, S_pad, 1]
+    pad = (-s_l) % blk
+    lse_f = lse.reshape(b * h, s_l, 1)
+    if pad:
+        lse_f = jnp.pad(lse_f, ((0, 0), (0, pad), (0, 0)))
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def block_bwd(kv_causal, k_cur, v_cur):
+        return _pallas_flash_bwd_impl(q_l, k_cur, v_cur, out, lse_f, g,
+                                      kv_causal, blk, blk, interpret)
+
+    def step(carry, t):
+        k_cur, v_cur, dk_acc, dv_acc, dq_acc = carry
+        src = (idx - t) % sp
+        if causal:
+            dq_c, dk_c, dv_c = jax.lax.cond(
+                src == idx,
+                lambda kc, vc: block_bwd(True, kc, vc),
+                lambda kc, vc: jax.lax.cond(
+                    src < idx,
+                    lambda kc2, vc2: block_bwd(False, kc2, vc2),
+                    lambda kc2, vc2: (jnp.zeros_like(q_l),
+                                      jnp.zeros_like(kc2),
+                                      jnp.zeros_like(vc2)),
+                    kc, vc),
+                k_cur, v_cur)
+        else:
+            dq_c, dk_c, dv_c = block_bwd(False, k_cur, v_cur)
+        dq_acc = dq_acc + dq_c.astype(jnp.float32)
+        dk_acc = dk_acc + dk_c.astype(jnp.float32)
+        dv_acc = dv_acc + dv_c.astype(jnp.float32)
+        # dk/dv accumulators ride the ring WITH their block; after sp hops
+        # every block (and its gradient) is back on its home device
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_next = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return (k_next, v_next, dk_next, dv_next, dq_acc), None
+
+    zk = jnp.zeros(k_l.shape, jnp.float32)
+    zq = jnp.zeros(q_l.shape, jnp.float32)
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step, (k_l, v_l, zk, jnp.zeros(v_l.shape, jnp.float32), zq),
+        jnp.arange(sp))
+    return dq.astype(q_l.dtype), dk.astype(k_l.dtype), dv.astype(v_l.dtype)
+
+
+ring_attention_local_flash.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd)
+
+
+def ring_attention(q, k, v, causal: bool = True, mesh=None,
+                   impl: Optional[str] = None):
+    """q,k,v: [B, S, H(kv), D] global, sequence-sharded. Returns [B, S, H, D].
+
+    ``impl``: ``"flash"`` (Pallas kernel per ring block — O(block) memory,
+    MXU-tiled; TPU default), ``"xla"`` (the jnp online-softmax body — any
+    backend), ``"interpret"`` (flash kernels in interpreter mode, for CPU
+    tests). Default picks flash on TPU, xla elsewhere.
+    """
     mesh = mesh or mesh_lib.get_global_mesh()
     sp = mesh.shape["sequence"]
     if sp == 1:
         from deepspeed_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal)
+    if impl is None:
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
 
-    h = q.shape[2]
     spec_q = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
 
-    def body(q_l, k_l, v_l):
-        return ring_attention_local(q_l, k_l, v_l, sp, causal=causal)
+    if impl == "xla":
+        def body(q_l, k_l, v_l):
+            return ring_attention_local(q_l, k_l, v_l, sp, causal=causal)
+    else:
+        interpret = impl == "interpret"
+
+        def body(q_l, k_l, v_l):
+            return ring_attention_local_flash(q_l, k_l, v_l, sp, causal,
+                                              "sequence", interpret)
 
     return jax.shard_map(body, mesh=mesh, in_specs=(spec_q, spec_q, spec_q),
                          out_specs=spec_q, check_vma=False)(q, k, v)
