@@ -252,3 +252,36 @@ def test_param_offload_mistral_style_sliding_window():
     l2 = run_steps(e2)
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
     assert l2[-1] < l2[0]
+
+
+def test_param_offload_from_hf_checkpoint():
+    """The real >HBM workflow: HF checkpoint -> from_hf_checkpoint ->
+    initialize(params=..., offload_param) trains without ever building
+    device-resident params."""
+    import dataclasses
+    from deepspeed_tpu.models.families import export_hf_state_dict
+    from deepspeed_tpu.models.hf import from_hf_checkpoint
+    cfg = tiny_cfg()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        random_tokens(2, 32, vocab_size=VOCAB))["params"]
+    hf_state = export_hf_state_dict(params, cfg)
+    hf_cfg = {"model_type": "llama", "vocab_size": VOCAB, "hidden_size": 64,
+              "intermediate_size": 128, "num_hidden_layers": 4,
+              "num_attention_heads": 4, "num_key_value_heads": 2,
+              "max_position_embeddings": 64, "rope_theta": cfg.rope_theta}
+    model2, cfg2, params2 = from_hf_checkpoint(hf_cfg, hf_state)
+    model2 = type(model2)(dataclasses.replace(
+        cfg2, dtype=jnp.float32, attention_backend="xla"))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model2, model_parameters=params2,
+        config={"train_batch_size": jax.device_count(), "optimizer": ADAMW,
+                "zero_optimization": {"stage": 0, "offload_param": {
+                    "device": "cpu", "layers_per_group": 2}}},
+        example_batch=random_tokens(2, 32, vocab_size=VOCAB))
+    losses = [float(jax.device_get(engine.train_batch(
+        batch=random_tokens(jax.device_count(), 32, vocab_size=VOCAB,
+                            seed=i, gas=1), stacked=True)))
+        for i in range(3)]
+    assert losses[-1] < losses[0], losses
+    assert engine.state.params == ()
