@@ -39,7 +39,7 @@ NEG_INF = -1e30
 
 
 def _masked_scores(q, k, qi, ki, *, sm_scale, causal, block_q, block_k,
-                   seq_len_k, window=None):
+                   seq_len_k, window=None, causal_shift=0):
     """Shared score-panel + mask construction for the forward and both backward
     kernels — keeps their masking numerically locked together. Returns
     (s[bq,bk] fp32 scores, mask[bq,bk] bool: kv-padding AND causal AND
@@ -51,8 +51,10 @@ def _masked_scores(q, k, qi, ki, *, sm_scale, causal, block_q, block_k,
     mask = kpos < seq_len_k
     if causal or window is not None:
         # a window implies the causal band (t-window, t] — same contract as
-        # attention_reference/_xla_attention
-        mask = jnp.logical_and(mask, qpos >= kpos)
+        # attention_reference/_xla_attention. ``causal_shift=1`` is the
+        # STRICT band (qpos > kpos): striped ring attention steps where the
+        # KV stripe sits one position ahead of the query stripe.
+        mask = jnp.logical_and(mask, qpos >= kpos + causal_shift)
     if window is not None:
         mask = jnp.logical_and(mask, kpos > qpos - window)
     return s, mask
@@ -73,7 +75,7 @@ def _block_live(qi, ki, *, causal, block_q, block_k, window):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                   sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k,
-                  window=None):
+                  window=None, causal_shift=0):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -89,7 +91,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         v = v_ref[0]
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 seq_len_k=seq_len_k, window=window)
+                                 seq_len_k=seq_len_k, window=window,
+                                 causal_shift=causal_shift)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]                  # [block_q, 1]
@@ -134,7 +137,7 @@ def _unfold(x, b, h, s):
 
 
 def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
-                           interpret: bool, window=None):
+                           interpret: bool, window=None, causal_shift=0):
     """q: [B, Sq, H, D]; k,v: [B, Sk, Hkv, D] -> (out, lse[B*H, Sq_padded])."""
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
@@ -151,7 +154,8 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len_k=sk, window=window),
+                          seq_len_k=sk, window=window,
+                          causal_shift=causal_shift),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -183,7 +187,7 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
                sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k,
-               window=None):
+               window=None, causal_shift=0):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -197,7 +201,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
         delta = delta_ref[0]               # [block_q, 1]
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 seq_len_k=seq_len_k, window=window)
+                                 seq_len_k=seq_len_k, window=window,
+                                 causal_shift=causal_shift)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -220,7 +225,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k,
-                num_q_blocks, num_q_steps, seq_len_k, window=None):
+                num_q_blocks, num_q_steps, seq_len_k, window=None,
+                causal_shift=0):
     j = pl.program_id(2)                   # folded (group, q_block) index
     ki = pl.program_id(1)
     qi = j % num_q_blocks
@@ -236,7 +242,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         delta = delta_ref[0]
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 seq_len_k=seq_len_k, window=window)
+                                 seq_len_k=seq_len_k, window=window,
+                                 causal_shift=causal_shift)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -262,7 +269,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
-                           interpret, window=None):
+                           interpret, window=None, causal_shift=0):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     rep = h // hkv
@@ -282,7 +289,8 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len_k=sk, window=window),
+                          seq_len_k=sk, window=window,
+                          causal_shift=causal_shift),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -305,7 +313,8 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          num_q_steps=nsteps, seq_len_k=sk, window=window),
+                          num_q_steps=nsteps, seq_len_k=sk, window=window,
+                          causal_shift=causal_shift),
         grid=(b * hkv, nk, nsteps),
         in_specs=[
             pl.BlockSpec((1, block_q, d),

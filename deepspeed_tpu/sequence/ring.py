@@ -100,49 +100,90 @@ def _ring_blocks(s_l: int):
     return blk
 
 
+# Striped layout (load balance): contiguous causal ring is skewed — device i
+# computes i+1 live blocks of P, so the last device works every step while
+# the first idles. With positions striped at stride P (device i holds global
+# positions ≡ i mod P in blocks of S_l/P), qpos = m_q*P + i and
+# kpos = m_k*P + src, so the causal test reduces to LOCAL causal with a
+# one-row shift: m_q >= m_k + (1 if src > idx else 0) — every ring step on
+# every device is one (shifted-)causal flash block of identical cost, and
+# the kernel's diagonal skipping drops the dead half. Resharding is one
+# all_to_all each way, which JAX differentiates through (its transpose is
+# the inverse all_to_all).
+
+
+def _stripe(x, sp, axis_name):
+    """Contiguous seq shard -> striped shard (positions ≡ idx mod sp)."""
+    b, s_l = x.shape[:2]
+    y = x.reshape(b, s_l // sp, sp, *x.shape[2:])
+    y = jax.lax.all_to_all(y, axis_name, split_axis=2, concat_axis=2)
+    return jnp.swapaxes(y, 1, 2).reshape(x.shape)
+
+
+def _unstripe(x, sp, axis_name):
+    b, s_l = x.shape[:2]
+    y = x.reshape(b, sp, s_l // sp, *x.shape[2:])
+    y = jax.lax.all_to_all(y, axis_name, split_axis=1, concat_axis=1)
+    return jnp.swapaxes(y, 1, 2).reshape(x.shape)
+
+
+# One fwd/bwd scaffold serves both ring layouts; ``mode`` picks the
+# per-step block policy (static, hashable -> one trace per mode):
+#   "causal":  contiguous layout — diagonal step causal, earlier steps full,
+#              later steps skipped (the skew the striped layout removes)
+#   "full":    non-causal — every step a full block
+#   "striped": striped layout — every step causal, with a one-row shift on
+#              strictly-future stripes (src > idx)
+
+
+def _step_fwd(mode, src, idx, block, skip):
+    """block(causal, shift) -> (o, lse); skip() -> zero contribution."""
+    if mode == "full":
+        return block(False, 0)
+    if mode == "striped":
+        return jax.lax.cond(src > idx,
+                            lambda: block(True, 1), lambda: block(True, 0))
+    return jax.lax.cond(
+        src == idx, lambda: block(True, 0),
+        lambda: jax.lax.cond(src < idx, lambda: block(False, 0), skip))
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def ring_attention_local_flash(q_l, k_l, v_l, sp: int, causal: bool,
-                               axis_name: str, interpret: bool):
+def _ring_core(q_l, k_l, v_l, sp: int, mode: str, axis_name: str,
+               interpret: bool):
     """Ring attention whose per-step block attention is the Pallas flash
     kernel: fwd stitches the blocks' (o, lse) online; bwd re-rotates KV and
     runs the flash backward per block against the FINAL lse (the standard
     multi-block decomposition — per-block probabilities under the global
     softmax), with dk/dv accumulators riding the ring home. q_l [B,S_l,H,D],
     k_l/v_l [B,S_l,Hkv,D] (GQA handled inside the kernel)."""
-    out, _ = _ring_flash_fwd(q_l, k_l, v_l, sp, causal, axis_name, interpret)
+    out, _ = _ring_fwd(q_l, k_l, v_l, sp, mode, axis_name, interpret)
     return out
 
 
-def _ring_flash_fwd(q_l, k_l, v_l, sp, causal, axis_name, interpret):
+def _ring_fwd(q_l, k_l, v_l, sp, mode, axis_name, interpret):
     from deepspeed_tpu.ops.pallas.flash_attention import _pallas_flash_fwd_impl
     b, s_l, h, d = q_l.shape
     blk = _ring_blocks(s_l)
     idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    def block(kv_causal, k_cur, v_cur):
-        o, lse = _pallas_flash_fwd_impl(q_l, k_cur, v_cur, kv_causal,
-                                        blk, blk, interpret)
-        lse3 = lse[:, :s_l, 0].reshape(b, h, s_l)
-        return o.astype(jnp.float32), lse3
-
     def step(carry, t):
         k_cur, v_cur, o_acc, lse_acc = carry
         src = (idx - t) % sp
-        if causal:
-            o_t, lse_t = jax.lax.cond(
-                src == idx,
-                lambda kc, vc: block(True, kc, vc),
-                lambda kc, vc: jax.lax.cond(
-                    src < idx,
-                    lambda kc2, vc2: block(False, kc2, vc2),
-                    lambda kc2, vc2: (jnp.zeros((b, s_l, h, d), jnp.float32),
-                                      jnp.full((b, h, s_l), _SKIP_LSE,
-                                               jnp.float32)),
-                    kc, vc),
-                k_cur, v_cur)
-        else:
-            o_t, lse_t = block(False, k_cur, v_cur)
+
+        def block(kv_causal, shift):
+            o, lse = _pallas_flash_fwd_impl(q_l, k_cur, v_cur, kv_causal,
+                                            blk, blk, interpret, None,
+                                            causal_shift=shift)
+            return (o.astype(jnp.float32),
+                    lse[:, :s_l, 0].reshape(b, h, s_l))
+
+        def skip():
+            return (jnp.zeros((b, s_l, h, d), jnp.float32),
+                    jnp.full((b, h, s_l), _SKIP_LSE, jnp.float32))
+
+        o_t, lse_t = _step_fwd(mode, src, idx, block, skip)
         o_acc, lse_acc = _combine(o_acc, lse_acc, o_t, lse_t)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
@@ -155,12 +196,12 @@ def _ring_flash_fwd(q_l, k_l, v_l, sp, causal, axis_name, interpret):
     return o.astype(q_l.dtype), lse
 
 
-def _ring_flash_fwd_vjp(q_l, k_l, v_l, sp, causal, axis_name, interpret):
-    out, lse = _ring_flash_fwd(q_l, k_l, v_l, sp, causal, axis_name, interpret)
+def _ring_fwd_vjp(q_l, k_l, v_l, sp, mode, axis_name, interpret):
+    out, lse = _ring_fwd(q_l, k_l, v_l, sp, mode, axis_name, interpret)
     return out, (q_l, k_l, v_l, out, lse)
 
 
-def _ring_flash_bwd(sp, causal, axis_name, interpret, res, g):
+def _ring_bwd(sp, mode, axis_name, interpret, res, g):
     from deepspeed_tpu.ops.pallas.flash_attention import _pallas_flash_bwd_impl
     q_l, k_l, v_l, out, lse = res
     b, s_l, h, d = q_l.shape
@@ -173,27 +214,20 @@ def _ring_flash_bwd(sp, causal, axis_name, interpret, res, g):
     idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    def block_bwd(kv_causal, k_cur, v_cur):
-        return _pallas_flash_bwd_impl(q_l, k_cur, v_cur, out, lse_f, g,
-                                      kv_causal, blk, blk, interpret)
-
     def step(carry, t):
         k_cur, v_cur, dk_acc, dv_acc, dq_acc = carry
         src = (idx - t) % sp
-        if causal:
-            dq_c, dk_c, dv_c = jax.lax.cond(
-                src == idx,
-                lambda kc, vc: block_bwd(True, kc, vc),
-                lambda kc, vc: jax.lax.cond(
-                    src < idx,
-                    lambda kc2, vc2: block_bwd(False, kc2, vc2),
-                    lambda kc2, vc2: (jnp.zeros_like(q_l),
-                                      jnp.zeros_like(kc2),
-                                      jnp.zeros_like(vc2)),
-                    kc, vc),
-                k_cur, v_cur)
-        else:
-            dq_c, dk_c, dv_c = block_bwd(False, k_cur, v_cur)
+
+        def block(kv_causal, shift):
+            return _pallas_flash_bwd_impl(q_l, k_cur, v_cur, out, lse_f, g,
+                                          kv_causal, blk, blk, interpret,
+                                          None, causal_shift=shift)
+
+        def skip():
+            return (jnp.zeros_like(q_l), jnp.zeros_like(k_cur),
+                    jnp.zeros_like(v_cur))
+
+        dq_c, dk_c, dv_c = _step_fwd(mode, src, idx, block, skip)
         dq_acc = dq_acc + dq_c.astype(jnp.float32)
         dk_acc = dk_acc + dk_c.astype(jnp.float32)
         dv_acc = dv_acc + dv_c.astype(jnp.float32)
@@ -205,15 +239,35 @@ def _ring_flash_bwd(sp, causal, axis_name, interpret, res, g):
         dv_next = jax.lax.ppermute(dv_acc, axis_name, perm)
         return (k_next, v_next, dk_next, dv_next, dq_acc), None
 
-    zk = jnp.zeros(k_l.shape, jnp.float32)
-    zq = jnp.zeros(q_l.shape, jnp.float32)
     (_, _, dk, dv, dq), _ = jax.lax.scan(
-        step, (k_l, v_l, zk, jnp.zeros(v_l.shape, jnp.float32), zq),
+        step, (k_l, v_l, jnp.zeros(k_l.shape, jnp.float32),
+               jnp.zeros(v_l.shape, jnp.float32),
+               jnp.zeros(q_l.shape, jnp.float32)),
         jnp.arange(sp))
     return dq.astype(q_l.dtype), dk.astype(k_l.dtype), dv.astype(v_l.dtype)
 
 
-ring_attention_local_flash.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd)
+_ring_core.defvjp(_ring_fwd_vjp, _ring_bwd)
+
+
+def ring_attention_local_flash(q_l, k_l, v_l, sp: int, causal: bool,
+                               axis_name: str = "sequence",
+                               interpret: bool = False):
+    """Contiguous-layout flash ring (see _ring_core)."""
+    return _ring_core(q_l, k_l, v_l, sp, "causal" if causal else "full",
+                      axis_name, interpret)
+
+
+def ring_attention_local_striped(q_l, k_l, v_l, sp: int,
+                                 axis_name: str = "sequence",
+                                 interpret: bool = False):
+    """Load-balanced causal ring: stripe q/k/v, run the shifted-causal flash
+    ring, unstripe the output. Requires S_l % sp == 0 (checked by caller)."""
+    q_s = _stripe(q_l, sp, axis_name)
+    k_s = _stripe(k_l, sp, axis_name)
+    v_s = _stripe(v_l, sp, axis_name)
+    out = _ring_core(q_s, k_s, v_s, sp, "striped", axis_name, interpret)
+    return _unstripe(out, sp, axis_name)
 
 
 def ring_attention(q, k, v, causal: bool = True, mesh=None,
@@ -221,9 +275,12 @@ def ring_attention(q, k, v, causal: bool = True, mesh=None,
     """q,k,v: [B, S, H(kv), D] global, sequence-sharded. Returns [B, S, H, D].
 
     ``impl``: ``"flash"`` (Pallas kernel per ring block — O(block) memory,
-    MXU-tiled; TPU default), ``"xla"`` (the jnp online-softmax body — any
-    backend), ``"interpret"`` (flash kernels in interpreter mode, for CPU
-    tests). Default picks flash on TPU, xla elsewhere.
+    MXU-tiled; causal runs STRIPED for load balance when S_l % sp == 0;
+    TPU default), ``"flash_contiguous"`` (skew-causal flash ring, no
+    resharding), ``"xla"`` (the jnp online-softmax body — any backend),
+    ``"interpret"`` / ``"interpret_contiguous"`` (the flash paths in
+    interpreter mode, for CPU tests). Default picks flash on TPU, xla
+    elsewhere.
     """
     mesh = mesh or mesh_lib.get_global_mesh()
     sp = mesh.shape["sequence"]
@@ -234,12 +291,20 @@ def ring_attention(q, k, v, causal: bool = True, mesh=None,
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
 
     spec_q = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
+    s_l = q.shape[1] // sp
+    striped = causal and s_l % sp == 0 and impl in ("flash", "interpret")
 
     if impl == "xla":
         def body(q_l, k_l, v_l):
             return ring_attention_local(q_l, k_l, v_l, sp, causal=causal)
-    else:
+    elif striped:
         interpret = impl == "interpret"
+
+        def body(q_l, k_l, v_l):
+            return ring_attention_local_striped(q_l, k_l, v_l, sp,
+                                                "sequence", interpret)
+    else:
+        interpret = impl.startswith("interpret")
 
         def body(q_l, k_l, v_l):
             return ring_attention_local_flash(q_l, k_l, v_l, sp, causal,
