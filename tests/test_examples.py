@@ -53,3 +53,11 @@ def test_long_context_example():
         out = _run("long_context.py", "--backend", backend, "--seq", "256",
                    "--steps", "3")
         assert f"{backend} sp=4 seq=256" in out
+
+
+def test_llama70b_north_star_dryrun():
+    """Both v5e-16 memory plans (ZeRO-3+offload_optimizer / offload_param
+    streaming) run the full config mechanics on 16 virtual devices."""
+    for mode in ("fsdp", "stream"):
+        out = _run("llama70b_v5e16.py", "--dryrun", "--mode", mode)
+        assert "ok" in out and "losses" in out
