@@ -40,12 +40,15 @@ TUNER_CLASSES = {
 
 
 def estimate_state_bytes(n_params: int, stage: int, fsdp_size: int,
-                         compute_bytes: int = 2) -> int:
+                         compute_bytes: int = 2,
+                         offload_optimizer: bool = False) -> int:
     """Analytic per-device bytes for params + grads + Adam states under a ZeRO stage
     (reference: autotuner.py get_instantiation_memory_required_per_gpu).
 
     stage 0: everything replicated; 1: optimizer states sharded; 2: +grads sharded;
-    3: +params sharded. Optimizer master+moments = 3 x fp32.
+    3: +params sharded. Optimizer master+moments = 3 x fp32;
+    ``offload_optimizer`` moves them (and the fp32 grad buffer — the host
+    path accumulates compute-dtype grads) to the host tier.
     """
     opt = 12 * n_params  # fp32 master + m + v
     grads = 4 * n_params  # fp32 grad accumulation
@@ -56,6 +59,9 @@ def estimate_state_bytes(n_params: int, stage: int, fsdp_size: int,
         grads //= fsdp_size
     if stage >= 3:
         params //= fsdp_size
+    if offload_optimizer:
+        opt = 0
+        grads = compute_bytes * n_params // (fsdp_size if stage >= 2 else 1)
     return params + grads + opt
 
 
@@ -78,6 +84,7 @@ class Autotuner:
                  max_micro_batch: int = 64,
                  num_micro_batches: int = 4,
                  try_remat: bool = False,
+                 try_offload: Optional[bool] = None,
                  warmup_steps: int = 1, measure_steps: int = 3,
                  n_trials: int = 50, early_stopping: int = 0,
                  results_dir: Optional[str] = None,
@@ -97,6 +104,8 @@ class Autotuner:
         self.max_micro_batch = max_micro_batch
         self.num_micro_batches = num_micro_batches
         self.try_remat = try_remat
+        # None = auto: offload variants only where nothing fits in HBM
+        self.try_offload = try_offload
         self.n_trials = n_trials
         self.early_stopping = early_stopping
         self.results_dir = results_dir
@@ -158,6 +167,28 @@ class Autotuner:
                 if estimate_state_bytes(n, s, fsdp_size) < self.hbm_bytes]
         return keep or [max(self.zero_stages)]
 
+    def feasible_configs(self, fsdp_size: int) -> List[Tuple[int, bool]]:
+        """(stage, offload_optimizer) candidates: stages feasible in-HBM run
+        plain (+offloaded too when try_offload); stages feasible ONLY with
+        the host optimizer tier enter the space offloaded — the reference
+        autotuner's offloading dimension (autotuning/config.py)."""
+        if not self.hbm_bytes:
+            pairs = [(s, False) for s in self.zero_stages]
+            if self.try_offload:
+                pairs += [(s, True) for s in self.zero_stages]
+            return pairs
+        n = self.model_info()["num_params"]
+        pairs = []
+        for s in self.zero_stages:
+            if estimate_state_bytes(n, s, fsdp_size) < self.hbm_bytes:
+                pairs.append((s, False))
+                if self.try_offload:
+                    pairs.append((s, True))
+            elif self.try_offload is not False and estimate_state_bytes(
+                    n, s, fsdp_size, offload_optimizer=True) < self.hbm_bytes:
+                pairs.append((s, True))   # only fits with the host tier
+        return pairs or [(max(self.zero_stages), True)]
+
     def _mbs_candidates(self) -> List[int]:
         """Log-spaced micro-batch sizes up to max (reference:
         _get_min_micro_batch_size/_get_max_micro_batch_size probe then interpolate)."""
@@ -171,15 +202,21 @@ class Autotuner:
             cands = [cands[int(round(i))] for i in idx]
         return sorted(set(cands))
 
-    def generate_experiments(self, stages: List[int]) -> List[Experiment]:
+    def generate_experiments(self, stages) -> List[Experiment]:
         exps = []
-        for stage in stages:
+        for entry in stages:
+            stage, offload = entry if isinstance(entry, tuple) else (entry,
+                                                                     False)
             for mbs in self._mbs_candidates():
                 variants = [False, True] if self.try_remat else [False]
                 for remat in variants:
-                    name = f"z{stage}_mbs{mbs}" + ("_remat" if remat else "")
+                    name = f"z{stage}_mbs{mbs}" + ("_remat" if remat else "") \
+                        + ("_off" if offload else "")
+                    zero: Dict[str, Any] = {"stage": stage}
+                    if offload:
+                        zero["offload_optimizer"] = {"device": "cpu"}
                     ov: Dict[str, Any] = {
-                        "zero_optimization": {"stage": stage},
+                        "zero_optimization": zero,
                         "train_micro_batch_size_per_gpu": mbs,
                         "gradient_accumulation_steps":
                             self.base_config.get("gradient_accumulation_steps", 1),
@@ -196,9 +233,10 @@ class Autotuner:
         if mesh is not None:
             fsdp = int(np.prod([mesh.shape.get(a, 1)
                                 for a in ("fsdp_out", "fsdp", "data")]))
-        stages = self.feasible_stages(fsdp)
+        stages = self.feasible_configs(fsdp)
         exps = self.generate_experiments(stages)
-        logger.info(f"autotuning: {len(exps)} candidates over stages {stages}, "
+        logger.info(f"autotuning: {len(exps)} candidates over "
+                    f"(stage, offload) {stages}, "
                     f"metric={self.metric}, tuner={self.tuner_type}")
         tuner_cls = TUNER_CLASSES.get(self.tuner_type)
         if tuner_cls is None:

@@ -195,3 +195,37 @@ def test_autotuner_process_isolation_requires_factory():
     with pytest.raises(ValueError, match="model_factory"):
         Autotuner(SimpleModel(hidden_dim=32), BASE, batch_fn=random_batch,
                   isolation="process")
+
+
+def test_offload_dimension_in_search_space():
+    """Stages that fit only with the host optimizer tier enter the space
+    offloaded; try_offload=True adds offload variants everywhere
+    (reference: the autotuner's offloading dimension)."""
+    from deepspeed_tpu.autotuning.autotuner import (Autotuner,
+                                                    estimate_state_bytes)
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+    n = 1_000_000
+    # offload zeroes device optimizer bytes and shrinks the grad buffer
+    assert estimate_state_bytes(n, 0, 1, offload_optimizer=True) < \
+        estimate_state_bytes(n, 0, 1)
+
+    def mk(**kw):
+        return Autotuner(SimpleModel(hidden_dim=512),
+                         {"train_batch_size": 8,
+                          "optimizer": {"type": "AdamW",
+                                        "params": {"lr": 1e-3}}},
+                         batch_fn=random_batch, **kw)
+    # HBM budget between the offloaded (4n) and plain (18n) footprints:
+    # plain stages can't fit, offloaded ones can
+    n_model = mk().model_info()["num_params"]
+    t = mk(hbm_bytes=8 * n_model)
+    pairs = t.feasible_configs(1)
+    assert pairs and all(off for _, off in pairs), pairs
+    names = [e.name for e in t.generate_experiments(pairs)]
+    assert all(n.endswith("_off") for n in names), names
+    # generous HBM: plain stages only unless try_offload=True
+    t2 = mk(hbm_bytes=int(1e12))
+    assert all(not off for _, off in t2.feasible_configs(1))
+    t3 = mk(hbm_bytes=int(1e12), try_offload=True)
+    offs = [off for _, off in t3.feasible_configs(1)]
+    assert any(offs) and not all(offs)
