@@ -205,11 +205,9 @@ def _dispatch_attention(backend: str, q, k, v, causal=True, segment_ids=None,
     if backend == "xla":
         return _xla_attention(q, k, v, causal, segment_ids)
     if backend == "flash":
-        if segment_ids is not None:
-            # packed-sequence masks are an XLA-path feature
-            return _xla_attention(q, k, v, causal, segment_ids, window=window)
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_auto
-        return flash_attention_auto(q, k, v, causal=causal, window=window)
+        return flash_attention_auto(q, k, v, causal=causal, window=window,
+                                    segment_ids=segment_ids)
     if backend == "ulysses":
         from deepspeed_tpu.sequence.ulysses import ulysses_attention
         return ulysses_attention(q, k, v, causal=causal)

@@ -100,10 +100,12 @@ def flash_attention(q, k, v, causal: bool = True, segment_ids=None,
     return out[:, :sq].astype(q.dtype)
 
 
-def attention_reference(q, k, v, causal: bool = True, window=None):
+def attention_reference(q, k, v, causal: bool = True, window=None,
+                        segment_ids=None):
     """Naive O(S^2)-memory reference for kernel tests (analog of the torch
     reference implementations in tests/unit/ops). ``window`` masks to the
-    band (t-window, t] — a window implies causal banding (mistral)."""
+    band (t-window, t] — a window implies causal banding (mistral);
+    ``segment_ids`` [B, S] confines attention within packed segments."""
     b, sq, h, d = q.shape
     k, v = _repeat_kv(k, v, h)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
@@ -115,5 +117,9 @@ def attention_reference(q, k, v, causal: bool = True, window=None):
         if window is not None:
             mask = jnp.logical_and(mask, kpos > qpos - window)
         s = jnp.where(mask[None, None], s, NEG_INF)
+    if segment_ids is not None:
+        seg = jnp.asarray(segment_ids)
+        seg_mask = seg[:, :, None] == seg[:, None, :]
+        s = jnp.where(seg_mask[:, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)

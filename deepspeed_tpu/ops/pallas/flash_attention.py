@@ -39,7 +39,8 @@ NEG_INF = -1e30
 
 
 def _masked_scores(q, k, qi, ki, *, sm_scale, causal, block_q, block_k,
-                   seq_len_k, window=None, causal_shift=0):
+                   seq_len_k, window=None, causal_shift=0,
+                   qseg=None, kseg=None):
     """Shared score-panel + mask construction for the forward and both backward
     kernels — keeps their masking numerically locked together. Returns
     (s[bq,bk] fp32 scores, mask[bq,bk] bool: kv-padding AND causal AND
@@ -57,6 +58,9 @@ def _masked_scores(q, k, qi, ki, *, sm_scale, causal, block_q, block_k,
         mask = jnp.logical_and(mask, qpos >= kpos + causal_shift)
     if window is not None:
         mask = jnp.logical_and(mask, kpos > qpos - window)
+    if qseg is not None:
+        # packed sequences: tokens attend within their segment only
+        mask = jnp.logical_and(mask, qseg == kseg.reshape(1, -1))
     return s, mask
 
 
@@ -73,9 +77,10 @@ def _block_live(qi, ki, *, causal, block_q, block_k, window):
     return live
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr, *,
                   sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k,
-                  window=None, causal_shift=0):
+                  window=None, causal_shift=0, has_seg=False):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -92,7 +97,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
                                  seq_len_k=seq_len_k, window=window,
-                                 causal_shift=causal_shift)
+                                 causal_shift=causal_shift,
+                                 qseg=qs_ref[0] if has_seg else None,
+                                 kseg=ks_ref[0] if has_seg else None)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]                  # [block_q, 1]
@@ -136,8 +143,43 @@ def _unfold(x, b, h, s):
     return x.reshape(b, h, x.shape[1], x.shape[2]).transpose(0, 2, 1, 3)[:, :s]
 
 
+def _seg_operands(segment_ids, sq, sk, block_q, block_k):
+    """Padded [B, S, 1] int32 segment arrays (+has_seg). Padding uses -1 on
+    the k side so padded keys mismatch every real segment (they are also
+    masked by seq_len_k)."""
+    if segment_ids is None:
+        return (jnp.zeros((1, block_q, 1), jnp.int32),
+                jnp.zeros((1, block_k, 1), jnp.int32), False)
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    qs = jnp.pad(seg, ((0, 0), (0, (-sq) % block_q)),
+                 constant_values=-1)[..., None]
+    ks = jnp.pad(seg[:, :sk], ((0, 0), (0, (-sk) % block_k)),
+                 constant_values=-1)[..., None]
+    return qs, ks, True
+
+
+def _seg_specs(has_seg, h_of, block_q, block_k, q_major=True):
+    """Block specs for the (q_seg, k_seg) operands: indexed by BATCH
+    (grid dim0 // heads). ``q_major``: grid is (g, q_blocks, k_blocks);
+    otherwise (g, k_blocks, q_steps) — the dkv layout."""
+    if not has_seg:
+        z = lambda bh, i, j: (0, 0, 0)
+        return [pl.BlockSpec((1, block_q, 1), z),
+                pl.BlockSpec((1, block_k, 1), z)]
+    if q_major:
+        return [pl.BlockSpec((1, block_q, 1),
+                             lambda bh, i, j: (h_of(bh), i, 0)),
+                pl.BlockSpec((1, block_k, 1),
+                             lambda bh, i, j: (h_of(bh), j, 0))]
+    return [pl.BlockSpec((1, block_q, 1),
+                         lambda bh, i, j: (h_of(bh), j, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bh, i, j: (h_of(bh), i, 0))]
+
+
 def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
-                           interpret: bool, window=None, causal_shift=0):
+                           interpret: bool, window=None, causal_shift=0,
+                           segment_ids=None):
     """q: [B, Sq, H, D]; k,v: [B, Sk, Hkv, D] -> (out, lse[B*H, Sq_padded])."""
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
@@ -147,6 +189,7 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
     qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v, block_k)
     sq_p, sk_p = qp.shape[1], kp.shape[1]
     q2, k2, v2 = _fold(qp), _fold(kp), _fold(vp)
+    qs, ks, has_seg = _seg_operands(segment_ids, sq, sk, block_q, block_k)
 
     nq, nk = sq_p // block_q, sk_p // block_k
     grid = (b * h, nq, nk)
@@ -155,7 +198,7 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
         functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
                           seq_len_k=sk, window=window,
-                          causal_shift=causal_shift),
+                          causal_shift=causal_shift, has_seg=has_seg),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -163,7 +206,7 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
                          lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
             pl.BlockSpec((1, block_k, d),
                          lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
-        ],
+        ] + _seg_specs(has_seg, lambda bh, h=h: bh // h, block_q, block_k),
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             # rank-3 [B*H, S, 1]: TPU blocks need sublane %8 == 0 and lane
@@ -180,14 +223,15 @@ def _pallas_flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q2, k2, v2)
+    )(q2, k2, v2, qs, ks)
 
     return _unfold(out, b, h, sq), lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
+def _dq_kernel(q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, dq_scr, *,
                sm_scale, causal, block_q, block_k, num_k_blocks, seq_len_k,
-               window=None, causal_shift=0):
+               window=None, causal_shift=0, has_seg=False):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -202,7 +246,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
                                  seq_len_k=seq_len_k, window=window,
-                                 causal_shift=causal_shift)
+                                 causal_shift=causal_shift,
+                                 qseg=qs_ref[0] if has_seg else None,
+                                 kseg=ks_ref[0] if has_seg else None)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -223,10 +269,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k,
                 num_q_blocks, num_q_steps, seq_len_k, window=None,
-                causal_shift=0):
+                causal_shift=0, has_seg=False):
     j = pl.program_id(2)                   # folded (group, q_block) index
     ki = pl.program_id(1)
     qi = j % num_q_blocks
@@ -243,7 +290,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         s, mask = _masked_scores(q, k, qi, ki, sm_scale=sm_scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
                                  seq_len_k=seq_len_k, window=window,
-                                 causal_shift=causal_shift)
+                                 causal_shift=causal_shift,
+                                 qseg=qs_ref[0] if has_seg else None,
+                                 kseg=ks_ref[0] if has_seg else None)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -269,7 +318,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
-                           interpret, window=None, causal_shift=0):
+                           interpret, window=None, causal_shift=0,
+                           segment_ids=None):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     rep = h // hkv
@@ -281,6 +331,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     sq_p, sk_p = qp.shape[1], kp.shape[1]
     q2, k2, v2 = _fold(qp), _fold(kp), _fold(vp)
     do2, o2 = _fold(gp), _fold(op)
+    qs, ks, has_seg = _seg_operands(segment_ids, sq, sk, block_q, block_k)
     delta = jnp.sum(do2.astype(jnp.float32) * o2.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
@@ -290,7 +341,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
                           seq_len_k=sk, window=window,
-                          causal_shift=causal_shift),
+                          causal_shift=causal_shift, has_seg=has_seg),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -298,6 +349,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
                          lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
             pl.BlockSpec((1, block_k, d),
                          lambda bh, i, j, rep=rep: (bh // rep, j, 0)),
+        ] + _seg_specs(has_seg, lambda bh, h=h: bh // h, block_q, block_k) + [
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
@@ -306,7 +358,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q2, k2, v2, do2, lse, delta)
+    )(q2, k2, v2, qs, ks, do2, lse, delta)
 
     # dKV: GQA group folded into the innermost grid axis → in-kernel accumulation
     nsteps = nq * rep
@@ -314,7 +366,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
                           num_q_steps=nsteps, seq_len_k=sk, window=window,
-                          causal_shift=causal_shift),
+                          causal_shift=causal_shift, has_seg=has_seg),
         grid=(b * hkv, nk, nsteps),
         in_specs=[
             pl.BlockSpec((1, block_q, d),
@@ -322,6 +374,17 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
                          (bh * rep + j // nq, j % nq, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+        ] + ([
+            # seg operands: q block j%nq (batch = bh // hkv), k block i
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, i, j, hkv=hkv, nq=nq:
+                         (bh // hkv, j % nq, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bh, i, j, hkv=hkv: (bh // hkv, i, 0)),
+        ] if has_seg else [
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (0, 0, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda bh, i, j: (0, 0, 0)),
+        ]) + [
             pl.BlockSpec((1, block_q, d),
                          lambda bh, i, j, rep=rep, nq=nq:
                          (bh * rep + j // nq, j % nq, 0)),
@@ -345,7 +408,7 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q2, k2, v2, do2, lse, delta)
+    )(q2, k2, v2, qs, ks, do2, lse, delta)
 
     return (_unfold(dq, b, h, sq), _unfold(dk, b, hkv, sk),
             _unfold(dv, b, hkv, sk))
@@ -354,32 +417,39 @@ def _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
                            block_k: int = 256, interpret: bool = False,
-                           window=None):
+                           window=None, segment_ids=None):
     """Flash attention with Pallas forward and backward kernels.
     ``interpret=True`` runs the kernels in interpreter mode (CPU CI);
     ``window`` adds mistral-style sliding-window masking with below-window
-    block skipping (long-context windowed cost is O(S*window))."""
+    block skipping (long-context windowed cost is O(S*window));
+    ``segment_ids`` [B, S] masks packed sequences in-kernel (tokens attend
+    within their segment only)."""
     out, _ = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k,
-                                    interpret, window)
+                                    interpret, window,
+                                    segment_ids=segment_ids)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window, segment_ids):
     out, lse = _pallas_flash_fwd_impl(q, k, v, causal, block_q, block_k,
-                                      interpret, window)
-    return out, (q, k, v, out, lse)
+                                      interpret, window,
+                                      segment_ids=segment_ids)
+    return out, (q, k, v, out, lse, segment_ids)
 
 
 def _bwd(causal, block_q, block_k, interpret, window, res, g):
-    q, k, v, out, lse = res
-    return _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q,
-                                  block_k, interpret, window)
+    q, k, v, out, lse, segment_ids = res
+    dq, dk, dv = _pallas_flash_bwd_impl(q, k, v, out, lse, g, causal, block_q,
+                                        block_k, interpret, window,
+                                        segment_ids=segment_ids)
+    return dq, dk, dv, None
 
 
 pallas_flash_attention.defvjp(_fwd, _bwd)
 
 
-def flash_attention_auto(q, k, v, causal: bool = True, window=None):
+def flash_attention_auto(q, k, v, causal: bool = True, window=None,
+                         segment_ids=None):
     """Dispatch: Pallas kernel on TPU, interpret/blockwise elsewhere."""
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -390,10 +460,11 @@ def flash_attention_auto(q, k, v, causal: bool = True, window=None):
         for blk in ((1024, 512, 256) if d <= 128 else (512, 256)):
             if q.shape[1] % blk == 0 and k.shape[1] % blk == 0:
                 return pallas_flash_attention(q, k, v, causal, blk, blk,
-                                              False, window)
+                                              False, window, segment_ids)
         return pallas_flash_attention(q, k, v, causal, 256, 256, False,
-                                      window)
-    if window is not None:
+                                      window, segment_ids)
+    if window is not None or segment_ids is not None:
         from deepspeed_tpu.ops.flash_attention import attention_reference
-        return attention_reference(q, k, v, causal=causal, window=window)
+        return attention_reference(q, k, v, causal=causal, window=window,
+                                   segment_ids=segment_ids)
     return blockwise_reference(q, k, v, causal=causal)

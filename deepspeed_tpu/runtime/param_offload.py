@@ -270,6 +270,7 @@ class ParamOffloadTrainer:
         self._embed_bwd_fn = None
         self._tail_fn = None
         self.bytes_streamed = 0            # per-step H2D stream volume
+        self.phase_seconds: Dict[str, float] = {}
         self.skipped_steps = 0
         log_dist(
             f"param offload: device={pcfg.device} groups={len(self._layer_groups)}"
@@ -532,15 +533,20 @@ class ParamOffloadTrainer:
 
     def train_batch(self, stacked_batch, step: int) -> Tuple[float, float]:
         """One full batch: gas streamed microbatches + host optimizer update.
-        Returns (loss, grad_norm) as host floats."""
+        Returns (loss, grad_norm) as host floats. Phase wall times land in
+        ``self.phase_seconds`` (stream+compute vs host optimizer vs store
+        refresh/write-back) for the bench ladder's swap-bandwidth rows."""
+        import time as _time
         gas = self.config.gradient_accumulation_steps
         self._accum = [None] * len(self._accum)
         self.bytes_streamed = 0
+        t0 = _time.perf_counter()
         losses = []
         for g in range(gas):
             micro = {k: np.asarray(v)[g] for k, v in stacked_batch.items()}
             losses.append(self._micro_grads(micro))
         loss = float(np.mean([jax.device_get(l) for l in losses]))
+        t_stream = _time.perf_counter()
 
         grads = [a / gas if a is not None else
                  np.zeros(self.opt.leaf_shapes()[i], np.float32)
@@ -554,7 +560,14 @@ class ParamOffloadTrainer:
                 g *= scale
         lr = float(jax.device_get(self.lr_schedule(jnp.int32(step))))
         self.opt.step(grads, lr=lr)
+        t_opt = _time.perf_counter()
         self.sync_store()
+        t_end = _time.perf_counter()
+        self.phase_seconds = {
+            "stream_fwd_bwd": round(t_stream - t0, 4),
+            "host_optimizer": round(t_opt - t_stream, 4),
+            "store_refresh": round(t_end - t_opt, 4),
+        }
         return loss, norm
 
     def sync_store(self):

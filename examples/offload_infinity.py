@@ -128,11 +128,13 @@ def main():
     print(f"offload={args.device}: loss {losses[0]:.4f} -> {losses[-1]:.4f}")
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
     if args.measure:
+        phases = {}
         if args.offload_param:
             # measured H2D param stream + fp32 grads D2H (once per microbatch)
             po = engine._param_offload
             swap_bytes = po.bytes_streamed + 4 * n_params * gas
             metric = "zero_infinity_param_offload_step_time"
+            phases = po.phase_seconds
         else:
             swap_bytes = 6 * n_params        # fp32 grads D2H + bf16 H2D
             metric = "zero_infinity_step_time"
@@ -144,6 +146,7 @@ def main():
             "swap_gib_per_step": round(swap_bytes / 2**30, 2),
             "effective_swap_gibps": round(swap_bytes / 2**30 / dt, 2),
             "seq_len": seq, "tokens_per_sec": round(mb * gas * seq / dt, 1),
+            **({"phase_seconds": phases} if phases else {}),
         }))
 
 

@@ -247,3 +247,32 @@ def test_distributed_attention_uneven_heads_with_custom_fn(sp_mesh, h, hkv):
     assert qshape[2] == -(-h // sp), qshape       # ceil(H/sp) heads/device
     assert kshape[2] == qshape[2], (kshape, qshape)  # kv densified to match
     assert qshape[1] == q.shape[1], qshape        # full gathered sequence
+
+
+def test_flash_segment_ids_matches_reference():
+    """Packed-sequence masking runs IN-KERNEL (fwd + all grads); previously
+    segment_ids forced the XLA fallback."""
+    from deepspeed_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    q, k, v = make_qkv(s=48, h=4, hkv=2)
+    rng = np.random.default_rng(7)
+    # 3 packed segments of uneven lengths per batch row
+    seg = jnp.asarray(np.sort(rng.integers(0, 3, size=(2, 48)), axis=1),
+                      jnp.int32)
+    for causal in (True, False):
+        out = pallas_flash_attention(q, k, v, causal, 16, 16, True, None, seg)
+        ref = attention_reference(q, k, v, causal=causal, segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5, err_msg=str(causal))
+
+    def loss_k(q, k, v):
+        return jnp.sum(pallas_flash_attention(
+            q, k, v, True, 16, 16, True, None, seg) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(attention_reference(
+            q, k, v, causal=True, segment_ids=seg) ** 2)
+    g1 = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4, err_msg=name)
