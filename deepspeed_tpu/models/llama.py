@@ -210,8 +210,15 @@ def _dispatch_attention(backend: str, q, k, v, causal=True, segment_ids=None,
                                     segment_ids=segment_ids)
     if backend == "ulysses":
         from deepspeed_tpu.sequence.ulysses import ulysses_attention
-        return ulysses_attention(q, k, v, causal=causal)
+        return ulysses_attention(q, k, v, causal=causal,
+                                 segment_ids=segment_ids)
     if backend == "ring":
+        if segment_ids is not None:
+            # silent drop would compute WRONG attention for packed batches
+            raise NotImplementedError(
+                "packed-sequence segment_ids are not supported by the ring "
+                "CP backend yet — use 'ulysses' (all-gathered ids) or "
+                "'flash'/'xla' (in-kernel masking)")
         from deepspeed_tpu.sequence.ring import ring_attention
         return ring_attention(q, k, v, causal=causal)
     raise ValueError(f"unknown attention backend '{backend}'")

@@ -36,7 +36,7 @@ from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_auto
 
 
 def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
-                      use_flash: bool = True, attn_fn=None):
+                      use_flash: bool = True, attn_fn=None, segment_ids=None):
     """q: [B, S, H, D] global (sequence-sharded on the mesh); returns same shape.
 
     Inside the shard_map each device holds [B, S/sp, H_local, D]; after the
@@ -44,38 +44,56 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
     ``attn_fn(q, k, v)`` overrides the local attention computed on the
     gathered sequence (the reference DistributedAttention's pluggable
     ``local_attention``); default: flash kernel / reference attention.
+    ``segment_ids`` [B, S] (packed sequences): the seq-sharded ids are
+    all-gathered inside the shard_map — after the head-scatter every device
+    holds the FULL sequence, so segment masking happens in the local
+    attention (flash kernel's in-kernel mask).
     """
     mesh = mesh or mesh_lib.get_global_mesh()
     sp = mesh.shape["sequence"]
 
-    def local(qq, kk, vv):
+    def local(qq, kk, vv, seg=None):
         if attn_fn is not None:
+            if seg is not None:
+                raise NotImplementedError(
+                    "segment_ids with a custom local_attention is "
+                    "unsupported — mask inside your attn_fn instead")
             return attn_fn(qq, kk, vv)
-        return flash_attention_auto(qq, kk, vv, causal=causal) if use_flash \
-            else _local_attn(qq, kk, vv, causal)
+        if use_flash:
+            return flash_attention_auto(qq, kk, vv, causal=causal,
+                                        segment_ids=seg)
+        return _local_attn(qq, kk, vv, causal, seg)
 
     if sp == 1:
-        return local(q, k, v)
+        return local(q, k, v, segment_ids)
 
     tp = max(mesh.shape["tensor"], 1)
     uneven = (q.shape[2] // tp) % sp != 0 or (k.shape[2] // tp) % sp != 0
 
     spec = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
+    seg_spec = P(mesh_lib.batch_axes(mesh), "sequence")
+    if segment_ids is not None and uneven:
+        raise NotImplementedError(
+            "segment_ids with an sp-indivisible head count (uneven-heads "
+            "ulysses) is unsupported — pad heads or use the flash/xla "
+            "backend")
 
-    def a2a_attention(q_l, k_l, v_l):
+    def a2a_attention(q_l, k_l, v_l, seg_l=None):
         # [B, S/sp, Hl, D] -> scatter heads / gather sequence -> [B, S, Hl/sp, D]
         a2a = partial(jax.lax.all_to_all, axis_name="sequence",
                       split_axis=2, concat_axis=1, tiled=True)
         qg, kg, vg = a2a(q_l), a2a(k_l), a2a(v_l)
+        seg = jax.lax.all_gather(seg_l, "sequence", axis=1, tiled=True) \
+            if seg_l is not None else None
         # Pallas kernel on TPU (runs inside the shard_map), lax elsewhere
-        out = local(qg, kg, vg)
+        out = local(qg, kg, vg, seg)
         # inverse: scatter sequence / gather heads
         return jax.lax.all_to_all(out, axis_name="sequence", split_axis=1,
                                   concat_axis=2, tiled=True)
 
-    def body(q_l, k_l, v_l):
+    def body(q_l, k_l, v_l, seg_l=None):
         if not uneven:
-            return a2a_attention(q_l, k_l, v_l)
+            return a2a_attention(q_l, k_l, v_l, seg_l)
         # uneven heads: densify GQA so q/kv share a head count, then
         h_local = q_l.shape[2]
         rep = q_l.shape[2] // k_l.shape[2]
@@ -118,10 +136,16 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
                 sp, causal=causal))
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=2)
 
+    if segment_ids is not None:
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec, check_vma=False)(
+                q, k, v, jnp.asarray(segment_ids, jnp.int32))
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
-def _local_attn(q, k, v, causal):
+def _local_attn(q, k, v, causal, segment_ids=None):
     from deepspeed_tpu.ops.flash_attention import attention_reference
-    return attention_reference(q, k, v, causal=causal)
+    return attention_reference(q, k, v, causal=causal,
+                               segment_ids=segment_ids)

@@ -276,3 +276,31 @@ def test_flash_segment_ids_matches_reference():
     for name, a, b in zip("qkv", g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+def test_ulysses_segment_ids(sp_mesh):
+    """Packed sequences under Ulysses: ids all-gather inside the shard_map
+    and mask the gathered-sequence attention (was: silently dropped)."""
+    from deepspeed_tpu.sequence.ulysses import ulysses_attention
+    q, k, v = make_qkv(s=64, h=8, hkv=8)
+    rng = np.random.default_rng(3)
+    seg = jnp.asarray(np.sort(rng.integers(0, 3, size=(2, 64)), axis=1),
+                      jnp.int32)
+    out = ulysses_attention(q, k, v, causal=True, mesh=sp_mesh,
+                            segment_ids=seg)
+    ref = attention_reference(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # uneven heads + segments: clear rejection, not silent wrongness
+    q2, k2, v2 = make_qkv(s=64, h=6, hkv=6)
+    with pytest.raises(NotImplementedError, match="uneven"):
+        ulysses_attention(q2, k2, v2, causal=True, mesh=sp_mesh,
+                          segment_ids=seg)
+
+
+def test_ring_segment_ids_rejected(sp_mesh):
+    from deepspeed_tpu.models.llama import _dispatch_attention
+    q, k, v = make_qkv(s=64, h=4)
+    seg = jnp.zeros((2, 64), jnp.int32)
+    with pytest.raises(NotImplementedError, match="ring"):
+        _dispatch_attention("ring", q, k, v, causal=True, segment_ids=seg)
