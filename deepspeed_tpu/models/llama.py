@@ -213,14 +213,15 @@ def _dispatch_attention(backend: str, q, k, v, causal=True, segment_ids=None,
         return ulysses_attention(q, k, v, causal=causal,
                                  segment_ids=segment_ids)
     if backend == "ring":
-        if segment_ids is not None:
-            # silent drop would compute WRONG attention for packed batches
+        if segment_ids is not None and jax.default_backend() != "tpu":
+            # the jnp ring body has no segment carry; only the flash ring
+            # (TPU) masks packed sequences — never silently drop the mask
             raise NotImplementedError(
-                "packed-sequence segment_ids are not supported by the ring "
-                "CP backend yet — use 'ulysses' (all-gathered ids) or "
-                "'flash'/'xla' (in-kernel masking)")
+                "packed-sequence segment_ids with the ring backend need "
+                "the flash ring (TPU); on CPU use 'ulysses'/'flash'/'xla'")
         from deepspeed_tpu.sequence.ring import ring_attention
-        return ring_attention(q, k, v, causal=causal)
+        return ring_attention(q, k, v, causal=causal,
+                              segment_ids=segment_ids)
     raise ValueError(f"unknown attention backend '{backend}'")
 
 

@@ -144,16 +144,23 @@ def _unfold(x, b, h, s):
 
 
 def _seg_operands(segment_ids, sq, sk, block_q, block_k):
-    """Padded [B, S, 1] int32 segment arrays (+has_seg). Padding uses -1 on
-    the k side so padded keys mismatch every real segment (they are also
-    masked by seq_len_k)."""
+    """Padded [B, S, 1] int32 segment arrays (+has_seg). ``segment_ids`` is
+    [B, S] shared by q and k, or a ``(q_ids [B, Sq], k_ids [B, Sk])`` pair
+    (ring attention: the rotating KV block carries different ids than the
+    local queries). Padding uses -1 on the k side so padded keys mismatch
+    every real segment (they are also masked by seq_len_k)."""
     if segment_ids is None:
         return (jnp.zeros((1, block_q, 1), jnp.int32),
                 jnp.zeros((1, block_k, 1), jnp.int32), False)
-    seg = jnp.asarray(segment_ids, jnp.int32)
-    qs = jnp.pad(seg, ((0, 0), (0, (-sq) % block_q)),
+    if isinstance(segment_ids, tuple):
+        q_ids, k_ids = segment_ids
+    else:
+        q_ids = k_ids = segment_ids
+    qs = jnp.pad(jnp.asarray(q_ids, jnp.int32),
+                 ((0, 0), (0, (-sq) % block_q)),
                  constant_values=-1)[..., None]
-    ks = jnp.pad(seg[:, :sk], ((0, 0), (0, (-sk) % block_k)),
+    ks = jnp.pad(jnp.asarray(k_ids, jnp.int32)[:, :sk],
+                 ((0, 0), (0, (-sk) % block_k)),
                  constant_values=-1)[..., None]
     return qs, ks, True
 

@@ -148,34 +148,39 @@ def _step_fwd(mode, src, idx, block, skip):
         lambda: jax.lax.cond(src < idx, lambda: block(False, 0), skip))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_core(q_l, k_l, v_l, sp: int, mode: str, axis_name: str,
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_core(q_l, k_l, v_l, seg_l, sp: int, mode: str, axis_name: str,
                interpret: bool):
     """Ring attention whose per-step block attention is the Pallas flash
     kernel: fwd stitches the blocks' (o, lse) online; bwd re-rotates KV and
     runs the flash backward per block against the FINAL lse (the standard
     multi-block decomposition — per-block probabilities under the global
     softmax), with dk/dv accumulators riding the ring home. q_l [B,S_l,H,D],
-    k_l/v_l [B,S_l,Hkv,D] (GQA handled inside the kernel)."""
-    out, _ = _ring_fwd(q_l, k_l, v_l, sp, mode, axis_name, interpret)
+    k_l/v_l [B,S_l,Hkv,D] (GQA handled inside the kernel). ``seg_l``
+    [B, S_l] packed-sequence ids or None; the KV block's ids ride the ring
+    with it (local queries keep their own)."""
+    out, _ = _ring_fwd(q_l, k_l, v_l, seg_l, sp, mode, axis_name, interpret)
     return out
 
 
-def _ring_fwd(q_l, k_l, v_l, sp, mode, axis_name, interpret):
+def _ring_fwd(q_l, k_l, v_l, seg_l, sp, mode, axis_name, interpret):
     from deepspeed_tpu.ops.pallas.flash_attention import _pallas_flash_fwd_impl
     b, s_l, h, d = q_l.shape
     blk = _ring_blocks(s_l)
     idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % sp) for j in range(sp)]
+    has_seg = seg_l is not None
+    kseg0 = seg_l if has_seg else jnp.zeros((b, s_l), jnp.int32)
 
     def step(carry, t):
-        k_cur, v_cur, o_acc, lse_acc = carry
+        k_cur, v_cur, kseg_cur, o_acc, lse_acc = carry
         src = (idx - t) % sp
 
         def block(kv_causal, shift):
-            o, lse = _pallas_flash_fwd_impl(q_l, k_cur, v_cur, kv_causal,
-                                            blk, blk, interpret, None,
-                                            causal_shift=shift)
+            o, lse = _pallas_flash_fwd_impl(
+                q_l, k_cur, v_cur, kv_causal, blk, blk, interpret, None,
+                causal_shift=shift,
+                segment_ids=(seg_l, kseg_cur) if has_seg else None)
             return (o.astype(jnp.float32),
                     lse[:, :s_l, 0].reshape(b, h, s_l))
 
@@ -187,23 +192,24 @@ def _ring_fwd(q_l, k_l, v_l, sp, mode, axis_name, interpret):
         o_acc, lse_acc = _combine(o_acc, lse_acc, o_t, lse_t)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_next, v_next, o_acc, lse_acc), None
+        kseg_next = jax.lax.ppermute(kseg_cur, axis_name, perm)
+        return (k_next, v_next, kseg_next, o_acc, lse_acc), None
 
     o0 = jnp.zeros((b, s_l, h, d), jnp.float32)
     lse0 = jnp.full((b, h, s_l), _SKIP_LSE, jnp.float32)
-    (_, _, o, lse), _ = jax.lax.scan(step, (k_l, v_l, o0, lse0),
-                                     jnp.arange(sp))
+    (_, _, _, o, lse), _ = jax.lax.scan(step, (k_l, v_l, kseg0, o0, lse0),
+                                        jnp.arange(sp))
     return o.astype(q_l.dtype), lse
 
 
-def _ring_fwd_vjp(q_l, k_l, v_l, sp, mode, axis_name, interpret):
-    out, lse = _ring_fwd(q_l, k_l, v_l, sp, mode, axis_name, interpret)
-    return out, (q_l, k_l, v_l, out, lse)
+def _ring_fwd_vjp(q_l, k_l, v_l, seg_l, sp, mode, axis_name, interpret):
+    out, lse = _ring_fwd(q_l, k_l, v_l, seg_l, sp, mode, axis_name, interpret)
+    return out, (q_l, k_l, v_l, seg_l, out, lse)
 
 
 def _ring_bwd(sp, mode, axis_name, interpret, res, g):
     from deepspeed_tpu.ops.pallas.flash_attention import _pallas_flash_bwd_impl
-    q_l, k_l, v_l, out, lse = res
+    q_l, k_l, v_l, seg_l, out, lse = res
     b, s_l, h, d = q_l.shape
     blk = _ring_blocks(s_l)
     # the bwd impl consumes lse in its folded padded layout [B*H, S_pad, 1]
@@ -213,15 +219,19 @@ def _ring_bwd(sp, mode, axis_name, interpret, res, g):
         lse_f = jnp.pad(lse_f, ((0, 0), (0, pad), (0, 0)))
     idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % sp) for j in range(sp)]
+    has_seg = seg_l is not None
+    b2 = q_l.shape[0]
+    kseg0 = seg_l if has_seg else jnp.zeros((b2, s_l), jnp.int32)
 
     def step(carry, t):
-        k_cur, v_cur, dk_acc, dv_acc, dq_acc = carry
+        k_cur, v_cur, kseg_cur, dk_acc, dv_acc, dq_acc = carry
         src = (idx - t) % sp
 
         def block(kv_causal, shift):
-            return _pallas_flash_bwd_impl(q_l, k_cur, v_cur, out, lse_f, g,
-                                          kv_causal, blk, blk, interpret,
-                                          None, causal_shift=shift)
+            return _pallas_flash_bwd_impl(
+                q_l, k_cur, v_cur, out, lse_f, g, kv_causal, blk, blk,
+                interpret, None, causal_shift=shift,
+                segment_ids=(seg_l, kseg_cur) if has_seg else None)
 
         def skip():
             return (jnp.zeros_like(q_l), jnp.zeros_like(k_cur),
@@ -235,16 +245,18 @@ def _ring_bwd(sp, mode, axis_name, interpret, res, g):
         # every block (and its gradient) is back on its home device
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        kseg_next = jax.lax.ppermute(kseg_cur, axis_name, perm)
         dk_next = jax.lax.ppermute(dk_acc, axis_name, perm)
         dv_next = jax.lax.ppermute(dv_acc, axis_name, perm)
-        return (k_next, v_next, dk_next, dv_next, dq_acc), None
+        return (k_next, v_next, kseg_next, dk_next, dv_next, dq_acc), None
 
-    (_, _, dk, dv, dq), _ = jax.lax.scan(
-        step, (k_l, v_l, jnp.zeros(k_l.shape, jnp.float32),
+    (_, _, _, dk, dv, dq), _ = jax.lax.scan(
+        step, (k_l, v_l, kseg0, jnp.zeros(k_l.shape, jnp.float32),
                jnp.zeros(v_l.shape, jnp.float32),
                jnp.zeros(q_l.shape, jnp.float32)),
         jnp.arange(sp))
-    return dq.astype(q_l.dtype), dk.astype(k_l.dtype), dv.astype(v_l.dtype)
+    return (dq.astype(q_l.dtype), dk.astype(k_l.dtype),
+            dv.astype(v_l.dtype), None)
 
 
 _ring_core.defvjp(_ring_fwd_vjp, _ring_bwd)
@@ -252,26 +264,29 @@ _ring_core.defvjp(_ring_fwd_vjp, _ring_bwd)
 
 def ring_attention_local_flash(q_l, k_l, v_l, sp: int, causal: bool,
                                axis_name: str = "sequence",
-                               interpret: bool = False):
+                               interpret: bool = False, seg_l=None):
     """Contiguous-layout flash ring (see _ring_core)."""
-    return _ring_core(q_l, k_l, v_l, sp, "causal" if causal else "full",
-                      axis_name, interpret)
+    return _ring_core(q_l, k_l, v_l, seg_l, sp,
+                      "causal" if causal else "full", axis_name, interpret)
 
 
 def ring_attention_local_striped(q_l, k_l, v_l, sp: int,
                                  axis_name: str = "sequence",
-                                 interpret: bool = False):
-    """Load-balanced causal ring: stripe q/k/v, run the shifted-causal flash
-    ring, unstripe the output. Requires S_l % sp == 0 (checked by caller)."""
+                                 interpret: bool = False, seg_l=None):
+    """Load-balanced causal ring: stripe q/k/v (and the segment ids), run
+    the shifted-causal flash ring, unstripe the output. Requires
+    S_l % sp == 0 (checked by caller)."""
     q_s = _stripe(q_l, sp, axis_name)
     k_s = _stripe(k_l, sp, axis_name)
     v_s = _stripe(v_l, sp, axis_name)
-    out = _ring_core(q_s, k_s, v_s, sp, "striped", axis_name, interpret)
+    seg_s = _stripe(seg_l, sp, axis_name) if seg_l is not None else None
+    out = _ring_core(q_s, k_s, v_s, seg_s, sp, "striped", axis_name,
+                     interpret)
     return _unstripe(out, sp, axis_name)
 
 
 def ring_attention(q, k, v, causal: bool = True, mesh=None,
-                   impl: Optional[str] = None):
+                   impl: Optional[str] = None, segment_ids=None):
     """q,k,v: [B, S, H(kv), D] global, sequence-sharded. Returns [B, S, H, D].
 
     ``impl``: ``"flash"`` (Pallas kernel per ring block — O(block) memory,
@@ -286,29 +301,42 @@ def ring_attention(q, k, v, causal: bool = True, mesh=None,
     sp = mesh.shape["sequence"]
     if sp == 1:
         from deepspeed_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids)
     if impl is None:
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if segment_ids is not None and impl == "xla":
+        raise NotImplementedError(
+            "packed-sequence segment_ids need the flash ring (the jnp body "
+            "does not carry segment ids) — impl='flash' or 'interpret'")
 
     spec_q = P(mesh_lib.batch_axes(mesh), "sequence", "tensor", None)
+    seg_spec = P(mesh_lib.batch_axes(mesh), "sequence")
     s_l = q.shape[1] // sp
     striped = causal and s_l % sp == 0 and impl in ("flash", "interpret")
 
     if impl == "xla":
-        def body(q_l, k_l, v_l):
+        def body(q_l, k_l, v_l, seg_l=None):
             return ring_attention_local(q_l, k_l, v_l, sp, causal=causal)
     elif striped:
         interpret = impl == "interpret"
 
-        def body(q_l, k_l, v_l):
+        def body(q_l, k_l, v_l, seg_l=None):
             return ring_attention_local_striped(q_l, k_l, v_l, sp,
-                                                "sequence", interpret)
+                                                "sequence", interpret,
+                                                seg_l=seg_l)
     else:
         interpret = impl.startswith("interpret")
 
-        def body(q_l, k_l, v_l):
+        def body(q_l, k_l, v_l, seg_l=None):
             return ring_attention_local_flash(q_l, k_l, v_l, sp, causal,
-                                              "sequence", interpret)
+                                              "sequence", interpret,
+                                              seg_l=seg_l)
 
+    if segment_ids is not None:
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec_q, spec_q, spec_q, seg_spec),
+            out_specs=spec_q, check_vma=False)(
+                q, k, v, jnp.asarray(segment_ids, jnp.int32))
     return jax.shard_map(body, mesh=mesh, in_specs=(spec_q, spec_q, spec_q),
                          out_specs=spec_q, check_vma=False)(q, k, v)

@@ -298,9 +298,35 @@ def test_ulysses_segment_ids(sp_mesh):
                           segment_ids=seg)
 
 
-def test_ring_segment_ids_rejected(sp_mesh):
-    from deepspeed_tpu.models.llama import _dispatch_attention
-    q, k, v = make_qkv(s=64, h=4)
-    seg = jnp.zeros((2, 64), jnp.int32)
-    with pytest.raises(NotImplementedError, match="ring"):
-        _dispatch_attention("ring", q, k, v, causal=True, segment_ids=seg)
+def test_ring_segment_ids_flash(sp_mesh):
+    """Packed sequences under ring CP: the KV block's ids ride the ring and
+    feed the kernel's in-kernel mask — both layouts, fwd + grads."""
+    from deepspeed_tpu.sequence.ring import ring_attention
+    q, k, v = make_qkv(s=64, h=4, hkv=2)
+    rng = np.random.default_rng(5)
+    seg = jnp.asarray(np.sort(rng.integers(0, 3, size=(2, 64)), axis=1),
+                      jnp.int32)
+    ref = attention_reference(q, k, v, causal=True, segment_ids=seg)
+    for impl in ("interpret", "interpret_contiguous"):
+        out = ring_attention(q, k, v, causal=True, mesh=sp_mesh, impl=impl,
+                             segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5, err_msg=impl)
+
+    def loss_r(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, causal=True, mesh=sp_mesh,
+                                      impl="interpret",
+                                      segment_ids=seg) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True,
+                                           segment_ids=seg) ** 2)
+    g1 = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4, err_msg=name)
+    # the jnp ring body has no segment carry: loud rejection
+    with pytest.raises(NotImplementedError, match="flash"):
+        ring_attention(q, k, v, causal=True, mesh=sp_mesh, impl="xla",
+                       segment_ids=seg)
