@@ -72,7 +72,9 @@ def quantize_model_params(params: Any, q_bits: int = 8, group_size: int = 64,
     (reference: inference/quantization quantization.py _init_group_wise_weight_
     quantization + fp_quantizer FP_Quantize). ``modules``: regexes of leaf
     paths to quantize (default: every floating leaf with ndim >= 2).
-    ``fmt="int"``: integer codes at any q_bits (int8 storage).
+    ``fmt="int"``: integer codes at any q_bits; q_bits=4 densely packs
+    two codes per byte (int4 at true 0.5 B/element — reference
+    csrc/quantization int4 layout), other widths store int8.
     ``fmt="fp"``: minifloat codes — q_bits 6/12 use the packed software
     formats (0.75/1.5 B per element), q_bits 8 native float8_e4m3fn."""
     if fmt not in ("int", "fp"):
@@ -105,6 +107,12 @@ def quantize_model_params(params: Any, q_bits: int = 8, group_size: int = 64,
                                    arr.shape, f"fp{q_bits}")
         scale = np.maximum(np.abs(g).max(axis=1, keepdims=True) / qmax, 1e-12)
         codes = np.clip(np.round(g / scale), -qmax - 1, qmax).astype(np.int8)
+        if q_bits == 4:
+            # nibble-pack: group_size is even (>= 2 codes per group row)
+            c = (codes + 8).astype(np.uint8).reshape(codes.shape[0], -1, 2)
+            packed = (c[:, :, 0] | (c[:, :, 1] << 4)).astype(np.uint8)
+            return QuantizedTensor(packed, scale.astype(np.float32),
+                                   arr.shape, "int4")
         return QuantizedTensor(codes, scale.astype(np.float32), arr.shape)
 
     return jax.tree_util.tree_map_with_path(quant, params)
@@ -117,7 +125,14 @@ def dequantize_model_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
         if not _is_qrecord(node):
             return node
         n = int(np.prod(node.shape))
-        if node.fmt in ("fp6", "fp12"):
+        if node.fmt == "int4":
+            packed = jnp.asarray(node.codes)
+            lo = (packed & 0xF).astype(jnp.int32) - 8
+            hi = (packed >> 4).astype(jnp.int32) - 8
+            codes = jnp.stack([lo, hi], axis=-1).reshape(packed.shape[0], -1)
+            flat = (codes.astype(jnp.float32)
+                    * jnp.asarray(node.scale)).ravel()
+        elif node.fmt in ("fp6", "fp12"):
             from deepspeed_tpu.ops.fp_formats import FPQuantizer
             bits = int(node.fmt[2:])
             d = node.codes.shape[-1] * 8 // bits
