@@ -23,3 +23,5 @@ __all__ = [
     "MMapIndexedDataset", "MMapIndexedDatasetBuilder", "RandomLTDScheduler",
     "gather_tokens", "scatter_tokens", "sample_token_indices", "random_ltd_layer",
 ]
+from deepspeed_tpu.data_pipeline.packing import (packing_efficiency,  # noqa: F401,E501
+                                                 pack_sequences)

@@ -1,0 +1,80 @@
+"""Sequence packing — variable-length documents into fixed [B, S] batches.
+
+Reference analog: none in-tree (the reference trains on pre-packed Megatron
+data); packing is the standard TPU-side answer to static shapes: XLA wants
+one [B, S] geometry, so short documents concatenate into rows with
+``segment_ids`` confining attention (masked IN-KERNEL by the flash kernel,
+under Ulysses, and under ring CP — see ops/pallas/flash_attention.py),
+``positions`` restarting per document (RoPE must not see cross-document
+offsets), and ``loss_mask`` zeroing the cross-document boundary token (the
+last token of doc i must not predict the first token of doc i+1).
+
+Greedy first-fit packing: documents are placed into the first open row with
+room (documents longer than ``seq_len`` are split). Rows pad with
+``pad_token`` under segment id -1 (mismatches every real segment) and zero
+loss mask.
+"""
+
+from typing import Dict, Iterable, List, Sequence
+
+import numpy as np
+
+
+def pack_sequences(docs: Iterable[Sequence[int]], batch_size: int,
+                   seq_len: int, pad_token: int = 0) -> List[Dict[str, np.ndarray]]:
+    """Pack token documents into batches of ``{input_ids, segment_ids,
+    positions, loss_mask}`` arrays [B, S]. Returns every FULL batch plus a
+    final partial batch (padded rows) if any tokens remain."""
+    rows: List[List[np.ndarray]] = []          # per open row: list of docs
+    lens: List[int] = []
+
+    def split(doc):
+        doc = np.asarray(doc, np.int32)
+        for a in range(0, len(doc), seq_len):
+            yield doc[a:a + seq_len]
+
+    for doc in docs:
+        for piece in split(doc):
+            for i, used in enumerate(lens):
+                if used + len(piece) <= seq_len:
+                    rows[i].append(piece)
+                    lens[i] += len(piece)
+                    break
+            else:
+                rows.append([piece])
+                lens.append(len(piece))
+
+    batches = []
+    for a in range(0, len(rows), batch_size):
+        chunk = rows[a:a + batch_size]
+        if len(chunk) < batch_size:
+            chunk = chunk + [[] for _ in range(batch_size - len(chunk))]
+        ids = np.full((batch_size, seq_len), pad_token, np.int32)
+        seg = np.full((batch_size, seq_len), -1, np.int32)
+        pos = np.zeros((batch_size, seq_len), np.int32)
+        mask = np.zeros((batch_size, seq_len), np.float32)
+        for r, pieces in enumerate(chunk):
+            off = 0
+            for s, piece in enumerate(pieces):
+                n = len(piece)
+                ids[r, off:off + n] = piece
+                seg[r, off:off + n] = s
+                pos[r, off:off + n] = np.arange(n)
+                # loss_mask[p] = 1 iff token p is a trainable TARGET — the
+                # convention of the model's shifted loss (prediction from
+                # position t is gated by loss_mask[t+1]): a document's first
+                # token has no in-document predictor, padding has none at all
+                mask[r, off + 1:off + n] = 1.0
+                off += n
+        batches.append({"input_ids": ids, "segment_ids": seg,
+                        "positions": pos, "loss_mask": mask})
+    return batches
+
+
+def packing_efficiency(batches: List[Dict[str, np.ndarray]]) -> float:
+    """Fraction of token slots holding real (non-padding) tokens."""
+    total = real = 0
+    for b in batches:
+        total += b["segment_ids"].size
+        real += int((b["segment_ids"] >= 0).sum())
+    return real / max(total, 1)

@@ -1,0 +1,97 @@
+"""Sequence packing (data_pipeline/packing.py): packed batches train
+identically to the same documents padded one-per-row (the segment mask +
+per-document positions + target-gated loss make packing transparent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.data_pipeline import pack_sequences, packing_efficiency
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def docs(rng, n, lo=5, hi=20, vocab=128):
+    return [rng.integers(1, vocab, size=rng.integers(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+def test_pack_shapes_masks_positions():
+    rng = np.random.default_rng(0)
+    batches = pack_sequences(docs(rng, 12), batch_size=2, seq_len=32)
+    assert all(b["input_ids"].shape == (2, 32) for b in batches)
+    b0 = batches[0]
+    # positions restart at each segment start; padding has segment -1
+    for r in range(2):
+        seg_row, pos_row = b0["segment_ids"][r], b0["positions"][r]
+        for s in np.unique(seg_row[seg_row >= 0]):
+            sel = pos_row[seg_row == s]
+            assert sel[0] == 0 and np.array_equal(sel, np.arange(len(sel)))
+            # first token of every doc is not a loss target
+            first = np.argmax(seg_row == s)
+            assert b0["loss_mask"][r, first] == 0.0
+    assert (b0["loss_mask"][b0["segment_ids"] < 0] == 0).all()
+    # long docs split across rows
+    long = pack_sequences([np.arange(70)], batch_size=1, seq_len=32)
+    assert sum((b["segment_ids"] >= 0).sum() for b in long) == 70
+    assert 0 < packing_efficiency(batches) <= 1
+
+
+def test_packed_loss_equals_unpacked():
+    """Mean CE over a packed batch == over the same docs one-per-row: the
+    kernel segment mask + position restart + target gating are exactly
+    per-document training."""
+    rng = np.random.default_rng(1)
+    ds = docs(rng, 6, lo=6, hi=14)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=2, num_kv_heads=2,
+                      max_seq_len=64, dtype=jnp.float32,
+                      attention_backend="xla")
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)})["params"]
+
+    packed = pack_sequences(ds, batch_size=2, seq_len=32)
+
+    def loss(batch):
+        return float(model.apply(
+            {"params": params},
+            {k: jnp.asarray(v) for k, v in batch.items()}))
+    # token-weighted mean over packed batches
+    pl, pw = 0.0, 0.0
+    for b in packed:
+        w = float(b["loss_mask"].sum())
+        pl += loss(b) * w
+        pw += w
+    packed_loss = pl / pw
+
+    # one doc per row, padded (segment ids still confine the pad row-tail)
+    ul, uw = 0.0, 0.0
+    for d in ds:
+        b = pack_sequences([d], batch_size=1, seq_len=32)[0]
+        w = float(b["loss_mask"].sum())
+        ul += loss(b) * w
+        uw += w
+    np.testing.assert_allclose(packed_loss, ul / uw, rtol=1e-5)
+
+
+def test_packed_training_with_flash_kernel_engine():
+    """End-to-end: engine.train_batch on packed batches with the flash
+    backend (in-kernel segment masking) decreases the loss."""
+    rng = np.random.default_rng(2)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=2, num_kv_heads=2,
+                      max_seq_len=64, dtype=jnp.float32,
+                      attention_backend="flash")
+    n_dev = jax.device_count()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=LlamaForCausalLM(cfg),
+        config={"train_batch_size": n_dev,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}}},
+        example_batch={"input_ids": np.zeros((2, 32), np.int32)})
+    batches = pack_sequences(docs(rng, 8 * n_dev, vocab=128),
+                             batch_size=n_dev, seq_len=32)
+    fixed = batches[0]
+    losses = [float(jax.device_get(engine.train_batch(batch=fixed)))
+              for _ in range(4)]
+    assert losses[-1] < losses[0], losses
