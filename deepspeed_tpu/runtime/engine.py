@@ -878,6 +878,12 @@ class DeepSpeedTPUEngine:
         self._record_metrics(StepOutput(
             loss=jnp.float32(loss), grad_norm=jnp.float32(norm),
             lr=jnp.float32(lr), overflow=jnp.bool_(False)))
+        # stream observability: H2D volume + phase split (monitor fan-out
+        # picks these up alongside the standard Train/Samples events)
+        self._last_metrics["param_offload_bytes_streamed"] = float(
+            self._param_offload.bytes_streamed)
+        for phase, secs in self._param_offload.phase_seconds.items():
+            self._last_metrics[f"param_offload_{phase}_s"] = secs
         return jnp.float32(loss)
 
     def _train_batch_offloaded(self, batch) -> jnp.ndarray:
