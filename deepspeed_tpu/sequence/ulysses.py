@@ -36,7 +36,8 @@ from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_auto
 
 
 def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
-                      use_flash: bool = True, attn_fn=None, segment_ids=None):
+                      use_flash: bool = True, attn_fn=None, segment_ids=None,
+                      ring_impl=None):
     """q: [B, S, H, D] global (sequence-sharded on the mesh); returns same shape.
 
     Inside the shard_map each device holds [B, S/sp, H_local, D]; after the
@@ -124,16 +125,24 @@ def ulysses_attention(q, k, v, causal: bool = True, mesh=None,
         # axis — exactly H/sp heads of compute per device, no padding, no
         # straggler (improves on the reference's uneven redistribution,
         # layer.py:43, whose ceil(H/sp) ranks bound the step)
-        from deepspeed_tpu.sequence.ring import ring_attention_local
+        from deepspeed_tpu.sequence.ring import (ring_attention_local,
+                                                 ring_attention_local_flash)
         h_even = (h_local // sp) * sp
         parts = []
         if h_even:
             parts.append(a2a_attention(q_l[:, :, :h_even], k_l[:, :, :h_even],
                                        v_l[:, :, :h_even]))
         if h_local - h_even:  # GQA-only unevenness can leave no remainder
-            parts.append(ring_attention_local(
-                q_l[:, :, h_even:], k_l[:, :, h_even:], v_l[:, :, h_even:],
-                sp, causal=causal))
+            rem = (q_l[:, :, h_even:], k_l[:, :, h_even:], v_l[:, :, h_even:])
+            impl = ring_impl or ("flash" if jax.default_backend() == "tpu"
+                                 else "xla")
+            if impl in ("flash", "interpret"):
+                # remainder heads ride the flash ring (no [S_l,S_l] panel)
+                parts.append(ring_attention_local_flash(
+                    *rem, sp, causal, "sequence",
+                    interpret=impl == "interpret"))
+            else:
+                parts.append(ring_attention_local(*rem, sp, causal=causal))
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=2)
 
     if segment_ids is not None:

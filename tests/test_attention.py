@@ -174,6 +174,11 @@ def test_ulysses_uneven_heads(sp_mesh, h, hkv):
     out = ulysses_attention(q, k, v, causal=True, mesh=sp_mesh)
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    # the remainder heads' flash-ring path (TPU default), interpret mode
+    out2 = ulysses_attention(q, k, v, causal=True, mesh=sp_mesh,
+                             ring_impl="interpret")
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
 
 
 @pytest.mark.slow
