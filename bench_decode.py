@@ -295,7 +295,10 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", 1)
     from bench_util import guard_device_discovery
-    disarm = guard_device_discovery("bench_decode")
+    # wedged tunnel: replay the banked decode headline (never a train one —
+    # wrong-metric records are rejected by the fallback)
+    disarm = guard_device_discovery(
+        "bench_decode", stale_metric="llama_decode_tokens_per_sec")
     import jax
     jax.devices()
     disarm()
@@ -323,13 +326,17 @@ def main():
     if os.environ.get("DSTPU_DECODE_SPEC") == "1":
         extra["speculative"] = speculative_gate()
 
-    print(json.dumps({
+    record = {
         "metric": "llama_decode_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(speedup, 3),
         "extra": extra,
-    }))
+    }
+    print(json.dumps(record))
+    if on_tpu and not any(k.startswith("DSTPU_DECODE_") for k in os.environ):
+        from bench_util import bank_headline
+        bank_headline(record, "latest_decode.json")
 
 
 if __name__ == "__main__":

@@ -65,18 +65,20 @@ def latest_banked_result(metric: str = None):
     return max(candidates, key=lambda c: c[2])
 
 
-def bank_headline(record: dict):
-    """Persist a successful bench headline as the canonical banked result.
+def bank_headline(record: dict, filename: str = "latest_headline.json"):
+    """Persist a successful bench headline as a banked result.
 
     Best-effort (never fails the bench): writes the line to
-    ``bench_logs/latest_headline.json`` so a later wedged-tunnel run can
-    replay it with stale provenance.
+    ``bench_logs/<filename>`` so a later wedged-tunnel run can replay it
+    with stale provenance (``latest_headline.json`` is the canonical train
+    headline; other benches bank under their own names and are found by
+    metric match).
     """
     try:
         record = dict(record)
         record.setdefault("measured_at", datetime.datetime.now(
             datetime.timezone.utc).isoformat())
-        path = os.path.join(_bench_logs_dir(), "latest_headline.json")
+        path = os.path.join(_bench_logs_dir(), filename)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:
             f.write(json.dumps(record) + "\n")
