@@ -236,7 +236,25 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             }),
     }
     ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(path, target)
+    try:
+        restored = ckptr.restore(path, target)
+    except ValueError:
+        if load_optimizer_states:
+            ckptr.close()
+            raise
+        # cross-topology/tier load without optimizer state: the saved
+        # opt_state tree (e.g. a zero-3 optax state vs a param-offload
+        # engine's empty tuple) need not match this engine — rebuild that
+        # part of the target from the checkpoint's own metadata and discard
+        # it after restore
+        meta = ckptr.metadata(path)
+        opt_meta = meta["opt_state"] if isinstance(meta, dict) else \
+            getattr(meta, "item_metadata", meta)["opt_state"]
+        target["opt_state"] = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
+            opt_meta)
+        restored = ckptr.restore(path, target)
+        restored["opt_state"] = state.opt_state
     ckptr.close()
 
     from deepspeed_tpu.runtime.engine import EngineState
