@@ -515,9 +515,11 @@ class ParamOffloadTrainer:
                              {"embed": {"embedding": g_emb_tie}})
         del tail_dev, x
 
-        # ---- backward stream (prefetch g-1 while g computes) ----
+        # ---- backward stream (prefetch g-1 while g computes; grads D2H
+        # overlaps the NEXT group's compute via deferred accumulation) ----
         self._prefetch_group(G - 1 if G else None)
         nxt = self._device_group(self._group_idx[G - 1], G - 1) if G else None
+        pending = None                       # (idx_tree, device grads)
         for gi in range(G - 1, -1, -1):
             cur = nxt
             self._prefetch_group(gi - 1 if gi - 1 >= 0 else None)
@@ -525,9 +527,16 @@ class ParamOffloadTrainer:
                 nxt = self._device_group(self._group_idx[gi - 1], gi - 1)
             gx, gp = self._bwd_fn(len(self._layer_groups[gi]))(
                 cur, acts[gi], positions, seg, gx)
-            self._accumulate(self._group_idx[gi], gp)
+            for leaf in jax.tree.leaves(gp):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            if pending is not None:          # gi's bwd is dispatched; at most
+                self._accumulate(*pending)   # 2 groups' grads live in HBM
+            pending = (self._group_idx[gi], gp)
             del cur
         g_embed = embed_bwd(embed_dev, ids, gx)
+        if pending is not None:
+            self._accumulate(*pending)
         self._accumulate(self._embed_idx, g_embed)
         return loss
 
