@@ -60,10 +60,12 @@ def validate_param_offload(config: DeepSpeedTPUConfig, model) -> None:
         raise ValueError(
             f"offload_param.device must be none|cpu|nvme, got {pcfg.device!r}")
     cfg = getattr(model, "cfg", None)
+    if cfg is not None and hasattr(cfg, "base") and hasattr(cfg, "moe"):
+        cfg = cfg.base          # MixtralConfig wraps a LlamaConfig
     if cfg is None or not hasattr(cfg, "num_layers"):
         raise ValueError(
             "offload_param needs a layered model exposing .cfg.num_layers "
-            "(the in-repo Llama family); got "
+            "(the in-repo Llama/Mixtral families); got "
             f"{type(model).__name__} — either drop offload_param or use a "
             "LlamaForCausalLM-style model")
     if getattr(cfg, "scan_layers", False):
@@ -118,11 +120,38 @@ class _BlockStack(nn.Module):
         return x
 
 
+class _MoEBlockStack(nn.Module):
+    """``n`` MixtralBlocks; returns (x, sum of the groups' MoE aux losses).
+    The aux sum streams through the fwd carry and its unit cotangent seeds
+    every group's backward (each block's gating contributes to the loss)."""
+    cfg: Any                     # MixtralConfig
+    n: int
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        from deepspeed_tpu.models.llama import REMAT_POLICIES
+        from deepspeed_tpu.models.mixtral import MixtralBlock
+        block_cls = MixtralBlock
+        if self.cfg.base.remat:
+            block_cls = nn.remat(
+                MixtralBlock,
+                policy=REMAT_POLICIES[self.cfg.base.remat_policy],
+                prevent_cse=True, static_argnums=())
+        aux = jnp.float32(0.0)
+        for i in range(self.n):
+            x, a = block_cls(self.cfg, name=f"layer_{i}")(x, positions)
+            aux = aux + a
+        return x, aux
+
+
 class _TailLoss(nn.Module):
     """final_norm + unembed + masked mean CE over all S positions (labels are
     pre-shifted/padded host-side so shapes stay static — same formulation as
-    LlamaForCausalLM._chunked_loss, numerically equal to the dense loss)."""
+    LlamaForCausalLM._chunked_loss, numerically equal to the dense loss).
+    ``head_dtype`` overrides the unembed matmul dtype (Mixtral's lm_head is
+    a plain fp32 Dense while its norm stays in the compute dtype)."""
     cfg: Any
+    head_dtype: Any = None
 
     @nn.compact
     def __call__(self, x, embedding, labels, mask):
@@ -135,8 +164,8 @@ class _TailLoss(nn.Module):
             logits = jnp.dot(x.astype(cfg.dtype),
                              embedding.astype(cfg.dtype).T)
         else:
-            logits = LMHead(cfg.hidden_size, cfg.vocab_size, cfg.dtype,
-                            name="lm_head")(x)
+            logits = LMHead(cfg.hidden_size, cfg.vocab_size,
+                            self.head_dtype or cfg.dtype, name="lm_head")(x)
         logits = logits.astype(jnp.float32)
         logits = softcap_logits(logits, cfg.logits_soft_cap)
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -156,7 +185,12 @@ class ParamOffloadTrainer:
     def __init__(self, model, config: DeepSpeedTPUConfig, params_host,
                  mesh, batch_sharding, lr_schedule, tensor_rules=None):
         validate_param_offload(config, model)
-        self.cfg = model.cfg
+        # Mixtral (MoE) wraps a LlamaConfig and keeps its param tree at top
+        # level (no "model/" prefix); blocks return (x, aux_loss)
+        self._moe = hasattr(model.cfg, "base") and hasattr(model.cfg, "moe")
+        self._model_cfg = model.cfg
+        self.cfg = model.cfg.base if self._moe else model.cfg
+        self._prefix = "" if self._moe else "model/"
         self.config = config
         self.mesh = mesh
         self.batch_sharding = batch_sharding
@@ -193,13 +227,14 @@ class ParamOffloadTrainer:
         per = max(1, int(getattr(pcfg, "layers_per_group", 1) or 1))
         self._layer_groups: List[List[int]] = [
             list(range(a, min(a + per, L))) for a in range(0, L, per)]
-        self._embed_idx = self._subtree_idx([("embed", "model/embed")])
-        tail_map = [("final_norm", "model/final_norm")]
+        pre = self._prefix
+        self._embed_idx = self._subtree_idx([("embed", pre + "embed")])
+        tail_map = [("final_norm", pre + "final_norm")]
         if not self.cfg.tie_embeddings:
-            tail_map.append(("lm_head", "model/lm_head"))
+            tail_map.append(("lm_head", pre + "lm_head"))
         self._tail_idx = self._subtree_idx(tail_map)
         self._group_idx: List[Any] = [
-            self._subtree_idx([(f"layer_{j}", f"model/layer_{i}")
+            self._subtree_idx([(f"layer_{j}", pre + f"layer_{i}")
                                for j, i in enumerate(g)])
             for g in self._layer_groups]
 
@@ -398,22 +433,46 @@ class ParamOffloadTrainer:
 
     # --- jitted per-group functions ------------------------------------------
     def _fwd_fn(self, n: int):
+        """Group forward: returns (x_out, aux) — aux is the group's MoE
+        gating loss sum (always 0.0 for dense llama, keeping one protocol)."""
         if n not in self._stack_fwd:
-            stack = _BlockStack(self.cfg, n)
-            self._stack_fwd[n] = jax.jit(
-                lambda p, x, pos, seg: stack.apply({"params": p}, x, pos, seg))
+            if self._moe:
+                stack = _MoEBlockStack(self._model_cfg, n)
+                self._stack_fwd[n] = jax.jit(
+                    lambda p, x, pos, seg: stack.apply({"params": p}, x, pos,
+                                                       seg))
+            else:
+                stack = _BlockStack(self.cfg, n)
+                self._stack_fwd[n] = jax.jit(
+                    lambda p, x, pos, seg: (
+                        stack.apply({"params": p}, x, pos, seg),
+                        jnp.float32(0.0)))
         return self._stack_fwd[n]
 
     def _bwd_fn(self, n: int):
+        """Group backward; under MoE the unit cotangent on the group's aux
+        output carries the gating-loss gradient into its params."""
         if n not in self._stack_bwd:
-            stack = _BlockStack(self.cfg, n)
+            if self._moe:
+                stack = _MoEBlockStack(self._model_cfg, n)
 
-            def bwd(p, x, pos, seg, g):
-                _, vjp = jax.vjp(
-                    lambda p_, x_: stack.apply({"params": p_}, x_, pos, seg),
-                    p, x)
-                gp, gx = vjp(g)
-                return gx, gp
+                def bwd(p, x, pos, seg, g):
+                    _, vjp = jax.vjp(
+                        lambda p_, x_: stack.apply({"params": p_}, x_, pos,
+                                                   seg),
+                        p, x)
+                    gp, gx = vjp((g, jnp.float32(1.0)))
+                    return gx, gp
+            else:
+                stack = _BlockStack(self.cfg, n)
+
+                def bwd(p, x, pos, seg, g):
+                    _, vjp = jax.vjp(
+                        lambda p_, x_: stack.apply({"params": p_}, x_, pos,
+                                                   seg),
+                        p, x)
+                    gp, gx = vjp(g)
+                    return gx, gp
             self._stack_bwd[n] = jax.jit(bwd)
         return self._stack_bwd[n]
 
@@ -440,7 +499,8 @@ class ParamOffloadTrainer:
         """Tied: grads flow to (tail, embedding, x). Untied: the embedding is
         not an input at all (a [V,H] zero cotangent would cost real HBM)."""
         if self._tail_fn is None:
-            tail_mod = _TailLoss(self.cfg)
+            tail_mod = _TailLoss(self.cfg,
+                                 head_dtype=jnp.float32 if self._moe else None)
             tied = self.cfg.tie_embeddings
 
             def tail_grad(tail_p, embedding, x, labels, mask):
@@ -471,6 +531,10 @@ class ParamOffloadTrainer:
             jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
         seg = micro.get("segment_ids")
         seg = jnp.asarray(seg) if seg is not None else None
+        if seg is not None and self._moe:
+            raise NotImplementedError(
+                "packed-sequence segment_ids with MoE param offload is "
+                "unsupported (MixtralBlock takes no segment mask)")
 
         # labels over all S (mask kills the shifted-out position) — equal to
         # the dense shifted loss, static shapes (LlamaForCausalLM._chunked_loss)
@@ -495,6 +559,7 @@ class ParamOffloadTrainer:
         embed_dev = self._device_group(self._embed_idx)
         x = embed_fwd(embed_dev, ids)
         acts = []
+        aux_total = jnp.float32(0.0)
         self._prefetch_group(0)
         nxt = self._device_group(self._group_idx[0], 0) if G else None
         for gi in range(G):
@@ -503,12 +568,17 @@ class ParamOffloadTrainer:
             if gi + 1 < G:
                 nxt = self._device_group(self._group_idx[gi + 1], gi + 1)
             acts.append(x)
-            x = self._fwd_fn(len(self._layer_groups[gi]))(cur, x, positions, seg)
+            x, aux_g = self._fwd_fn(len(self._layer_groups[gi]))(
+                cur, x, positions, seg)
+            aux_total = aux_total + aux_g
 
         # ---- loss + head/embed-tie grads ----
         tail_dev = self._device_group(self._tail_idx)
         loss, gx, g_tail, g_emb_tie = self._tail_grad_fn()(
             tail_dev, embed_dev["embed"]["embedding"], x, labels, mask)
+        # the MoE gating losses join the reported loss; their param grads
+        # flow through each group's aux cotangent in the backward stream
+        loss = loss + aux_total
         self._accumulate(self._tail_idx, g_tail)
         if cfg.tie_embeddings:
             self._accumulate(self._embed_idx,
