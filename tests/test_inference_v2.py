@@ -390,12 +390,12 @@ def test_speculative_decode_exact_greedy_equivalence(model_and_params):
     spec_real = make(4).generate(prompt, max_new_tokens=24)
     assert spec_real[:len(plain)] == plain, (spec_real, plain)
 
-    # sampling configs refuse (acceptance compares argmax chains)
-    eng_s = InferenceEngineV2(params, cfg, V2EngineConfig(
-        kv_block_size=16, kv_num_blocks=64, greedy=False,
-        speculative_k=4))
+    # sampling configs refuse AT CONSTRUCTION (acceptance compares argmax
+    # chains; a step-time failure would leak a half-processed sequence)
     with pytest.raises(ValueError, match="greedy"):
-        eng_s.generate(prompt, max_new_tokens=4)
+        InferenceEngineV2(params, cfg, V2EngineConfig(
+            kv_block_size=16, kv_num_blocks=64, greedy=False,
+            speculative_k=4))
 
 
 def test_speculative_propose_prompt_lookup(model_and_params):
