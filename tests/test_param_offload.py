@@ -350,3 +350,19 @@ def test_param_offload_mixtral_moe_matches_dense():
     np.testing.assert_allclose(l1, l2, rtol=1e-4)
     assert l2[-1] < l2[0]
     assert e2.state.params == ()
+
+
+def test_param_offload_gemma_flavor_matches_dense():
+    """Gemma-family knobs compose: tied embeddings + embed scaling + rms
+    scale-offset + logit softcap all stream correctly."""
+    model = LlamaForCausalLM(tiny_cfg(
+        tie_embeddings=True, scale_embeddings=True, rms_scale_offset=True,
+        logits_soft_cap=30.0, hidden_act="gelu_tanh"))
+    e1 = make_engine(model)
+    l1 = run_steps(e1)
+    e2 = make_engine(model, zero={"stage": 0,
+                                  "offload_param": {"device": "cpu"}})
+    l2 = run_steps(e2)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    assert max_param_diff(jax.device_get(e1.state.params),
+                          e2.get_params()) < 5e-4
