@@ -29,6 +29,10 @@ def main():
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--ckpt_dir", default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--packed", action="store_true",
+                   help="pack variable-length synthetic documents into the "
+                        "batch (segment_ids masked in-kernel, per-document "
+                        "positions, target-gated loss)")
     args = p.parse_args()
 
     import jax
@@ -61,10 +65,32 @@ def main():
     if args.resume and args.ckpt_dir:
         engine.load_checkpoint(args.ckpt_dir)
 
+    packed_batches = None
+    if args.packed:
+        import numpy as np
+        from deepspeed_tpu.data_pipeline import (pack_sequences,
+                                                 packing_efficiency)
+        rng = np.random.default_rng(0)
+        docs = [rng.integers(1, cfg.vocab_size,
+                             size=rng.integers(args.seq_len // 6,
+                                               args.seq_len)).astype(np.int32)
+                for _ in range(24 * n_dev)]
+        packed_batches = pack_sequences(docs, batch_size=2 * n_dev,
+                                        seq_len=args.seq_len)
+        print(f"packed {len(docs)} docs into {len(packed_batches)} batches "
+              f"({packing_efficiency(packed_batches):.0%} slot utilization)")
+
     for step in range(args.steps):
-        batch = random_tokens(2 * n_dev, args.seq_len,
-                              vocab_size=cfg.vocab_size, seed=step % 4, gas=2)
-        loss = engine.train_batch(batch=batch)
+        if packed_batches is not None:
+            import numpy as np
+            micro = [packed_batches[(2 * step + g) % len(packed_batches)]
+                     for g in range(2)]
+            batch = {k: np.stack([m[k] for m in micro]) for k in micro[0]}
+        else:
+            batch = random_tokens(2 * n_dev, args.seq_len,
+                                  vocab_size=cfg.vocab_size, seed=step % 4,
+                                  gas=2)
+        loss = engine.train_batch(batch=batch, stacked=True)
         if step % 5 == 0 or step == args.steps - 1:
             lr = engine.get_lr()
             lr = lr[0] if isinstance(lr, (list, tuple)) else lr

@@ -61,3 +61,8 @@ def test_llama70b_north_star_dryrun():
     for mode in ("fsdp", "stream"):
         out = _run("llama70b_v5e16.py", "--dryrun", "--mode", mode)
         assert "ok" in out and "losses" in out
+
+
+def test_pretrain_packed():
+    out = _run("pretrain_llama.py", "--steps", "4", "--packed")
+    assert "slot utilization" in out and "step 3:" in out
