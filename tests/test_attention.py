@@ -335,3 +335,18 @@ def test_ring_segment_ids_flash(sp_mesh):
     with pytest.raises(NotImplementedError, match="flash"):
         ring_attention(q, k, v, causal=True, mesh=sp_mesh, impl="xla",
                        segment_ids=seg)
+
+
+def test_flash_window_and_segments_compose():
+    """Sliding window AND packed segments in one kernel mask (mistral-style
+    packed training)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    q, k, v = make_qkv(s=48, h=4, hkv=2)
+    rng = np.random.default_rng(9)
+    seg = jnp.asarray(np.sort(rng.integers(0, 3, size=(2, 48)), axis=1),
+                      jnp.int32)
+    out = pallas_flash_attention(q, k, v, True, 16, 16, True, 12, seg)
+    ref = attention_reference(q, k, v, causal=True, window=12,
+                              segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
