@@ -112,6 +112,11 @@ def emit_stale_banked(name: str, metric: str = None) -> bool:
     return True
 
 
+# distinct from rc 3 (nothing banked) and rc 0 (fresh run): exit status alone
+# must never conflate a stale replay with a real measurement
+STALE_REPLAY_EXIT_CODE = 7
+
+
 def guard_device_discovery(name: str, timeout: float = 180.0,
                            stale_metric: str = None):
     """Fail fast if TPU device discovery hangs (wedged axon tunnel, observed
@@ -121,8 +126,11 @@ def guard_device_discovery(name: str, timeout: float = 180.0,
 
     When ``stale_metric`` is set (the round-end driver path), a timeout
     emits the newest banked headline for that metric (marked
-    ``stale: true``) and exits 0 so the driver always records a parseable
-    line; exits 3 when nothing is banked or ``stale_metric`` is None.
+    ``stale: true``) and exits ``STALE_REPLAY_EXIT_CODE`` (7) so the driver
+    records a parseable line while the exit status still says "replay, not
+    fresh". Drivers that can only accept rc 0 opt in with
+    ``DSTPU_STALE_REPLAY_RC0=1``. Exits 3 when nothing is banked or
+    ``stale_metric`` is None.
     """
     discovered = threading.Event()
 
@@ -132,7 +140,8 @@ def guard_device_discovery(name: str, timeout: float = 180.0,
                   "tunnel wedged", file=sys.stderr)
             if stale_metric is not None and emit_stale_banked(name, stale_metric):
                 sys.stdout.flush()
-                os._exit(0)
+                rc0 = os.environ.get("DSTPU_STALE_REPLAY_RC0", "") not in ("", "0")
+                os._exit(0 if rc0 else STALE_REPLAY_EXIT_CODE)
             os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()

@@ -283,6 +283,48 @@ class InferenceEngineV2:
         self.kv.release(seq.blocks)
         return seq.generated
 
+    # ------------------------------------------------------------------
+    # serving hooks (consumed by deepspeed_tpu/serving: the serve loop
+    # admits without stepping, steps in its own cadence, and reaps
+    # finished sequences between steps)
+    # ------------------------------------------------------------------
+    def admit(self, uid: int, prompt_tokens: Sequence[int]) -> SequenceDescriptor:
+        """Admission-only: create sequence state WITHOUT running a step.
+        ``put`` couples admission to stepping; a serving loop needs them
+        apart so a burst of arrivals lands in one SplitFuse plan."""
+        if not self.can_schedule([uid], [len(prompt_tokens)]):
+            raise RuntimeError(
+                "cannot admit: out of KV blocks or sequence slots")
+        return self.state.create(uid, prompt_tokens)
+
+    def finish(self, uid: int) -> None:
+        """Mark a sequence done (length limit / cancel) so the scheduler
+        stops planning it; KV blocks are released at reap time."""
+        seq = self.state.get(uid)
+        if seq is not None:
+            seq.done = True
+
+    def finished_uids(self) -> List[int]:
+        return [s.uid for s in self.state.all() if s.done]
+
+    def reap_finished(self) -> Dict[int, List[int]]:
+        """Flush every done sequence (releasing its KV blocks); returns
+        {uid: generated_tokens} for the reaped set."""
+        return {uid: self.flush(uid) for uid in self.finished_uids()}
+
+    def has_work(self) -> bool:
+        return any(not s.done for s in self.state.all())
+
+    def kv_usable_blocks(self) -> int:
+        """Blocks available to sequences (the last block is the permanent
+        trash page for padding writes and never allocates)."""
+        return self.kv.cfg.num_blocks - 1
+
+    def kv_occupancy(self) -> float:
+        """Fraction of usable KV cache blocks currently reserved (0..1)."""
+        usable = self.kv_usable_blocks()
+        return (usable - self.kv.free_blocks) / max(usable, 1)
+
     def generate(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
                  uid: int = 0) -> List[int]:
         """Convenience serial generation loop over the continuous-batching

@@ -66,6 +66,9 @@ class StateManager:
     def pop(self, uid: int) -> SequenceDescriptor:
         return self._seqs.pop(uid)
 
+    def all(self) -> List[SequenceDescriptor]:
+        return list(self._seqs.values())
+
     def running(self) -> List[SequenceDescriptor]:
         return [s for s in self._seqs.values() if not s.done]
 

@@ -87,6 +87,10 @@ def quantize_model_params(params: Any, q_bits: int = 8, group_size: int = 64,
             raise ValueError(
                 f"fp{q_bits} packs {pack_group} codes per unit: group_size "
                 f"{group_size} must be divisible by {pack_group}")
+    if fmt == "int" and q_bits == 4 and group_size % 2:
+        raise ValueError(
+            f"int4 packs two codes per byte: group_size {group_size} "
+            f"must be even")
     pats = [re.compile(p) for p in (modules or [".*"])]
     qmax = 2.0 ** (q_bits - 1) - 1
 

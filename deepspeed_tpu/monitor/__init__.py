@@ -1,0 +1,13 @@
+from deepspeed_tpu.monitor.monitor import (CometMonitor, CSVMonitor, Event,
+                                           Monitor, MonitorMaster,
+                                           TensorBoardMonitor, WandbMonitor)
+
+__all__ = [
+    "CometMonitor",
+    "CSVMonitor",
+    "Event",
+    "Monitor",
+    "MonitorMaster",
+    "TensorBoardMonitor",
+    "WandbMonitor",
+]

@@ -865,8 +865,9 @@ class DeepSpeedTPUEngine:
         batch_host = {k: np.asarray(v) for k, v in batch.items()}
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
-        loss, norm = self._param_offload.train_batch(
-            batch_host, step=self.global_steps)
+        applied_step = self.global_steps   # the step the offload optimizer
+        loss, norm = self._param_offload.train_batch(  # evaluates lr at
+            batch_host, step=applied_step)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         self.state = self.state._replace(step=self.state.step + 1)
@@ -874,7 +875,9 @@ class DeepSpeedTPUEngine:
         self.micro_steps += self.gradient_accumulation_steps
         self.global_samples += self.train_batch_size
         self._advance_data_schedules()
-        lr = float(jax.device_get(self.lr_schedule(self.state.step)))
+        # report the lr that was ACTUALLY applied (pre-increment step), not
+        # the next step's schedule value
+        lr = float(jax.device_get(self.lr_schedule(jnp.int32(applied_step))))
         self._record_metrics(StepOutput(
             loss=jnp.float32(loss), grad_norm=jnp.float32(norm),
             lr=jnp.float32(lr), overflow=jnp.bool_(False)))

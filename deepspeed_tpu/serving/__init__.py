@@ -1,0 +1,22 @@
+"""Continuous-batching serving layer over the v2 ragged engine (MII analog).
+
+Request lifecycle + serve loop + admission control + observability + an
+stdlib HTTP front door. See docs/serving.md.
+"""
+
+from deepspeed_tpu.serving.frontend import ServingFrontend
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import Request, RequestState
+from deepspeed_tpu.serving.server import (BackpressureError, InferenceServer,
+                                          ServerClosedError, ServingConfig)
+
+__all__ = [
+    "BackpressureError",
+    "InferenceServer",
+    "Request",
+    "RequestState",
+    "ServerClosedError",
+    "ServingConfig",
+    "ServingFrontend",
+    "ServingMetrics",
+]

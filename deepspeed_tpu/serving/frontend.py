@@ -1,0 +1,179 @@
+"""Stdlib-only HTTP front-end for ``InferenceServer``.
+
+Reference analog: MII's REST/gRPC front door, reduced to what the standard
+library provides (``http.server.ThreadingHTTPServer`` — one thread per
+connection, fine for the request rates a single engine can absorb; a
+production deployment would terminate HTTP elsewhere and speak to the serve
+loop directly).
+
+Endpoints:
+  POST /generate  {"prompt_tokens": [..], "max_new_tokens": N,
+                   "timeout_s": S, "stream": false}
+      -> 200 {"uid", "tokens", "finish_reason", ...}
+      -> with "stream": true, chunked JSON-lines: one {"token": t} per
+         generated token, then a final {"done": true, ...} record
+      -> 429 + Retry-After on backpressure, 503 while draining
+  GET /metrics    Prometheus text format
+  GET /healthz    200 {"status": "serving", ...} / 503 otherwise
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deepspeed_tpu.serving.request import RequestState
+from deepspeed_tpu.serving.server import (BackpressureError, InferenceServer,
+                                          ServerClosedError)
+from deepspeed_tpu.utils.logging import logger
+
+
+class ServingFrontend:
+    """Binds an ``InferenceServer`` to a localhost HTTP socket. ``port=0``
+    picks an ephemeral port (tests); read it back from ``.port``."""
+
+    def __init__(self, server: InferenceServer, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout_s: float = 120.0):
+        self.serving = server
+        self.request_timeout_s = request_timeout_s
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):   # route to our logger
+                logger.debug("frontend: " + fmt % args)
+
+            def _json(self, code: int, payload: dict, headers=()):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    h = frontend.serving.health()
+                    self._json(200 if h["ok"] else 503, h)
+                elif self.path == "/metrics":
+                    body = frontend.serving.metrics.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                # drain the body FIRST: responding with unread body bytes on
+                # the socket corrupts the next keep-alive request
+                raw = self.rfile.read(int(self.headers.get("Content-Length",
+                                                           0) or 0))
+                if self.path != "/generate":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    body = json.loads(raw or b"{}")
+                    prompt = body["prompt_tokens"]
+                except (ValueError, KeyError, TypeError) as e:
+                    # TypeError: valid JSON that isn't an object
+                    self._json(400, {"error": f"bad request: {e!r}"})
+                    return
+                try:
+                    req = frontend.serving.submit(
+                        prompt,
+                        max_new_tokens=body.get("max_new_tokens"),
+                        timeout_s=body.get("timeout_s"))
+                except (TypeError, ValueError) as e:
+                    # type-malformed payloads (non-list prompt, string
+                    # max_new_tokens, ...) are client errors, not 500s
+                    self._json(400, {"error": f"bad request: {e!r}"})
+                    return
+                except BackpressureError as e:
+                    self._json(429, {"error": str(e),
+                                     "retry_after_s": e.retry_after_s},
+                               headers=[("Retry-After",
+                                         f"{e.retry_after_s:.0f}")])
+                    return
+                except ServerClosedError as e:
+                    self._json(503, {"error": str(e)})
+                    return
+                if body.get("stream"):
+                    self._stream_response(req)
+                else:
+                    try:
+                        req.result(timeout=frontend.request_timeout_s)
+                    except TimeoutError:
+                        # a 200 here would pass truncated output off as
+                        # success; 504 lets the caller retry deliberately
+                        req.cancel()
+                        req.wait(timeout=5.0)
+                        self._json(504, req.describe()
+                                   | {"tokens": req.tokens,
+                                      "error": "generation timed out "
+                                               "server-side"})
+                        return
+                    # status mirrors the terminal state: only a normal
+                    # finish is a 200 — FAILED/TIMED_OUT with a 200 would
+                    # pass a broken or truncated generation off as success
+                    code = {RequestState.FINISHED: 200,
+                            RequestState.TIMED_OUT: 504,
+                            RequestState.FAILED: 500}.get(req.state, 200)
+                    self._json(code, req.describe() | {"tokens": req.tokens})
+
+            def _stream_response(self, req):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonlines")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj):
+                    data = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for tok in req.stream(timeout=frontend.request_timeout_s):
+                        chunk({"token": tok})
+                    chunk({"done": True} | req.describe())
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception:
+                    # per-token timeout or client gone: free the engine slot
+                    # and try to terminate the chunked stream so a live
+                    # client isn't left waiting on a response that never
+                    # ends; either way this connection is done
+                    req.cancel()
+                    try:
+                        chunk({"done": True, "error": "stream aborted"}
+                              | req.describe())
+                        self.wfile.write(b"0\r\n\r\n")
+                    except Exception:
+                        pass
+                    self.close_connection = True
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingFrontend":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="dstpu-frontend", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

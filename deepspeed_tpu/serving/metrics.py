@@ -1,0 +1,195 @@
+"""Serving observability: per-request latency stats, rolling throughput,
+KV-occupancy gauges.
+
+Two export paths: ``events()`` emits ``(tag, value, step)`` tuples for the
+``deepspeed_tpu.monitor`` fan-out (CSV / TensorBoard / WandB / Comet), and
+``prometheus_text()`` renders a Prometheus text-format dump for the
+front-end's ``/metrics`` endpoint.
+"""
+
+import collections
+import threading
+from typing import List
+
+from deepspeed_tpu.monitor import Event
+from deepspeed_tpu.utils.timer import RateTracker
+
+# bounded sample reservoirs: serving runs indefinitely, metric memory must not
+_SAMPLE_WINDOW = 1024
+
+
+class _LatencyStat:
+    """Bounded-window latency aggregate (mean / p50 / p99 / max + lifetime
+    count and sum — the count/sum pair is what Prometheus summaries carry)."""
+
+    def __init__(self, window: int = _SAMPLE_WINDOW):
+        self.samples = collections.deque(maxlen=window)
+        self.count = 0
+        self.sum = 0.0
+
+    def add(self, v: float):
+        self.samples.append(v)
+        self.count += 1
+        self.sum += v
+
+    def mean(self) -> float:
+        return sum(self.samples) / len(self.samples) if self.samples else 0.0
+
+    def quantile(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        i = min(int(q * len(s)), len(s) - 1)
+        return s[i]
+
+    def max(self) -> float:
+        return max(self.samples) if self.samples else 0.0
+
+
+class ServingMetrics:
+    """All counters/gauges the serve loop maintains. Thread-safe: the serve
+    loop writes, front-end threads read."""
+
+    def __init__(self, rate_window_s: float = 30.0):
+        self._lock = threading.Lock()
+        # counters
+        self.requests_submitted = 0
+        self.requests_rejected = 0       # backpressure at submit()
+        self.requests_completed = 0      # finished (length / eos)
+        self.requests_cancelled = 0
+        self.requests_timed_out = 0
+        self.requests_failed = 0
+        self.tokens_generated = 0
+        self.engine_steps = 0
+        # latency distributions (seconds)
+        self.ttft = _LatencyStat()
+        self.tpot = _LatencyStat()
+        self.queue_wait = _LatencyStat()
+        # gauges (set each serve-loop tick)
+        self.queue_depth = 0
+        self.inflight = 0
+        self.kv_occupancy = 0.0
+        self.kv_occupancy_peak = 0.0
+        # rolling throughput
+        self.token_rate = RateTracker(window_s=rate_window_s)
+        self.request_rate = RateTracker(window_s=rate_window_s)
+
+    # ---- serve-loop write API --------------------------------------------
+    def on_submit(self):
+        with self._lock:
+            self.requests_submitted += 1
+
+    def on_reject(self):
+        with self._lock:
+            self.requests_rejected += 1
+
+    def on_tokens(self, n: int):
+        with self._lock:
+            self.tokens_generated += n
+        self.token_rate.add(n)
+
+    def on_step(self):
+        with self._lock:
+            self.engine_steps += 1
+
+    def on_finish(self, req):
+        """Fold a terminal request's latency samples in (any terminal state)."""
+        from deepspeed_tpu.serving.request import RequestState
+        with self._lock:
+            if req.state == RequestState.FINISHED:
+                self.requests_completed += 1
+            elif req.state == RequestState.CANCELLED:
+                self.requests_cancelled += 1
+            elif req.state == RequestState.TIMED_OUT:
+                self.requests_timed_out += 1
+            else:
+                self.requests_failed += 1
+            if req.queue_wait_s is not None:
+                self.queue_wait.add(req.queue_wait_s)
+            if req.ttft_s is not None:
+                self.ttft.add(req.ttft_s)
+            if req.tpot_s is not None:
+                self.tpot.add(req.tpot_s)
+        self.request_rate.add(1)
+
+    def set_gauges(self, queue_depth: int, inflight: int, kv_occupancy: float):
+        with self._lock:
+            self.queue_depth = queue_depth
+            self.inflight = inflight
+            self.kv_occupancy = kv_occupancy
+            self.kv_occupancy_peak = max(self.kv_occupancy_peak, kv_occupancy)
+
+    # ---- export -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "requests_submitted": self.requests_submitted,
+                "requests_rejected": self.requests_rejected,
+                "requests_completed": self.requests_completed,
+                "requests_cancelled": self.requests_cancelled,
+                "requests_timed_out": self.requests_timed_out,
+                "requests_failed": self.requests_failed,
+                "tokens_generated": self.tokens_generated,
+                "engine_steps": self.engine_steps,
+                "queue_depth": self.queue_depth,
+                "inflight": self.inflight,
+                "kv_occupancy": self.kv_occupancy,
+                "kv_occupancy_peak": self.kv_occupancy_peak,
+                "ttft_mean_s": self.ttft.mean(),
+                "ttft_p50_s": self.ttft.quantile(0.5),
+                "ttft_p99_s": self.ttft.quantile(0.99),
+                "tpot_mean_s": self.tpot.mean(),
+                "tpot_p50_s": self.tpot.quantile(0.5),
+                "queue_wait_mean_s": self.queue_wait.mean(),
+                "queue_wait_max_s": self.queue_wait.max(),
+                "tokens_per_sec": self.token_rate.rate(),
+                "requests_per_sec": self.request_rate.rate(),
+            }
+
+    def events(self, step: int) -> List[Event]:
+        """(tag, value, step) tuples for ``MonitorMaster.write_events``."""
+        return [(f"serving/{k}", float(v), step)
+                for k, v in self.snapshot().items()]
+
+    def export(self, monitor, step: int):
+        """Fan the current snapshot out through a ``deepspeed_tpu.monitor``
+        backend (anything with ``write_events``)."""
+        if monitor is not None and getattr(monitor, "enabled", False):
+            monitor.write_events(self.events(step))
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (counters + gauges + summary stats)."""
+        snap = self.snapshot()
+        counters = {"requests_submitted", "requests_rejected",
+                    "requests_completed", "requests_cancelled",
+                    "requests_timed_out", "requests_failed",
+                    "tokens_generated", "engine_steps"}
+        lines = []
+        with self._lock:
+            summaries = [
+                ("ttft_seconds", "time to first token (from arrival)",
+                 self.ttft),
+                ("tpot_seconds", "time per output token (decode phase)",
+                 self.tpot),
+                ("queue_wait_seconds", "admission queue wait", self.queue_wait),
+            ]
+            for name, help_text, stat in summaries:
+                full = f"dstpu_serving_{name}"
+                lines.append(f"# HELP {full} {help_text}")
+                lines.append(f"# TYPE {full} summary")
+                for q in (0.5, 0.9, 0.99):
+                    lines.append(f'{full}{{quantile="{q}"}} '
+                                 f"{stat.quantile(q):.9g}")
+                lines.append(f"{full}_sum {stat.sum:.9g}")
+                lines.append(f"{full}_count {stat.count}")
+        for key in ("requests_submitted", "requests_rejected",
+                    "requests_completed", "requests_cancelled",
+                    "requests_timed_out", "requests_failed",
+                    "tokens_generated", "engine_steps", "queue_depth",
+                    "inflight", "kv_occupancy", "kv_occupancy_peak",
+                    "tokens_per_sec", "requests_per_sec"):
+            full = f"dstpu_serving_{key}"
+            kind = "counter" if key in counters else "gauge"
+            lines.append(f"# TYPE {full} {kind}")
+            lines.append(f"{full} {snap[key]:.9g}")
+        return "\n".join(lines) + "\n"

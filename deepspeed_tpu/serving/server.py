@@ -1,0 +1,397 @@
+"""Continuous-batching serve loop over ``InferenceEngineV2``.
+
+Reference analog: DeepSpeed-MII's async pipeline — the missing layer the
+SURVEY marks "serving layer (MII, external)" above the v2 ragged engine.
+Architecture:
+
+  submit() threads --> bounded admission queue --> serve loop (ONE thread)
+                                                     |-- engine.admit / step
+                                                     |-- token fan-out to
+                                                     |   per-request streams
+                                                     `-- deadline / cancel /
+                                                         reap / metrics
+
+The engine is single-threaded by construction (jit dispatch + host-side KV
+bookkeeping), so ONLY the serve loop touches it; callers interact through
+thread-safe ``Request`` objects. Admission control is two-tier: a bounded
+queue (depth) plus a projected KV-occupancy watermark — both reject at
+``submit()`` with a retry-after hint rather than buffering unboundedly.
+"""
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import Request, RequestState
+from deepspeed_tpu.utils.logging import logger
+
+
+class BackpressureError(RuntimeError):
+    """Admission rejected: queue full or projected KV occupancy over the
+    watermark. ``retry_after_s`` is the client backoff hint (HTTP 429 +
+    Retry-After in the front-end)."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class ServerClosedError(RuntimeError):
+    """Submission refused: the server is draining or stopped."""
+
+
+class _EngineStepError(RuntimeError):
+    """Internal: ``engine.step`` raised — engine state is suspect, so the
+    serve loop fails every engine-resident request (other tick errors are
+    logged and survived)."""
+
+
+@dataclass
+class ServingConfig:
+    max_queue_depth: int = 64            # bounded admission queue
+    kv_high_watermark: float = 0.95      # projected KV-occupancy reject line
+    default_max_new_tokens: int = 64
+    default_timeout_s: Optional[float] = None   # per-request deadline
+    retry_after_s: float = 1.0           # backoff hint on rejection
+    idle_poll_s: float = 0.002           # loop sleep when no work
+    monitor_export_every: int = 0        # engine steps between monitor
+    # exports; 0 disables the fan-out even when a monitor is attached
+
+
+class InferenceServer:
+    """Drives one ``InferenceEngineV2`` from a background thread with
+    continuous batching, streaming fan-out, admission control, and
+    graceful drain (the shutdown AND elastic-resize hook: drain, resize or
+    recreate the engine, start a fresh server)."""
+
+    def __init__(self, engine, config: Optional[ServingConfig] = None,
+                 monitor=None):
+        self.engine = engine
+        self.config = config or ServingConfig()
+        if not 0.0 < self.config.kv_high_watermark <= 1.0:
+            # the watermark IS the no-mid-decode-exhaustion invariant: the
+            # sum of accepted requests' worst-case blocks never exceeds
+            # watermark * usable blocks, so lazy per-step reservation can't
+            # run dry; above 1.0 that guarantee is gone
+            raise ValueError(
+                f"kv_high_watermark must be in (0, 1], got "
+                f"{self.config.kv_high_watermark}")
+        self.metrics = ServingMetrics()
+        self.monitor = monitor
+        self._uid = itertools.count(1)
+        self._lock = threading.Lock()          # queue + tables, never engine
+        self._queue: List[Request] = []        # accepted, not yet in engine
+        self._inflight: Dict[int, Request] = {}  # uid -> engine-resident
+        self._draining = False
+        self._stopped = False
+        self._wake = threading.Event()         # submit() nudges the loop
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="dstpu-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting new requests; keep stepping until every accepted
+        request reaches a terminal state. Returns True when fully drained
+        (False on timeout, with requests still in flight)."""
+        with self._lock:
+            self._draining = True
+        self._wake.set()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                live = len(self._queue) + len(self._inflight)
+            if live == 0:
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(self.config.idle_poll_s)
+
+    def stop(self, drain_timeout: Optional[float] = 30.0):
+        """Graceful shutdown: drain, then stop the loop. Requests still
+        live after the drain timeout are force-cancelled."""
+        if self._thread is None or not self._thread.is_alive():
+            # no serve loop to honor cancellations: settle accepted
+            # requests directly instead of polling a drain that can't
+            # progress (callers blocked in result() would hang forever)
+            with self._lock:
+                self._draining = True
+            self._fail_all("server stopped before the serve loop ran")
+            with self._lock:
+                self._stopped = True
+            return
+        drained = self.drain(timeout=drain_timeout)
+        if not drained:
+            with self._lock:
+                leftovers = list(self._queue) + list(self._inflight.values())
+            for req in leftovers:
+                req.cancel()
+            self.drain(timeout=5.0)
+        with self._lock:
+            self._stopped = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    @property
+    def running(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and not self._stopped)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def health(self) -> dict:
+        with self._lock:
+            queued, inflight = len(self._queue), len(self._inflight)
+        state = ("stopped" if self._stopped else
+                 "draining" if self._draining else
+                 "serving" if self.running else "not_started")
+        return {"status": state, "ok": state == "serving",
+                "queued": queued, "inflight": inflight,
+                "kv_occupancy": self.engine.kv_occupancy()}
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    def _blocks_for(self, req: Request) -> int:
+        return self.engine.kv.blocks_needed(
+            len(req.prompt_tokens) + req.max_new_tokens)
+
+    def submit(self, prompt_tokens: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> Request:
+        """Accept a request (thread-safe) or reject synchronously.
+        Raises ``ServerClosedError`` when draining/stopped and
+        ``BackpressureError`` when the queue or the projected KV occupancy
+        is over its limit."""
+        cfg = self.config
+        if max_new_tokens is None:
+            max_new_tokens = cfg.default_max_new_tokens
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        req = Request(uid=next(self._uid), prompt_tokens=prompt_tokens,
+                      max_new_tokens=max_new_tokens,
+                      timeout_s=(timeout_s if timeout_s is not None
+                                 else cfg.default_timeout_s))
+        if not req.prompt_tokens:
+            raise ValueError("empty prompt")
+        max_ctx = self.engine.state.max_context_length
+        if len(req.prompt_tokens) + req.max_new_tokens > max_ctx:
+            # past max_seq_len the decode would silently clamp positions
+            # (garbage RoPE rotations), so reject at the door
+            raise ValueError(
+                f"prompt ({len(req.prompt_tokens)}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max context {max_ctx}")
+        with self._lock:
+            if self._draining or self._stopped:
+                raise ServerClosedError("server is draining; not accepting "
+                                        "new requests")
+            if len(self._queue) >= cfg.max_queue_depth:
+                self.metrics.on_reject()
+                raise BackpressureError(
+                    f"admission queue full ({cfg.max_queue_depth}); retry "
+                    f"after {cfg.retry_after_s:.1f}s", cfg.retry_after_s)
+            # projected occupancy at completion: worst-case blocks of every
+            # accepted request (queued AND in flight — an admitted request
+            # keeps reserving blocks as it decodes) + this one
+            total_blocks = max(self.engine.kv_usable_blocks(), 1)
+            projected = (sum(self._blocks_for(r) for r in self._queue)
+                         + sum(self._blocks_for(r)
+                               for r in self._inflight.values())
+                         + self._blocks_for(req))
+            if projected / total_blocks > cfg.kv_high_watermark:
+                self.metrics.on_reject()
+                raise BackpressureError(
+                    f"projected KV occupancy {projected}/{total_blocks} over "
+                    f"watermark {cfg.kv_high_watermark:.2f}; retry after "
+                    f"{cfg.retry_after_s:.1f}s", cfg.retry_after_s)
+            self._queue.append(req)
+        self.metrics.on_submit()
+        self._wake.set()
+        return req
+
+    def cancel(self, uid: int) -> bool:
+        """Request cancellation by uid; True if the request was found live."""
+        with self._lock:
+            for r in self._queue:
+                if r.uid == uid:
+                    r.cancel()
+                    return True
+            req = self._inflight.get(uid)
+        if req is not None:
+            req.cancel()
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # the serve loop (single thread; sole owner of the engine)
+    # ------------------------------------------------------------------
+    def _serve_loop(self):
+        while True:
+            if self._stopped:
+                return
+            try:
+                worked = self._serve_once()
+            except _EngineStepError:
+                # the KV cache / sequence state may be inconsistent after a
+                # failed step: every engine-resident request is compromised
+                logger.exception("serve loop: engine step failed; failing "
+                                 "in-flight requests")
+                self._fail_all("engine step raised")
+                worked = False
+            except Exception:
+                # non-engine bookkeeping glitch: requests are still healthy,
+                # log and keep serving
+                logger.exception("serve loop: non-fatal tick error")
+                worked = False
+            if not worked:
+                # nothing to do: block until a submit() nudge (bounded so
+                # deadline expiry of QUEUED requests is still noticed)
+                self._wake.wait(timeout=self.config.idle_poll_s * 10)
+                self._wake.clear()
+
+    def _serve_once(self) -> bool:
+        self._expire_and_cancel()
+        self._admit_from_queue()
+        worked = False
+        if self.engine.has_work():
+            try:
+                out = self.engine.step()
+            except Exception as e:
+                raise _EngineStepError(str(e)) from e
+            self.metrics.on_step()
+            worked = True
+            self._fan_out(out)
+        self._reap()
+        with self._lock:
+            queued, inflight = len(self._queue), len(self._inflight)
+        self.metrics.set_gauges(queue_depth=queued, inflight=inflight,
+                                kv_occupancy=self.engine.kv_occupancy())
+        every = self.config.monitor_export_every
+        if every and self.metrics.engine_steps % every == 0:
+            try:
+                self.metrics.export(self.monitor, self.metrics.engine_steps)
+            except Exception:
+                logger.exception("serve loop: monitor export failed")
+        return worked
+
+    def _admit_from_queue(self):
+        """FIFO admission while the engine currently has room for the
+        request's FULL worst case (prompt + max_new_tokens). Note
+        ``can_schedule`` checks free blocks WITHOUT reserving — the actual
+        no-mid-decode-exhaustion guarantee is submit()'s worst-case
+        projection against the <=1.0 KV watermark."""
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                req = self._queue[0]
+            need = len(req.prompt_tokens) + req.max_new_tokens
+            if not self.engine.can_schedule([req.uid], [need]):
+                return
+            with self._lock:
+                self._queue.pop(0)
+                self._inflight[req.uid] = req
+            try:
+                self.engine.admit(req.uid, req.prompt_tokens)
+            except Exception as e:
+                # fail THIS request, not the batch (e.g. prompt longer than
+                # the engine's max context)
+                with self._lock:
+                    self._inflight.pop(req.uid, None)
+                req.finalize(RequestState.FAILED, "error", error=repr(e))
+                self.metrics.on_finish(req)
+                continue
+            req.admit_ts = time.monotonic()
+            req.state = RequestState.PREFILL
+
+    def _fan_out(self, step_out: Dict[int, int]):
+        now = time.monotonic()
+        n = 0
+        for uid, tok in step_out.items():
+            req = self._inflight.get(uid)
+            if req is None or req.state.terminal:
+                continue
+            req.state = RequestState.DECODE
+            req.push_token(int(tok), now=now)
+            n += 1
+            seq = self.engine.state.get(uid)
+            if seq is not None and seq.done:
+                req.finalize(RequestState.FINISHED, "eos")
+            elif len(req.tokens) >= req.max_new_tokens:
+                req.finalize(RequestState.FINISHED, "length")
+                self.engine.finish(uid)
+        if n:
+            self.metrics.on_tokens(n)
+
+    def _expire_and_cancel(self):
+        now = time.monotonic()
+        with self._lock:
+            queued = list(self._queue)
+            inflight = list(self._inflight.values())
+        for req in queued:
+            if req.cancelled_requested or req.expired:
+                with self._lock:
+                    if req in self._queue:
+                        self._queue.remove(req)
+                self._finalize_expired(req, now)
+                # never reached the engine: settle metrics here (engine-
+                # resident requests settle in _reap)
+                self.metrics.on_finish(req)
+        for req in inflight:
+            if req.cancelled_requested or req.expired:
+                self._finalize_expired(req, now)
+                self.engine.finish(req.uid)
+
+    def _finalize_expired(self, req: Request, now: float):
+        if req.cancelled_requested:
+            req.finalize(RequestState.CANCELLED, "cancelled")
+        else:
+            req.finalize(RequestState.TIMED_OUT, "timeout")
+
+    def _reap(self):
+        """Release engine state (KV blocks, sequence slots) for every done
+        sequence and settle the owning requests."""
+        reaped = self.engine.reap_finished()
+        for uid in reaped:
+            with self._lock:
+                req = self._inflight.pop(uid, None)
+            if req is None:
+                continue
+            if not req.state.terminal:
+                # engine marked it done (eos) but no token crossed this step
+                req.finalize(RequestState.FINISHED, "eos")
+            self.metrics.on_finish(req)
+
+    def _fail_all(self, why: str):
+        with self._lock:
+            victims = list(self._queue) + list(self._inflight.values())
+            self._queue.clear()
+            inflight = list(self._inflight)
+            self._inflight.clear()
+        for req in victims:
+            req.finalize(RequestState.FAILED, "error", error=why)
+            self.metrics.on_finish(req)
+        for uid in inflight:
+            try:
+                self.engine.finish(uid)
+            except Exception:
+                pass
+        try:
+            self.engine.reap_finished()
+        except Exception:
+            logger.exception("serve loop: reap after failure also failed")

@@ -1,0 +1,39 @@
+"""Version-drift shims for the jax API surface this tree targets.
+
+The codebase is written against the promoted locations (``jax.shard_map``,
+``jax.distributed.is_initialized``); older jax releases only carry the
+experimental/private ones. Importing this module aliases the old locations
+onto the new names so one tree runs on both. Imported once from the package
+root, before any call site.
+"""
+
+import jax
+
+
+def ensure_compat():
+    if not hasattr(jax, "shard_map"):
+        import functools
+        import inspect
+
+        from jax.experimental.shard_map import shard_map
+        accepts_vma = "check_vma" in inspect.signature(shard_map).parameters
+
+        @functools.wraps(shard_map)
+        def _shard_map(*args, **kwargs):
+            if not accepts_vma and "check_vma" in kwargs:
+                # the kwarg was renamed check_rep -> check_vma upstream
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return shard_map(*args, **kwargs)
+
+        jax.shard_map = _shard_map
+    if not hasattr(jax.distributed, "is_initialized"):
+        def _is_initialized():
+            try:
+                from jax._src import distributed
+                return distributed.global_state.client is not None
+            except Exception:
+                return False
+        jax.distributed.is_initialized = _is_initialized
+
+
+ensure_compat()

@@ -21,7 +21,12 @@ import jax  # noqa: E402
 # the env vars above too late; config updates still apply pre-backend-init.
 if os.environ.get("DSTPU_TEST_PLATFORM", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax: no such option — XLA_FLAGS above already forces the
+        # 8-device host platform when jax wasn't pre-imported
+        pass
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402

@@ -77,18 +77,30 @@ def test_bench_watchdog_emits_stale_banked_headline(tmp_path):
         "unit": "tokens/sec/chip", "vs_baseline": 1.212,
         "measured_at": "2026-07-31T03:52:00+00:00"}) + "\n")
     env = dict(os.environ, DSTPU_BENCH_LOGS=str(logs))
-    # driver path: stale_metric set -> banked headline replayed, rc 0
-    out = subprocess.run([sys.executable, "-c", (
+    env.pop("DSTPU_STALE_REPLAY_RC0", None)
+    # driver path: stale_metric set -> banked headline replayed with the
+    # DISTINCT replay exit code (exit status alone must never conflate a
+    # stale replay with a fresh rc-0 run)
+    from bench_util import STALE_REPLAY_EXIT_CODE
+    replay_src = (
         "import time\n"
         "from bench_util import guard_device_discovery\n"
         "guard_device_discovery('bench', timeout=0.2,"
         " stale_metric='llama_train_tokens_per_sec_per_chip')\n"
-        "time.sleep(10)\n")], capture_output=True, text=True, cwd=repo, env=env)
-    assert out.returncode == 0, out.stderr
+        "time.sleep(10)\n")
+    out = subprocess.run([sys.executable, "-c", replay_src],
+                         capture_output=True, text=True, cwd=repo, env=env)
+    assert out.returncode == STALE_REPLAY_EXIT_CODE, out.stderr
     rec = json.loads(out.stdout.strip())
     assert rec["stale"] is True
     assert rec["metric"] == "llama_train_tokens_per_sec_per_chip"
     assert rec["source"] and rec["measured_at"] == "2026-07-31T03:52:00+00:00"
+    # rc-0 replay is an explicit env opt-in for drivers that reject nonzero
+    out_rc0 = subprocess.run(
+        [sys.executable, "-c", replay_src], capture_output=True, text=True,
+        cwd=repo, env=dict(env, DSTPU_STALE_REPLAY_RC0="1"))
+    assert out_rc0.returncode == 0, out_rc0.stderr
+    assert json.loads(out_rc0.stdout.strip())["stale"] is True
     # wrong metric is rejected, never substituted -> rc 3
     out2 = subprocess.run([sys.executable, "-c", (
         "import time\n"

@@ -61,6 +61,8 @@ def max_param_diff(a_tree, b_tree):
 
 
 def test_param_offload_cpu_matches_dense():
+    # NOT slow-marked: the one dense-vs-offload parity assert kept in the
+    # default run (the exhaustive flavor matrix runs under -m slow)
     model = LlamaForCausalLM(tiny_cfg())
     e1 = make_engine(model)
     l1 = run_steps(e1)
@@ -85,6 +87,7 @@ def test_param_offload_uneven_groups_and_gas1():
     assert [len(g) for g in e._param_offload._layer_groups] == [3, 1]
 
 
+@pytest.mark.slow
 def test_param_offload_nvme_trains_and_twin_flow(tmp_path):
     model = LlamaForCausalLM(tiny_cfg())
     e = make_engine(model, zero={"stage": 0, "offload_param": {
@@ -105,6 +108,7 @@ def test_param_offload_nvme_trains_and_twin_flow(tmp_path):
     assert max_param_diff(e.get_params(), e2.get_params()) < 1e-6
 
 
+@pytest.mark.slow
 def test_param_offload_tied_embeddings_matches_dense():
     model = LlamaForCausalLM(tiny_cfg(tie_embeddings=True))
     e1 = make_engine(model)
@@ -117,6 +121,7 @@ def test_param_offload_tied_embeddings_matches_dense():
                           e2.get_params()) < 5e-4
 
 
+@pytest.mark.slow
 def test_param_offload_grad_clip_matches_dense():
     model = LlamaForCausalLM(tiny_cfg())
     e1 = make_engine(model, gradient_clipping=0.01)
@@ -146,6 +151,7 @@ def test_param_offload_bf16_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_param_offload_checkpoint_roundtrip(tmp_path):
     model = LlamaForCausalLM(tiny_cfg())
     zero = {"stage": 0, "offload_param": {"device": "cpu"}}
@@ -241,6 +247,7 @@ def test_param_offload_tp_sharded_streaming():
     np.testing.assert_allclose(losses, l2, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_param_offload_mistral_style_sliding_window():
     """Param offload covers the whole LlamaConfig family — a mistral-style
     config (sliding window, GQA) streams and matches its dense engine."""
@@ -287,6 +294,7 @@ def test_param_offload_from_hf_checkpoint():
     assert engine.state.params == ()
 
 
+@pytest.mark.slow
 def test_checkpoint_interchange_with_zero3(tmp_path, mesh8):
     """UCP across memory tiers: a param-offload checkpoint restores into a
     plain ZeRO-3 engine (device-sharded params) and vice versa — same orbax
@@ -320,6 +328,7 @@ def test_checkpoint_interchange_with_zero3(tmp_path, mesh8):
                           e3.get_params()) < 1e-6
 
 
+@pytest.mark.slow
 def test_param_offload_mixtral_moe_matches_dense():
     """MoE param offload (streaming experts is THE weights>HBM MoE case):
     MixtralBlocks stream layer-group by layer-group, each group's gating
@@ -352,6 +361,7 @@ def test_param_offload_mixtral_moe_matches_dense():
     assert e2.state.params == ()
 
 
+@pytest.mark.slow
 def test_param_offload_gemma_flavor_matches_dense():
     """Gemma-family knobs compose: tied embeddings + embed scaling + rms
     scale-offset + logit softcap all stream correctly."""
@@ -366,3 +376,18 @@ def test_param_offload_gemma_flavor_matches_dense():
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
     assert max_param_diff(jax.device_get(e1.state.params),
                           e2.get_params()) < 5e-4
+
+
+def test_param_offload_reports_applied_lr():
+    """The lr metric must be the schedule value at the step the offload
+    optimizer ACTUALLY applied (pre-increment), not the next step's."""
+    model = LlamaForCausalLM(tiny_cfg(num_layers=2))
+    e = make_engine(
+        model, zero={"stage": 0, "offload_param": {"device": "cpu"}},
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                              "warmup_num_steps": 10}})
+    for applied_step in range(2):
+        run_steps(e, steps=1)
+        expected = float(jax.device_get(e.lr_schedule(applied_step)))
+        assert float(e._last_metrics["lr"]) == pytest.approx(expected)

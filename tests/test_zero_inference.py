@@ -194,3 +194,13 @@ def test_int4_packed_weights_halve_storage_and_serve(tiny_model):
     eng = ZeROInferenceEngine(model, params, model_config=cfg, q_bits=4)
     out = eng.generate(list(range(8)), max_new_tokens=4)
     assert len(out) == 4
+
+
+def test_int4_odd_group_size_rejected(tiny_model):
+    """int4 packs two codes per byte: an odd group_size must fail with a
+    descriptive config error, not an opaque reshape ValueError."""
+    _, _, params = tiny_model
+    with pytest.raises(ValueError, match="two codes per byte"):
+        quantize_model_params(params, q_bits=4, group_size=63)
+    # other int widths don't pack, so odd groups stay legal
+    quantize_model_params(params, q_bits=8, group_size=63)
