@@ -18,19 +18,163 @@ The ``latest`` tag-file protocol is kept for API parity.
 
 import json
 import os
+import zlib
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
+MANIFEST_FILE = "ds_manifest.json"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed its integrity-manifest verification: a listed
+    file is missing or its checksum no longer matches — the checkpoint is
+    torn and must never be restored."""
 
 
 def _ckpt_dir(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), str(tag))
+
+
+# ---------------------------------------------------------------------------
+# durability primitives: fsync + integrity manifest + atomic commit
+# ---------------------------------------------------------------------------
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Flush directory entries (the rename/create records) to disk; no-op on
+    platforms whose directory fds reject fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _file_crc(path: str, chunk: int = 1 << 20):
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+            size += len(buf)
+    return size, crc
+
+
+def write_manifest(path: str, extra_meta: Optional[Dict[str, Any]] = None,
+                   exclude=None) -> Dict[str, Any]:
+    """Walk the checkpoint dir, checksum every file (crc32 + size), persist
+    ``ds_manifest.json`` and fsync it + every hashed file. Written strictly
+    BEFORE the ``latest`` commit: a committed tag therefore always carries a
+    verifiable manifest, and a crash mid-save leaves a tag that simply never
+    commits. ``exclude(filename) -> bool`` skips files another process may
+    still be writing (no cross-process barrier exists here — checksumming a
+    peer's in-flight sidecar would brand a good checkpoint torn forever)."""
+    files: Dict[str, Dict[str, int]] = {}
+    for root, _, names in os.walk(path):
+        for name in sorted(names):
+            if name == MANIFEST_FILE:
+                continue
+            if exclude is not None and exclude(name):
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, path)
+            size, crc = _file_crc(full)
+            files[rel] = {"size": size, "crc32": crc}
+            _fsync_file(full)
+    manifest = {"version": 1, "files": files, "meta": extra_meta or {}}
+    mpath = os.path.join(path, MANIFEST_FILE)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(path)
+    return manifest
+
+
+def verify_manifest(path: str) -> bool:
+    """Re-checksum a checkpoint against its manifest. Returns True when the
+    manifest exists and every listed file matches; False for a legacy
+    (manifest-less) checkpoint; raises ``CheckpointCorruptionError`` on any
+    missing file or checksum mismatch."""
+    mpath = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(mpath):
+        return False
+    with open(mpath) as f:
+        manifest = json.load(f)
+    for rel, want in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            raise CheckpointCorruptionError(
+                f"checkpoint {path}: manifest file missing: {rel}")
+        size, crc = _file_crc(full)
+        if size != want["size"] or crc != want["crc32"]:
+            raise CheckpointCorruptionError(
+                f"checkpoint {path}: checksum mismatch for {rel} "
+                f"(size {size} vs {want['size']}, crc {crc} vs {want['crc32']})")
+    return True
+
+
+def is_committed(save_dir: str, tag: str, verify: bool = True) -> bool:
+    """True when ``tag`` is a fully-committed, integrity-clean checkpoint
+    (manifest verification failures count as not-committed rather than
+    raising — callers use this to pick a fallback tag)."""
+    path = _ckpt_dir(save_dir, tag)
+    if not os.path.isdir(path) or not os.path.exists(
+            os.path.join(path, "ds_meta.json")):
+        return False
+    if not verify:
+        return True
+    try:
+        verify_manifest(path)
+    except CheckpointCorruptionError as e:
+        logger.warning(f"checkpoint integrity: {e}")
+        return False
+    return True
+
+
+def read_latest_tag(save_dir: str) -> Optional[str]:
+    """The tag the ``latest`` pointer names, or None — the single reader for
+    the pointer protocol (resume discovery, pruning, env_report, and the
+    load path all go through here)."""
+    latest = os.path.join(os.path.abspath(save_dir), LATEST_FILE)
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as f:
+        return f.read().strip() or None
+
+
+def _commit_latest(save_dir: str, tag: str) -> None:
+    """Atomically publish ``tag`` as the latest committed checkpoint:
+    tmp-file + fsync + rename + directory fsync, so a host crash at any
+    instant leaves either the old pointer or the new one — never a torn
+    ``latest``."""
+    save_dir = os.path.abspath(save_dir)
+    tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(tag)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+    _fsync_dir(save_dir)
 
 
 def wait_pending_checkpoint(engine) -> None:
@@ -147,18 +291,23 @@ def _snapshot_sidecars(engine, client_state):
 
 
 def _write_sidecars_and_commit(save_dir, tag, path, sidecars):
-    """Persist the point-in-time sidecar snapshot + the ``latest`` tag commit
-    (the tag is the durability marker, so it is written strictly after the
-    array write)."""
+    """Persist the point-in-time sidecar snapshot, fsync everything, write
+    the integrity manifest, and only THEN commit the ``latest`` tag (atomic
+    tmp+rename). The commit marker is the last durable write, so a host
+    crash at any point leaves either no commit (tag ignored on resume) or a
+    fully-verifiable checkpoint — never a torn-but-committed one."""
     offload_sd = sidecars["offload"]
     if offload_sd is not None:
         # host optimizer moments, one file per process (process-local shards)
+        npz_path = os.path.join(
+            path, f"offload_state_proc{jax.process_index()}.npz")
         np.savez(
-            os.path.join(path, f"offload_state_proc{jax.process_index()}.npz"),
+            npz_path,
             step_count=np.int64(offload_sd["step_count"]),
             **{f"s_{i}_{j}": s
                for i, states in enumerate(offload_sd["states"])
                for j, s in enumerate(states)})
+        _fsync_file(npz_path)
 
     comp_sd = sidecars["compression"]
     if comp_sd is not None and jax.process_index() == 0:
@@ -167,33 +316,56 @@ def _write_sidecars_and_commit(save_dir, tag, path, sidecars):
         arrays = {f"mask::{m}::{name}": arr
                   for m, d in comp_sd["masks"].items()
                   for name, arr in d.items()}
-        np.savez(os.path.join(path, "compression_state.npz"),
+        comp_path = os.path.join(path, "compression_state.npz")
+        np.savez(comp_path,
                  training_steps=np.int64(comp_sd["training_steps"]),
                  mask_frozen=np.array(json.dumps(comp_sd["mask_frozen"])),
                  **arrays)
+        _fsync_file(comp_path)
 
     if jax.process_index() == 0:
-        with open(os.path.join(path, "ds_meta.json"), "w") as f:
+        meta_path = os.path.join(path, "ds_meta.json")
+        with open(meta_path, "w") as f:
             json.dump(sidecars["meta"], f, indent=2, default=str)
-        with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
-            f.write(tag)
+            f.flush()
+            os.fsync(f.fileno())
+        # manifest covers every file process 0 can vouch for at commit time;
+        # on shared storage other processes' per-process sidecars may still
+        # be mid-write (no barrier here), so they are excluded rather than
+        # risk recording a partial checksum that brands the tag torn
+        own = f"offload_state_proc{jax.process_index()}.npz"
+        write_manifest(
+            path,
+            extra_meta={"tag": tag,
+                        "global_steps": sidecars["meta"].get("global_steps")},
+            exclude=(None if jax.process_count() == 1 else
+                     (lambda name: name.startswith("offload_state_proc")
+                      and name != own)))
+        _commit_latest(save_dir, tag)
+    else:
+        _fsync_dir(path)
     log_dist(f"saved checkpoint {path}", ranks=[0])
 
 
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
-                           load_optimizer_states: bool = True):
+                           load_optimizer_states: bool = True,
+                           verify_integrity: bool = True):
     wait_pending_checkpoint(engine)      # an in-flight async save must commit
     load_dir = os.path.abspath(load_dir)
     if tag is None:
-        latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.exists(latest):
+        tag = read_latest_tag(load_dir)
+        if tag is None:
             log_dist(f"no '{LATEST_FILE}' file in {load_dir}; nothing restored", ranks=[0])
             return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
     path = _ckpt_dir(load_dir, tag)
     if not os.path.isdir(path):
         raise FileNotFoundError(f"checkpoint not found: {path}")
+    if verify_integrity and verify_manifest(path):
+        # raises CheckpointCorruptionError on any mismatch — a torn
+        # checkpoint is never restored (resume_from_latest catches this and
+        # falls back to the newest clean tag); manifest-less (legacy)
+        # checkpoints load unverified
+        log_dist(f"checkpoint integrity verified: {path}", ranks=[0])
 
     state = engine.state
     offload = getattr(engine, "_offload", None)

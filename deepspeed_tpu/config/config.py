@@ -326,6 +326,12 @@ class DeepSpeedTPUConfig:
         # reference: get_sparse_gradients_enabled (runtime/config.py:247)
         self.sparse_gradients_enabled: bool = bool(
             self._raw.get("sparse_gradients", False))
+        # resilience subsystem (step guards / autosave / watchdog); the engine
+        # only arms its device-side guard when the group is explicitly present
+        # so default bf16/fp32 NaN propagation semantics are unchanged
+        from deepspeed_tpu.resilience.config import ResilienceConfig
+        self.resilience = ResilienceConfig(**self._raw.get("resilience", {}))
+        self.resilience_explicit: bool = "resilience" in self._raw
 
         self.gradient_clipping: float = float(
             self._raw.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -28,10 +28,30 @@ from deepspeed_tpu.utils.logging import logger
 class WorkerSpec:
     """What to run on each alive host (reference: torchelastic WorkerSpec)."""
     cmd: List[str]
-    max_restarts: int = 100
+    max_restarts: int = 100          # CRASH budget (preemptions are free)
+    # absolute backstop over ALL relaunches (crashes + preemptions + scale
+    # changes): a worker that dies preemption-shaped at startup forever must
+    # not spin the agent indefinitely just because no crash was charged
+    max_total_restarts: int = 1000
     monitor_interval_s: float = 1.0
     coordinator_port: int = 8476
     env: Dict[str, str] = field(default_factory=dict)
+    # shutdown escalation: SIGTERM, wait this long, then SIGKILL — one hung
+    # worker must not block the group teardown forever
+    term_grace_s: float = 30.0
+    # crash-loop backoff: sleep base * 2^(consecutive_crashes - 1) before a
+    # crash relaunch, capped; a generation that survives healthy_uptime_s
+    # resets the streak. Preemptions/scale changes relaunch immediately.
+    restart_backoff_s: float = 1.0
+    restart_backoff_max_s: float = 60.0
+    healthy_uptime_s: float = 300.0
+    # exit statuses that mean "the platform took the node" rather than "the
+    # worker crashed": SIGTERM/SIGINT deaths (negative Popen returncodes) and
+    # their 128+N shell-convention forms
+    preemption_exit_codes: tuple = (-15, -2, 143, 130)
+    # relaunches get DSTPU_RESUME=latest so workers resume from the newest
+    # committed checkpoint (resilience.resume_from_latest) instead of step 0
+    resume_env: bool = True
 
 
 class ElasticAgent:
@@ -47,8 +67,11 @@ class ElasticAgent:
         # localhost-only (single-host elasticity = restart-on-crash).
         self.host_provider = host_provider or (lambda: ["localhost"])
         self.popen = popen  # injectable for tests
-        self.restart_count = 0
+        self.restart_count = 0        # total relaunches (generation counter)
+        self.crash_restarts = 0       # relaunches charged to the budget
+        self.consecutive_crashes = 0  # crash-loop streak (drives backoff)
         self.procs: List[subprocess.Popen] = []
+        self._launch_time = 0.0
 
     def _validate_world(self, world_size: int) -> int:
         """Check the world size against the elastic config; returns the global
@@ -72,29 +95,73 @@ class ElasticAgent:
             env[ENV_PROCESS_ID] = str(pid)
             env["DSTPU_ELASTIC_RESTART"] = str(self.restart_count)
             env["DSTPU_ELASTIC_BATCH"] = str(final_batch)
+            if self.restart_count > 0 and self.spec.resume_env:
+                # relaunch marker: workers call FaultTolerantRunner
+                # .maybe_resume() at startup, which resumes from the newest
+                # committed checkpoint iff this var is set
+                env["DSTPU_RESUME"] = "latest"
             self.procs.append(self.popen(self.spec.cmd, env=env))
+        self._launch_time = time.monotonic()
 
     def _poll(self) -> Optional[int]:
-        """None while all healthy; first non-zero exit code on failure; 0 done."""
+        """None while all healthy; first non-zero exit code on failure; 0
+        done. The full code vector is kept (``_last_codes``) so the restart
+        accounting can distinguish preemption exits from crashes."""
         codes = [p.poll() for p in self.procs]
+        self._last_codes = codes
         if any(c not in (None, 0) for c in codes):
             return next(c for c in codes if c not in (None, 0))
         if all(c == 0 for c in codes):
             return 0
         return None
 
+    def _is_preemption(self, status: Optional[int]) -> bool:
+        """True when every failed worker died by a preemption-shaped status
+        (SIGTERM/SIGINT or their 128+N forms) — the platform reclaimed
+        capacity; nobody's code crashed, so the restart budget is untouched.
+        A SIGKILL/OOM/traceback in ANY worker makes the generation a crash."""
+        bad = [c for c in getattr(self, "_last_codes", [])
+               if c not in (None, 0)]
+        return (status is not None and status != 0 and bool(bad)
+                and all(c in self.spec.preemption_exit_codes for c in bad))
+
     def _terminate_all(self):
+        """SIGTERM the group, give each worker ``term_grace_s`` to autosave
+        and exit (the resilience runner's preemption path), then SIGKILL the
+        stragglers — one hung worker can't block shutdown."""
         for p in self.procs:
             if p.poll() is None:
                 p.terminate()
+        deadline = time.monotonic() + self.spec.term_grace_s
         for p in self.procs:
             try:
-                p.wait(timeout=30)
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
+                logger.warning("elastic agent: worker ignored SIGTERM for "
+                               f"{self.spec.term_grace_s:.0f}s; escalating "
+                               "to SIGKILL")
                 p.kill()
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    logger.error("elastic agent: worker survived SIGKILL "
+                                 "wait; abandoning process")
+
+    def _crash_backoff_s(self) -> float:
+        """Exponential crash-loop backoff: base * 2^(streak-1), capped."""
+        if self.consecutive_crashes <= 0 or self.spec.restart_backoff_s <= 0:
+            return 0.0
+        return min(
+            self.spec.restart_backoff_s * 2 ** (self.consecutive_crashes - 1),
+            self.spec.restart_backoff_max_s)
 
     def run(self) -> int:
-        """Supervise until success or restart budget exhausted."""
+        """Supervise until success or the crash-restart budget is exhausted.
+        Preemption exits and membership changes relaunch for free (the
+        platform's churn is not the workload's fault); crashes consume the
+        budget and back off exponentially while the streak lasts."""
         hosts = self.host_provider()
         self._launch(hosts)
         while True:
@@ -107,12 +174,37 @@ class ElasticAgent:
             if status == 0 and not scale_change:
                 logger.info("elastic agent: all workers finished")
                 return 0
+            crash = (status is not None and status != 0
+                     and not self._is_preemption(status))
+            uptime = time.monotonic() - self._launch_time
             # failure or membership change → restart the group at new scale
             self._terminate_all()
             self.restart_count += 1
-            if self.restart_count > self.spec.max_restarts:
-                logger.error("elastic agent: restart budget exhausted")
+            if self.restart_count > self.spec.max_total_restarts:
+                logger.error("elastic agent: total restart backstop "
+                             f"exhausted ({self.spec.max_total_restarts})")
                 return status or 1
+            if crash:
+                if uptime >= self.spec.healthy_uptime_s:
+                    self.consecutive_crashes = 0    # not a crash LOOP
+                self.consecutive_crashes += 1
+                self.crash_restarts += 1
+                if self.crash_restarts > self.spec.max_restarts:
+                    logger.error("elastic agent: crash-restart budget "
+                                 f"exhausted ({self.spec.max_restarts})")
+                    return status or 1
+                backoff = self._crash_backoff_s()
+                if backoff:
+                    logger.warning(
+                        f"elastic agent: crash #{self.consecutive_crashes} "
+                        f"(exit {status}, uptime {uptime:.1f}s); backing off "
+                        f"{backoff:.1f}s before relaunch")
+                    time.sleep(backoff)
+            else:
+                self.consecutive_crashes = 0
+                why = "scale change" if scale_change else f"preemption (exit {status})"
+                logger.info(f"elastic agent: {why}; relaunching immediately "
+                            "(budget untouched)")
             hosts = current_hosts
             try:
                 self._launch(hosts)

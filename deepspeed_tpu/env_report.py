@@ -1,7 +1,14 @@
 """``dstpu_report`` — environment/compatibility report (reference: ``bin/ds_report``
 → ``deepspeed/env_report.py``: op compatibility table + version/platform dump).
+
+``--ckpt RUN_DIR`` additionally reports checkpoint/resume status for a run
+directory: the ``latest`` pointer, which tag ``resume_from_latest`` would
+actually restore (newest *committed*, integrity-verified), and a per-tag
+commit/verification table — the first thing to look at when deciding whether
+a preempted run can resume.
 """
 
+import argparse
 import importlib
 import platform
 import shutil
@@ -62,7 +69,56 @@ def debug_report():
     return rows
 
 
-def main(hide_operator_status=False, hide_errors_and_warnings=False):
+def checkpoint_report(run_dir):
+    """Latest-committed-checkpoint status for a run directory. Returns
+    ``(summary_rows, tag_rows)``: the resume decision up top, then one row
+    per tag — committed+verified / torn (never loaded) / legacy."""
+    import os
+
+    from deepspeed_tpu.checkpoint.engine import (
+        LATEST_FILE, MANIFEST_FILE, CheckpointCorruptionError,
+        read_latest_tag, verify_manifest)
+    from deepspeed_tpu.resilience.checkpointing import _tag_meta, list_tags
+    run_dir = os.path.abspath(run_dir)
+    pointed = read_latest_tag(run_dir)
+    # one verification pass over every tag; the resume decision derives
+    # from the same results (a second find_latest_committed scan would
+    # re-read every multi-GB checkpoint end to end)
+    tags, clean = [], []
+    for tag in list_tags(run_dir):
+        path = os.path.join(run_dir, tag)
+        meta = _tag_meta(run_dir, tag)
+        step = meta.get("global_steps", "?")
+        if not os.path.exists(os.path.join(path, "ds_meta.json")):
+            status = f"{NO} uncommitted (no ds_meta.json)"
+        elif not os.path.exists(os.path.join(path, MANIFEST_FILE)):
+            status = f"{WARNING} committed, no manifest (legacy, unverified)"
+        else:
+            try:
+                verify_manifest(path)
+                status = f"{OKAY} committed + verified"
+                clean.append(tag)
+            except CheckpointCorruptionError as e:
+                status = f"{NO} TORN ({e})"
+        tags.append((f"{tag} (step {step})", status))
+    # mirror find_latest_committed's preference: the pointer when clean,
+    # else the newest clean tag (list_tags is already newest-first)
+    resume_tag = pointed if pointed in clean else (clean[0] if clean else None)
+    summary = [
+        ("run dir", run_dir),
+        ("latest pointer", pointed or f"{NO} (no '{LATEST_FILE}' file)"),
+        ("resume_from_latest would load",
+         resume_tag if resume_tag else f"{NO} (no committed checkpoint)"),
+    ]
+    if pointed and resume_tag and pointed != resume_tag:
+        summary.append(("pointer status",
+                        f"{WARNING} latest points at a torn/missing tag; "
+                        f"falling back to '{resume_tag}'"))
+    return summary, tags
+
+
+def main(hide_operator_status=False, hide_errors_and_warnings=False,
+         ckpt_dir=None):
     print("-" * 60)
     print("DeepSpeed-TPU C++/Pallas op report")
     print("-" * 60)
@@ -74,11 +130,27 @@ def main(hide_operator_status=False, hide_errors_and_warnings=False):
     print("-" * 60)
     for key, val in debug_report():
         print(f"{key:.<30} {val}")
+    if ckpt_dir is not None:
+        print("-" * 60)
+        print("Checkpoint / resume status:")
+        print("-" * 60)
+        summary, tags = checkpoint_report(ckpt_dir)
+        for key, val in summary:
+            print(f"{key:.<34} {val}")
+        for tag, status in tags:
+            print(f"  {tag:.<32} {status}")
+        if not tags:
+            print("  (no checkpoint tags found)")
     return 0
 
 
 def cli_main():
-    sys.exit(main())
+    parser = argparse.ArgumentParser(prog="dstpu_report")
+    parser.add_argument("--ckpt", metavar="RUN_DIR", default=None,
+                        help="also report latest-committed-checkpoint status "
+                             "for this run/checkpoint directory")
+    args = parser.parse_args()
+    sys.exit(main(ckpt_dir=args.ckpt))
 
 
 if __name__ == "__main__":

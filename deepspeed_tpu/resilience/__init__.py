@@ -1,0 +1,45 @@
+"""Resilience subsystem: fault-tolerant training loop, preemption-safe
+checkpointing, hung-step watchdog, and a deterministic fault-injection
+harness. See docs/resilience.md.
+"""
+
+from deepspeed_tpu.resilience.chaos import (ChaosConfig, ChaosInjectedIOError,
+                                            ChaosMonkey, monkey_from_env)
+from deepspeed_tpu.resilience.checkpointing import (Autosaver,
+                                                    CheckpointSaveError,
+                                                    find_latest_committed,
+                                                    list_tags,
+                                                    prune_checkpoints,
+                                                    resume_from_latest,
+                                                    save_with_retry)
+from deepspeed_tpu.resilience.config import (AutosaveConfig, ResilienceConfig,
+                                             StepGuardConfig, WatchdogConfig)
+from deepspeed_tpu.resilience.guards import (BadStepError, QuarantineError,
+                                             StepGuard)
+from deepspeed_tpu.resilience.runner import FaultTolerantRunner, RunResult
+from deepspeed_tpu.resilience.watchdog import StepWatchdog, WatchdogEvent
+
+__all__ = [
+    "Autosaver",
+    "AutosaveConfig",
+    "BadStepError",
+    "ChaosConfig",
+    "ChaosInjectedIOError",
+    "ChaosMonkey",
+    "CheckpointSaveError",
+    "FaultTolerantRunner",
+    "QuarantineError",
+    "ResilienceConfig",
+    "RunResult",
+    "StepGuard",
+    "StepGuardConfig",
+    "StepWatchdog",
+    "WatchdogConfig",
+    "WatchdogEvent",
+    "find_latest_committed",
+    "list_tags",
+    "monkey_from_env",
+    "prune_checkpoints",
+    "resume_from_latest",
+    "save_with_retry",
+]

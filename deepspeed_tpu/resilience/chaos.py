@@ -1,0 +1,191 @@
+"""Deterministic fault-injection harness.
+
+Every injection decision is a pure function of ``(seed, kind, step)`` — a
+sha256-derived roll — so a chaos run replays bit-identically regardless of
+call order, thread timing, or how many other chaos sites fire. That is what
+lets the tier-1 chaos suite pin seeds and assert exact recovery behavior.
+
+Faults covered (the failure modes the resilience subsystem exists for):
+  - ``nan``   : poison the training batch so the step produces non-finite
+                loss/grads (exercises the step guard + engine skip path)
+  - ``ckpt``  : checkpoint I/O failure (exercises save retry-with-backoff)
+  - ``slow``  : stall a step past the watchdog deadline
+  - ``die``   : SIGKILL this worker at a step boundary (exercises the
+                elastic agent's restart + resume-latest path)
+
+Knobs come from an explicit ``ChaosConfig`` or from the environment
+(``ChaosConfig.from_env``), so a launcher can chaos-test an unmodified
+training script:
+
+  DSTPU_CHAOS_SEED=7 DSTPU_CHAOS_NAN_STEPS=3,5 DSTPU_CHAOS_CKPT_FAIL_FIRST=2 \
+  DSTPU_CHAOS_SLOW_STEPS=9 DSTPU_CHAOS_SLOW_S=2.0 DSTPU_CHAOS_DIE_STEP=12 ...
+"""
+
+import hashlib
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import FrozenSet, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _parse_steps(raw: str) -> FrozenSet[int]:
+    return frozenset(int(s) for s in raw.replace(" ", "").split(",") if s)
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    seed: int = 0
+    # NaN-grad injection: explicit steps, a cadence, or a per-step probability
+    nan_steps: FrozenSet[int] = frozenset()
+    nan_every: int = 0
+    nan_prob: float = 0.0
+    # checkpoint I/O: fail the first K attempts of each save, plus a per-
+    # attempt probability for steady-state flakiness
+    ckpt_fail_first: int = 0
+    ckpt_fail_prob: float = 0.0
+    # slow/hung steps
+    slow_steps: FrozenSet[int] = frozenset()
+    slow_prob: float = 0.0
+    slow_s: float = 0.0
+    # worker death (SIGKILL — the uncatchable case) at a step boundary.
+    # die_once (default): a relaunched worker (DSTPU_RESUME set by the
+    # elastic agent) does NOT die again, so the kill→restart→resume path is
+    # exercised once instead of crash-looping until the restart budget dies
+    die_step: int = -1
+    die_once: bool = True
+
+    @property
+    def active(self) -> bool:
+        return bool(self.nan_steps or self.nan_every or self.nan_prob
+                    or self.ckpt_fail_first or self.ckpt_fail_prob
+                    or self.slow_steps or self.slow_prob
+                    or self.die_step >= 0)
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "ChaosConfig":
+        g = env.get
+        return cls(
+            seed=int(g("DSTPU_CHAOS_SEED", "0")),
+            nan_steps=_parse_steps(g("DSTPU_CHAOS_NAN_STEPS", "")),
+            nan_every=int(g("DSTPU_CHAOS_NAN_EVERY", "0")),
+            nan_prob=float(g("DSTPU_CHAOS_NAN_PROB", "0")),
+            ckpt_fail_first=int(g("DSTPU_CHAOS_CKPT_FAIL_FIRST", "0")),
+            ckpt_fail_prob=float(g("DSTPU_CHAOS_CKPT_FAIL_PROB", "0")),
+            slow_steps=_parse_steps(g("DSTPU_CHAOS_SLOW_STEPS", "")),
+            slow_prob=float(g("DSTPU_CHAOS_SLOW_PROB", "0")),
+            slow_s=float(g("DSTPU_CHAOS_SLOW_S", "0")),
+            die_step=int(g("DSTPU_CHAOS_DIE_STEP", "-1")),
+            die_once=g("DSTPU_CHAOS_DIE_ONCE", "1") not in ("0", "false"),
+        )
+
+
+class ChaosInjectedIOError(OSError):
+    """A checkpoint write failed by injection (distinguishable from a real
+    I/O error in logs, indistinguishable to the retry machinery)."""
+
+
+class ChaosMonkey:
+    """Stateless-roll injector; the only mutable state is bookkeeping
+    counters so tests can assert exactly what fired."""
+
+    def __init__(self, config: Optional[ChaosConfig] = None):
+        self.config = config if config is not None else ChaosConfig.from_env()
+        self.injected = {"nan": 0, "ckpt": 0, "slow": 0}
+
+    # ------------------------------------------------------------------
+    def _roll(self, kind: str, step: int, salt: int = 0) -> float:
+        """Deterministic uniform [0, 1) from (seed, kind, step, salt)."""
+        h = hashlib.sha256(
+            f"{self.config.seed}:{kind}:{step}:{salt}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2 ** 64
+
+    # ------------------------------------------------------------------
+    # nan grads
+    # ------------------------------------------------------------------
+    def nan_due(self, step: int) -> bool:
+        c = self.config
+        if step in c.nan_steps:
+            return True
+        if c.nan_every and step > 0 and step % c.nan_every == 0:
+            return True
+        return c.nan_prob > 0 and self._roll("nan", step) < c.nan_prob
+
+    def corrupt_batch(self, batch, step: int):
+        """Poison the first float leaf of the batch with a NaN so the loss
+        and every grad it touches go non-finite — the same blast radius as
+        a real data-pipeline/numerics fault (nothing engine-internal is
+        patched, so the full detect/skip path is exercised)."""
+        if not self.nan_due(step):
+            return batch
+        self.injected["nan"] += 1
+        logger.warning(f"chaos: injecting NaN into batch at step {step}")
+        poisoned = [False]
+
+        def poison(x):
+            x = np.asarray(x)
+            if not poisoned[0] and np.issubdtype(x.dtype, np.floating):
+                x = np.array(x, copy=True)
+                x.reshape(-1)[0] = np.nan
+                poisoned[0] = True
+            return x
+
+        import jax
+        batch = jax.tree.map(poison, batch)
+        if not poisoned[0]:
+            logger.warning("chaos: batch has no float leaf; NaN injection "
+                           "skipped (integer-only inputs)")
+        return batch
+
+    # ------------------------------------------------------------------
+    # checkpoint I/O
+    # ------------------------------------------------------------------
+    def ckpt_io_check(self, step: int, attempt: int) -> None:
+        """Raise ``ChaosInjectedIOError`` when this save attempt is chosen
+        to fail. ``attempt`` is 0-based within one logical save."""
+        c = self.config
+        fail = attempt < c.ckpt_fail_first or (
+            c.ckpt_fail_prob > 0
+            and self._roll("ckpt", step, salt=attempt) < c.ckpt_fail_prob)
+        if fail:
+            self.injected["ckpt"] += 1
+            raise ChaosInjectedIOError(
+                f"chaos: injected checkpoint I/O failure "
+                f"(step {step}, attempt {attempt})")
+
+    # ------------------------------------------------------------------
+    # slow / hung steps
+    # ------------------------------------------------------------------
+    def maybe_stall(self, step: int) -> float:
+        c = self.config
+        due = step in c.slow_steps or (
+            c.slow_prob > 0 and self._roll("slow", step) < c.slow_prob)
+        if due and c.slow_s > 0:
+            self.injected["slow"] += 1
+            logger.warning(f"chaos: stalling step {step} for {c.slow_s:.2f}s")
+            time.sleep(c.slow_s)
+            return c.slow_s
+        return 0.0
+
+    # ------------------------------------------------------------------
+    # worker death
+    # ------------------------------------------------------------------
+    def maybe_die(self, step: int) -> None:
+        if self.config.die_step < 0 or step < self.config.die_step:
+            return
+        if self.config.die_once and os.environ.get("DSTPU_RESUME"):
+            # this worker is a post-kill relaunch: let it live so the
+            # restart+resume path actually completes
+            return
+        logger.warning(f"chaos: SIGKILL self at step {step}")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def monkey_from_env() -> Optional[ChaosMonkey]:
+    """A ``ChaosMonkey`` when any DSTPU_CHAOS_* knob is set, else None."""
+    cfg = ChaosConfig.from_env()
+    return ChaosMonkey(cfg) if cfg.active else None

@@ -1,0 +1,103 @@
+"""Resilience configuration (the "resilience" config group).
+
+Reference analogs: the engine-level skip-step / loss-scale backoff knobs
+(``runtime/fp16/loss_scaler.py``), torchelastic's restart budget, and the
+checkpoint-cadence keys scattered through ``runtime/config.py`` — gathered
+here into one subsystem config the ``FaultTolerantRunner`` consumes.
+
+Every knob is also reachable through the standard single-JSON engine config::
+
+    {"resilience": {"step_guard": {...}, "autosave": {...}, "watchdog": {...}}}
+"""
+
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.config.config_utils import DeepSpeedTPUConfigModel
+
+
+class StepGuardConfig(DeepSpeedTPUConfigModel):
+    """Non-finite loss / grad-norm policy, layered on the engine's overflow
+    path: with ``policy="skip"`` the engine treats non-finite grads exactly
+    like an fp16 overflow (drop the update, keep params clean) even in
+    bf16/fp32, and the runner layers backoff/quarantine on top."""
+    enabled: bool = True
+    # "skip": drop the bad update on-device (engine overflow path) and keep
+    #         training; "abort": raise at the first bad step with a bundle
+    policy: str = "skip"
+    # after this many CONSECUTIVE bad steps, multiply the lr by
+    # lr_backoff_factor (0 disables backoff)
+    backoff_after: int = 3
+    lr_backoff_factor: float = 0.5
+    min_lr_scale: float = 1e-3
+    # after this many consecutive GOOD steps, one backoff level is undone
+    # (0 = never recover; backoff is permanent for the run)
+    lr_recovery_steps: int = 0
+    # consecutive bad steps before the runner gives up: raises
+    # QuarantineError with a diagnostic bundle (0 disables)
+    quarantine_after: int = 10
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.policy not in ("skip", "abort"):
+            raise ValueError(f"step_guard.policy must be skip|abort, "
+                             f"got {self.policy}")
+        if not 0.0 < self.lr_backoff_factor <= 1.0:
+            raise ValueError("lr_backoff_factor must be in (0, 1]")
+        return self
+
+
+class AutosaveConfig(DeepSpeedTPUConfigModel):
+    """Periodic + preemption-triggered checkpointing with retry."""
+    every_steps: int = 0              # autosave every N global steps (0 = off)
+    every_seconds: float = 0.0        # autosave every S wall seconds (0 = off)
+    save_on_preemption: bool = True   # SIGTERM/SIGINT triggers a final save
+    keep_last: int = 0                # prune committed tags beyond N (0 = all)
+    # checkpoint I/O retry: attempt, then backoff_s, 2*backoff_s, ... between
+    # up to io_retries re-attempts
+    io_retries: int = 3
+    io_backoff_s: float = 0.5
+
+
+class WatchdogConfig(DeepSpeedTPUConfigModel):
+    """Hung-step monitor: a step running past ``step_deadline_s`` gets a
+    diagnostics snapshot (live stacks + last metrics) and escalates per
+    ``policy``."""
+    enabled: bool = False
+    step_deadline_s: float = 1800.0
+    poll_s: float = 1.0
+    # "warn": log + snapshot only; "interrupt": request a preemption-style
+    # stop — with the runner's handlers installed this sets the preempt
+    # flag, so it takes effect when the slow step eventually RETURNS
+    # (autosave + clean stop). It cannot break a step that never returns:
+    # blocked calls are retried after the handler (PEP 475) and native XLA
+    # code never reaches another bytecode. For hard hangs use "kill":
+    # SIGKILL from the monitor thread (works regardless of what the main
+    # thread is stuck in); the snapshot is already on disk and the elastic
+    # agent relaunches with resume
+    policy: str = "warn"
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.policy not in ("warn", "interrupt", "kill"):
+            raise ValueError(f"watchdog.policy must be warn|interrupt|kill, "
+                             f"got {self.policy}")
+        return self
+
+
+class ResilienceConfig(DeepSpeedTPUConfigModel):
+    step_guard: StepGuardConfig = Field(default_factory=StepGuardConfig)
+    autosave: AutosaveConfig = Field(default_factory=AutosaveConfig)
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+    # where quarantine/watchdog diagnostic bundles land
+    diagnostics_dir: str = "./resilience_diagnostics"
+    # history ring kept for diagnostic bundles (steps)
+    history_steps: int = 64
+
+
+def resolve_resilience_config(engine) -> ResilienceConfig:
+    """The engine config's parsed "resilience" group (always present — a
+    default-constructed group when the key was absent)."""
+    cfg: Optional[ResilienceConfig] = getattr(engine.config, "resilience", None)
+    return cfg if cfg is not None else ResilienceConfig()

@@ -1,0 +1,210 @@
+"""Per-step health guards: non-finite detection, lr backoff, quarantine.
+
+Reference analog: the engine's fp16 overflow skip (``fused_optimizer.py``
+``_overflow_check_and_loss_scale_update``) generalized to bf16/fp32 — the
+device-side skip itself lives in ``runtime/engine.py::_update`` (enabled via
+``engine.set_nonfinite_guard``); this module is the host-side policy layer
+that watches the step outputs and decides backoff / quarantine / abort.
+
+Division of labor per bad step:
+  device (engine)   : grads found non-finite -> update dropped, params kept,
+                      ``skipped_steps`` incremented (fp16 additionally backs
+                      off the loss scale — the existing scaler)
+  host (this guard) : counts consecutive bad steps; after ``backoff_after``
+                      shrinks the lr by ``lr_backoff_factor`` (re-tracing the
+                      compiled step with the scaled schedule); after
+                      ``quarantine_after`` raises ``QuarantineError`` so the
+                      runner can emit a diagnostic bundle and stop burning
+                      accelerator time on a poisoned run.
+"""
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+
+from deepspeed_tpu.resilience.config import StepGuardConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class BadStepError(RuntimeError):
+    """A non-finite step under ``policy="abort"``."""
+
+
+class QuarantineError(RuntimeError):
+    """Too many consecutive bad steps — the run is quarantined.
+    ``bundle_path`` (set by the runner) points at the diagnostic bundle."""
+
+    def __init__(self, msg: str, bundle_path: Optional[str] = None):
+        super().__init__(msg)
+        self.bundle_path = bundle_path
+
+
+def _finite(x) -> bool:
+    try:
+        return math.isfinite(float(jax.device_get(x)))
+    except (TypeError, ValueError):
+        return True          # non-scalar / absent metrics don't trip the guard
+
+
+class StepGuard:
+    def __init__(self, engine, config: Optional[StepGuardConfig] = None):
+        self.engine = engine
+        self.cfg = config or StepGuardConfig()
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self.good_since_backoff = 0
+        self.lr_scale = 1.0
+        self._base_lr_schedule = engine.lr_schedule
+        self._armed = False
+        self._tx_wrapped = False
+        if self.cfg.enabled and self.cfg.policy == "skip":
+            if getattr(engine, "_param_offload", None) is not None:
+                # the ZeRO-Infinity streamed step applies updates in the
+                # fused host optimizer, outside the guarded jit path — a NaN
+                # update there CANNOT be dropped, so don't advertise
+                # clean-params semantics; detection/backoff/quarantine still
+                # run on the observed loss
+                logger.warning(
+                    "step guard: on-device skip is not supported with "
+                    "offload_param (fused host optimizer applies updates "
+                    "outside the guarded path); bad steps are detected and "
+                    "quarantined but their updates are NOT dropped")
+            elif not getattr(engine, "_guard_nonfinite", False):
+                # device-side skip: non-finite grads behave like an fp16
+                # overflow (update dropped, params stay clean) in every
+                # precision mode
+                engine.set_nonfinite_guard(True)
+                self._armed = True
+
+    def detach(self):
+        """Disarm the device-side guard IF this StepGuard armed it (an
+        engine whose config armed it explicitly keeps it): after the runner
+        closes, bf16/fp32 regain their default NaN-propagation semantics."""
+        if self._armed:
+            self.engine.set_nonfinite_guard(False)
+            self._armed = False
+
+    # ------------------------------------------------------------------
+    def observe(self, loss, metrics: Dict[str, Any]) -> bool:
+        """Inspect one completed step; returns True when the step was bad.
+        Raises ``BadStepError`` (policy "abort") or ``QuarantineError``."""
+        if not self.cfg.enabled:
+            return False
+        overflow = metrics.get("overflow")
+        bad = (not _finite(loss)
+               or not _finite(metrics.get("grad_norm", 0.0)))
+        if not bad and overflow is not None:
+            bad = bool(jax.device_get(overflow))
+            if bad and self.engine.config.fp16.enabled:
+                # overflow-only with finite loss/grad-norm under fp16 is the
+                # dynamic loss scaler doing its job (scale-search overflows
+                # are routine, especially at run start) — the scaler owns
+                # that path; counting it here would back off / quarantine a
+                # healthy run
+                bad = False
+        if not bad:
+            self._on_good_step()
+            return False
+        self.consecutive_bad += 1
+        self.total_bad += 1
+        self.good_since_backoff = 0
+        logger.warning(
+            f"step guard: non-finite step detected "
+            f"(consecutive={self.consecutive_bad}, total={self.total_bad})")
+        if self.cfg.policy == "abort":
+            raise BadStepError(
+                f"non-finite loss/grads at global step "
+                f"{self.engine.global_steps} (policy=abort)")
+        if (self.cfg.backoff_after
+                and self.consecutive_bad % self.cfg.backoff_after == 0):
+            self._backoff_lr()
+        if (self.cfg.quarantine_after
+                and self.consecutive_bad >= self.cfg.quarantine_after):
+            raise QuarantineError(
+                f"{self.consecutive_bad} consecutive non-finite steps "
+                f"(quarantine_after={self.cfg.quarantine_after}); "
+                + ("engine state preserved at the last good step"
+                   if self._armed or self.engine.config.fp16.enabled
+                   else "engine state may be poisoned (no on-device skip "
+                        "active)"))
+        return True
+
+    # ------------------------------------------------------------------
+    def _on_good_step(self):
+        self.consecutive_bad = 0
+        if self.lr_scale < 1.0 and self.cfg.lr_recovery_steps:
+            self.good_since_backoff += 1
+            if self.good_since_backoff >= self.cfg.lr_recovery_steps:
+                self.good_since_backoff = 0
+                self._set_lr_scale(
+                    min(1.0, self.lr_scale / self.cfg.lr_backoff_factor))
+
+    def _backoff_lr(self):
+        new_scale = max(self.cfg.min_lr_scale,
+                        self.lr_scale * self.cfg.lr_backoff_factor)
+        if new_scale != self.lr_scale:
+            self._set_lr_scale(new_scale)
+
+    def _wrap_tx(self):
+        """Wrap ``engine.tx`` so the lr scale reaches the REAL update, not
+        just the reported metric: the schedule was baked into the optax
+        chain at engine construction, so scaling must happen on the updates
+        the chain emits. ``init`` is untouched — opt_state structure (and
+        its shardings / the restore target) is unchanged. The scale is read
+        at trace time; every change re-traces via _reset_compiled_fns."""
+        if self._tx_wrapped:
+            return
+        import optax
+        inner = self.engine.tx
+        guard = self
+
+        def update(grads, state, params=None):
+            updates, new_state = inner.update(grads, state, params)
+            s = guard.lr_scale            # trace-time constant
+            if s != 1.0:
+                updates = jax.tree.map(lambda u: u * s, updates)
+            return updates, new_state
+
+        self.engine.tx = optax.GradientTransformation(inner.init, update)
+        self._tx_wrapped = True
+
+    def _set_lr_scale(self, scale: float):
+        if getattr(self.engine, "_param_offload", None) is not None:
+            # the ZeRO-Infinity fused host optimizer captured its schedule at
+            # construction and uses neither engine.tx nor engine.lr_schedule;
+            # silently "scaling" here would report a backed-off lr while
+            # updates keep applying at full rate — refuse instead so the
+            # telemetry stays truthful
+            logger.warning(
+                "step guard: lr backoff is not supported with offload_param "
+                "(fused host optimizer owns the schedule); lr unchanged")
+            return
+        self.lr_scale = float(scale)
+        base = self._base_lr_schedule
+        s = self.lr_scale
+        self._wrap_tx()
+        # the reported/host-side lr (get_lr, monitor events, the host
+        # offload optimizer's per-step lr) follows the same scale
+        self.engine.lr_schedule = (base if s == 1.0
+                                   else (lambda step: base(step) * s))
+        # the fused step closed over the old tx/schedule — force a re-trace
+        self.engine._reset_compiled_fns()
+        logger.warning(f"step guard: lr scale now {self.lr_scale:g}")
+
+    # ------------------------------------------------------------------
+    # checkpointable state (rides in client_state so backoff survives resume)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"consecutive_bad": self.consecutive_bad,
+                "total_bad": self.total_bad,
+                "good_since_backoff": self.good_since_backoff,
+                "lr_scale": self.lr_scale}
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.consecutive_bad = int(sd.get("consecutive_bad", 0))
+        self.total_bad = int(sd.get("total_bad", 0))
+        self.good_since_backoff = int(sd.get("good_since_backoff", 0))
+        scale = float(sd.get("lr_scale", 1.0))
+        if scale != self.lr_scale:
+            self._set_lr_scale(scale)

@@ -1,0 +1,166 @@
+"""Hung-step watchdog.
+
+A stuck collective (one slice preempted mid-allreduce, a wedged DMA) makes
+``train_batch`` block forever with no exception to catch — the job burns its
+reservation silently. The watchdog is a monitor thread fed step begin/end
+heartbeats; when a step overruns its deadline it (1) dumps a diagnostics
+snapshot — live Python stacks of every thread (``faulthandler``), the last
+step metrics, device memory stats — and (2) escalates per policy: ``warn``
+logs and keeps waiting; ``interrupt`` delivers SIGINT to the main thread,
+which the ``FaultTolerantRunner`` treats exactly like a preemption (final
+autosave, clean stop) — note this only reaches a main thread that still
+executes Python bytecode, i.e. host-side stalls; ``kill`` SIGKILLs the
+process from the monitor thread, which works even for a main thread wedged
+inside a native XLA collective — the snapshot is already on disk and the
+elastic agent relaunches from the last committed checkpoint.
+
+Reference analog: torchelastic's watchdog/health-check loop + the
+``py-spy``-style stack dump operators attach by hand when a job wedges.
+"""
+
+import faulthandler
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from deepspeed_tpu.resilience.config import WatchdogConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class WatchdogEvent:
+    step: int
+    elapsed_s: float
+    snapshot_path: Optional[str]
+
+
+class StepWatchdog:
+    """``begin_step``/``end_step`` bracket every engine step; the monitor
+    thread flags at most once per step index."""
+
+    def __init__(self, config: Optional[WatchdogConfig] = None,
+                 diagnostics_dir: str = "./resilience_diagnostics",
+                 on_flag: Optional[Callable[[WatchdogEvent], None]] = None,
+                 context_fn: Optional[Callable[[], dict]] = None):
+        self.cfg = config or WatchdogConfig()
+        self.diagnostics_dir = diagnostics_dir
+        self.on_flag = on_flag
+        self.context_fn = context_fn
+        self.events = []                     # flagged WatchdogEvents
+        self._lock = threading.Lock()
+        self._current: Optional[tuple] = None    # (step, start_monotonic)
+        self._flagged_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "StepWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="dstpu-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def begin_step(self, step: int):
+        with self._lock:
+            self._current = (int(step), time.monotonic())
+
+    def end_step(self):
+        with self._lock:
+            self._current = None
+
+    # ------------------------------------------------------------------
+    def _loop(self):
+        poll = max(0.05, min(self.cfg.poll_s, self.cfg.step_deadline_s / 4))
+        while not self._stop.wait(timeout=poll):
+            with self._lock:
+                cur = self._current
+            if cur is None:
+                continue
+            step, start = cur
+            elapsed = time.monotonic() - start
+            if elapsed < self.cfg.step_deadline_s or self._flagged_step == step:
+                continue
+            self._flagged_step = step
+            self._flag(step, elapsed)
+
+    def _flag(self, step: int, elapsed: float):
+        snapshot = None
+        try:
+            snapshot = self._dump_snapshot(step, elapsed)
+        except Exception:
+            logger.exception("watchdog: diagnostics snapshot failed")
+        logger.error(
+            f"watchdog: step {step} exceeded deadline "
+            f"({elapsed:.1f}s > {self.cfg.step_deadline_s:.1f}s); "
+            f"snapshot: {snapshot}")
+        event = WatchdogEvent(step=step, elapsed_s=elapsed,
+                              snapshot_path=snapshot)
+        self.events.append(event)
+        if self.on_flag is not None:
+            try:
+                self.on_flag(event)
+            except Exception:
+                logger.exception("watchdog: on_flag callback failed")
+        if self.cfg.policy == "interrupt":
+            # reaches the main thread at its next bytecode — effective for
+            # Python-level stalls; a native-code hang needs policy "kill"
+            import _thread
+            _thread.interrupt_main()
+        elif self.cfg.policy == "kill":
+            import os as _os
+            import signal as _signal
+            logger.error("watchdog: policy=kill — SIGKILL self; the "
+                         "supervisor relaunches from the last committed "
+                         "checkpoint")
+            _os.kill(_os.getpid(), _signal.SIGKILL)
+
+    def _dump_snapshot(self, step: int, elapsed: float) -> str:
+        """Diagnostics bundle for one hang: live stacks of every thread plus
+        whatever host-side context the runner wired in (last metrics, KV/HBM
+        occupancy)."""
+        d = os.path.join(self.diagnostics_dir, f"hang_step{step}")
+        os.makedirs(d, exist_ok=True)
+        stacks = os.path.join(d, "stacks.txt")
+        with open(stacks, "w") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+        context = {"step": step, "elapsed_s": elapsed,
+                   "deadline_s": self.cfg.step_deadline_s,
+                   "device_memory": _device_memory_stats()}
+        if self.context_fn is not None:
+            try:
+                context.update(self.context_fn())
+            except Exception as e:
+                context["context_error"] = repr(e)
+        with open(os.path.join(d, "context.json"), "w") as f:
+            json.dump(context, f, indent=2, default=str)
+        return d
+
+
+def _device_memory_stats() -> dict:
+    """Best-effort per-device memory stats (HBM occupancy on TPU; often
+    empty on CPU backends)."""
+    out = {}
+    try:
+        import jax
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                out[str(dev)] = {k: stats[k] for k in
+                                 ("bytes_in_use", "bytes_limit",
+                                  "peak_bytes_in_use") if k in stats}
+    except Exception:
+        pass
+    return out

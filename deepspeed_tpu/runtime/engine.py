@@ -409,6 +409,17 @@ class DeepSpeedTPUEngine:
                 warnings.warn("qgZ: " + msg, UserWarning, stacklevel=3)
                 logger.warning("qgZ: %s", msg)
 
+        # --- resilience step guard -------------------------------------------
+        # When armed, _update treats non-finite grads as an overflow in EVERY
+        # precision mode (bf16/fp32 included): update dropped, params kept,
+        # skipped_steps incremented. Armed from an explicit "resilience"
+        # config group or at runtime via set_nonfinite_guard (the
+        # FaultTolerantRunner's step-guard hook).
+        rcfg = getattr(config, "resilience", None)
+        self._guard_nonfinite = bool(
+            getattr(config, "resilience_explicit", False) and rcfg is not None
+            and rcfg.step_guard.enabled and rcfg.step_guard.policy == "skip")
+
         # --- compiled functions ----------------------------------------------
         self._reset_compiled_fns()
 
@@ -752,18 +763,20 @@ class DeepSpeedTPUEngine:
                 fp16) -> Tuple[EngineState, StepOutput]:
         """Optimizer update with overflow skip + dynamic loss scale + clipping.
         reference: stage3.py step (:2061) / fused_optimizer.py step."""
-        if fp16.enabled:
+        if fp16.enabled or self._guard_nonfinite:
             # fp16: detect overflow, neutralize non-finite grads so the (discarded)
             # update arithmetic stays clean, and skip the step (reference
             # _overflow_check_and_loss_scale_update). This single post-sum
             # check also covers per-microbatch overflow under the gas scan —
-            # IEEE non-finites are absorbing under addition.
+            # IEEE non-finites are absorbing under addition. The resilience
+            # step guard reuses the same path for bf16/fp32 (skip, no scaler).
             overflow = precision.has_inf_or_nan(grads)
             safe_grads = jax.tree.map(
                 lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads)
         else:
-            # bf16/fp32: no loss scaler in the reference either — a NaN propagates
-            # into params/loss so divergence is visible, never silently masked.
+            # bf16/fp32 without the guard: no loss scaler in the reference
+            # either — a NaN propagates into params/loss so divergence is
+            # visible, never silently masked.
             overflow = jnp.bool_(False)
             safe_grads = grads
         clipped, grad_norm = precision.clip_by_global_norm(safe_grads, clip)
@@ -786,6 +799,14 @@ class DeepSpeedTPUEngine:
         )
         return new_state, StepOutput(loss=jnp.float32(0.0), grad_norm=grad_norm,
                                      lr=lr, overflow=overflow)
+
+    @staticmethod
+    def stack_microbatches(data_iter, gas: int):
+        """Pull ``gas`` microbatches and stack every leaf to [gas, ...] —
+        THE stacked-batch contract train_batch consumes (shared with the
+        resilience runner so the two never drift)."""
+        micro = [next(data_iter) for _ in range(gas)]
+        return jax.tree.map(lambda *xs: np.stack(xs), *micro)
 
     def _shard_batch(self, batch, stacked: bool):
         """Place a host batch on the mesh: [B, ...] (or [gas, B, ...]) with B split
@@ -816,8 +837,7 @@ class DeepSpeedTPUEngine:
         if batch is None:
             if data_iter is None:
                 raise ValueError("train_batch needs data_iter or batch")
-            micro = [next(data_iter) for _ in range(gas)]
-            batch = jax.tree.map(lambda *xs: np.stack(xs), *micro)
+            batch = self.stack_microbatches(data_iter, gas)
         elif gas == 1 and not stacked:
             # deterministic rule (no shape-guessing): gas==1 batches are unstacked
             # unless the caller says otherwise
@@ -907,7 +927,8 @@ class DeepSpeedTPUEngine:
                 loss, grads = grads_phase(params, stacked_batch, rngs, scale)
                 grads = jax.tree.map(
                     lambda g: g.astype(jnp.float32) / (scale * gas), grads)
-                overflow = precision.has_inf_or_nan(grads) if fp16.enabled \
+                overflow = precision.has_inf_or_nan(grads) \
+                    if (fp16.enabled or self._guard_nonfinite) \
                     else jnp.bool_(False)
                 if cfg.gradient_clipping > 0:
                     grads, norm = precision.clip_by_global_norm(
@@ -957,6 +978,19 @@ class DeepSpeedTPUEngine:
                 loss_scale=new_scale)
         self._record_metrics(StepOutput(loss=loss, grad_norm=norm,
                                         lr=jnp.float32(lr), overflow=overflow))
+
+    def set_nonfinite_guard(self, enabled: bool = True) -> None:
+        """Arm/disarm the resilience step guard: with it armed, non-finite
+        grads are treated exactly like an fp16 overflow in every precision
+        mode — the update is dropped, params stay at the last good step, and
+        ``skipped_steps`` increments (reference: CheckOverflow generalized
+        past the loss scaler). Toggling re-traces the compiled step."""
+        enabled = bool(enabled)
+        if self._guard_nonfinite != enabled:
+            self._guard_nonfinite = enabled
+            self._reset_compiled_fns()
+            log_dist(f"non-finite step guard {'armed' if enabled else 'off'}",
+                     ranks=[0])
 
     def start_profile_trace(self, log_dir: str) -> None:
         """Start an XLA/TPU profiler trace (reference: NVTX ranges + torch
@@ -1138,7 +1172,8 @@ class DeepSpeedTPUEngine:
                     grads = jax.tree.map(
                         lambda g: g.astype(jnp.float32) / (scale * gas), grad_sum)
                     overflow = precision.has_inf_or_nan(grads) \
-                        if cfg.fp16.enabled else jnp.bool_(False)
+                        if (cfg.fp16.enabled or self._guard_nonfinite) \
+                        else jnp.bool_(False)
                     if cfg.gradient_clipping > 0:
                         grads, norm = precision.clip_by_global_norm(
                             grads, cfg.gradient_clipping)

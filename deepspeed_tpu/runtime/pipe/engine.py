@@ -192,7 +192,9 @@ class PipelineEngine:
         after the array write)."""
         import orbax.checkpoint as ocp
 
-        from deepspeed_tpu.checkpoint.engine import LATEST_FILE, _ckpt_dir
+        from deepspeed_tpu.checkpoint.engine import (_ckpt_dir,
+                                                     _commit_latest,
+                                                     write_manifest)
         tag = tag if tag is not None else f"global_step{self.global_steps}"
         path = _ckpt_dir(save_dir, tag)
         ckptr = ocp.StandardCheckpointer()
@@ -206,9 +208,20 @@ class PipelineEngine:
         ckptr.wait_until_finished()
         ckptr.close()
         if jax.process_index() == 0:
-            with open(os.path.join(os.path.abspath(save_dir),
-                                   LATEST_FILE), "w") as f:
-                f.write(tag)
+            # same committed-checkpoint contract as the main engine: the
+            # commit-detection tooling (is_committed / dstpu_report --ckpt /
+            # resume discovery) keys on ds_meta.json + the manifest, so a
+            # pipeline checkpoint must carry them too or it reads as torn
+            import json as _json
+            with open(os.path.join(path, "ds_meta.json"), "w") as f:
+                _json.dump({"global_steps": self.global_steps}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            write_manifest(path, extra_meta={
+                "tag": tag, "global_steps": self.global_steps})
+            # atomic tmp+fsync+rename commit (same crash-safety contract as
+            # the main engine's checkpoint path)
+            _commit_latest(save_dir, tag)
         return path
 
     def load_checkpoint(self, load_dir: str, tag=None) -> str:

@@ -87,6 +87,7 @@ class InferenceServer:
         self._inflight: Dict[int, Request] = {}  # uid -> engine-resident
         self._draining = False
         self._stopped = False
+        self._degraded: Optional[str] = None   # sticky engine-failure reason
         self._wake = threading.Event()         # submit() nudges the loop
         self._thread: Optional[threading.Thread] = None
 
@@ -156,12 +157,21 @@ class InferenceServer:
     def health(self) -> dict:
         with self._lock:
             queued, inflight = len(self._queue), len(self._inflight)
+            degraded = self._degraded
         state = ("stopped" if self._stopped else
+                 # an engine-step failure means the KV/sequence state is
+                 # suspect: report unhealthy (503 at /healthz) so load
+                 # balancers stop routing here — sticky until the engine is
+                 # replaced (drain + recreate), not self-clearing
+                 "degraded" if degraded else
                  "draining" if self._draining else
                  "serving" if self.running else "not_started")
-        return {"status": state, "ok": state == "serving",
-                "queued": queued, "inflight": inflight,
-                "kv_occupancy": self.engine.kv_occupancy()}
+        out = {"status": state, "ok": state == "serving",
+               "queued": queued, "inflight": inflight,
+               "kv_occupancy": self.engine.kv_occupancy()}
+        if degraded:
+            out["degraded_reason"] = degraded
+        return out
 
     # ------------------------------------------------------------------
     # admission
@@ -200,6 +210,12 @@ class InferenceServer:
             if self._draining or self._stopped:
                 raise ServerClosedError("server is draining; not accepting "
                                         "new requests")
+            if self._degraded:
+                # new work on a suspect engine would fail anyway — refuse at
+                # the door (503) until the replica is drained and replaced
+                raise ServerClosedError(
+                    f"server degraded ({self._degraded}); not accepting "
+                    "new requests")
             if len(self._queue) >= cfg.max_queue_depth:
                 self.metrics.on_reject()
                 raise BackpressureError(
@@ -246,11 +262,14 @@ class InferenceServer:
                 return
             try:
                 worked = self._serve_once()
-            except _EngineStepError:
+            except _EngineStepError as e:
                 # the KV cache / sequence state may be inconsistent after a
                 # failed step: every engine-resident request is compromised
+                # and the replica must stop advertising itself healthy
                 logger.exception("serve loop: engine step failed; failing "
                                  "in-flight requests")
+                with self._lock:
+                    self._degraded = f"engine step failed: {e}"
                 self._fail_all("engine step raised")
                 worked = False
             except Exception:

@@ -116,3 +116,41 @@ def test_bench_watchdog_emits_stale_banked_headline(tmp_path):
         "guard_device_discovery('bench_decode', timeout=0.2)\n"
         "time.sleep(10)\n")], capture_output=True, text=True, cwd=repo, env=env)
     assert out3.returncode == 3 and not out3.stdout.strip()
+
+
+def test_env_report_checkpoint_status(tmp_path, capsys):
+    """dstpu_report --ckpt: latest pointer + per-tag committed/verified/torn
+    status for a run dir (the resume-or-not triage view)."""
+    import json as _json
+    import os as _os
+
+    from deepspeed_tpu.checkpoint.engine import write_manifest, _commit_latest
+    from deepspeed_tpu.env_report import checkpoint_report
+
+    run = tmp_path / "run"
+    # committed + verified tag
+    good = run / "global_step2"
+    good.mkdir(parents=True)
+    (good / "ds_meta.json").write_text(_json.dumps({"global_steps": 2}))
+    write_manifest(str(good))
+    _commit_latest(str(run), "global_step2")
+    # newer tag, committed but then corrupted (torn)
+    torn = run / "global_step4"
+    torn.mkdir()
+    (torn / "ds_meta.json").write_text(_json.dumps({"global_steps": 4}))
+    (torn / "data.bin").write_bytes(b"abcdef")
+    write_manifest(str(torn))
+    (torn / "data.bin").write_bytes(b"ABCDEF")
+    _commit_latest(str(run), "global_step4")
+    # uncommitted junk tag
+    (run / "global_step9").mkdir()
+
+    summary, tags = checkpoint_report(str(run))
+    summary = dict(summary)
+    assert summary["latest pointer"] == "global_step4"
+    # resume skips the torn tag and falls back to the clean one
+    assert summary["resume_from_latest would load"] == "global_step2"
+    status = {t.split(" ")[0]: s for t, s in tags}
+    assert "TORN" in status["global_step4"]
+    assert "committed + verified" in status["global_step2"]
+    assert "uncommitted" in status["global_step9"]

@@ -133,7 +133,7 @@ def test_elastic_agent_restarts_on_failure():
                           "micro_batch_sizes": [2, 4], "min_gpus": 1,
                           "max_gpus": 8, "version": 0.1}}
     spec = WorkerSpec(cmd=["python", "train.py"], max_restarts=3,
-                      monitor_interval_s=0.01)
+                      monitor_interval_s=0.01, restart_backoff_s=0.0)
     agent = ElasticAgent(spec, cfg,
                          host_provider=lambda: ["h0", "h1"], popen=fake_popen)
     assert agent.run() == 0
@@ -151,10 +151,112 @@ def test_elastic_agent_budget_exhausted():
     cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
                           "micro_batch_sizes": [2], "min_gpus": 1,
                           "max_gpus": 8, "version": 0.1}}
-    spec = WorkerSpec(cmd=["x"], max_restarts=2, monitor_interval_s=0.01)
+    spec = WorkerSpec(cmd=["x"], max_restarts=2, monitor_interval_s=0.01,
+                      restart_backoff_s=0.0)
     agent = ElasticAgent(spec, cfg, popen=always_fail)
     assert agent.run() == 2
     assert agent.restart_count == 3  # budget (2) + the final attempt
+    assert agent.crash_restarts == 3
+
+
+class _ScriptedProc:
+    """Fake Popen whose poll() walks a code script then repeats the final
+    value (unlike _FakeProc, safe to poll any number of times). terminate()
+    is a no-op unless ``term_exits``; kill() always lands."""
+
+    def __init__(self, codes, term_exits=False):
+        self.codes = list(codes)
+        self.last = None
+        self.terminated = False
+        self.killed = False
+        self.term_exits = term_exits
+
+    def poll(self):
+        if self.codes:
+            self.last = self.codes.pop(0)
+        return self.last
+
+    def terminate(self):
+        self.terminated = True
+        if self.term_exits and self.last is None:
+            self.last = -15
+            self.codes = []
+
+    def wait(self, timeout=None):
+        if self.poll() is None:
+            raise subprocess.TimeoutExpired(cmd="x", timeout=timeout or 0)
+        return self.last
+
+    def kill(self):
+        self.killed = True
+        self.last = -9
+        self.codes = []
+
+
+def _agent_cfg():
+    return {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                           "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                           "max_gpus": 8, "version": 0.1}}
+
+
+def test_terminate_all_escalates_sigterm_to_sigkill():
+    """One hung worker (ignores SIGTERM) must not block group teardown: the
+    agent SIGKILLs it after the grace window."""
+    spec = WorkerSpec(cmd=["x"], term_grace_s=0.05)
+    agent = ElasticAgent(spec, _agent_cfg(), popen=lambda *a, **k: None)
+    polite = _ScriptedProc([None], term_exits=True)
+    hung = _ScriptedProc([None], term_exits=False)
+    agent.procs = [polite, hung]
+    agent._terminate_all()
+    assert polite.terminated and not polite.killed
+    assert hung.terminated and hung.killed
+
+
+def test_preemption_exits_do_not_consume_restart_budget():
+    """SIGTERM deaths are platform churn, not crashes: with a crash budget
+    of ZERO the agent still relaunches through two preemptions, and the
+    relaunch env carries DSTPU_RESUME=latest."""
+    launches = []
+
+    def popen(cmd, env=None):
+        launches.append(env)
+        gen = int(env["DSTPU_ELASTIC_RESTART"])
+        return _ScriptedProc([None, -15] if gen < 2 else [0])
+
+    spec = WorkerSpec(cmd=["x"], max_restarts=0, monitor_interval_s=0.01,
+                      term_grace_s=0.05, restart_backoff_s=0.0)
+    agent = ElasticAgent(spec, _agent_cfg(), popen=popen)
+    assert agent.run() == 0
+    assert agent.restart_count == 2      # two relaunches happened...
+    assert agent.crash_restarts == 0     # ...none charged to the budget
+    assert "DSTPU_RESUME" not in launches[0]
+    assert launches[1]["DSTPU_RESUME"] == "latest"
+    assert launches[2]["DSTPU_RESUME"] == "latest"
+
+
+def test_mixed_exit_vector_counts_as_crash():
+    """A generation where ANY worker crashed is a crash, even if another
+    worker died by SIGTERM."""
+    spec = WorkerSpec(cmd=["x"])
+    agent = ElasticAgent(spec, _agent_cfg(), popen=lambda *a, **k: None)
+    agent._last_codes = [-15, 1]
+    assert not agent._is_preemption(1)
+    agent._last_codes = [-15, None]      # other worker still running
+    assert agent._is_preemption(-15)
+    agent._last_codes = [130, 143]       # shell-convention SIGINT/SIGTERM
+    assert agent._is_preemption(143)
+    agent._last_codes = [-9]             # SIGKILL (OOM killer) = crash
+    assert not agent._is_preemption(-9)
+
+
+def test_crash_backoff_exponential_and_capped():
+    spec = WorkerSpec(cmd=["x"], restart_backoff_s=1.0,
+                      restart_backoff_max_s=5.0)
+    agent = ElasticAgent(spec, _agent_cfg())
+    for streak, expected in [(0, 0.0), (1, 1.0), (2, 2.0), (3, 4.0),
+                             (4, 5.0), (10, 5.0)]:
+        agent.consecutive_crashes = streak
+        assert agent._crash_backoff_s() == expected
 
 
 @pytest.mark.slow
@@ -215,3 +317,19 @@ def test_elastic_kill_and_resume_end_to_end(tmp_path):
     # the restart boundary within tolerance
     assert g1[0]["loss"] < g0[0]["loss"] * 1.05
     assert g1[-1]["loss"] < g0[0]["loss"]
+
+
+def test_total_restart_backstop_bounds_preemption_loops():
+    """Preemptions don't consume the crash budget, but max_total_restarts
+    still bounds a worker that always dies preemption-shaped — the agent
+    must not spin forever."""
+    def popen(cmd, env=None):
+        return _ScriptedProc([-15])
+
+    spec = WorkerSpec(cmd=["x"], max_restarts=0, max_total_restarts=3,
+                      monitor_interval_s=0.01, term_grace_s=0.05,
+                      restart_backoff_s=0.0)
+    agent = ElasticAgent(spec, _agent_cfg(), popen=popen)
+    assert agent.run() == -15
+    assert agent.restart_count == 4      # 3 allowed relaunches + the breaker
+    assert agent.crash_restarts == 0     # still not charged as crashes

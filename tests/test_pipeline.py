@@ -345,6 +345,13 @@ def test_llama_pipe_module_via_initialize(flavor, tmp_path):
     assert np.isfinite(ev) and ev < ref_loss    # trained -> lower loss
     d = str(tmp_path)
     engine.save_checkpoint(d)
+    # pipeline checkpoints carry the same committed-checkpoint contract as
+    # the main engine (ds_meta.json + integrity manifest + atomic latest),
+    # so the resilience tooling recognizes them
+    from deepspeed_tpu.checkpoint.engine import is_committed
+    from deepspeed_tpu.resilience import find_latest_committed
+    assert find_latest_committed(d) is not None
+    assert is_committed(d, find_latest_committed(d))
     engine.train_batch(tokens)              # diverge past the checkpoint
     engine.load_checkpoint(d)
     e_after = engine.eval_batch(tokens)

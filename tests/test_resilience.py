@@ -1,0 +1,538 @@
+"""Resilience subsystem tests: the seed-pinned chaos suite.
+
+Every injected fault here is deterministic (``ChaosConfig`` rolls are pure
+functions of (seed, kind, step)), so this suite runs in tier-1 by default
+(``chaos`` marker) and asserts *exact* recovery behavior:
+
+  - NaN steps    -> engine-level skip, params stay clean, lr backs off,
+                    quarantine aborts with a diagnostic bundle
+  - ckpt I/O     -> save retries with backoff and commits; torn checkpoints
+                    (checksum-mismatched or uncommitted) are NEVER loaded
+  - preemption   -> SIGTERM triggers an atomic autosave; resume restores a
+                    run whose loss/step/lr/curriculum state matches an
+                    uninterrupted baseline bit-for-bit
+  - hung steps   -> watchdog flags past-deadline steps and dumps stacks
+"""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.engine import (CheckpointCorruptionError,
+                                             MANIFEST_FILE, is_committed)
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+from deepspeed_tpu.resilience import (BadStepError, ChaosConfig, ChaosMonkey,
+                                      CheckpointSaveError, FaultTolerantRunner,
+                                      QuarantineError, ResilienceConfig,
+                                      find_latest_committed, list_tags)
+
+pytestmark = pytest.mark.chaos
+
+CFG = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+}
+
+
+def _engine(seed=1, extra=None):
+    cfg = dict(CFG)
+    if extra:
+        cfg.update(extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=cfg,
+        example_batch=random_batch(4), seed=seed)
+    return engine
+
+
+def _rc(tmp_path, **kw):
+    kw.setdefault("diagnostics_dir", str(tmp_path / "diag"))
+    kw.setdefault("autosave", {})
+    kw["autosave"].setdefault("io_backoff_s", 0.01)
+    return ResilienceConfig(**kw)
+
+
+def _runner(engine, tmp_path, rc=None, chaos=None, **rc_kw):
+    return FaultTolerantRunner(
+        engine, save_dir=str(tmp_path / "ckpt"),
+        config=rc if rc is not None else _rc(tmp_path, **rc_kw),
+        chaos=chaos)
+
+
+def _params_finite(engine) -> bool:
+    return all(bool(np.isfinite(np.asarray(jax.device_get(p))).all())
+               for p in jax.tree.leaves(engine.state.params))
+
+
+def _batch_fn(step):
+    return random_batch(8, seed=step)
+
+
+# ---------------------------------------------------------------------------
+# step guards
+# ---------------------------------------------------------------------------
+def test_nan_step_skipped_params_clean_lr_backoff(tmp_path):
+    """An injected NaN batch is detected on-device (overflow path), the
+    update is dropped, params stay finite, and the guard backs the lr off."""
+    engine = _engine()
+    chaos = ChaosMonkey(ChaosConfig(seed=7, nan_steps=frozenset({2})))
+    with _runner(engine, tmp_path, chaos=chaos,
+                 step_guard={"backoff_after": 1, "quarantine_after": 0},
+                 ) as runner:
+        base_lr = engine.get_lr()[0]
+        result = runner.run(num_steps=5, batch_fn=_batch_fn)
+    assert result.stop_reason == "completed"
+    assert result.steps_completed == 5
+    assert chaos.injected["nan"] == 1
+    assert engine.skipped_steps == 1          # the bad update never applied
+    assert _params_finite(engine)
+    assert np.isfinite(result.last_loss)
+    # one bad step at backoff_after=1 -> lr halved, then counter reset
+    assert runner.guard.lr_scale == pytest.approx(0.5)
+    assert engine.get_lr()[0] == pytest.approx(base_lr * 0.5, rel=1e-6)
+    assert runner.guard.consecutive_bad == 0
+    assert runner.guard.total_bad == 1
+
+
+def test_consecutive_nans_quarantine_with_bundle(tmp_path):
+    engine = _engine()
+    chaos = ChaosMonkey(ChaosConfig(seed=1, nan_prob=1.0))  # every step bad
+    runner = _runner(engine, tmp_path, chaos=chaos,
+                     step_guard={"backoff_after": 0, "quarantine_after": 3})
+    with pytest.raises(QuarantineError) as ei:
+        runner.run(num_steps=10, batch_fn=_batch_fn)
+    runner.close()
+    assert engine.skipped_steps == 3          # every bad step was still skipped
+    assert _params_finite(engine)             # quarantined, not poisoned
+    bundle = ei.value.bundle_path
+    assert bundle and os.path.isdir(bundle)
+    with open(os.path.join(bundle, "diag.json")) as f:
+        diag = json.load(f)
+    assert diag["reason"] == "quarantine"
+    assert diag["guard"]["consecutive_bad"] == 3
+    assert len(diag["history"]) == 3
+    assert os.path.exists(os.path.join(bundle, "stacks.txt"))
+
+
+def test_abort_policy_raises_on_first_bad_step(tmp_path):
+    engine = _engine()
+    chaos = ChaosMonkey(ChaosConfig(seed=1, nan_steps=frozenset({1})))
+    runner = _runner(engine, tmp_path, chaos=chaos,
+                     step_guard={"policy": "abort"})
+    with pytest.raises(BadStepError):
+        runner.run(num_steps=5, batch_fn=_batch_fn)
+    runner.close()
+    # the abort bundle exists too
+    diags = os.listdir(tmp_path / "diag")
+    assert any(d.startswith("abort_step") for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint I/O retry + torn-checkpoint protection
+# ---------------------------------------------------------------------------
+def test_ckpt_io_failure_retried_then_committed(tmp_path):
+    engine = _engine()
+    chaos = ChaosMonkey(ChaosConfig(seed=2, ckpt_fail_first=2))
+    with _runner(engine, tmp_path, chaos=chaos,
+                 autosave={"every_steps": 2, "io_retries": 3,
+                           "io_backoff_s": 0.01}) as runner:
+        runner.run(num_steps=2, batch_fn=_batch_fn)
+    assert chaos.injected["ckpt"] == 2        # two injected failures consumed
+    ckpt_dir = str(tmp_path / "ckpt")
+    tag = find_latest_committed(ckpt_dir)
+    assert tag == "global_step2"
+    assert is_committed(ckpt_dir, tag)
+    assert os.path.exists(os.path.join(ckpt_dir, tag, MANIFEST_FILE))
+
+
+def test_ckpt_retry_budget_exhausted_raises(tmp_path):
+    engine = _engine()
+    chaos = ChaosMonkey(ChaosConfig(seed=2, ckpt_fail_first=99))
+    runner = _runner(engine, tmp_path, chaos=chaos,
+                     autosave={"io_retries": 2, "io_backoff_s": 0.01})
+    runner.run(num_steps=1, batch_fn=_batch_fn)
+    with pytest.raises(CheckpointSaveError):
+        runner.save(reason="manual")
+    runner.close()
+    assert find_latest_committed(str(tmp_path / "ckpt")) is None
+
+
+def test_torn_checkpoint_never_loaded_falls_back(tmp_path):
+    """Corrupting the newest committed tag (post-commit bit rot / torn
+    write) must fail verification and resume from the older clean tag —
+    the 'latest' pointer is a hint, not trusted."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    engine = _engine(seed=1)
+    with _runner(engine, tmp_path, autosave={"every_steps": 2}) as runner:
+        runner.run(num_steps=4, batch_fn=_batch_fn)
+    assert list_tags(ckpt_dir) == ["global_step4", "global_step2"]
+
+    # corrupt a manifest-listed file of the newest tag
+    newest = os.path.join(ckpt_dir, "global_step4")
+    with open(os.path.join(newest, MANIFEST_FILE)) as f:
+        victim = sorted(json.load(f)["files"])[0]
+    with open(os.path.join(newest, victim), "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+
+    # direct load of the torn tag refuses
+    probe = _engine(seed=9)
+    with pytest.raises(CheckpointCorruptionError):
+        probe.load_checkpoint(ckpt_dir, tag="global_step4")
+
+    # discovery skips it even though 'latest' points at it
+    assert (tmp_path / "ckpt" / "latest").read_text() == "global_step4"
+    assert find_latest_committed(ckpt_dir) == "global_step2"
+
+    fresh = _engine(seed=5)
+    runner2 = _runner(fresh, tmp_path)
+    tag = runner2.resume_from_latest()
+    runner2.close()
+    assert tag == "global_step2"
+    assert fresh.global_steps == 2
+
+
+def test_uncommitted_tag_ignored(tmp_path):
+    """A tag dir without a commit (crash mid-save: arrays written, sidecars/
+    manifest never landed) is invisible to resume."""
+    ckpt_dir = tmp_path / "ckpt"
+    engine = _engine()
+    with _runner(engine, tmp_path) as runner:
+        runner.run(num_steps=1, batch_fn=_batch_fn)
+        runner.save(reason="manual")
+    # fabricate a newer, uncommitted tag (no ds_meta.json / manifest)
+    (ckpt_dir / "global_step99").mkdir()
+    (ckpt_dir / "global_step99" / "junk.bin").write_bytes(b"x" * 16)
+    assert find_latest_committed(str(ckpt_dir)) == "global_step1"
+
+
+def test_autosave_cadence_and_prune(tmp_path):
+    engine = _engine()
+    with _runner(engine, tmp_path,
+                 autosave={"every_steps": 1, "keep_last": 2}) as runner:
+        runner.run(num_steps=5, batch_fn=_batch_fn)
+    ckpt_dir = str(tmp_path / "ckpt")
+    tags = list_tags(ckpt_dir)
+    assert tags == ["global_step5", "global_step4"]   # pruned to keep_last
+    assert find_latest_committed(ckpt_dir) == "global_step5"
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> autosave -> resume parity (save→kill→resume)
+# ---------------------------------------------------------------------------
+CURRICULUM_CFG = {
+    "curriculum_learning": {
+        "enabled": True, "curriculum_type": "seqlen",
+        "min_difficulty": 2, "max_difficulty": 8,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 8, "difficulty_step": 2},
+    },
+    "scheduler": {"type": "WarmupDecayLR",
+                  "params": {"warmup_num_steps": 2, "total_num_steps": 12,
+                             "warmup_max_lr": 1e-2}},
+}
+
+
+def _trajectory(engine, start, stop):
+    """Per-step (loss, lr) + final (step, seqlen) fingerprints."""
+    out = []
+    for step in range(start, stop):
+        loss = float(engine.train_batch(batch=_batch_fn(step)))
+        out.append((loss, engine.get_lr()[0]))
+    return out
+
+
+def test_sigterm_autosave_then_resume_matches_uninterrupted(tmp_path):
+    """The acceptance scenario: a SIGTERM mid-run commits an autosave; the
+    relaunched run restores engine + lr-schedule + curriculum state and its
+    loss trajectory matches an uninterrupted baseline step for step."""
+    total = 6
+    # --- baseline: uninterrupted ---------------------------------------
+    base = _engine(seed=1, extra=CURRICULUM_CFG)
+    base_traj = _trajectory(base, 0, total)
+    base_seqlen = base.curriculum_seqlen()
+
+    # --- interrupted: SIGTERM arrives during step 3 --------------------
+    victim = _engine(seed=1, extra=CURRICULUM_CFG)
+    runner = _runner(victim, tmp_path)
+    fired = []
+
+    def preempting_batches(step):
+        if step == 3 and not fired:
+            fired.append(step)
+            os.kill(os.getpid(), signal.SIGTERM)   # delivered this step
+        return _batch_fn(step)
+
+    result = runner.run(num_steps=total, batch_fn=preempting_batches)
+    runner.close()
+    assert result.stop_reason == "preempted"
+    assert result.steps_completed == 4            # step 3 completed, then stop
+    saved = find_latest_committed(str(tmp_path / "ckpt"))
+    assert saved == "global_step4"
+
+    # --- relaunch: fresh process state, different init seed ------------
+    resumed = _engine(seed=42, extra=CURRICULUM_CFG)
+    runner2 = _runner(resumed, tmp_path)
+    tag = runner2.resume_from_latest()
+    assert tag == "global_step4"
+    assert resumed.global_steps == 4
+    assert int(jax.device_get(resumed.state.step)) == 4
+    # lr schedule position restored exactly
+    assert resumed.get_lr()[0] == pytest.approx(
+        victim.get_lr()[0], rel=1e-7)
+    # curriculum/data-schedule state restored exactly
+    assert resumed.curriculum_seqlen() == victim.curriculum_seqlen()
+
+    resumed_traj = _trajectory(resumed, 4, total)
+    runner2.close()
+    # post-resume trajectory identical to the uninterrupted baseline
+    for (bl, blr), (rl, rlr) in zip(base_traj[4:], resumed_traj):
+        assert abs(bl - rl) < 1e-6
+        assert rlr == pytest.approx(blr, rel=1e-7)
+    assert resumed.global_steps == total
+    assert resumed.curriculum_seqlen() == base_seqlen
+
+
+def test_guard_state_survives_resume(tmp_path):
+    """lr backoff must not reset on restart — a crash-loop would otherwise
+    retry at the lr that was melting the run."""
+    engine = _engine()
+    chaos = ChaosMonkey(ChaosConfig(seed=7, nan_steps=frozenset({1})))
+    with _runner(engine, tmp_path, chaos=chaos,
+                 step_guard={"backoff_after": 1, "quarantine_after": 0},
+                 ) as runner:
+        runner.run(num_steps=3, batch_fn=_batch_fn)
+        assert runner.guard.lr_scale == pytest.approx(0.5)
+        runner.save(reason="manual")
+
+    fresh = _engine(seed=3)
+    runner2 = _runner(fresh, tmp_path)
+    runner2.resume_from_latest()
+    base_lr = 1e-2
+    assert runner2.guard.lr_scale == pytest.approx(0.5)
+    assert fresh.get_lr()[0] == pytest.approx(base_lr * 0.5, rel=1e-6)
+    runner2.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_flags_hung_step_with_snapshot(tmp_path):
+    engine = _engine()
+    # warm the compile cache so only the chaos stall (not XLA tracing) can
+    # cross the tight test deadline; guard off so nothing re-traces mid-run
+    engine.train_batch(batch=_batch_fn(0))
+    chaos = ChaosMonkey(ChaosConfig(seed=5, slow_steps=frozenset({2}),
+                                    slow_s=0.6))
+    with _runner(engine, tmp_path, chaos=chaos,
+                 step_guard={"enabled": False},
+                 watchdog={"enabled": True, "step_deadline_s": 0.2,
+                           "poll_s": 0.05}) as runner:
+        runner.run(num_steps=3, batch_fn=_batch_fn)
+        events = list(runner.watchdog.events)
+    assert chaos.injected["slow"] == 1
+    assert len(events) == 1
+    assert events[0].step == 2
+    assert events[0].elapsed_s >= 0.2
+    snap = events[0].snapshot_path
+    assert snap and os.path.isdir(snap)
+    with open(os.path.join(snap, "context.json")) as f:
+        ctx = json.load(f)
+    assert ctx["step"] == 2
+    assert "history_tail" in ctx
+    stacks = open(os.path.join(snap, "stacks.txt")).read()
+    assert "Thread" in stacks or "Current thread" in stacks
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+def test_resilience_config_via_engine_json(tmp_path):
+    """The "resilience" config group arms the engine guard and drives the
+    runner without a separate config object."""
+    engine = _engine(extra={"resilience": {
+        "step_guard": {"backoff_after": 1},
+        "autosave": {"every_steps": 2, "io_backoff_s": 0.01},
+        "diagnostics_dir": str(tmp_path / "diag"),
+    }})
+    assert engine._guard_nonfinite                 # armed at init
+    chaos = ChaosMonkey(ChaosConfig(seed=11, nan_steps=frozenset({0})))
+    runner = FaultTolerantRunner(engine, save_dir=str(tmp_path / "ckpt"),
+                                 chaos=chaos)     # config resolved from engine
+    result = runner.run(num_steps=3, batch_fn=_batch_fn)
+    runner.close()
+    assert result.steps_completed == 3
+    assert engine.skipped_steps == 1
+    assert runner.cfg.autosave.every_steps == 2
+    assert find_latest_committed(str(tmp_path / "ckpt")) is not None
+
+
+def test_resilience_monitor_events(tmp_path):
+    """Bad steps and saves fan resilience gauges out through the engine's
+    monitor (skipped steps, lr scale, checkpoints saved)."""
+    engine = _engine(extra={"csv_monitor": {"enabled": True,
+                                            "output_path": str(tmp_path / "mon"),
+                                            "job_name": "res"}})
+    chaos = ChaosMonkey(ChaosConfig(seed=7, nan_steps=frozenset({1})))
+    with _runner(engine, tmp_path, chaos=chaos,
+                 step_guard={"backoff_after": 1, "quarantine_after": 0},
+                 ) as runner:
+        runner.run(num_steps=3, batch_fn=_batch_fn)
+        runner.save(reason="manual")
+    names = {p.stem for p in (tmp_path / "mon" / "res").glob("*.csv")}
+    assert "Train_Resilience_skipped_steps" in names
+    assert "Train_Resilience_lr_scale" in names
+    assert "Train_Resilience_checkpoints_saved" in names
+
+
+def test_lr_backoff_scales_the_actual_update(tmp_path):
+    """Backoff must reach the REAL optimizer update (regression: the lr
+    schedule is baked into the optax chain at engine construction, so
+    rescaling only the reported schedule would silently keep training at
+    full rate). First-step Adam updates scale ~linearly with lr."""
+    a = _engine(seed=1)
+    b = _engine(seed=1)
+    p0 = [np.asarray(x) for x in jax.tree.leaves(jax.device_get(a.state.params))]
+    ra = _runner(a, tmp_path)
+    rb = _runner(b, tmp_path)
+    rb.guard._set_lr_scale(0.5)
+    batch = _batch_fn(0)
+    ra.step(batch=batch)
+    rb.step(batch=batch)
+    ra.close()
+    rb.close()
+
+    def delta(engine):
+        now = [np.asarray(x) for x in
+               jax.tree.leaves(jax.device_get(engine.state.params))]
+        return np.sqrt(sum(float(np.sum((n - o) ** 2))
+                           for n, o in zip(now, p0)))
+
+    da, db = delta(a), delta(b)
+    assert da > 0
+    assert db == pytest.approx(da * 0.5, rel=0.05)
+
+
+def test_close_disarms_guard_unless_config_armed(tmp_path):
+    """Runner close restores default bf16/fp32 NaN semantics — unless the
+    engine's own config armed the guard explicitly."""
+    engine = _engine()
+    runner = _runner(engine, tmp_path)
+    assert engine._guard_nonfinite
+    runner.close()
+    assert not engine._guard_nonfinite
+
+    armed = _engine(extra={"resilience": {}})
+    assert armed._guard_nonfinite
+    runner2 = FaultTolerantRunner(armed, save_dir=str(tmp_path / "ckpt2"))
+    runner2.close()
+    assert armed._guard_nonfinite          # config-armed: stays armed
+
+
+def test_chaos_die_once_spares_resumed_worker(monkeypatch):
+    """A relaunched worker (DSTPU_RESUME set by the agent) is spared by
+    die_once, so kill->restart->resume completes instead of crash-looping."""
+    died = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: died.append(sig))
+    m = ChaosMonkey(ChaosConfig(die_step=3))
+    monkeypatch.delenv("DSTPU_RESUME", raising=False)
+    m.maybe_die(2)
+    assert not died
+    m.maybe_die(3)
+    assert len(died) == 1                  # first life: killed
+    monkeypatch.setenv("DSTPU_RESUME", "latest")
+    m.maybe_die(3)
+    m.maybe_die(10)
+    assert len(died) == 1                  # relaunched life: spared
+
+
+def test_fp16_scaler_overflows_not_counted_as_bad_steps(tmp_path):
+    """Routine fp16 loss-scale-search overflows (finite loss/grad-norm,
+    overflow flag set) belong to the dynamic scaler, not the guard — they
+    must not drive lr backoff or quarantine on a healthy run."""
+    fp16_engine = _engine(extra={"fp16": {"enabled": True,
+                                          "initial_scale_power": 6}})
+    runner = _runner(fp16_engine, tmp_path,
+                     step_guard={"backoff_after": 1, "quarantine_after": 2})
+    # overflow-only: the scaler's domain
+    assert runner.guard.observe(2.0, {"grad_norm": 1.0, "overflow": True}) \
+        is False
+    assert runner.guard.consecutive_bad == 0
+    assert runner.guard.lr_scale == 1.0
+    # a genuinely non-finite loss still counts, fp16 or not
+    assert runner.guard.observe(float("nan"),
+                                {"grad_norm": 1.0, "overflow": True}) is True
+    assert runner.guard.consecutive_bad == 1
+    runner.close()
+
+    fp32_engine = _engine()
+    runner32 = _runner(fp32_engine, tmp_path,
+                       step_guard={"backoff_after": 0, "quarantine_after": 0})
+    # without a scaler, overflow means non-finite grads -> bad
+    assert runner32.guard.observe(2.0, {"grad_norm": 1.0, "overflow": True}) \
+        is True
+    runner32.close()
+
+
+def test_keyboard_interrupt_in_batch_fn_gets_preemption_contract(tmp_path):
+    """A KeyboardInterrupt landing OUTSIDE step() (in batch_fn / the loop
+    head) still yields the preemption contract: autosave + RunResult, never
+    an uncaught escape from run()."""
+    engine = _engine()
+    runner = _runner(engine, tmp_path)
+
+    def interrupting_batches(step):
+        if step == 2:
+            raise KeyboardInterrupt
+        return _batch_fn(step)
+
+    result = runner.run(num_steps=5, batch_fn=interrupting_batches)
+    runner.close()
+    assert result.stop_reason == "preempted"
+    assert result.steps_completed == 2
+    assert find_latest_committed(str(tmp_path / "ckpt")) == "global_step2"
+
+
+def test_maybe_resume_honors_relaunch_marker(tmp_path, monkeypatch):
+    """maybe_resume(): the worker-side half of the agent's DSTPU_RESUME
+    contract — fresh launches start clean, relaunches resume."""
+    engine = _engine()
+    with _runner(engine, tmp_path) as runner:
+        runner.run(num_steps=2, batch_fn=_batch_fn)
+        runner.save(reason="manual")
+
+    fresh = _engine(seed=9)
+    runner2 = _runner(fresh, tmp_path)
+    monkeypatch.delenv("DSTPU_RESUME", raising=False)
+    assert runner2.maybe_resume() is None
+    assert fresh.global_steps == 0
+    monkeypatch.setenv("DSTPU_RESUME", "latest")
+    assert runner2.maybe_resume() == "global_step2"
+    assert fresh.global_steps == 2
+    runner2.close()
+
+
+def test_resume_falls_back_past_tag_torn_before_manifest(tmp_path):
+    """A tag torn BEFORE its manifest landed (crash mid-sidecar-write: has
+    ds_meta.json, no manifest, no arrays) fails load with a non-corruption
+    error — resume must still fall back to the older clean commit."""
+    ckpt_dir = tmp_path / "ckpt"
+    engine = _engine()
+    with _runner(engine, tmp_path) as runner:
+        runner.run(num_steps=1, batch_fn=_batch_fn)
+        runner.save(reason="manual")
+    # fabricate a newer half-written tag: committed-looking marker, no
+    # manifest, no orbax payload
+    half = ckpt_dir / "global_step7"
+    half.mkdir()
+    (half / "ds_meta.json").write_text('{"global_steps": 7}')
+
+    fresh = _engine(seed=4)
+    runner2 = _runner(fresh, tmp_path)
+    tag = runner2.resume_from_latest()
+    runner2.close()
+    assert tag == "global_step1"
+    assert fresh.global_steps == 1

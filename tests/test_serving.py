@@ -300,3 +300,68 @@ def test_monitor_export(model_and_params, tmp_path):
     names = {p.stem for p in written}
     assert "serving_tokens_generated" in names
     assert "serving_ttft_mean_s" in names
+
+
+# ---------------------------------------------------------------------------
+# engine failure -> degraded health (load balancers must stop routing)
+# ---------------------------------------------------------------------------
+class _ExplodingEngine:
+    """Minimal engine double whose step() always raises — the serve loop
+    must fail the in-flight requests AND flip health to unhealthy."""
+
+    def __init__(self):
+        import types
+        self.state = types.SimpleNamespace(max_context_length=512,
+                                           get=lambda uid: None)
+        self.kv = types.SimpleNamespace(blocks_needed=lambda total: 1)
+        self._resident = set()
+
+    def kv_usable_blocks(self):
+        return 64
+
+    def kv_occupancy(self):
+        return 0.0
+
+    def can_schedule(self, uids, needs):
+        return True
+
+    def admit(self, uid, tokens):
+        self._resident.add(uid)
+
+    def has_work(self):
+        return bool(self._resident)
+
+    def step(self):
+        raise RuntimeError("kaboom: device went away")
+
+    def finish(self, uid):
+        self._resident.discard(uid)
+
+    def reap_finished(self):
+        return []
+
+
+def test_health_degraded_after_engine_step_failure():
+    server = InferenceServer(_ExplodingEngine(),
+                             ServingConfig(idle_poll_s=0.001)).start()
+    frontend = ServingFrontend(server).start()
+    try:
+        req = server.submit([1, 2, 3], max_new_tokens=4)
+        assert req.wait(timeout=10.0)
+        assert req.state == RequestState.FAILED
+
+        h = server.health()
+        assert h["status"] == "degraded"
+        assert h["ok"] is False
+        assert "engine step failed" in h["degraded_reason"]
+        # /healthz mirrors it with a 503 so LBs eject this replica
+        status, _, body = _http("GET", frontend.host, frontend.port,
+                                "/healthz")
+        assert status == 503
+        assert json.loads(body)["status"] == "degraded"
+        # a suspect engine refuses new work at the door (503, not a slow 500)
+        with pytest.raises(ServerClosedError):
+            server.submit([1, 2, 3], max_new_tokens=4)
+    finally:
+        frontend.stop()
+        server.stop(drain_timeout=2.0)
