@@ -4,8 +4,17 @@ Measures sustained Llama training throughput (tokens/sec/chip) under the engine'
 fused train step on real TPU hardware, and derives MFU against the chip's peak
 bf16 TFLOPS. ``vs_baseline`` compares our MFU to the reference's headline Ulysses
 efficiency (>54% of peak on A100, BASELINE.md row 1) — ratio > 1.0 beats it.
+
+Alongside tokens/sec the record now carries ``steps_per_sec`` and the host
+``dispatch_gap_ms`` (mean host time per step spent *launching* work — the
+number the async step pipeline drives toward zero). ``--sync-every 1,8``
+[+ ``--prefetch``] additionally sweeps the async pipeline's drain cadence and
+reports per-arm steps/sec + dispatch gap under ``extra.async_sweep`` — run with
+``DSTPU_BENCH_MODEL=micro`` for the seed-pinned CPU micro-bench. Any sweep flag
+disables headline banking (A/B runs must never become the replayed record).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -16,7 +25,28 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_MFU = 0.54  # BASELINE.md: Ulysses sustained >54% of peak
 
 
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu training bench")
+    p.add_argument("--sync-every", default="1",
+                   help="comma-separated async-pipeline drain cadences to "
+                        "sweep (1 = per-step readback; e.g. '1,8')")
+    p.add_argument("--prefetch", action="store_true",
+                   help="enable double-buffered batch prefetch in the sweep")
+    p.add_argument("--sweep-steps", type=int, default=20,
+                   help="timed steps per sweep arm")
+    return p.parse_args(argv)
+
+
 def main():
+    args = parse_args()
+    sweep_values = [int(x) for x in str(args.sync_every).split(",")
+                    if x.strip()]
+    if args.prefetch and not any(se > 1 for se in sweep_values):
+        print("# --prefetch has no effect without a pipelined arm: prefetch "
+              "engages only on --sync-every values > 1 (sync_every=1 is the "
+              "synchronous baseline) — add e.g. --sync-every 1,8",
+              file=sys.stderr)
+    sweep_requested = sweep_values != [1] or args.prefetch
     from bench_util import guard_device_discovery
     # per-preset metric names: a wedged 8b run must NOT replay the banked
     # 697m headline as its own (cross-measurement substitution)
@@ -48,11 +78,21 @@ def main():
         "1b":   (2048,  5632, 24,   16,   8,  1,  4,  "optimizer"),
         "3b":   (3072,  8192, 28,   24,   8,  1,  4,  "optimizer"),
         "8b":   (4096, 14336, 32,   32,   8,  1,  2,  "param"),
+        # CPU-runnable micro model for async-pipeline A/B sweeps (the
+        # seed-pinned micro-bench behind docs/performance.md numbers): small
+        # enough that one step is tens of ms on a CPU host, so the host-side
+        # work the pipeline hides (collate + staging + readback) is a
+        # measurable fraction of the step
+        "micro": (64,   172,  2,    4,    2,  8,  1,  "none"),
     }
     preset = os.environ.get("DSTPU_BENCH_MODEL", "697m")
     if preset not in presets:
         raise SystemExit(f"DSTPU_BENCH_MODEL must be one of {sorted(presets)}")
     hidden, inter, layers, heads, kv, mb_default, gas_default, tier = presets[preset]
+    vocab = 32000
+    if preset == "micro":
+        seq_len = 64
+        vocab = 2048
     # micro_batch=4/gas=2 reaches ~0.68 MFU on 697m but sits within ~260MB of
     # the HBM ceiling (flaky OOM depending on allocator state); the preset
     # defaults are the safe configs
@@ -61,11 +101,12 @@ def main():
     batch = micro_batch * gas * n_devices
 
     cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=hidden, intermediate_size=inter,
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
         num_layers=layers, num_heads=heads, num_kv_heads=kv,
         max_seq_len=seq_len,
-        dtype=jnp.bfloat16,
-        attention_backend=os.environ.get("DSTPU_BENCH_ATTN", "flash"),
+        dtype=jnp.bfloat16 if preset != "micro" else jnp.float32,
+        attention_backend=os.environ.get(
+            "DSTPU_BENCH_ATTN", "flash" if preset != "micro" else "xla"),
         # chunked head+CE fusion: the fp32 [B*S,V] logits (1GB at mb=4) never
         # materialize, freeing ~3GB of HLO temps (enables micro_batch 4).
         # OFF by default: its TPU compile was in flight when the axon tunnel
@@ -78,7 +119,8 @@ def main():
         loss_chunk_size=int(os.environ.get("DSTPU_BENCH_LOSS_CHUNK", 0)) or (
             2048 if os.environ.get("DSTPU_BENCH_LOSS_UNROLL") == "1" else None),
         loss_chunk_unroll=os.environ.get("DSTPU_BENCH_LOSS_UNROLL", "0") == "1",
-        remat=os.environ.get("DSTPU_BENCH_REMAT", "1") == "1",
+        remat=os.environ.get(
+            "DSTPU_BENCH_REMAT", "1" if preset != "micro" else "0") == "1",
         remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
                                     "dots_with_no_batch_dims_saveable"))
     zero = {"stage": 0 if n_devices == 1 else 3}
@@ -93,8 +135,11 @@ def main():
         "train_batch_size": batch,
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
-        "bf16": {"enabled": True},
-        "data_types": {"grad_accum_dtype": "bf16"},
+        # micro runs fp32: CPU bf16 is emulated (slow), and the micro-bench
+        # wants a hardware-honest step time so the host share is realistic
+        "bf16": {"enabled": preset != "micro"},
+        "data_types": {"grad_accum_dtype":
+                       "bf16" if preset != "micro" else "fp32"},
         "zero_optimization": zero,
         "steps_per_print": 1000000,
     }
@@ -107,6 +152,9 @@ def main():
         return random_tokens(micro_batch * n_devices, seq_len,
                              vocab_size=cfg.vocab_size, seed=i, gas=gas)
 
+    from deepspeed_tpu.utils.timer import (TRAIN_BATCH_DISPATCH_TIMER,
+                                           TRAIN_BATCH_TIMER)
+
     # Sync barrier: fetch a device scalar to host. (On tunneled platforms
     # block_until_ready can return before execution finishes; a D2H transfer
     # cannot.)
@@ -114,11 +162,88 @@ def main():
     float(jax.device_get(loss))
 
     steps = 10
+    engine.timers(TRAIN_BATCH_TIMER).reset()   # drop the compile-step record
     t0 = time.time()
     for i in range(1, steps + 1):
         loss = engine.train_batch(batch=make_batch(i), stacked=True)
     float(jax.device_get(loss))
     dt = time.time() - t0
+    steps_per_sec = steps / dt
+    # host time per step spent *launching* — only meaningful on the fused
+    # path (async dispatch leaves completion on-device, so its timer records
+    # pure dispatch); offload tiers block on the host optimizer between
+    # start/stop, which would mislabel the full step time as dispatch
+    dispatch_gap_ms = engine.timers(TRAIN_BATCH_TIMER).mean() * 1000.0 \
+        if tier == "none" else None
+
+    # --- async-pipeline sweep (--sync-every 1,8 [--prefetch]) ---------------
+    # Same engine, reconfigured per arm at an iterator boundary; each arm
+    # feeds train_batch(data_iter=...) so prefetch staging can engage. The
+    # iterator runs a real host data pipeline per microbatch — greedy
+    # pair-merge tokenization of a synthetic byte corpus (the BPE-shaped
+    # python work every LM loader pays) + collate — so the sweep measures
+    # the host share the pipeline exists to hide, not a zero-cost replay.
+    async_sweep = {}
+    if sweep_requested and tier != "none":
+        print(f"# async sweep skipped: preset '{preset}' runs a "
+              "host-synchronous offload step (nothing to defer)",
+              file=sys.stderr)
+        sweep_requested = False
+    if sweep_requested:
+        sweep_steps = max(1, args.sweep_steps)
+        corpus = np.random.default_rng(1234).integers(
+            0, 256, size=(1 << 16,), dtype=np.uint8)
+        merges = {(i, i + 1): 256 + i for i in range(0, 200, 2)}
+        bytes_per_sample = seq_len * 8
+
+        def tokenize(buf):
+            ids, out, i = list(buf), [], 0
+            while i < len(ids):
+                if i + 1 < len(ids) and (ids[i], ids[i + 1]) in merges:
+                    out.append(merges[(ids[i], ids[i + 1])])
+                    i += 2
+                else:
+                    out.append(ids[i])
+                    i += 1
+            return np.asarray(out[:seq_len], np.int32) % cfg.vocab_size
+
+        for se in sweep_values:
+            # sync_every=1 is the synchronous baseline (per-step readback,
+            # inline batch staging — the pre-pipeline loop); --prefetch
+            # engages only on the pipelined arms it belongs to
+            arm_prefetch = args.prefetch and se > 1
+            engine.configure_async_pipeline(
+                enabled=True, sync_every=se, prefetch=arm_prefetch)
+
+            def micro_iter(arm=se):
+                rng = np.random.default_rng(100_000 + arm)
+                while True:
+                    starts = rng.integers(
+                        0, len(corpus) - bytes_per_sample,
+                        size=micro_batch * n_devices)
+                    yield {"input_ids": np.stack(
+                        [tokenize(bytes(corpus[s:s + bytes_per_sample]))
+                         for s in starts])}
+
+            it = micro_iter()
+            engine.train_batch(data_iter=it)      # warm the arm
+            engine.flush_metrics()                # completion barrier
+            engine.timers(TRAIN_BATCH_TIMER).reset()
+            engine.timers(TRAIN_BATCH_DISPATCH_TIMER).reset()
+            a0 = time.time()
+            for _ in range(sweep_steps):
+                engine.train_batch(data_iter=it)
+            engine.flush_metrics()                # completion barrier
+            adt = time.time() - a0
+            async_sweep[f"sync_every={se}"] = {
+                "steps_per_sec": round(sweep_steps / adt, 3),
+                "dispatch_gap_ms": round(
+                    engine.timers(TRAIN_BATCH_DISPATCH_TIMER).mean() * 1000.0, 3),
+                "step_ms_reconciled": round(
+                    engine.timers(TRAIN_BATCH_TIMER).mean() * 1000.0, 3),
+                "prefetch": arm_prefetch,
+            }
+        engine.configure_async_pipeline(enabled=False, prefetch=False)
 
     tokens_per_sec = steps * batch * seq_len / dt
     tokens_per_sec_chip = tokens_per_sec / n_devices
@@ -143,10 +268,16 @@ def main():
             "model_tflops_per_chip": round(achieved_tflops, 1),
             "mfu": round(mfu, 3),
             "peak_tflops": peak,
+            "steps_per_sec": round(steps_per_sec, 3),
         },
     }
+    if dispatch_gap_ms is not None:
+        record["extra"]["dispatch_gap_ms"] = round(dispatch_gap_ms, 3)
+    if async_sweep:
+        record["extra"]["async_sweep"] = async_sweep
     print(json.dumps(record))
-    if not any(k.startswith("DSTPU_BENCH_") for k in os.environ):
+    if not any(k.startswith("DSTPU_BENCH_") for k in os.environ) \
+            and not sweep_requested:
         # only the all-defaults config banks the canonical stale-fallback
         # headline — an A/B knob run must never become the replayed record
         from bench_util import bank_headline

@@ -272,6 +272,40 @@ class PLDConfig(DeepSpeedTPUConfigModel):
     gamma: float = 0.001
 
 
+class AsyncPipelineConfig(DeepSpeedTPUConfigModel):
+    """Latency-hiding step pipeline (TPU-native; no reference analog — JAX's
+    async dispatch makes the host loop the bottleneck the reference never had).
+
+    With ``enabled``, ``train_batch`` returns without touching step outputs:
+    they queue on a device-side ring drained with ONE batched ``device_get``
+    every ``sync_every`` steps (and at log/checkpoint boundaries or explicit
+    ``engine.flush_metrics()``). Host-side consumers (monitor events, the
+    resilience StepGuard) observe steps with up to ``sync_every`` steps of
+    lag — numerics are bit-identical, only *detection* is deferred.
+
+    ``prefetch`` stages batches (stack + device_put) one step ahead on a
+    background thread so host→device transfer of batch N+1 overlaps compute
+    of batch N. Disabled by default: the default config preserves per-step
+    readback semantics exactly."""
+    enabled: bool = False
+    # drain the step-output ring every N steps (1 = per-step readback, the
+    # synchronous baseline; only honored when enabled)
+    sync_every: int = 8
+    # double-buffered background batch staging (train_batch(data_iter=...))
+    prefetch: bool = False
+    # staged batches kept ready ahead of compute (2 = classic double buffer)
+    prefetch_depth: int = 2
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {self.sync_every}")
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}")
+        return self
+
+
 class DeepSpeedTPUConfig:
     """Parses the single JSON/dict config (reference: DeepSpeedConfig,
     runtime/config.py). Performs the batch-size triple reconciliation with
@@ -318,6 +352,8 @@ class DeepSpeedTPUConfig:
         self.data_efficiency = DataEfficiencyConfig(
             **self._raw.get(C.DATA_EFFICIENCY, {}))
         self.data_types = DataTypesConfig(**self._raw.get(C.DATA_TYPES, {}))
+        self.async_pipeline = AsyncPipelineConfig(
+            **self._raw.get(C.ASYNC_PIPELINE, {}))
         self.pld = PLDConfig(**self._raw.get("progressive_layer_drop", {}))
         # single schema shared with the implementation (no parallel copy to
         # keep in sync): reference get_eigenvalue_config (runtime/config.py:565)

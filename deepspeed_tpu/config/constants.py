@@ -36,6 +36,9 @@ AUTOTUNING = "autotuning"
 CHECKPOINT = "checkpoint"
 DATA_TYPES = "data_types"                 # reference: constants.py:426
 GRAD_ACCUM_DTYPE = "grad_accum_dtype"     # reference: constants.py:427
+# TPU-native: latency-hiding step pipeline (deferred metric readback +
+# double-buffered batch prefetch) — no reference analog
+ASYNC_PIPELINE = "async_pipeline"
 
 # Defaults (mirroring reference semantics)
 STEPS_PER_PRINT_DEFAULT = 10

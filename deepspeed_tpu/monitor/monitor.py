@@ -142,6 +142,10 @@ class MonitorMaster(Monitor):
         self.enabled = any(b.enabled for b in self.backends)
 
     def write_events(self, events: List[Event]):
+        # normalize once for every backend: producers hand numpy/jax scalars
+        # (e.g. the engine's async metric drain) as readily as floats, and a
+        # device array here would make each backend force its own transfer
+        events = [(tag, float(value), int(step)) for tag, value, step in events]
         for b in self.backends:
             if b.enabled:
                 b.write_events(events)

@@ -18,10 +18,11 @@ Division of labor per bad step:
                       accelerator time on a poisoned run.
 """
 
-import math
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.resilience.config import StepGuardConfig
 from deepspeed_tpu.utils.logging import logger
@@ -40,11 +41,54 @@ class QuarantineError(RuntimeError):
         self.bundle_path = bundle_path
 
 
-def _finite(x) -> bool:
-    try:
-        return math.isfinite(float(jax.device_get(x)))
-    except (TypeError, ValueError):
-        return True          # non-scalar / absent metrics don't trip the guard
+def _finite_report(values: Dict[str, Any]) -> Dict[str, bool]:
+    """Fused step-health readback: every device-resident value is reduced ON
+    DEVICE (``isfinite(...).all()`` for floats, identity for the bool
+    overflow flag), the flags are stacked, and ONE ``device_get`` moves them
+    to host — replacing the per-tensor scalar transfers the old ``_finite``
+    paid. Host scalars are checked locally; absent/non-numeric values count
+    as finite (None overflow stays None so callers can tell "no flag" from
+    False)."""
+    out: Dict[str, Any] = {}
+    names, flags = [], []
+    for k, v in values.items():
+        if v is None:
+            out[k] = None
+            continue
+        if isinstance(v, jax.Array):
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                names.append(k)
+                flags.append(jnp.isfinite(v).all())
+            elif jnp.issubdtype(v.dtype, jnp.bool_):
+                names.append(k)      # flag VALUE (overflow), not finiteness
+                flags.append(v.any())
+            else:
+                out[k] = True        # integer metrics are always finite
+            continue
+        # host values (python scalars, numpy scalars/0-d arrays from a prior
+        # device_get, drained async-pipeline entries): dtype decides —
+        # bools are flag values, everything else is finiteness-checked
+        try:
+            a = np.asarray(v)
+        except Exception:
+            out[k] = True
+            continue
+        if a.dtype == np.bool_:
+            out[k] = bool(a.any())
+        elif np.issubdtype(a.dtype, np.integer):
+            out[k] = True            # ints can't be non-finite
+        else:
+            # cast-then-check covers ml_dtypes floats too (bf16/fp8 numpy
+            # scalars fail np.issubdtype(..., np.floating) but a NaN there
+            # is still a bad step); non-numerics count as finite
+            try:
+                out[k] = bool(np.isfinite(np.asarray(a, np.float64)).all())
+            except (TypeError, ValueError):
+                out[k] = True
+    if flags:
+        host = jax.device_get(jnp.stack(flags))
+        out.update({k: bool(f) for k, f in zip(names, host)})
+    return out
 
 
 class StepGuard:
@@ -88,14 +132,22 @@ class StepGuard:
     # ------------------------------------------------------------------
     def observe(self, loss, metrics: Dict[str, Any]) -> bool:
         """Inspect one completed step; returns True when the step was bad.
-        Raises ``BadStepError`` (policy "abort") or ``QuarantineError``."""
+        Raises ``BadStepError`` (policy "abort") or ``QuarantineError``.
+        Device-resident values cost ONE fused device check + host transfer
+        for the whole health report (loss finiteness, grad-norm finiteness,
+        overflow flag); host scalars (e.g. a drained async-pipeline entry)
+        cost no transfer at all."""
         if not self.cfg.enabled:
             return False
-        overflow = metrics.get("overflow")
-        bad = (not _finite(loss)
-               or not _finite(metrics.get("grad_norm", 0.0)))
+        report = _finite_report({
+            "loss": loss,
+            "grad_norm": metrics.get("grad_norm", 0.0),
+            "overflow": metrics.get("overflow"),
+        })
+        overflow = report["overflow"]
+        bad = not report["loss"] or not report["grad_norm"]
         if not bad and overflow is not None:
-            bad = bool(jax.device_get(overflow))
+            bad = bool(overflow)
             if bad and self.engine.config.fp16.enabled:
                 # overflow-only with finite loss/grad-norm under fp16 is the
                 # dynamic loss scaler doing its job (scale-search overflows

@@ -87,6 +87,7 @@ class FaultTolerantRunner:
 
         self.history = collections.deque(maxlen=self.cfg.history_steps)
         self._last_host: Dict[str, Any] = {}
+        self._dispatch_durations: Dict[int, float] = {}
         self.saved_tags: list = []
         self._preempt_signal: Optional[int] = None
         self._preemption_saved = False
@@ -121,6 +122,12 @@ class FaultTolerantRunner:
         if self._closed:
             return
         self._closed = True
+        # drain any leftover deferred metrics (guard errors are logged, not
+        # raised — close() must always complete)
+        try:
+            self.flush(raise_guard=False)
+        except Exception:
+            logger.exception("resilience: final metric drain failed")
         self.guard.detach()            # engine regains default NaN semantics
         if self.watchdog is not None:
             self.watchdog.stop()
@@ -155,7 +162,11 @@ class FaultTolerantRunner:
     def save(self, tag: Optional[str] = None, reason: str = "manual") -> str:
         """Checkpoint with retry; the runner's own state (guard backoff,
         autosave cadence) rides in ``client_state`` so recovery behavior
-        survives the restart too."""
+        survives the restart too. The async-pipeline ring is drained and
+        guard-replayed FIRST — a quarantine hiding in the un-drained window
+        raises here, before anything is snapshotted, so committed
+        checkpoints never capture un-guarded steps."""
+        self.flush()
         state = dict(self.client_state)
         state[_CLIENT_STATE_KEY] = {
             "guard": self.guard.state_dict(),
@@ -210,12 +221,21 @@ class FaultTolerantRunner:
         """One guarded ``engine.train_batch``. Raises ``BadStepError`` /
         ``QuarantineError`` per the step-guard policy (with a diagnostic
         bundle written first); after a preemption signal the step completes,
-        an autosave commits, and ``should_stop`` turns True."""
+        an autosave commits, and ``should_stop`` turns True.
+
+        With the engine's async step pipeline enabled, step outputs are
+        consumed from the drained metric ring instead of a per-step device
+        fetch: the guard observes steps with up to ``sync_every`` steps of
+        detection lag (replayed in order), and every save boundary forces a
+        flush first so checkpoints never capture un-guarded steps. Params
+        stay clean regardless of the lag — the engine's on-device skip drops
+        bad updates at the step they happen."""
         if self._closed:
             raise RuntimeError("runner is closed")
         engine = self.engine
         step_idx = engine.global_steps
-        batch, stacked = self._prepare_batch(batch, data_iter, step_idx)
+        batch, stacked, feed_iter = self._prepare_batch(batch, data_iter,
+                                                        step_idx)
         if self.chaos is not None:
             self.chaos.maybe_die(step_idx)
         if self.watchdog is not None:
@@ -225,22 +245,35 @@ class FaultTolerantRunner:
             if self.chaos is not None:
                 # inside the watchdog window: a chaos stall IS a hung step
                 self.chaos.maybe_stall(step_idx)
-            loss = engine.train_batch(batch=batch, stacked=stacked)
+            loss = engine.train_batch(batch=batch, data_iter=feed_iter,
+                                      stacked=stacked)
         finally:
             if self.watchdog is not None:
                 self.watchdog.end_step()
         duration = time.monotonic() - t0
-        metrics = getattr(engine, "_last_metrics", {})
-        # ONE host transfer for everything the host-side policy layer needs
-        # (guard verdict, history ring, run()'s last_loss)
-        fetch = {"loss": loss}
-        for k in ("lr", "grad_norm", "overflow"):
-            if metrics.get(k) is not None:
-                fetch[k] = metrics[k]
-        host = self._last_host = jax.device_get(fetch)
-        self._record_history(step_idx, host, duration)
+        if getattr(engine, "_async_enabled", False):
+            # deferred readback: the engine drains its ring every sync_every
+            # steps; replay whatever landed (possibly nothing this step)
+            self._dispatch_durations[step_idx] = duration
+            self._consume_drained()
+        else:
+            metrics = getattr(engine, "_last_metrics", {})
+            # ONE host transfer for everything the host-side policy layer
+            # needs (guard verdict, history ring, run()'s last_loss)
+            fetch = {"loss": loss}
+            for k in ("lr", "grad_norm", "overflow"):
+                if metrics.get(k) is not None:
+                    fetch[k] = metrics[k]
+            host = self._last_host = jax.device_get(fetch)
+            self._record_history(step_idx, host, duration)
+            self._observe_guarded(host["loss"], host)
+        self._maybe_save(engine.global_steps)
+        return loss
+
+    def _observe_guarded(self, loss, host: Dict[str, Any]):
+        """guard.observe with the runner's bundle-on-raise contract."""
         try:
-            if self.guard.observe(host["loss"], host):
+            if self.guard.observe(loss, host):
                 self._export_monitor_events()
         except (QuarantineError, BadStepError) as e:
             bundle = self.write_diagnostic_bundle(
@@ -249,22 +282,65 @@ class FaultTolerantRunner:
             if isinstance(e, QuarantineError):
                 e.bundle_path = bundle
             raise
-        self._maybe_save(engine.global_steps)
-        return loss
+
+    def _consume_drained(self, raise_guard: bool = True) -> int:
+        """Replay newly drained async-pipeline entries IN ORDER through the
+        history ring and the step guard (bounded lag: entries arrive at most
+        ``sync_every`` steps after their step ran). Returns the number of
+        entries consumed."""
+        take = getattr(self.engine, "take_drained_metrics", None)
+        if take is None:
+            return 0
+        entries = take()
+        for i, e in enumerate(entries):
+            # ring entries carry the post-step global step; history keys by
+            # the pre-step index (same convention as the synchronous path)
+            pre_idx = int(e.get("step", self.engine.global_steps)) - 1
+            duration = self._dispatch_durations.pop(pre_idx, None)
+            self._record_history(pre_idx, e, duration)
+            self._last_host = e
+            try:
+                self._observe_guarded(e.get("loss"), e)
+            except (QuarantineError, BadStepError):
+                if raise_guard:
+                    # the unjudged tail goes back to the engine's queue so a
+                    # later flush/save still replays it through the guard —
+                    # nothing escapes judgment because an earlier entry blew up
+                    self.engine.requeue_drained_metrics(entries[i + 1:])
+                    raise
+                logger.exception(
+                    "resilience: guard raised during final drain")
+        return len(entries)
+
+    def flush(self, raise_guard: bool = True) -> int:
+        """Force-drain the engine's deferred metric ring and replay it
+        through the guard/history — the barrier ``save()`` and ``run()``
+        use so no checkpoint or RunResult ever reflects un-guarded steps."""
+        if hasattr(self.engine, "flush_metrics"):
+            self.engine.flush_metrics()
+        return self._consume_drained(raise_guard=raise_guard)
 
     def _prepare_batch(self, batch, data_iter, step_idx):
         """Materialize the step's batch (pulling gas microbatches when an
-        iterator is given) and run chaos NaN injection on the result."""
+        iterator is given) and run chaos NaN injection on the result.
+
+        With the engine's prefetch enabled and NO chaos monkey, the iterator
+        is handed through untouched (third return value) so the engine's
+        background staging engages — chaos batch corruption needs the host
+        batch materialized here, so chaos runs keep the inline path."""
         stacked = None
         if batch is None:
             if data_iter is None:
                 raise ValueError("step() needs batch or data_iter")
+            if self.chaos is None and \
+                    getattr(self.engine, "_prefetch_enabled", False):
+                return None, None, data_iter
             batch = self.engine.stack_microbatches(
                 data_iter, self.engine.gradient_accumulation_steps)
             stacked = True
         if self.chaos is not None:
             batch = self.chaos.corrupt_batch(batch, step_idx)
-        return batch, stacked
+        return batch, stacked, None
 
     def _maybe_save(self, step: int):
         if self.preempted:
@@ -284,9 +360,12 @@ class FaultTolerantRunner:
                 return None
         self.history.append({
             "step": step, "loss": f(host.get("loss")),
-            "duration_s": round(duration, 4),
+            # async pipeline: per-step host duration is DISPATCH time (the
+            # reconciled step time lives in the engine's TRAIN_BATCH_TIMER)
+            "duration_s": round(duration, 4) if duration is not None else None,
             "lr": f(host.get("lr")), "grad_norm": f(host.get("grad_norm")),
-            "overflow": bool(host["overflow"]) if "overflow" in host else None,
+            "overflow": bool(host["overflow"]) if host.get("overflow")
+            is not None else None,
         })
 
     # ------------------------------------------------------------------
@@ -319,14 +398,20 @@ class FaultTolerantRunner:
                 result.stop_reason = self._stop_reason()
                 break
             result.steps_completed += 1
-            result.last_loss = float(self._last_host["loss"])
+            if "loss" in self._last_host:
+                result.last_loss = float(self._last_host["loss"])
         else:
             if self.should_stop:
                 result.stop_reason = self._stop_reason()
+        # final drain: the tail of the async ring reaches the guard/history
+        # before the RunResult is reported (and before any preemption save)
+        self.flush()
         if self.should_stop and not self._preemption_saved \
                 and self.cfg.autosave.save_on_preemption:
             self._preemption_saved = True
             self.save(reason="preemption")
+        if "loss" in self._last_host:
+            result.last_loss = float(self._last_host["loss"])
         result.saved_tags = list(self.saved_tags)
         return result
 

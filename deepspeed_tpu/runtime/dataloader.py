@@ -7,9 +7,101 @@ engine shards it onto the mesh), so the loader's job is batching + per-process
 sharding + repeat.
 """
 
-from typing import Any, Callable, Iterator, Optional, Sequence
+import queue
+import threading
+from typing import Any, Callable, Iterator, NamedTuple, Optional, Sequence
 
 import numpy as np
+
+
+class StagedBatch(NamedTuple):
+    """A batch already placed on the mesh (device-resident, correctly
+    sharded). ``train_batch`` consumes it directly, skipping its own
+    ``_shard_batch`` — the marker that lets the prefetch thread do the
+    host→device transfer one step ahead of compute."""
+    arrays: Any
+
+
+class PrefetchLoader:
+    """Background-thread prefetch with a bounded ready-buffer (``depth=2`` is
+    the classic double buffer).
+
+    A single worker thread pulls items from ``source`` in order, optionally
+    transforms them via ``stage_fn`` (the engine passes its
+    ``_shard_batch``/``device_put`` staging so the H2D transfer of batch N+1
+    overlaps compute of batch N), and parks up to ``depth`` ready items.
+    Because there is exactly one worker consuming ``source`` sequentially,
+    the yielded order is identical to iterating ``source`` directly —
+    prefetch on/off is batch-for-batch deterministic. A ``stage_fn`` or
+    ``source`` exception is re-raised at the consuming ``__next__``.
+    """
+
+    _DONE = object()
+
+    def __init__(self, source, stage_fn: Optional[Callable] = None,
+                 depth: int = 2):
+        self._source = iter(source)
+        self._stage_fn = stage_fn
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._closed = False
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._worker, name="dstpu-prefetch", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        def _put(item) -> bool:
+            # bounded-wait put so close() can always terminate the worker
+            while not self._closed:
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            while not self._closed:
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    break
+                if self._stage_fn is not None:
+                    item = self._stage_fn(item)
+                if not _put(item):   # blocks while `depth` batches are ready
+                    return
+            _put(self._DONE)
+        except BaseException as e:   # surfaced at the consumer's __next__
+            _put(e)
+            # terminate the stream: a consumer that swallows the error and
+            # keeps pulling gets StopIteration, never a permanent hang
+            _put(self._DONE)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        if self._done:          # exhaustion is sticky: a drained stream
+            raise StopIteration  # keeps raising instead of blocking forever
+        item = self._q.get()
+        if item is self._DONE:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        """Stop the worker and drop buffered batches (used when the engine
+        switches data iterators or is reconfigured)."""
+        self._closed = True
+        self._done = True
+        while True:                  # unblock a producer stuck on put()
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
 
 
 class RepeatingLoader:

@@ -19,9 +19,11 @@ compatibility shim: ``forward`` runs a jitted value-and-grad and caches the grad
 update at the accumulation boundary — the idiomatic entry point is ``train_batch``.
 """
 
+import collections
 import functools
 import os
-from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,10 +43,12 @@ from deepspeed_tpu.runtime.zero.partition import (
     build_secondary_shardings,
 )
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.runtime.dataloader import PrefetchLoader, StagedBatch
 from deepspeed_tpu.utils.timer import (
     BACKWARD_GLOBAL_TIMER,
     FORWARD_GLOBAL_TIMER,
     STEP_GLOBAL_TIMER,
+    TRAIN_BATCH_DISPATCH_TIMER,
     TRAIN_BATCH_TIMER,
     SynchronizedWallClockTimer,
     ThroughputTimer,
@@ -482,6 +486,46 @@ class DeepSpeedTPUEngine:
                        "no replica batch axis or no embedding-like leaf — ")
                     + "gradients reduce densely", ranks=[0])
 
+        # --- async step pipeline (deferred metric readback + prefetch) --------
+        # config.async_pipeline; disabled -> per-step readback semantics are
+        # bit-for-bit today's (no ring, no extra sync, device-array metrics)
+        acfg = config.async_pipeline
+        self._async_enabled = bool(acfg.enabled)
+        if self._async_enabled and (self._param_offload is not None
+                                    or self._offload is not None):
+            # the fused host-optimizer step is host-synchronous by
+            # construction — a deferred ring would never fill and async-mode
+            # consumers (the resilience runner) would go blind
+            log_dist("async_pipeline: disabled — offload tiers run a "
+                     "host-synchronous optimizer step (nothing to defer)",
+                     ranks=[0])
+            self._async_enabled = False
+        # the configured cadence survives enable/disable toggles; the live
+        # _sync_every collapses to 1 whenever the pipeline is off
+        self._sync_every_cfg = int(acfg.sync_every)
+        self._sync_every = self._sync_every_cfg if self._async_enabled else 1
+        self._prefetch_enabled = self._async_enabled and bool(acfg.prefetch)
+        if self._prefetch_enabled and (config.flops_profiler.enabled
+                                       or config.eigenvalue.enabled):
+            # both side paths materialize the batch on host (np.asarray),
+            # which a staged multi-host array cannot satisfy — profiling /
+            # diagnostic runs keep inline staging
+            log_dist("async_pipeline: prefetch disabled — flops_profiler/"
+                     "eigenvalue need host-materialized batches", ranks=[0])
+            self._prefetch_enabled = False
+        self._prefetch_depth = int(acfg.prefetch_depth)
+        self._metric_ring: List[Dict[str, Any]] = []   # device-side pending
+        self._drained_metrics: collections.deque = collections.deque(
+            maxlen=4096)                               # host entries, unconsumed
+        self._last_drain_time: Optional[float] = None
+        self._prefetcher: Optional[PrefetchLoader] = None
+        self._prefetcher_src = None
+        self._prefetch_switches = 0
+        if self._async_enabled and config.wall_clock_breakdown:
+            log_dist("async_pipeline: wall_clock_breakdown forces a device "
+                     "sync per timer start/stop — the breakdown timers will "
+                     "serialize the pipeline they are measuring", ranks=[0])
+
         # --- bookkeeping / observability -------------------------------------
         self.global_steps = 0
         self.global_samples = 0
@@ -490,7 +534,8 @@ class DeepSpeedTPUEngine:
             synchronize=config.wall_clock_breakdown)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
-            steps_per_output=config.steps_per_print)
+            steps_per_output=config.steps_per_print,
+            synchronize=not self._async_enabled)
         self._last_metrics: Dict[str, float] = {}
         self.monitor = None
         if (config.tensorboard.enabled or config.csv_monitor.enabled
@@ -834,30 +879,50 @@ class DeepSpeedTPUEngine:
         stacked [gas, micro_global, ...]. When gas == 1 an unstacked
         [micro_global, ...] batch is accepted (``stacked=True`` overrides)."""
         gas = self.gradient_accumulation_steps
+        fused_path = self._param_offload is None and self._offload is None
         if batch is None:
             if data_iter is None:
                 raise ValueError("train_batch needs data_iter or batch")
-            batch = self.stack_microbatches(data_iter, gas)
-        elif gas == 1 and not stacked:
+            if self._prefetch_enabled and fused_path:
+                # background double buffer: stack + device_put happen one
+                # step ahead, so batch N+1's H2D overlaps batch N's compute
+                batch = next(self._ensure_prefetcher(data_iter))
+            else:
+                batch = self.stack_microbatches(data_iter, gas)
+        elif gas == 1 and not stacked and not isinstance(batch, StagedBatch):
             # deterministic rule (no shape-guessing): gas==1 batches are unstacked
             # unless the caller says otherwise
             batch = jax.tree.map(lambda x: np.asarray(x)[None], batch)
+        # rare host-side consumers (profiler/eigenvalue) read through the wrapper
+        host_view = batch.arrays if isinstance(batch, StagedBatch) else batch
         if (self.config.flops_profiler.enabled
                 and self.global_steps == self.config.flops_profiler.profile_step):
-            self._run_flops_profile(batch)
+            self._run_flops_profile(host_view)
         if self._param_offload is not None:
-            return self._train_batch_param_offload(batch)
+            return self._train_batch_param_offload(host_view)
         if self._offload is not None:
-            return self._train_batch_offloaded(batch)
+            return self._train_batch_offloaded(host_view)
         if self._train_batch_fn is None:
             self._build_train_batch_fn()
-        device_batch = self._shard_batch(batch, stacked=True)
+        if isinstance(batch, StagedBatch):
+            device_batch = batch.arrays    # prefetch thread already staged it
+        else:
+            device_batch = self._shard_batch(batch, stacked=True)
         self._rng, step_rng = jax.random.split(self._rng)
 
+        # async mode times *dispatch* per step (no completion wait); the true
+        # step time is reconciled into TRAIN_BATCH_TIMER at each ring drain
+        step_timer = self.timers(TRAIN_BATCH_DISPATCH_TIMER
+                                 if self._async_enabled else TRAIN_BATCH_TIMER)
+        if self._async_enabled and not self._metric_ring:
+            # empty ring = a fresh window: anchor it at this dispatch, so
+            # host pauses between windows (checkpoint I/O, idle gaps after a
+            # flush) are never booked as step time at the next drain
+            self._last_drain_time = time.time()
         self.tput_timer.start()
-        self.timers(TRAIN_BATCH_TIMER).start()
+        step_timer.start()
         self.state, out = self._train_batch_fn(self.state, device_batch, step_rng)
-        self.timers(TRAIN_BATCH_TIMER).stop()
+        step_timer.stop()
         self.tput_timer.stop(global_step=True)
 
         self.global_steps += 1
@@ -870,7 +935,7 @@ class DeepSpeedTPUEngine:
             # reference: eigenvalue at gas boundaries feeding compression MoQ
             # (engine.py quantizer hooks); results cached on the engine
             eval_batch = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[0]),
-                                      batch)
+                                      host_view)
             self.block_eigenvalues = self.eigenvalue.compute_eigenvalue(
                 lambda p: self._compute_loss(p, eval_batch,
                                              jax.random.PRNGKey(0)),
@@ -900,7 +965,7 @@ class DeepSpeedTPUEngine:
         lr = float(jax.device_get(self.lr_schedule(jnp.int32(applied_step))))
         self._record_metrics(StepOutput(
             loss=jnp.float32(loss), grad_norm=jnp.float32(norm),
-            lr=jnp.float32(lr), overflow=jnp.bool_(False)))
+            lr=jnp.float32(lr), overflow=jnp.bool_(False)), sync=True)
         # stream observability: H2D volume + phase split (monitor fan-out
         # picks these up alongside the standard Train/Samples events)
         self._last_metrics["param_offload_bytes_streamed"] = float(
@@ -977,7 +1042,8 @@ class DeepSpeedTPUEngine:
                 step=self.state.step + 1,
                 loss_scale=new_scale)
         self._record_metrics(StepOutput(loss=loss, grad_norm=norm,
-                                        lr=jnp.float32(lr), overflow=overflow))
+                                        lr=jnp.float32(lr), overflow=overflow),
+                             sync=True)
 
     def set_nonfinite_guard(self, enabled: bool = True) -> None:
         """Arm/disarm the resilience step guard: with it armed, non-finite
@@ -1053,20 +1119,222 @@ class DeepSpeedTPUEngine:
             raise RuntimeError("random_ltd not enabled in config")
         return self.random_ltd_scheduler.get_current_seq()
 
-    def _record_metrics(self, out: StepOutput):
+    def _record_metrics(self, out: StepOutput, sync: bool = False):
+        """Step-output fan-out. Async pipeline OFF (default) or ``sync=True``
+        (host-offload / compat paths, which are host-synchronous by
+        construction): today's per-step semantics, device-array
+        ``_last_metrics`` + monitor floats at ``steps_per_print`` boundaries.
+        Async pipeline ON: the outputs queue on the device-side ring —
+        NOTHING is transferred here — and the ring drains (one batched
+        ``device_get``) every ``sync_every`` steps."""
+        if self._async_enabled and not sync:
+            # NOTE: only StepOutput arrays are queued — they are fresh jit
+            # outputs. EngineState buffers (e.g. loss_scale.scale) must NOT
+            # be captured here: the state is donated to the next compiled
+            # step, which deletes those buffers while they'd still sit in
+            # the ring. The live scale is fetched at drain time instead.
+            self._metric_ring.append({
+                "step": self.global_steps,
+                "samples": self.global_samples,
+                "loss": out.loss, "grad_norm": out.grad_norm, "lr": out.lr,
+                "overflow": out.overflow,
+            })
+            if len(self._metric_ring) >= self._sync_every:
+                self._drain_metric_ring()
+            return
         self._last_metrics = {"lr": out.lr, "grad_norm": out.grad_norm,
                               "loss": out.loss, "overflow": out.overflow}
         if self.monitor and self.monitor.enabled:
-            if self.global_steps % self.config.steps_per_print == 0:
-                events = [
-                    ("Train/Samples/train_loss", float(out.loss), self.global_samples),
-                    ("Train/Samples/lr", float(out.lr), self.global_samples),
-                ]
-                if self.config.fp16.enabled:
-                    events.append(("Train/Samples/loss_scale",
-                                   float(self.state.loss_scale.scale),
-                                   self.global_samples))
+            events = self._monitor_step_events(
+                self.global_steps, self.global_samples, out.loss, out.lr,
+                self.state.loss_scale.scale)
+            if events:
                 self.monitor.write_events(events)
+
+    def _monitor_step_events(self, step, samples, loss, lr, loss_scale):
+        """Train/Samples events for one step, gated on the steps_per_print
+        boundary — THE single source for both the synchronous record path
+        and the async drain (so the two can never log different metrics)."""
+        if step % self.config.steps_per_print != 0:
+            return []
+        events = [("Train/Samples/train_loss", float(loss), samples),
+                  ("Train/Samples/lr", float(lr), samples)]
+        if self.config.fp16.enabled:
+            events.append(("Train/Samples/loss_scale", float(loss_scale),
+                           samples))
+        return events
+
+    # ------------------------------------------------------------------
+    # async step pipeline: the designated drain + its consumers
+    # ------------------------------------------------------------------
+    def _drain_metric_ring(self) -> List[Dict[str, Any]]:
+        """THE designated readback point of the async pipeline: one batched
+        ``device_get`` moves every pending step's outputs to host (and, by
+        data dependency, proves those steps' device work completed — the
+        anchor that keeps the reconciled timers honest). Host fan-out:
+        ``_last_metrics``, monitor events for ``steps_per_print``-boundary
+        steps, TRAIN_BATCH_TIMER/throughput reconciliation, and the ordered
+        entry queue the resilience runner replays through its StepGuard."""
+        if not self._metric_ring:
+            return []
+        ring, self._metric_ring = self._metric_ring, []
+        # the LIVE loss scale rides the same transfer (exact at sync_every=1;
+        # for lagged fp16 entries the monitor shows the drain-time scale)
+        host, scale = jax.device_get((ring, self.state.loss_scale.scale))
+        now = time.time()
+        scale = float(scale)
+        entries = [{"step": int(e["step"]), "samples": int(e["samples"]),
+                    "loss": float(e["loss"]),
+                    "grad_norm": float(e["grad_norm"]),
+                    "lr": float(e["lr"]), "overflow": bool(e["overflow"]),
+                    "loss_scale": scale} for e in host]
+        last = entries[-1]
+        self._last_metrics = {"lr": last["lr"], "grad_norm": last["grad_norm"],
+                              "loss": last["loss"],
+                              "overflow": last["overflow"]}
+        # window anchor = dispatch of this window's FIRST step (train_batch
+        # re-anchors whenever the ring is empty), so checkpoint I/O or idle
+        # gaps between windows never inflate the reconciled step time
+        window = 0.0
+        if self._last_drain_time is not None:
+            window = max(now - self._last_drain_time, 0.0)
+            self.timers(TRAIN_BATCH_TIMER).record_external(
+                window, count=len(entries))
+        self.tput_timer.mark_edge()
+        if self.monitor and self.monitor.enabled:
+            events = []
+            for e in entries:
+                events.extend(self._monitor_step_events(
+                    e["step"], e["samples"], e["loss"], e["lr"],
+                    e["loss_scale"]))
+            if window > 0:
+                events.append(("Train/Samples/steps_per_sec",
+                               len(entries) / window, last["samples"]))
+            if events:
+                self.monitor.write_events(events)
+        dropped = (len(self._drained_metrics) + len(entries)
+                   - self._drained_metrics.maxlen)
+        if dropped > 0:
+            # deque eviction must never be silent: with no consumer attached
+            # the bounded-lag guard guarantee degrades past this point
+            logger.warning(
+                "async_pipeline: drained-metrics queue overflow — %d oldest "
+                "un-consumed entries dropped (no take_drained_metrics "
+                "consumer attached?)", dropped)
+        self._drained_metrics.extend(entries)
+        return entries
+
+    def flush_metrics(self) -> List[Dict[str, Any]]:
+        """Force-drain the deferred step-output ring (one batched device_get);
+        returns the newly drained host entries, [] when nothing is pending.
+        Callers use it as a barrier at log/checkpoint boundaries — the
+        resilience runner flushes before every save so a checkpoint never
+        captures steps its guard has not judged."""
+        return self._drain_metric_ring()
+
+    def take_drained_metrics(self) -> List[Dict[str, Any]]:
+        """Pop the drained-but-unconsumed host metric entries (ordered, one
+        per step: step/samples/loss/grad_norm/lr/overflow/loss_scale). The
+        resilience runner's per-step hook — with ``sync_every=N`` its guard
+        observes steps with up to N steps of detection lag, replayed in
+        order here."""
+        out = list(self._drained_metrics)
+        self._drained_metrics.clear()
+        return out
+
+    def requeue_drained_metrics(self, entries: List[Dict[str, Any]]) -> None:
+        """Put taken-but-unprocessed entries back at the FRONT of the queue
+        (original order preserved) — the runner uses this when its guard
+        raises mid-replay, so the tail still gets judged by a later flush."""
+        free = self._drained_metrics.maxlen - len(self._drained_metrics)
+        if len(entries) > free:
+            # appendleft on a full deque would evict the NEWEST entries from
+            # the right — refuse to lose them silently
+            logger.warning(
+                "async_pipeline: requeue overflow — %d newest entries "
+                "dropped from the drained-metrics queue",
+                len(entries) - free)
+            entries = entries[:free]
+        for e in reversed(entries):
+            self._drained_metrics.appendleft(e)
+
+    def configure_async_pipeline(self, enabled: Optional[bool] = None,
+                                 sync_every: Optional[int] = None,
+                                 prefetch: Optional[bool] = None,
+                                 prefetch_depth: Optional[int] = None):
+        """Reconfigure the latency-hiding pipeline at runtime (bench sweeps,
+        notebooks). The pending ring is flushed FIRST so no step crosses a
+        semantics change un-drained. Closing an active prefetcher drops its
+        staged batches (the source iterator has already advanced past them)
+        — reconfigure at iterator boundaries when exact batch order matters."""
+        self.flush_metrics()
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+            self._prefetcher_src = None
+        if enabled is not None:
+            if enabled and (self._param_offload is not None
+                            or self._offload is not None):
+                raise ValueError(
+                    "async_pipeline cannot be enabled on a host-offload "
+                    "engine: the fused host optimizer step is synchronous "
+                    "by construction")
+            self._async_enabled = bool(enabled)
+        if sync_every is not None:
+            if int(sync_every) < 1:
+                raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+            self._sync_every_cfg = int(sync_every)
+        # an explicitly-set cadence survives toggling orthogonal knobs
+        self._sync_every = self._sync_every_cfg if self._async_enabled else 1
+        if prefetch is not None:
+            self._prefetch_enabled = bool(prefetch)
+        self._prefetch_enabled = self._prefetch_enabled and self._async_enabled
+        if self._prefetch_enabled and (self.config.flops_profiler.enabled
+                                       or self.config.eigenvalue.enabled):
+            log_dist("async_pipeline: prefetch disabled — flops_profiler/"
+                     "eigenvalue need host-materialized batches", ranks=[0])
+            self._prefetch_enabled = False
+        if prefetch_depth is not None:
+            self._prefetch_depth = max(1, int(prefetch_depth))
+        self.tput_timer.synchronize = not self._async_enabled
+        self._last_drain_time = None
+        return self
+
+    def _ensure_prefetcher(self, data_iter) -> PrefetchLoader:
+        """One staged-batch prefetcher per source iterator (identity-keyed;
+        a new source closes the old prefetcher, dropping its staged
+        batches — swap iterators at epoch boundaries)."""
+        if self._prefetcher is not None and self._prefetcher_src is data_iter:
+            return self._prefetcher
+        if self._prefetcher is not None:
+            self._prefetch_switches += 1
+            if self._prefetch_switches <= 3 or \
+                    self._prefetch_switches % 100 == 0:
+                # a fresh iterator object per call defeats prefetch (thread
+                # churn + staged batches already pulled from the source are
+                # dropped) — loud the first few times, throttled after
+                logger.warning(
+                    "async_pipeline: data_iter identity changed (switch "
+                    "#%d) — discarding the previous prefetcher and up to "
+                    "%d staged batches; pass a STABLE iterator across "
+                    "train_batch calls", self._prefetch_switches,
+                    self._prefetch_depth)
+            self._prefetcher.close()
+        gas = self.gradient_accumulation_steps
+
+        def stacked_batches():
+            while True:
+                try:
+                    yield self.stack_microbatches(data_iter, gas)
+                except StopIteration:   # PEP 479: surface as a clean end
+                    return
+
+        self._prefetcher = PrefetchLoader(
+            stacked_batches(),
+            stage_fn=lambda b: StagedBatch(self._shard_batch(b, stacked=True)),
+            depth=self._prefetch_depth)
+        self._prefetcher_src = data_iter
+        return self._prefetcher
 
     # ------------------------------------------------------------------
     # forward/backward/step compatibility protocol
@@ -1189,7 +1457,7 @@ class DeepSpeedTPUEngine:
             if self._apply_update_fn is None:
                 self._build_micro_fns()
             self.state, out = self._apply_update_fn(self.state, self._grad_buffer)
-            self._record_metrics(out)
+            self._record_metrics(out, sync=True)
         self._grad_buffer = None
         self._accum_count = 0
         self.global_steps += 1
@@ -1274,6 +1542,9 @@ class DeepSpeedTPUEngine:
                         client_state: Optional[dict] = None):
         """reference: engine.save_checkpoint:3109. Writes ONE logical sharded
         checkpoint (every rank participates; reshape-on-load by construction)."""
+        # checkpoint boundary = drain boundary: pending deferred metrics land
+        # (monitor/timers/guard consumers) before the state is snapshotted
+        self.flush_metrics()
         from deepspeed_tpu.checkpoint.engine import save_engine_checkpoint
         return save_engine_checkpoint(self, save_dir, tag=tag,
                                       client_state=client_state or {})

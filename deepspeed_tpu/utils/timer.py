@@ -20,6 +20,10 @@ BACKWARD_GLOBAL_TIMER = "bwd"
 STEP_MICRO_TIMER = "step_microstep"
 STEP_GLOBAL_TIMER = "step"
 TRAIN_BATCH_TIMER = "train_batch"
+# async step pipeline: host time spent *dispatching* a step (enqueue only, no
+# completion wait) — the gap between launches that latency hiding minimizes.
+# True per-step time is reconciled into TRAIN_BATCH_TIMER at each metric drain.
+TRAIN_BATCH_DISPATCH_TIMER = "train_batch_dispatch"
 
 
 def _device_sync():
@@ -38,6 +42,7 @@ class Timer:
         self.name = name
         self.synchronize = synchronize
         self._started = False
+        self._ever_started = False
         self._start_time = 0.0
         self._elapsed = 0.0
         self._records: List[float] = []
@@ -49,6 +54,7 @@ class Timer:
             _device_sync()
         self._start_time = time.time()
         self._started = True
+        self._ever_started = True
 
     def stop(self, record: bool = True):
         if not self._started:
@@ -66,8 +72,24 @@ class Timer:
         self._elapsed = 0.0
         self._records = []
 
+    def record_external(self, seconds: float, count: int = 1):
+        """Fold externally measured wall time into this timer as ``count``
+        equal records. The async step pipeline's reconciliation hook: per-step
+        start/stop in ``synchronize=False`` mode only sees dispatch time, so
+        the engine measures the true drain-to-drain window (whose end is
+        anchored by the drain's device_get) and books it here."""
+        self._ever_started = True
+        seconds = max(float(seconds), 0.0)
+        count = max(int(count), 1)
+        self._elapsed += seconds
+        self._records.extend([seconds / count] * count)
+
     def elapsed(self, reset: bool = True) -> float:
         """Elapsed seconds since last reset (stops/restarts a running timer)."""
+        if not self._ever_started:
+            logger.warning(f"timer '{self.name}': elapsed() before any "
+                           "start(); returning 0.0")
+            return 0.0
         was_started = self._started
         if was_started:
             self.stop(record=False)
@@ -80,6 +102,10 @@ class Timer:
         return value
 
     def mean(self) -> float:
+        if not self._ever_started:
+            logger.warning(f"timer '{self.name}': mean() before any start(); "
+                           "returning 0.0")
+            return 0.0
         return sum(self._records) / len(self._records) if self._records else 0.0
 
 
@@ -137,12 +163,20 @@ class ThroughputTimer:
     untimed gap between ``stop()`` and the next ``start()`` and the report
     would only see ~ms dispatch times. Edge-to-edge includes those gaps by
     construction, at one device round trip per window.
+
+    ``synchronize=False`` (async step pipeline): start/stop never touch the
+    device and NEVER close a window on their own — only ``mark_edge()``,
+    called by the engine right after a metric-ring drain (whose batched
+    ``device_get`` already proves the drained steps' device work finished),
+    closes windows. Throughput stays honest without any extra sync.
     """
 
     def __init__(self, batch_size: int, steps_per_output: int = 100,
-                 monitor_memory: bool = False, logging_fn=None):
+                 monitor_memory: bool = False, logging_fn=None,
+                 synchronize: bool = True):
         self.batch_size = max(1, batch_size)
         self.steps_per_output = steps_per_output
+        self.synchronize = synchronize
         self.logging = logging_fn or logger.info
         self.started = False
         self.global_step_count = 0
@@ -150,12 +184,14 @@ class ThroughputTimer:
         self.total_elapsed_time = 0.0   # sum over completed report windows
         self._steps_in_total = 0        # steps covered by total_elapsed_time
         self._edge_time: Optional[float] = None
+        self._last_report_step = 0
         self.flops_per_sample: Optional[float] = None
 
     def start(self):
         self.started = True
         if self._edge_time is None:
-            _device_sync()
+            if self.synchronize:
+                _device_sync()
             self._edge_time = time.time()
 
     def stop(self, global_step: bool = True, report_speed: bool = True):
@@ -166,23 +202,41 @@ class ThroughputTimer:
             return
         self.global_step_count += 1
         self.steps_since_edge += 1
-        if self.steps_per_output and \
+        if self.synchronize and self.steps_per_output and \
                 self.global_step_count % self.steps_per_output == 0:
             _device_sync()   # drain device work belonging to this window
-            now = time.time()
-            window = max(now - self._edge_time, 1e-9)
-            self.total_elapsed_time += window
-            self._steps_in_total += self.steps_since_edge
-            if report_speed:
-                sps = self.batch_size * self.steps_since_edge / window
-                msg = (f"epoch step {self.global_step_count}: "
-                       f"{sps:.1f} samples/s, batch time "
-                       f"{window / self.steps_since_edge * 1000:.1f} ms")
-                if self.flops_per_sample:
-                    msg += f", {sps * self.flops_per_sample / 1e12:.2f} TFLOPS"
-                self.logging(msg)
-            self._edge_time = now
-            self.steps_since_edge = 0
+            self._close_window(report_speed)
+
+    def mark_edge(self, report_speed: bool = True):
+        """Close the current window at a caller-guaranteed completion point
+        (the async engine calls this right after its drain's device_get, so
+        no device sync happens here). Reports at ``steps_per_output`` cadence
+        like the synchronous path."""
+        if self.steps_since_edge == 0:
+            if self._edge_time is None:
+                self._edge_time = time.time()
+            return
+        report = (report_speed and bool(self.steps_per_output)
+                  and self.global_step_count - self._last_report_step
+                  >= self.steps_per_output)
+        self._close_window(report)
+
+    def _close_window(self, report_speed: bool):
+        now = time.time()
+        window = max(now - self._edge_time, 1e-9)
+        self.total_elapsed_time += window
+        self._steps_in_total += self.steps_since_edge
+        if report_speed:
+            sps = self.batch_size * self.steps_since_edge / window
+            msg = (f"epoch step {self.global_step_count}: "
+                   f"{sps:.1f} samples/s, batch time "
+                   f"{window / self.steps_since_edge * 1000:.1f} ms")
+            if self.flops_per_sample:
+                msg += f", {sps * self.flops_per_sample / 1e12:.2f} TFLOPS"
+            self.logging(msg)
+            self._last_report_step = self.global_step_count
+        self._edge_time = now
+        self.steps_since_edge = 0
 
     def avg_samples_per_sec(self) -> float:
         """Cumulative samples/sec over completed report windows (falls back to

@@ -1,0 +1,405 @@
+"""Async step pipeline tests (runtime.async_pipeline config group).
+
+The pipeline defers step-output readback onto a device-side ring drained
+every ``sync_every`` steps and stages batches one step ahead on a background
+thread. These tests pin the contracts that make that safe:
+
+  numerics    : sync_every=1 vs 8 (± prefetch) produce bit-identical params
+                and identical per-step losses on a seed-pinned run
+  determinism : prefetch preserves batch order and the engine RNG stream
+  readback    : host transfers scale as steps/sync_every (counted, not
+                timed — wall-clock wins depend on host slack CI lacks)
+  guard lag   : the resilience StepGuard observes steps with bounded lag
+                (≤ sync_every) and every save/stop boundary flushes first,
+                so checkpoints and RunResults never reflect un-guarded steps
+"""
+
+import numpy as np
+import jax
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+from deepspeed_tpu.runtime.dataloader import PrefetchLoader
+
+CFG = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+}
+
+
+def _engine(seed=1, extra=None):
+    cfg = dict(CFG)
+    if extra:
+        cfg.update(extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=cfg,
+        example_batch=random_batch(4), seed=seed)
+    return engine
+
+
+def _params(engine):
+    return [np.asarray(x) for x in
+            jax.tree.leaves(jax.device_get(engine.state.params))]
+
+
+def _async_cfg(sync_every, prefetch=False):
+    return {"async_pipeline": {"enabled": True, "sync_every": sync_every,
+                               "prefetch": prefetch}}
+
+
+# ---------------------------------------------------------------------------
+# PrefetchLoader unit behavior
+# ---------------------------------------------------------------------------
+def test_prefetch_loader_preserves_order_and_ends():
+    src = [{"x": np.full((2,), i)} for i in range(17)]
+    out = list(PrefetchLoader(iter(src), depth=2))
+    assert len(out) == 17
+    for i, item in enumerate(out):
+        assert item["x"][0] == i          # exact source order
+
+
+def test_prefetch_loader_exhaustion_is_sticky():
+    """A drained (or closed) loader keeps raising StopIteration — it must
+    never block a caller that retries after the end of the stream."""
+    pl = PrefetchLoader(iter(range(2)), depth=2)
+    assert list(pl) == [0, 1]
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(pl)
+    pl2 = PrefetchLoader(iter(range(100)), depth=2)
+    next(pl2)
+    pl2.close()
+    with pytest.raises(StopIteration):
+        next(pl2)
+
+
+def test_prefetch_loader_applies_stage_fn_and_propagates_errors():
+    def bad_stage(item):
+        if item == 3:
+            raise ValueError("boom")
+        return item * 10
+
+    pl = PrefetchLoader(iter(range(5)), stage_fn=bad_stage, depth=2)
+    assert next(pl) == 0
+    assert next(pl) == 10
+    assert next(pl) == 20
+    with pytest.raises(ValueError, match="boom"):
+        # the staged error surfaces at the consuming __next__
+        next(pl)
+    pl.close()
+
+
+# ---------------------------------------------------------------------------
+# numerics: the acceptance parity gate
+# ---------------------------------------------------------------------------
+def test_bit_identical_params_and_losses_sync1_vs_sync8_vs_prefetch():
+    """sync_every=8 (+ prefetch) must be a pure scheduling change: identical
+    per-step losses and bit-identical final params vs the synchronous path,
+    with the engine RNG stream consumed identically."""
+    steps = 8
+    batches = [random_batch(8, seed=i) for i in range(steps)]
+
+    sync = _engine(seed=1)
+    sync_losses = [float(sync.train_batch(batch=b)) for b in batches]
+
+    lagged = _engine(seed=1, extra=_async_cfg(8))
+    lagged_losses = [lagged.train_batch(batch=b) for b in batches]
+    lagged.flush_metrics()
+    lagged_losses = [float(x) for x in lagged_losses]
+
+    pre = _engine(seed=1, extra=_async_cfg(8, prefetch=True))
+    it = iter(batches)
+    pre_losses = []
+    for _ in range(steps):
+        pre_losses.append(pre.train_batch(data_iter=it))
+    pre.flush_metrics()
+    pre_losses = [float(x) for x in pre_losses]
+
+    assert sync_losses == lagged_losses == pre_losses
+    for a, b, c in zip(_params(sync), _params(lagged), _params(pre)):
+        assert np.array_equal(a, b)
+        assert np.array_equal(a, c)       # bit-identical, not approx
+    # same RNG stream consumed (prefetch must not touch engine RNG)
+    assert np.array_equal(np.asarray(jax.device_get(sync._rng)),
+                          np.asarray(jax.device_get(pre._rng)))
+
+
+# ---------------------------------------------------------------------------
+# readback: transfers scale as steps / sync_every
+# ---------------------------------------------------------------------------
+def test_deferred_readback_transfer_count(monkeypatch):
+    """The mechanical claim of the optimization, asserted deterministically:
+    N steps cost ceil(N / sync_every) drain transfers, not N."""
+    counts = {}
+
+    real_device_get = jax.device_get
+
+    def run(sync_every, steps=8):
+        engine = _engine(seed=1, extra=_async_cfg(sync_every))
+        batches = [random_batch(8, seed=i) for i in range(steps)]
+        calls = [0]
+
+        def counting_device_get(x):
+            calls[0] += 1
+            return real_device_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_device_get)
+        try:
+            for b in batches:
+                engine.train_batch(batch=b)
+        finally:
+            monkeypatch.setattr(jax, "device_get", real_device_get)
+        counts[sync_every] = calls[0]
+
+    run(1)
+    run(8)
+    assert counts[1] == 8                 # one drain per step
+    assert counts[8] == 1                 # one drain per 8 steps
+
+
+def test_drained_entries_ordered_and_complete():
+    engine = _engine(seed=1, extra=_async_cfg(3))
+    for i in range(7):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    assert len(engine._metric_ring) == 1            # 7 = 2 drains * 3 + 1
+    flushed = engine.flush_metrics()
+    assert len(flushed) == 1
+    entries = engine.take_drained_metrics()
+    assert [e["step"] for e in entries] == list(range(1, 8))
+    for e in entries:
+        assert {"step", "samples", "loss", "grad_norm", "lr", "overflow",
+                "loss_scale"} <= set(e)
+        assert isinstance(e["loss"], float)
+    # consumed: the queue is drained
+    assert engine.take_drained_metrics() == []
+    # _last_metrics reflects the newest step, as host scalars
+    assert isinstance(engine._last_metrics["loss"], float)
+
+
+def test_monitor_events_land_at_drain(tmp_path):
+    """steps_per_print-boundary events survive the deferred readback (at most
+    sync_every late), plus the drain's steps_per_sec gauge."""
+    engine = _engine(seed=1, extra={
+        **_async_cfg(4),
+        "steps_per_print": 2,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "async"}})
+    for i in range(8):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    engine.flush_metrics()
+    d = tmp_path / "async"
+    loss_rows = (d / "Train_Samples_train_loss.csv").read_text().strip()
+    assert len(loss_rows.splitlines()) == 1 + 4     # header + steps 2,4,6,8
+    assert (d / "Train_Samples_steps_per_sec.csv").exists()
+
+
+def test_configure_async_pipeline_runtime_toggle():
+    engine = _engine(seed=1)
+    assert not engine._async_enabled
+    engine.configure_async_pipeline(enabled=True, sync_every=4)
+    for i in range(3):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    assert len(engine._metric_ring) == 3
+    engine.configure_async_pipeline(enabled=False)  # flushes first
+    assert engine._metric_ring == []
+    engine.train_batch(batch=random_batch(8, seed=9))
+    assert engine._metric_ring == []                # back to per-step path
+
+
+def test_async_disabled_on_host_offload_engines():
+    """The fused host-optimizer step is synchronous by construction: an
+    async ring would never fill and async-mode consumers would go blind —
+    the engine refuses instead of silently degrading."""
+    engine = _engine(seed=1, extra={
+        **_async_cfg(8),
+        "zero_optimization": {"offload_optimizer": {"device": "cpu"}}})
+    assert not engine._async_enabled                # forced off at init
+    loss = engine.train_batch(batch=random_batch(8, seed=0))
+    assert np.isfinite(float(loss))
+    assert engine._metric_ring == []
+    with pytest.raises(ValueError, match="host-offload"):
+        engine.configure_async_pipeline(enabled=True)
+
+
+def test_save_checkpoint_flushes_ring(tmp_path):
+    engine = _engine(seed=1, extra=_async_cfg(8))
+    for i in range(3):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    assert len(engine._metric_ring) == 3
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    assert engine._metric_ring == []                # ckpt boundary = drain
+
+
+# ---------------------------------------------------------------------------
+# resilience integration: bounded guard lag + drain-on-signal ordering
+# ---------------------------------------------------------------------------
+def _runner(engine, tmp_path, chaos=None, **rc_kw):
+    from deepspeed_tpu.resilience import FaultTolerantRunner, ResilienceConfig
+    rc_kw.setdefault("diagnostics_dir", str(tmp_path / "diag"))
+    rc_kw.setdefault("autosave", {})
+    rc_kw["autosave"].setdefault("io_backoff_s", 0.01)
+    return FaultTolerantRunner(engine, save_dir=str(tmp_path / "ckpt"),
+                               config=ResilienceConfig(**rc_kw), chaos=chaos)
+
+
+def test_guard_detection_lag_bounded_by_sync_every(tmp_path):
+    """A NaN step is skipped on-device immediately, but the host guard only
+    learns about it at the next drain — and no later."""
+    from deepspeed_tpu.resilience import ChaosConfig, ChaosMonkey
+    engine = _engine(seed=1, extra=_async_cfg(4))
+    chaos = ChaosMonkey(ChaosConfig(seed=7, nan_steps=frozenset({1})))
+    runner = _runner(engine, tmp_path, chaos=chaos,
+                     step_guard={"backoff_after": 0, "quarantine_after": 0})
+    for step in range(3):
+        runner.step(batch=random_batch(8, seed=step))
+    # device-side skip already happened; host guard hasn't drained yet
+    assert engine.skipped_steps == 1
+    assert runner.guard.total_bad == 0
+    runner.step(batch=random_batch(8, seed=3))      # step 4 -> drain boundary
+    assert runner.guard.total_bad == 1              # lag <= sync_every
+    assert len(runner.history) == 4
+    runner.close()
+
+
+def test_quarantine_still_fires_with_lag_and_params_stay_clean(tmp_path):
+    from deepspeed_tpu.resilience import (ChaosConfig, ChaosMonkey,
+                                          QuarantineError)
+    engine = _engine(seed=1, extra=_async_cfg(4))
+    chaos = ChaosMonkey(ChaosConfig(seed=1, nan_prob=1.0))
+    runner = _runner(engine, tmp_path, chaos=chaos,
+                     step_guard={"backoff_after": 0, "quarantine_after": 3})
+    with pytest.raises(QuarantineError):
+        runner.run(num_steps=10, batch_fn=lambda s: random_batch(8, seed=s))
+    runner.close()
+    # every bad step was still dropped on-device at the step it happened
+    assert engine.skipped_steps >= 3
+    assert all(bool(np.isfinite(p).all()) for p in _params(engine))
+    # quarantine fired at the 3rd bad entry; close()'s final drain judged
+    # the requeued 4th (no step escapes the guard), hence >= not ==
+    assert runner.guard.consecutive_bad >= 3
+
+
+def test_runner_hands_iterator_through_to_prefetch(tmp_path):
+    """FaultTolerantRunner(data_iter=...) must not defeat prefetch by
+    materializing batches itself — without a chaos monkey the iterator goes
+    straight through to the engine's background staging. With chaos, batch
+    corruption needs host materialization, so prefetch stays off."""
+    from deepspeed_tpu.resilience import ChaosConfig, ChaosMonkey
+    engine = _engine(seed=1, extra=_async_cfg(4, prefetch=True))
+    runner = _runner(engine, tmp_path, chaos=None)
+    it = iter([random_batch(8, seed=i) for i in range(6)])
+    result = runner.run(num_steps=4, data_iter=it)
+    runner.close()
+    assert result.steps_completed == 4
+    assert engine._prefetcher is not None          # staging engaged
+    assert np.isfinite(result.last_loss)
+
+    chaotic = _engine(seed=1, extra=_async_cfg(4, prefetch=True))
+    runner2 = _runner(chaotic, tmp_path,
+                      chaos=ChaosMonkey(ChaosConfig(seed=5)))
+    it2 = iter([random_batch(8, seed=i) for i in range(3)])
+    runner2.run(num_steps=2, data_iter=it2)
+    runner2.close()
+    assert chaotic._prefetcher is None             # inline path kept
+
+
+def test_guard_raise_mid_replay_requeues_unjudged_tail(tmp_path):
+    """When quarantine fires on entry k of a drained batch, entries k+1..n
+    go back to the engine's queue — a later flush still judges them, so no
+    step ever escapes the guard because an earlier one blew up."""
+    from deepspeed_tpu.resilience import (ChaosConfig, ChaosMonkey,
+                                          QuarantineError)
+    engine = _engine(seed=1, extra=_async_cfg(4))
+    chaos = ChaosMonkey(ChaosConfig(seed=1, nan_prob=1.0))
+    runner = _runner(engine, tmp_path, chaos=chaos,
+                     step_guard={"backoff_after": 0, "quarantine_after": 2})
+    with pytest.raises(QuarantineError):
+        runner.run(num_steps=8, batch_fn=lambda s: random_batch(8, seed=s))
+    # 4 entries drained at the step-4 boundary; quarantine raised on the
+    # 2nd -> the other 2 are back in the queue, not silently dropped
+    assert len(engine._drained_metrics) == 2
+    assert [e["step"] for e in engine._drained_metrics] == [3, 4]
+    runner.close()                                  # final drain judges them
+    assert len(engine._drained_metrics) == 0
+    assert runner.guard.total_bad == 4
+    runner.close()
+
+
+def test_sigterm_autosave_flushes_ring_before_snapshot(tmp_path):
+    """Drain-on-signal ordering: the preemption save replays the pending
+    ring through the guard FIRST, so the committed checkpoint's guard state
+    already counts a NaN hiding in the un-drained window."""
+    import os
+    import signal
+    from deepspeed_tpu.checkpoint.engine import is_committed
+    from deepspeed_tpu.resilience import (ChaosConfig, ChaosMonkey,
+                                          find_latest_committed)
+    engine = _engine(seed=1, extra=_async_cfg(8))
+    chaos = ChaosMonkey(ChaosConfig(seed=7, nan_steps=frozenset({0})))
+    runner = _runner(engine, tmp_path, chaos=chaos,
+                     step_guard={"backoff_after": 0, "quarantine_after": 0})
+    fired = []
+
+    def batches(step):
+        if step == 2 and not fired:
+            fired.append(step)
+            os.kill(os.getpid(), signal.SIGTERM)
+        return random_batch(8, seed=step)
+
+    result = runner.run(num_steps=6, batch_fn=batches)
+    runner.close()
+    assert result.stop_reason == "preempted"
+    assert result.steps_completed == 3
+    assert engine._metric_ring == []                # flushed before snapshot
+    assert runner.guard.total_bad == 1              # NaN seen despite lag
+    ckpt_dir = str(tmp_path / "ckpt")
+    tag = find_latest_committed(ckpt_dir)
+    assert tag == "global_step3"
+    assert is_committed(ckpt_dir, tag)
+
+    # the committed client_state carries the flushed guard verdicts
+    fresh = _engine(seed=9, extra=_async_cfg(8))
+    runner2 = _runner(fresh, tmp_path)
+    assert runner2.resume_from_latest() == "global_step3"
+    assert runner2.guard.total_bad == 1
+    runner2.close()
+
+
+@pytest.mark.slow
+def test_resume_parity_with_async_pipeline(tmp_path):
+    """save -> SIGTERM -> resume under the async pipeline matches an
+    uninterrupted async baseline step for step (the PR-2 chaos contract
+    survives deferred readback). Marked slow: tier-1 keeps the cheaper
+    drain-on-signal ordering test above; full CI (`pytest -m ""`) runs
+    this three-engine parity flavor."""
+    import os
+    import signal
+    total = 6
+    base = _engine(seed=1, extra=_async_cfg(4))
+    base_losses = [float(base.train_batch(batch=random_batch(8, seed=s)))
+                   for s in range(total)]
+
+    victim = _engine(seed=1, extra=_async_cfg(4))
+    runner = _runner(victim, tmp_path)
+    fired = []
+
+    def batches(step):
+        if step == 3 and not fired:
+            fired.append(step)
+            os.kill(os.getpid(), signal.SIGTERM)
+        return random_batch(8, seed=step)
+
+    result = runner.run(num_steps=total, batch_fn=batches)
+    runner.close()
+    assert result.stop_reason == "preempted"
+
+    resumed = _engine(seed=42, extra=_async_cfg(4))
+    runner2 = _runner(resumed, tmp_path)
+    assert runner2.resume_from_latest() == "global_step4"
+    post = [float(resumed.train_batch(batch=random_batch(8, seed=s)))
+            for s in range(4, total)]
+    resumed.flush_metrics()
+    runner2.close()
+    for expect, got in zip(base_losses[4:], post):
+        assert abs(expect - got) < 1e-6

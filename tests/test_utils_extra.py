@@ -123,3 +123,52 @@ def test_tensor_fragment_routes_through_offload_masters():
     # master survives on the host tier (not just the shadow)
     idx_master = safe_get_full_optimizer_state(eng, "lm_head/kernel", "mu")
     assert idx_master.shape == w.shape
+
+
+# ---------------------------------------------------------------------------
+# timers: never-started hardening + async-pipeline reconciliation hooks
+# ---------------------------------------------------------------------------
+def test_timer_never_started_returns_zero_with_warning(monkeypatch):
+    from deepspeed_tpu.utils import timer as timer_mod
+    from deepspeed_tpu.utils.timer import Timer
+    warned = []
+    monkeypatch.setattr(timer_mod.logger, "warning",
+                        lambda msg, *a: warned.append(msg % a if a else msg))
+    t = Timer("idle", synchronize=False)
+    assert t.elapsed() == 0.0
+    assert t.mean() == 0.0
+    assert len(warned) == 2          # one per accessor, no raise
+    assert all("idle" in m for m in warned)
+
+    t.start()
+    t.stop()
+    assert t.mean() >= 0.0           # started once: no warning path
+    assert t.elapsed(reset=True) >= 0.0
+    assert t.elapsed() == 0.0        # post-reset: still no raise/warning spam
+
+
+def test_timer_record_external_reconciles_async_windows():
+    from deepspeed_tpu.utils.timer import Timer
+    t = Timer("train_batch", synchronize=False)
+    t.record_external(0.8, count=4)  # one drained window, 4 steps
+    assert t.mean() == pytest.approx(0.2)
+    assert t.elapsed(reset=False) == pytest.approx(0.8)
+    t.record_external(0.2, count=2)
+    assert t.mean() == pytest.approx(1.0 / 6)
+
+
+def test_throughput_timer_mark_edge_closes_windows_without_sync():
+    import time as _time
+    from deepspeed_tpu.utils.timer import ThroughputTimer
+    msgs = []
+    t = ThroughputTimer(batch_size=4, steps_per_output=2, synchronize=False,
+                        logging_fn=msgs.append)
+    for _ in range(4):
+        t.start()
+        t.stop(global_step=True)     # no window close without an edge
+        _time.sleep(0.01)
+    assert t.total_elapsed_time == 0.0
+    t.mark_edge()                    # the engine's post-drain hook
+    assert t.total_elapsed_time > 0.0
+    assert t.avg_samples_per_sec() > 0.0
+    assert len(msgs) == 1            # reported once past steps_per_output
