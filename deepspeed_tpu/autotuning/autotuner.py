@@ -29,6 +29,7 @@ from deepspeed_tpu.autotuning.tuner import (
     ModelBasedTuner,
     RandomTuner,
 )
+from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.utils.logging import logger
 
 DEFAULT_MIN_MBS = 1
@@ -37,6 +38,13 @@ TUNER_CLASSES = {
     "random": RandomTuner,
     "model_based": ModelBasedTuner,
 }
+#: keys accepted in the ds-config "autotuning" group (reference:
+#: autotuning/config.py DeepSpeedAutotuningConfig — subset meaningful here)
+_AUTOTUNING_GROUP_KEYS = frozenset({
+    "enabled", "metric", "tuner_type", "zero_stages", "max_micro_batch",
+    "num_micro_batches", "try_remat", "try_offload", "num_tuning_trials",
+    "early_stopping", "results_dir",
+})
 
 
 def estimate_state_bytes(n_params: int, stage: int, fsdp_size: int,
@@ -95,20 +103,46 @@ class Autotuner:
                  isolation_cpu_devices: Optional[int] = None):
         self.model = model
         self.base_config = dict(base_config)
+        # the ds-config "autotuning" group configures the tuner exactly like
+        # the reference (single-JSON contract: one config drives engine AND
+        # tuner); group values override the constructor defaults for any
+        # knob the caller did not set in the config dict itself
+        at = self.base_config.get(C.AUTOTUNING)
+        if at is None:
+            at = {}
+        elif isinstance(at, bool):
+            at = {"enabled": at}      # `"autotuning": false` shorthand
+        elif not isinstance(at, dict):
+            raise ValueError(
+                f'config "{C.AUTOTUNING}" group must be a dict or bool '
+                f'(e.g. {{"enabled": true, "metric": "throughput"}}), '
+                f"got {type(at).__name__}: {at!r}")
+        unknown = set(at) - _AUTOTUNING_GROUP_KEYS
+        if unknown:
+            logger.warning(f"autotuning config group: unknown keys "
+                           f"{sorted(unknown)} ignored "
+                           f"(known: {sorted(_AUTOTUNING_GROUP_KEYS)})")
+        # "enabled": false turns tune() into a pass-through (reference: the
+        # launcher consults autotuning.enabled before tuning) — porting a
+        # reference config with tuning switched off must not burn trials
+        self.enabled = bool(at.get("enabled", True))
+        metric = at.get("metric", metric)
         if metric not in ExperimentRunner.METRICS:
             raise ValueError(f"unknown autotuning metric {metric!r}; "
                              f"one of {ExperimentRunner.METRICS}")
         self.metric = metric
-        self.tuner_type = tuner_type
+        self.tuner_type = at.get("tuner_type", tuner_type)
+        zero_stages = at.get("zero_stages", zero_stages)
         self.zero_stages = zero_stages if zero_stages is not None else [0, 1, 2, 3]
-        self.max_micro_batch = max_micro_batch
-        self.num_micro_batches = num_micro_batches
-        self.try_remat = try_remat
+        self.max_micro_batch = int(at.get("max_micro_batch", max_micro_batch))
+        self.num_micro_batches = int(at.get("num_micro_batches",
+                                            num_micro_batches))
+        self.try_remat = bool(at.get("try_remat", try_remat))
         # None = auto: offload variants only where nothing fits in HBM
-        self.try_offload = try_offload
-        self.n_trials = n_trials
-        self.early_stopping = early_stopping
-        self.results_dir = results_dir
+        self.try_offload = at.get("try_offload", try_offload)
+        self.n_trials = int(at.get("num_tuning_trials", n_trials))
+        self.early_stopping = int(at.get("early_stopping", early_stopping))
+        self.results_dir = at.get("results_dir", results_dir)
         self.hbm_bytes = hbm_bytes
         self._prune_mesh = mesh   # stage-feasibility pruning (tune()) even
         if isolation == "process":  # when experiments run in children
@@ -228,6 +262,11 @@ class Autotuner:
 
     # ------------------------------------------------------------------
     def tune(self) -> Tuple[Optional[Dict[str, Any]], Dict[str, float]]:
+        if not self.enabled:
+            logger.info("autotuning: disabled via the config group "
+                        "(autotuning.enabled=false); returning base config "
+                        "unchanged")
+            return dict(self.base_config), {}
         fsdp = 1
         mesh = getattr(self.runner, "mesh", None) or self._prune_mesh
         if mesh is not None:

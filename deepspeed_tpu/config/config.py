@@ -108,7 +108,8 @@ class DataTypesConfig(DeepSpeedTPUConfigModel):
     def _check(self):
         if self.grad_accum_dtype not in (None, "fp32", "bf16", "fp16"):
             raise ValueError(
-                f"grad_accum_dtype must be fp32|bf16|fp16, got {self.grad_accum_dtype}")
+                f"{C.GRAD_ACCUM_DTYPE} must be fp32|bf16|fp16, "
+                f"got {self.grad_accum_dtype}")
         return self
 
 
@@ -328,7 +329,7 @@ class DeepSpeedTPUConfig:
                 logger.warning(f"config key '{key}' has no TPU equivalent; ignored")
 
         self.fp16 = FP16Config(**self._raw.get(C.FP16, {}))
-        self.bf16 = BF16Config(**self._raw.get(C.BF16, self._raw.get("bfloat16", {})))
+        self.bf16 = BF16Config(**self._raw.get(C.BF16, self._raw.get(C.BF16_LEGACY, {})))
         self.zero_config = ZeroConfig(**self._raw.get(C.ZERO_OPTIMIZATION, {}))
         self.optimizer = OptimizerConfig(**self._raw[C.OPTIMIZER]) if C.OPTIMIZER in self._raw else None
         self.scheduler = SchedulerConfig(**self._raw[C.SCHEDULER]) if C.SCHEDULER in self._raw else None
@@ -354,20 +355,20 @@ class DeepSpeedTPUConfig:
         self.data_types = DataTypesConfig(**self._raw.get(C.DATA_TYPES, {}))
         self.async_pipeline = AsyncPipelineConfig(
             **self._raw.get(C.ASYNC_PIPELINE, {}))
-        self.pld = PLDConfig(**self._raw.get("progressive_layer_drop", {}))
+        self.pld = PLDConfig(**self._raw.get(C.PROGRESSIVE_LAYER_DROP, {}))
         # single schema shared with the implementation (no parallel copy to
         # keep in sync): reference get_eigenvalue_config (runtime/config.py:565)
         from deepspeed_tpu.runtime.eigenvalue import EigenvalueConfig
-        self.eigenvalue = EigenvalueConfig(**self._raw.get("eigenvalue", {}))
+        self.eigenvalue = EigenvalueConfig(**self._raw.get(C.EIGENVALUE, {}))
         # reference: get_sparse_gradients_enabled (runtime/config.py:247)
         self.sparse_gradients_enabled: bool = bool(
-            self._raw.get("sparse_gradients", False))
+            self._raw.get(C.SPARSE_GRADIENTS, False))
         # resilience subsystem (step guards / autosave / watchdog); the engine
         # only arms its device-side guard when the group is explicitly present
         # so default bf16/fp32 NaN propagation semantics are unchanged
         from deepspeed_tpu.resilience.config import ResilienceConfig
-        self.resilience = ResilienceConfig(**self._raw.get("resilience", {}))
-        self.resilience_explicit: bool = "resilience" in self._raw
+        self.resilience = ResilienceConfig(**self._raw.get(C.RESILIENCE, {}))
+        self.resilience_explicit: bool = C.RESILIENCE in self._raw
 
         self.gradient_clipping: float = float(
             self._raw.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))
@@ -377,12 +378,12 @@ class DeepSpeedTPUConfig:
         self.steps_per_print: int = int(
             self._raw.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
         self.wall_clock_breakdown: bool = bool(self._raw.get(C.WALL_CLOCK_BREAKDOWN, False))
-        self.dump_state: bool = bool(self._raw.get("dump_state", False))
+        self.dump_state: bool = bool(self._raw.get(C.DUMP_STATE, False))
         # numerical sanitizer (SURVEY §5.2): aborts with a traceback at the
         # first NaN-producing op instead of silently propagating — the
         # jax_debug_nans analog of the reference's CheckOverflow/_has_inf_or_nan
         # guards (with fp16 enabled, prefer the loss-scaler's overflow skip)
-        self.debug_nans: bool = bool(self._raw.get("debug_nans", False))
+        self.debug_nans: bool = bool(self._raw.get(C.DEBUG_NANS, False))
 
         # --- batch size triple reconciliation (reference: config.py
         #     _configure_train_batch_size / _batch_assertion) ---
@@ -416,13 +417,15 @@ class DeepSpeedTPUConfig:
         elif mb is not None and gas is not None:
             tb = mb * gas * dp_world_size
         elif tb is not None:
-            gas = 1
-            if tb % dp_world_size != 0:
-                raise ValueError(f"train_batch_size {tb} not divisible by dp {dp_world_size}")
-            mb = tb // dp_world_size
+            gas = C.GRADIENT_ACCUMULATION_STEPS_DEFAULT
+            if tb % (gas * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by "
+                    f"gas {gas} * dp {dp_world_size}")
+            mb = tb // (gas * dp_world_size)
         elif mb is not None:
-            gas = 1
-            tb = mb * dp_world_size
+            gas = C.GRADIENT_ACCUMULATION_STEPS_DEFAULT
+            tb = mb * gas * dp_world_size
         elif gas is not None:
             # gas alone (reference _set_batch_related_parameters: micro
             # defaults to 1, train batch follows) — the pipeline engine
@@ -431,8 +434,8 @@ class DeepSpeedTPUConfig:
             mb = 1
             tb = gas * dp_world_size
         else:
-            mb, gas = 1, 1
-            tb = dp_world_size
+            mb, gas = 1, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT
+            tb = mb * gas * dp_world_size
         self.train_batch_size, self.train_micro_batch_size_per_gpu, \
             self.gradient_accumulation_steps = int(tb), int(mb), int(gas)
 

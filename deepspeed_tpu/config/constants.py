@@ -36,9 +36,17 @@ AUTOTUNING = "autotuning"
 CHECKPOINT = "checkpoint"
 DATA_TYPES = "data_types"                 # reference: constants.py:426
 GRAD_ACCUM_DTYPE = "grad_accum_dtype"     # reference: constants.py:427
-# TPU-native: latency-hiding step pipeline (deferred metric readback +
-# double-buffered batch prefetch) — no reference analog
-ASYNC_PIPELINE = "async_pipeline"
+PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
+EIGENVALUE = "eigenvalue"
+SPARSE_GRADIENTS = "sparse_gradients"
+DUMP_STATE = "dump_state"
+# legacy spelling of the bf16 group accepted for drop-in compatibility
+# (reference: BFLOAT16_CONFIG_LEGACY, constants.py:132)
+BF16_LEGACY = "bfloat16"
+# TPU-native keys — no reference analog
+ASYNC_PIPELINE = "async_pipeline"   # latency-hiding step pipeline group
+RESILIENCE = "resilience"           # fault-tolerance group (guards/autosave)
+DEBUG_NANS = "debug_nans"           # jax_debug_nans for the compiled step
 
 # Defaults (mirroring reference semantics)
 STEPS_PER_PRINT_DEFAULT = 10

@@ -91,7 +91,7 @@ class CurriculumDataSampler:
         for sched in self.schedulers.values():
             sched.update_difficulty(self.global_step)
         admitted = self._admitted_mask()
-        if not admitted.any():
+        if not bool(admitted.any()):
             # Degenerate config (min difficulty below every sample): admit all, like
             # the reference's fallback to the first cluster.
             admitted = np.ones(self.total_samples, dtype=bool)

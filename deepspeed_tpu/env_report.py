@@ -66,7 +66,31 @@ def debug_report():
         rows.append(("device kind", devices[0].device_kind))
     except Exception as e:  # no devices available
         rows.append(("jax backend", f"unavailable ({e})"))
+    rows.extend(dslint_report())
     return rows
+
+
+def dslint_report():
+    """Static-analysis surface: how many rules enforce the TPU bug classes
+    and how much grandfathered debt the checked-in baseline carries (0 is
+    the healthy steady state — new findings fail tier-1)."""
+    import os
+    try:
+        from deepspeed_tpu.tools.dslint import (find_default_baseline,
+                                                get_rules, load_baseline)
+        rows = [("dslint rules", str(len(get_rules())))]
+        bl = find_default_baseline(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        if bl is None:
+            rows.append(("dslint baseline", "not found (installed package?)"))
+        else:
+            n = len(load_baseline(bl).get("entries", []))
+            rows.append(("dslint baseline",
+                         f"{n} grandfathered finding{'s' if n != 1 else ''} "
+                         f"({bl})"))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("dslint", f"unavailable ({e})")]
 
 
 def checkpoint_report(run_dir):

@@ -78,7 +78,8 @@ class FaultTolerantRunner:
         self.autosaver = ckpt.Autosaver(self.cfg.autosave.every_steps,
                                         self.cfg.autosave.every_seconds)
         self.watchdog: Optional[StepWatchdog] = None
-        self._watchdog_stop = False     # an interrupt-policy flag fired
+        # set from the watchdog monitor thread, read by the main loop
+        self._watchdog_stop = threading.Event()
         if self.cfg.watchdog.enabled:
             self.watchdog = StepWatchdog(
                 self.cfg.watchdog, diagnostics_dir=self.cfg.diagnostics_dir,
@@ -114,7 +115,13 @@ class FaultTolerantRunner:
         # async-signal context: set the flag only; the save happens at the
         # step boundary (a save from inside a handler could re-enter orbax
         # mid-write — the torn-checkpoint case this subsystem exists to kill)
+        # dslint: disable=DS004 -- handler runs ON the main thread between
+        # bytecodes; taking a lock here could deadlock against the code it
+        # interrupted, so a GIL-atomic int store is the only safe write
         self._preempt_signal = signum
+        # dslint: disable=DS005 -- one best-effort log line: logging's RLock
+        # is re-entrant on this same (main) thread, and operators need the
+        # "preemption acknowledged" breadcrumb exactly at signal time
         logger.warning(f"resilience: caught signal {signum}; autosave + "
                        f"clean stop at the next step boundary")
 
@@ -419,10 +426,10 @@ class FaultTolerantRunner:
         # only an interrupt-policy flag stops the run; a warn-policy flag
         # earlier in the run must not relabel a later real preemption
         if self.cfg.watchdog.policy == "interrupt":
-            self._watchdog_stop = True
+            self._watchdog_stop.set()
 
     def _stop_reason(self) -> str:
-        return "watchdog" if self._watchdog_stop else "preempted"
+        return "watchdog" if self._watchdog_stop.is_set() else "preempted"
 
     def _export_monitor_events(self):
         """Resilience observability through the engine's monitor fan-out

@@ -43,7 +43,7 @@ class PrefetchLoader:
         self._source = iter(source)
         self._stage_fn = stage_fn
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
-        self._closed = False
+        self._closed = threading.Event()   # set by close(), read by worker
         self._done = False
         self._thread = threading.Thread(
             target=self._worker, name="dstpu-prefetch", daemon=True)
@@ -52,7 +52,7 @@ class PrefetchLoader:
     def _worker(self):
         def _put(item) -> bool:
             # bounded-wait put so close() can always terminate the worker
-            while not self._closed:
+            while not self._closed.is_set():
                 try:
                     self._q.put(item, timeout=0.1)
                     return True
@@ -61,7 +61,7 @@ class PrefetchLoader:
             return False
 
         try:
-            while not self._closed:
+            while not self._closed.is_set():
                 try:
                     item = next(self._source)
                 except StopIteration:
@@ -94,7 +94,7 @@ class PrefetchLoader:
     def close(self):
         """Stop the worker and drop buffered batches (used when the engine
         switches data iterators or is reconfigured)."""
-        self._closed = True
+        self._closed.set()
         self._done = True
         while True:                  # unblock a producer stuck on put()
             try:

@@ -75,6 +75,9 @@ def has_inf_or_nan(grads: Any) -> jnp.ndarray:
     if not leaves:
         return jnp.bool_(False)
     flags = [jnp.logical_not(jnp.all(jnp.isfinite(l.astype(jnp.float32)))) for l in leaves]
+    # dslint: disable=DS003 -- device-side flag BY DESIGN: this runs inside
+    # the jitted step, so the traced jnp.bool_ is the product (bool() here
+    # would be a tracer error); the host boundary converts at readback
     return jnp.any(jnp.stack(flags))
 
 

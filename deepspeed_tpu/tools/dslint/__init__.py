@@ -1,0 +1,48 @@
+"""dslint — JAX/TPU-aware static analysis for this repo's recurring bug
+classes.
+
+Rule catalog (see ``docs/static_analysis.md``):
+
+  DS001 donation-safety        read of a pytree after donate_argnums dispatch
+  DS002 host-sync-in-hot-path  float()/.item()/device_get in a registered hot path
+  DS003 0-d-array-truthiness   array reduction used as a Python bool
+  DS004 thread-shared-state    unlocked writes across a thread boundary
+  DS005 signal-handler-safety  non-reentrant work inside a signal handler
+  DS006 config-key-drift       raw keys vs config/constants.py, dead constants
+
+Programmatic entry points::
+
+    from deepspeed_tpu.tools.dslint import lint_paths
+    result = lint_paths(["deepspeed_tpu/"], baseline_path="dslint_baseline.json")
+    assert not result.findings
+"""
+
+from typing import Iterable, Optional
+
+from deepspeed_tpu.tools.dslint.baseline import (find_default_baseline,
+                                                 load_baseline,
+                                                 write_baseline)
+from deepspeed_tpu.tools.dslint.engine import (Finding, LintEngine,
+                                               LintResult, Rule)
+from deepspeed_tpu.tools.dslint.rules import ALL_RULES, get_rules
+
+__all__ = [
+    "Finding", "LintEngine", "LintResult", "Rule", "ALL_RULES", "get_rules",
+    "lint_paths", "load_baseline", "write_baseline", "find_default_baseline",
+]
+
+
+def lint_paths(paths: Iterable[str], baseline_path: Optional[str] = None,
+               root: Optional[str] = None,
+               select: Optional[Iterable[str]] = None,
+               ignore: Optional[Iterable[str]] = None,
+               rules: Optional[list] = None) -> LintResult:
+    """One-call lint: fresh rules, optional baseline, relative to ``root``
+    (defaults to the baseline file's directory so baseline paths match)."""
+    baseline = load_baseline(baseline_path) if baseline_path else None
+    if root is None and baseline_path:
+        import os
+        root = os.path.dirname(os.path.abspath(baseline_path))
+    engine = LintEngine(rules if rules is not None else get_rules(),
+                        root=root, select=select, ignore=ignore)
+    return engine.run(paths, baseline=baseline)

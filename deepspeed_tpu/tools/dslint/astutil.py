@@ -1,0 +1,159 @@
+"""Small AST helpers shared by the dslint rules."""
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None. ``self.x`` keeps the
+    ``self.`` prefix so callers can distinguish methods from locals."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def literal_int_tuple(node: ast.expr) -> Optional[Tuple[int, ...]]:
+    """Evaluate an int / tuple-of-ints literal (donate_argnums shapes)."""
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(v, int):
+        return (v,)
+    if isinstance(v, (tuple, list)) and all(isinstance(x, int) for x in v):
+        return tuple(v)
+    return None
+
+
+def functions_of(scope: ast.AST) -> Iterator[ast.AST]:
+    """Direct function/method children of a module or class body."""
+    for node in ast.iter_child_nodes(scope):
+        if isinstance(node, FunctionNode):
+            yield node
+
+
+def classes_of(module: ast.Module) -> Iterator[ast.ClassDef]:
+    for node in ast.walk(module):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def methods_of(cls: ast.ClassDef) -> dict:
+    return {n.name: n for n in cls.body if isinstance(n, FunctionNode)}
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``x`` when node is the attribute access ``self.x``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def assigned_names(target: ast.expr) -> Iterator[ast.expr]:
+    """Flatten tuple/list/starred assignment targets to leaf expressions."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            yield from assigned_names(el)
+    elif isinstance(target, ast.Starred):
+        yield from assigned_names(target.value)
+    else:
+        yield target
+
+
+def statement_targets(stmt: ast.stmt) -> List[ast.expr]:
+    """Assignment target leaves of a statement (Assign/AugAssign/AnnAssign/
+    with-as/for)."""
+    out: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            out.extend(assigned_names(t))
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        out.extend(assigned_names(stmt.target))
+    elif isinstance(stmt, ast.For):
+        out.extend(assigned_names(stmt.target))
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out.extend(assigned_names(item.optional_vars))
+    return out
+
+
+_LOCKISH = ("lock", "mutex", "cond", "condition", "sem")
+
+
+def _lockish_expr(expr: ast.expr) -> bool:
+    name = dotted_name(expr)
+    if isinstance(expr, ast.Call):
+        name = call_name(expr)        # with self._lock_for(x): ...
+    if not name:
+        return False
+    leaf = name.split(".")[-1].lower()
+    return any(tok in leaf for tok in _LOCKISH)
+
+
+def lock_protected_lines(func: ast.AST) -> set:
+    """Line numbers inside ``with <lock-ish>`` blocks of ``func``, plus —
+    for the explicit ``x.acquire()`` / ``x.release()`` pattern — the span
+    from the first acquire to the matching release (to the end of the
+    function when no release is visible). Code BEFORE the acquire is not
+    protected: treating the whole function as locked would silence real
+    unprotected writes."""
+    lines: set = set()
+    acquire_line = None
+    release_line = None
+    for node in ast.walk(func):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            if any(_lockish_expr(item.context_expr) for item in node.items):
+                hi = max((getattr(n, "end_lineno", None) or node.lineno)
+                         for n in ast.walk(node))
+                lines.update(range(node.lineno, hi + 1))
+        elif isinstance(node, ast.Call):
+            nm = call_name(node)
+            if nm and nm.endswith(".acquire"):
+                acquire_line = min(acquire_line or node.lineno, node.lineno)
+            elif nm and nm.endswith(".release"):
+                release_line = max(release_line or node.lineno, node.lineno)
+    if acquire_line is not None:
+        end = release_line if release_line is not None \
+            else max((getattr(n, "end_lineno", None) or func.lineno)
+                     for n in ast.walk(func))
+        lines.update(range(acquire_line, end + 1))
+    return lines
+
+
+def import_aliases(module: ast.Module, targets: Sequence[str]) -> dict:
+    """Map local alias -> canonical module name for ``targets`` (e.g.
+    ``{"np": "numpy", "jnp": "jax.numpy", "jax": "jax"}``)."""
+    out = {}
+    for node in ast.walk(module):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in targets:
+                    out[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            full = node.module or ""
+            for a in node.names:
+                dotted = f"{full}.{a.name}" if full else a.name
+                if dotted in targets:
+                    out[a.asname or a.name] = dotted
+    return out

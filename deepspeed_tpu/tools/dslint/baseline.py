@@ -1,0 +1,127 @@
+"""Checked-in baseline of grandfathered findings.
+
+The baseline lets dslint land with the tree non-clean and ratchet from
+there: existing findings are recorded once (``dslint --write-baseline``),
+new code must be clean, and fixing a grandfathered finding surfaces the
+entry as *stale* so it can be expired (re-run ``--write-baseline``) instead
+of silently shielding a future regression at the same anchor.
+
+Entries are keyed ``(rule, path, anchor)`` with an occurrence ``count`` —
+anchors carry no line numbers, so edits elsewhere in the file never churn
+the baseline, and introducing a *second* violation at an anchor that
+grandfathers one is still reported.
+"""
+
+import collections
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.tools.dslint.engine import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = "dslint_baseline.json"
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} in {path} "
+            f"(expected {BASELINE_VERSION})")
+    return data
+
+
+def find_default_baseline(start: str) -> Optional[str]:
+    """Walk up from ``start`` looking for the checked-in baseline file."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, DEFAULT_BASELINE_NAME)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def _covered(entry: dict, covered_paths, active_rules) -> bool:
+    """Did a run with this coverage actually re-evaluate ``entry``?"""
+    if covered_paths is not None and entry["path"] not in covered_paths:
+        return False
+    if active_rules is not None and entry["rule"] not in active_rules:
+        return False
+    return True
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   prior: Optional[dict] = None,
+                   covered_paths=None, active_rules=None):
+    """Serialize current findings as the new baseline (sorted, counted).
+
+    With ``prior`` + coverage sets, entries the run did NOT re-evaluate
+    (file outside the linted paths, rule deselected) are carried over
+    verbatim — ``--write-baseline`` on a subset must never truncate the
+    repo baseline for everything else.
+
+    ``DS000`` parse errors are never grandfathered: an unparseable file is
+    an UNLINTED file, and hiding it behind the baseline would make every
+    future violation in it invisible.
+    """
+    findings = [f for f in findings if f.rule != "DS000"]
+    counts: Dict[Tuple[str, str, str], int] = collections.Counter(
+        f.key for f in findings)
+    messages: Dict[Tuple[str, str, str], str] = {}
+    for f in findings:
+        messages.setdefault(f.key, f.message)
+    entries = [{"rule": rule, "path": p, "anchor": anchor, "count": n,
+                "message": messages[(rule, p, anchor)]}
+               for (rule, p, anchor), n in sorted(counts.items())]
+    if prior is not None:
+        kept = [e for e in prior.get("entries", [])
+                if not _covered(e, covered_paths, active_rules)]
+        entries = sorted(entries + kept,
+                         key=lambda e: (e["rule"], e["path"], e["anchor"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION, "entries": entries}, f,
+                  indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def match_baseline(findings: List[Finding], baseline: Optional[dict],
+                   covered_paths=None, active_rules=None
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split findings into (new, grandfathered) and report stale entries.
+
+    Per key, up to ``count`` findings are absorbed by the baseline; the
+    rest stay live. Entries whose key matched fewer findings than their
+    count are stale (the violation was fixed — expire the entry) — but
+    only when the run actually re-evaluated them: an entry for a file
+    outside ``covered_paths`` or a rule outside ``active_rules`` (a
+    partial / --select run) is simply not judged.
+    """
+    if not baseline:
+        return list(findings), [], []
+    budget: Dict[Tuple[str, str, str], int] = {}
+    entry_by_key: Dict[Tuple[str, str, str], dict] = {}
+    for e in baseline.get("entries", []):
+        key = (e["rule"], e["path"], e["anchor"])
+        budget[key] = budget.get(key, 0) + int(e.get("count", 1))
+        entry_by_key[key] = e
+    new: List[Finding] = []
+    grandfathered: List[Finding] = []
+    used: Dict[Tuple[str, str, str], int] = collections.defaultdict(int)
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            used[f.key] += 1
+            grandfathered.append(f)
+        else:
+            new.append(f)
+    stale = [entry_by_key[k] for k, leftover in sorted(budget.items())
+             if leftover > 0
+             and _covered(entry_by_key[k], covered_paths, active_rules)]
+    return new, grandfathered, stale

@@ -1,0 +1,153 @@
+"""``dslint`` CLI — lint the tree against the repo's TPU bug classes.
+
+    dslint deepspeed_tpu/                     # text report, auto baseline
+    dslint --format json deepspeed_tpu/      # machine-readable
+    dslint --write-baseline deepspeed_tpu/   # grandfather current findings
+    dslint --select DS002 path/to/file.py    # one rule only
+    dslint --list-rules
+
+Exit codes: 0 clean (vs baseline); 1 findings — including DS000 parse
+errors — or stale baseline entries; 2 usage / baseline-load problems.
+"""
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+from deepspeed_tpu.tools.dslint import baseline as baseline_mod
+from deepspeed_tpu.tools.dslint.engine import LintEngine
+from deepspeed_tpu.tools.dslint.rules import get_rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dslint",
+        description="JAX/TPU-aware static analysis (rules DS001-DS006)")
+    p.add_argument("paths", nargs="*", default=["."],
+                   help="files/directories to lint (default: .)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default="auto",
+                   help="baseline json path; 'auto' walks up from the first "
+                        "path looking for dslint_baseline.json; 'none' "
+                        "disables the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current (unsuppressed) findings as the new "
+                        "baseline and exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--root", default=None,
+                   help="directory findings paths are relative to "
+                        "(default: the baseline file's directory, else cwd)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="findings only, no summary")
+    return p
+
+
+def _resolve_baseline(args) -> str:
+    if args.baseline == "none":
+        return ""
+    if args.baseline != "auto":
+        return args.baseline
+    found = baseline_mod.find_default_baseline(
+        args.paths[0] if args.paths else ".")
+    return found or ""
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = get_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name:<24} {r.description}")
+        return 0
+
+    split = lambda s: [x.strip() for x in s.split(",") if x.strip()] \
+        if s else None
+    baseline_path = _resolve_baseline(args)
+    root = args.root or (os.path.dirname(os.path.abspath(baseline_path))
+                         if baseline_path else None)
+    engine = LintEngine(rules, root=root, select=split(args.select),
+                        ignore=split(args.ignore))
+    if not engine.rules:
+        print("dslint: no rules selected", file=sys.stderr)
+        return 2
+
+    baseline = None
+    if baseline_path and not args.write_baseline:
+        try:
+            baseline = baseline_mod.load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"dslint: cannot load baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    result = engine.run(args.paths, baseline=baseline)
+
+    if args.write_baseline:
+        out = baseline_path or baseline_mod.DEFAULT_BASELINE_NAME
+        prior = None
+        if os.path.exists(out):
+            try:
+                # partial runs (path subset, --select) must not truncate
+                # the baseline for everything they did not re-evaluate
+                prior = baseline_mod.load_baseline(out)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"dslint: cannot merge existing baseline {out}: {e}",
+                      file=sys.stderr)
+                return 2
+        baseline_mod.write_baseline(
+            out, result.findings, prior=prior,
+            covered_paths=set(result.linted_paths),
+            active_rules=set(result.active_rules))
+        grandfathered = [f for f in result.findings if f.rule != "DS000"]
+        if not args.quiet:
+            print(f"dslint: baseline written -> {out} "
+                  f"({len(grandfathered)} findings grandfathered)")
+        if result.parse_errors:
+            # an unparseable file cannot be linted, so it cannot be
+            # grandfathered — it keeps failing until it parses
+            for f in result.parse_errors:
+                print(f"dslint: NOT grandfathered: {f.render()}",
+                      file=sys.stderr)
+            return 1
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in result.findings],
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+            "stale_baseline": result.stale_baseline,
+            "files_checked": result.files_checked,
+            "exit_code": result.exit_code,
+        }, indent=2))
+        return result.exit_code
+
+    for f in result.findings:
+        print(f.render())
+    if not args.quiet:
+        by_rule = collections.Counter(f.rule for f in result.findings)
+        summary = ", ".join(f"{r}:{n}" for r, n in sorted(by_rule.items())) \
+            or "clean"
+        print(f"dslint: {result.files_checked} files, "
+              f"{len(result.findings)} findings ({summary}), "
+              f"{len(result.suppressed)} suppressed inline, "
+              f"{len(result.baselined)} baselined"
+              + (f" [{os.path.basename(baseline_path)}]"
+                 if baseline_path else ""))
+        if result.stale_baseline:
+            print(f"dslint: {len(result.stale_baseline)} stale baseline "
+                  f"entries (violation fixed — expire with "
+                  f"--write-baseline):")
+            for e in result.stale_baseline:
+                print(f"  {e['rule']} {e['path']} :: {e['anchor']}")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,322 @@
+"""dslint rule engine — AST-based static analysis for the JAX/TPU bug
+classes this repo keeps fixing by hand.
+
+The engine is deliberately dependency-free (stdlib ``ast`` only, no jax
+import) so it runs anywhere the source does — pre-commit, CI collection
+phase, the tier-1 self-lint test — in well under a second for the whole
+tree.
+
+Pipeline per run:
+
+  collect .py files -> parse once -> per-file rules (``Rule.check``)
+                                  -> project rules (``Rule.finalize``)
+          -> inline ``# dslint: disable=RULE`` suppressions
+          -> checked-in baseline (grandfathered findings)
+          -> text/JSON report + exit code
+
+Findings are keyed for the baseline by ``(rule, path, anchor)`` where the
+anchor is a line-number-free symbol (enclosing qualname + offending token),
+so unrelated edits above a grandfathered finding never churn the baseline.
+"""
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Finding", "FileContext", "ProjectContext", "Rule", "LintResult",
+    "LintEngine", "iter_python_files", "parse_suppressions",
+]
+
+_DISABLE_RE = re.compile(
+    r"#\s*dslint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s+--.*)?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation.
+
+    ``anchor`` is the stable baseline key (qualname + token, no line
+    number); ``line``/``col`` locate it for humans.
+    """
+    rule: str
+    path: str           # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    anchor: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.anchor)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def parse_suppressions(source: str) -> Dict[int, set]:
+    """Map line number -> set of rule ids disabled on that line.
+
+    Two comment forms (1-indexed lines, matching ``ast`` node linenos):
+
+      x = risky()            # dslint: disable=DS001 -- reason
+      # dslint: disable=DS004 -- reason
+      x = risky()            (standalone comment applies to the NEXT line)
+
+    ``disable=all`` disables every rule.
+    """
+    out: Dict[int, set] = {}
+    pending: set = set()      # standalone comments bind to the NEXT code
+    for i, text in enumerate(source.splitlines(), start=1):
+        stripped = text.strip()
+        m = _DISABLE_RE.search(text)
+        rules = ({r.strip().upper() for r in m.group(1).split(",")
+                  if r.strip()} if m else set())
+        if stripped.startswith("#"):
+            pending.update(rules)     # (continuation comment lines pass by)
+            continue
+        if not stripped:
+            continue
+        if pending:
+            out.setdefault(i, set()).update(pending)
+            pending = set()
+        if rules:                     # trailing comment: applies here
+            out.setdefault(i, set()).update(rules)
+    return out
+
+
+class FileContext:
+    """One parsed source file plus the lookups rules share."""
+
+    def __init__(self, abspath: str, relpath: str, source: str,
+                 tree: ast.Module):
+        self.abspath = abspath
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.suppressions = parse_suppressions(source)
+        self._scope_spans: Optional[List[Tuple[int, int, str]]] = None
+        self._stmt_spans: Optional[List[Tuple[int, int]]] = None
+
+    # ------------------------------------------------------------------
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted class/function path of the scope *containing* ``node``
+        (``""`` at module level) — the stable half of a baseline anchor."""
+        if self._scope_spans is None:
+            self._scope_spans = []
+            self._index_scopes(self.tree, prefix="")
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            return ""
+        containing = [(lo, hi, name) for lo, hi, name in self._scope_spans
+                      if lo <= lineno <= hi]
+        if not containing:
+            return ""
+        # innermost scope = the latest-starting span that contains the node
+        return max(containing, key=lambda s: s[0])[2]
+
+    def _index_scopes(self, node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                hi = max((getattr(n, "end_lineno", None) or child.lineno)
+                         for n in ast.walk(child))
+                self._scope_spans.append((child.lineno, hi, name))
+                self._index_scopes(child, name)
+            else:
+                self._index_scopes(child, prefix)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """A finding is suppressed by a disable comment on its own line OR
+        on the first line of any statement enclosing it — so the documented
+        standalone form works for findings anchored on a continuation line
+        of a multi-line statement."""
+        for cand in (line, *self._stmt_starts_covering(line)):
+            disabled = self.suppressions.get(cand, set())
+            if rule in disabled or "ALL" in disabled:
+                return True
+        return False
+
+    _COMPOUND = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+                 ast.AsyncWith, ast.Try)
+
+    def _stmt_starts_covering(self, line: int):
+        # SIMPLE statements only: a disable on a `def`/`if`/`with` line
+        # must not silence the whole block under it
+        if getattr(self, "_stmt_spans", None) is None:
+            self._stmt_spans = []
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.stmt) \
+                        and not isinstance(node, self._COMPOUND):
+                    hi = max((getattr(n, "end_lineno", None) or node.lineno)
+                             for n in ast.walk(node))
+                    self._stmt_spans.append((node.lineno, hi))
+        return [lo for lo, hi in self._stmt_spans if lo <= line <= hi]
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                token: str) -> Finding:
+        """Build a Finding anchored at ``node`` with a line-free anchor."""
+        qn = self.qualname(node)
+        anchor = f"{qn}:{token}" if qn else token
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       message=message, anchor=anchor)
+
+
+class ProjectContext:
+    """Every parsed file of one run (project-wide rules finalize over it)."""
+
+    def __init__(self, root: str, files: List[FileContext]):
+        self.root = root
+        self.files = files
+
+    def get(self, relpath: str) -> Optional[FileContext]:
+        for f in self.files:
+            if f.relpath == relpath:
+                return f
+        return None
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``description`` and
+    implement ``check`` (per file) and/or ``finalize`` (project-wide).
+    Rules that accumulate cross-file state override ``begin_run`` to clear
+    it — one rule instance may serve several ``LintEngine.run`` calls."""
+
+    id: str = "DS000"
+    name: str = "base"
+    description: str = ""
+
+    def begin_run(self) -> None:
+        pass
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        return ()
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]               # unsuppressed, not in baseline
+    suppressed: List[Finding]             # killed by inline disables
+    baselined: List[Finding]              # matched a baseline entry
+    stale_baseline: List[dict]            # covered entries nothing matched
+    files_checked: int = 0
+    parse_errors: List[Finding] = dataclasses.field(default_factory=list)
+    linted_paths: List[str] = dataclasses.field(default_factory=list)
+    active_rules: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        # stale entries fail too: an expired-but-unexpunged baseline entry
+        # would silently absorb one future regression at the same anchor
+        return 1 if self.findings or self.stale_baseline else 0
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".hg", "build", "dist", "node_modules",
+              "csrc",
+              # seeded-violation fixtures: linted only when targeted
+              # explicitly by tests, never by a directory sweep
+              "dslint_fixtures"}
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    # overlapping inputs (a directory plus a file inside it) must not lint
+    # a file twice — duplicates double findings and blow per-anchor
+    # baseline count budgets
+    return list(dict.fromkeys(out))
+
+
+class LintEngine:
+    def __init__(self, rules: List[Rule], root: Optional[str] = None,
+                 select: Optional[Iterable[str]] = None,
+                 ignore: Optional[Iterable[str]] = None):
+        selected = {r.upper() for r in select} if select else None
+        ignored = {r.upper() for r in ignore} if ignore else set()
+        self.rules = [r for r in rules
+                      if (selected is None or r.id in selected)
+                      and r.id not in ignored]
+        self.root = os.path.abspath(root) if root else None
+
+    # ------------------------------------------------------------------
+    def _relpath(self, abspath: str) -> str:
+        root = self.root or os.getcwd()
+        try:
+            rel = os.path.relpath(abspath, root)
+        except ValueError:            # different drive (windows)
+            rel = abspath
+        return rel.replace(os.sep, "/")
+
+    def run(self, paths: Iterable[str],
+            baseline: Optional[dict] = None) -> LintResult:
+        files: List[FileContext] = []
+        parse_errors: List[Finding] = []
+        for abspath in iter_python_files(paths):
+            relpath = self._relpath(abspath)
+            try:
+                with open(abspath, "r", encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=abspath)
+            except (SyntaxError, UnicodeDecodeError) as e:
+                parse_errors.append(Finding(
+                    rule="DS000", path=relpath,
+                    line=getattr(e, "lineno", 0) or 0, col=0,
+                    message=f"file does not parse: {e.__class__.__name__}: {e}",
+                    anchor="parse-error"))
+                continue
+            files.append(FileContext(abspath, relpath, source, tree))
+
+        project = ProjectContext(self.root or os.getcwd(), files)
+        raw: List[Finding] = list(parse_errors)
+        for rule in self.rules:
+            rule.begin_run()
+            for ctx in files:
+                raw.extend(rule.check(ctx))
+            raw.extend(rule.finalize(project))
+        raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+        # inline suppressions
+        kept, suppressed = [], []
+        by_path = {f.relpath: f for f in files}
+        for f in raw:
+            ctx = by_path.get(f.path)
+            if ctx is not None and ctx.is_suppressed(f.rule, f.line):
+                suppressed.append(f)
+            else:
+                kept.append(f)
+
+        # baseline (stale judgment only over what this run re-evaluated)
+        from deepspeed_tpu.tools.dslint.baseline import match_baseline
+        covered = {f.relpath for f in files}
+        active = {r.id for r in self.rules}
+        findings, baselined, stale = match_baseline(
+            kept, baseline, covered_paths=covered, active_rules=active)
+        return LintResult(findings=findings, suppressed=suppressed,
+                          baselined=baselined, stale_baseline=stale,
+                          files_checked=len(files),
+                          parse_errors=parse_errors,
+                          linted_paths=sorted(covered),
+                          active_rules=sorted(active))

@@ -1,0 +1,110 @@
+"""The hot-path registry DS002 enforces.
+
+One place — shared by the rule, the CLI, and ``tests/test_no_hot_sync.py``
+(now a thin wrapper over this registry) — naming every function that runs
+on the per-step/per-tick fast path and therefore must never host-sync.
+Growing a registry entry is a conscious, reviewed decision; a registered
+function disappearing (renamed without updating the registry) is itself a
+DS002 finding so the tripwire can't silently rot.
+
+Spec fields:
+
+  path            repo-relative file the spec applies to
+  cls             class whose methods are listed (None = module functions)
+  hot_functions   fully forbidden: any host sync inside is a finding
+  guard_branches  (function, guard_attr): only ``if ...<guard_attr>``
+                  branches of that function are checked (async fan-in
+                  points whose synchronous fallback MAY sync)
+  confine         attr call -> functions allowed to use it anywhere in the
+                  file (e.g. ``device_get`` confined to the designated
+                  drain); any other function using it is a finding
+  forbidden       call names treated as host syncs for this spec
+"""
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+#: calls that force (or can force) a device->host sync. ``float``/``int``/
+#: ``bool`` on a jax.Array block on the value; ``.item()``/``np.asarray``/
+#: ``np.array`` copy to host; device_get / block_until_ready are explicit.
+DEFAULT_FORBIDDEN: Tuple[str, ...] = (
+    "float", ".item", ".device_get", ".block_until_ready",
+    ".copy_to_host_async", "np.asarray", "np.array",
+)
+
+#: the engine hot path legitimately touches numpy on HOST batches before
+#: they are staged (stack_microbatches/_shard_batch) — np.* stays allowed
+#: there; device syncs stay forbidden.
+ENGINE_FORBIDDEN: Tuple[str, ...] = (
+    "float", ".item", ".device_get", ".block_until_ready",
+    ".copy_to_host_async",
+)
+
+#: for the engine spec itself `.device_get` is enforced by the file-wide
+#: confine entry (which covers the hot functions too) — listing it here as
+#: well would double-report one violation under two baseline anchors
+ENGINE_HOT_FORBIDDEN: Tuple[str, ...] = (
+    "float", ".item", ".block_until_ready", ".copy_to_host_async",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class HotPathSpec:
+    path: str
+    cls: Optional[str]
+    hot_functions: Tuple[str, ...] = ()
+    guard_branches: Tuple[Tuple[str, str], ...] = ()
+    confine: Optional[Dict[str, Tuple[str, ...]]] = None
+    forbidden: Tuple[str, ...] = DEFAULT_FORBIDDEN
+
+
+HOT_PATHS: Tuple[HotPathSpec, ...] = (
+    # the training engine's per-step fused path: everything that runs on
+    # EVERY train_batch call. Readback belongs ONLY in _drain_metric_ring
+    # (the designated drain) and the explicitly host-synchronous paths.
+    HotPathSpec(
+        path="deepspeed_tpu/runtime/engine.py",
+        cls="DeepSpeedTPUEngine",
+        hot_functions=(
+            "train_batch",
+            "stack_microbatches",
+            "_shard_batch",
+            "_advance_data_schedules",
+            "_ensure_prefetcher",
+        ),
+        # the async push branch of _record_metrics queues device arrays
+        # verbatim — any transfer there re-serializes every step; the
+        # synchronous fallback branch MAY sync (it is the designed sync path)
+        guard_branches=(("_record_metrics", "_async_enabled"),),
+        confine={
+            ".device_get": (
+                "_drain_metric_ring",           # THE drain
+                "_offload_host_update",         # host optimizer: sync by design
+                "_train_batch_param_offload",   # ditto (streamed host step)
+                "_host_init_params",            # init-time, not per-step
+                "__init__",                     # offload master construction
+                "get_lr", "get_global_grad_norm", "cur_scale",
+                "skipped_steps",                # accessors: sync on request
+                "module_state_dict",
+            ),
+        },
+        forbidden=ENGINE_HOT_FORBIDDEN,
+    ),
+    # the serving tick: one thread drives admit/step/fan-out for every live
+    # request — a sync here stalls every stream at once
+    HotPathSpec(
+        path="deepspeed_tpu/serving/server.py",
+        cls="InferenceServer",
+        hot_functions=("_serve_once", "_admit_from_queue", "_fan_out",
+                       "_reap"),
+        forbidden=ENGINE_FORBIDDEN,
+    ),
+    # the prefetch worker exists to overlap H2D with compute; a host sync in
+    # the worker body (outside stage_fn, which the engine owns) re-serializes
+    HotPathSpec(
+        path="deepspeed_tpu/runtime/dataloader.py",
+        cls="PrefetchLoader",
+        hot_functions=("_worker", "__next__"),
+        forbidden=ENGINE_FORBIDDEN,
+    ),
+)

@@ -1,0 +1,31 @@
+"""dslint rule registry: one module per rule, IDs DS001..DS006.
+
+Adding a rule: subclass ``Rule`` in a new ``ds0XX_*.py``, give it ``id``/
+``name``/``description``, implement ``check`` (per-file) and/or
+``finalize`` (project-wide), and append it to ``ALL_RULES`` here. Add a
+fires/doesn't-fire fixture pair under ``tests/dslint_fixtures/`` and a case
+in ``tests/test_dslint.py`` — the rule-coverage test fails on a rule with
+no fixture.
+"""
+
+from deepspeed_tpu.tools.dslint.rules.ds001_donation import DonationSafetyRule
+from deepspeed_tpu.tools.dslint.rules.ds002_hot_sync import HotPathSyncRule
+from deepspeed_tpu.tools.dslint.rules.ds003_truthiness import (
+    ArrayTruthinessRule)
+from deepspeed_tpu.tools.dslint.rules.ds004_threads import ThreadSharedStateRule
+from deepspeed_tpu.tools.dslint.rules.ds005_signals import SignalHandlerRule
+from deepspeed_tpu.tools.dslint.rules.ds006_config_keys import ConfigKeyDriftRule
+
+ALL_RULES = (
+    DonationSafetyRule,
+    HotPathSyncRule,
+    ArrayTruthinessRule,
+    ThreadSharedStateRule,
+    SignalHandlerRule,
+    ConfigKeyDriftRule,
+)
+
+
+def get_rules():
+    """Fresh rule instances (project rules keep per-run state)."""
+    return [cls() for cls in ALL_RULES]

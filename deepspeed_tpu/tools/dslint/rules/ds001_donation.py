@@ -1,0 +1,172 @@
+"""DS001 — donation safety: never read a pytree after donating it.
+
+``jax.jit(..., donate_argnums=...)`` invalidates the donated input buffers
+the moment the call dispatches; a later read of the same Python reference
+returns a deleted array (``RuntimeError: Array has been deleted``) — or,
+worse, silently stale data when the read races the async dispatch. PR 3's
+metric-ring bug was exactly this shape: ``EngineState`` buffers captured
+after the state had been donated to the next compiled step.
+
+Detection (scoped, line-ordered heuristic — loops/branches are not
+path-sensitive):
+
+  * donating callables: ``f = jax.jit(g, donate_argnums=...)`` locals,
+    ``self._f = jax.jit(...)`` attributes (class-wide), and direct
+    ``jax.jit(g, donate_argnums=...)(args)`` calls
+  * a call through one marks its donated positional args (plain names or
+    ``self.attr``) as dead
+  * any later read of a dead reference in the same function — without an
+    intervening rebind — is a finding; rebinding in the same statement
+    (``state = f(state)``) is the blessed pattern and is not flagged
+
+Non-literal ``donate_argnums`` fall back to position 0 (the overwhelmingly
+common ``donate_argnums=(0,)`` state-threading shape).
+"""
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.tools.dslint import astutil
+from deepspeed_tpu.tools.dslint.engine import FileContext, Rule
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _donating_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positions when ``call`` is a jit/pjit wrap with donation."""
+    if astutil.call_name(call) not in _JIT_NAMES:
+        return None
+    kw = astutil.keyword_arg(call, "donate_argnums")
+    if kw is None:
+        return None
+    pos = astutil.literal_int_tuple(kw)
+    if pos is not None:
+        return pos or None      # donate_argnums=() donates NOTHING
+    return (0,)                 # non-literal: assume the common state-at-0
+
+
+class DonationSafetyRule(Rule):
+    id = "DS001"
+    name = "donation-safety"
+    description = ("read of a pytree after it was passed to a "
+                   "donate_argnums callable in the same scope")
+
+    def check(self, ctx: FileContext):
+        findings = []
+        # class-wide donating attributes: self._f = jax.jit(..., donate...)
+        for cls in astutil.classes_of(ctx.tree):
+            donating_attrs: Dict[str, Tuple[int, ...]] = {}
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                pos = _donating_positions(node.value)
+                if pos is None:
+                    continue
+                for t in node.targets:
+                    attr = astutil.self_attr(t)
+                    if attr:
+                        donating_attrs[f"self.{attr}"] = pos
+            for meth in astutil.methods_of(cls).values():
+                findings.extend(
+                    self._check_scope(ctx, meth, dict(donating_attrs)))
+        for fn in astutil.functions_of(ctx.tree):
+            findings.extend(self._check_scope(ctx, fn, {}))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_scope(self, ctx: FileContext, func: ast.AST,
+                     donating: Dict[str, Tuple[int, ...]]):
+        """``donating``: callee dotted name -> donated positions (seeded
+        with class-wide jit attributes; locals added as they are bound)."""
+        # pass 1: local donating callables (f = jax.jit(..., donate...))
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                pos = _donating_positions(node.value)
+                if pos is None:
+                    continue
+                for t in node.targets:
+                    name = astutil.dotted_name(t)
+                    if name:
+                        donating[name] = pos
+
+        # pass 2: donation events — (ref dotted name, line donated). Each
+        # call is attributed to its innermost enclosing statement so the
+        # "rebound by the same statement" exemption sees the right targets
+        # even when the call sits inside a compound statement.
+        parents = {}
+        for node in ast.walk(func):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def innermost_stmt(node):
+            n = parents.get(node)
+            while n is not None and not isinstance(n, ast.stmt):
+                n = parents.get(n)
+            return n
+
+        dead: List[Tuple[str, int, str]] = []   # (ref, line, callee)
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = astutil.dotted_name(node.func)
+            pos = donating.get(callee) if callee else None
+            if pos is None and isinstance(node.func, ast.Call):
+                # direct jax.jit(fn, donate_argnums=...)(args)
+                pos = _donating_positions(node.func)
+                callee = callee or "jax.jit(...)"
+            if pos is None:
+                continue
+            stmt = innermost_stmt(node)
+            rebound = ({astutil.dotted_name(t)
+                        for t in astutil.statement_targets(stmt)}
+                       if stmt is not None else set())
+            end = getattr(node, "end_lineno", None) or node.lineno
+            for i in pos:
+                if i >= len(node.args):
+                    continue
+                ref = astutil.dotted_name(node.args[i])
+                if ref is None or ref in rebound:
+                    continue              # rebound by the same statement
+                dead.append((ref, end, callee))
+        if not dead:
+            return []
+
+        # pass 3: stores per ref (to clear deadness) and offending loads
+        stores: Dict[str, List[int]] = {}
+        for stmt in ast.walk(func):
+            if not isinstance(stmt, ast.stmt):
+                continue
+            for t in astutil.statement_targets(stmt):
+                name = astutil.dotted_name(t)
+                if name:
+                    stores.setdefault(name, []).append(stmt.lineno)
+
+        findings = []
+        reported = set()
+        for node in ast.walk(func):
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            ref = astutil.dotted_name(node)
+            if ref is None:
+                continue
+            for dref, dline, callee in dead:
+                if ref != dref or node.lineno <= dline:
+                    continue
+                if any(dline < s <= node.lineno for s in stores.get(ref, [])):
+                    continue              # rebound before this read
+                key = (ref, dline, node.lineno)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"`{ref}` read after being donated to `{callee}` "
+                    f"(line {dline}): donated buffers are deleted at "
+                    f"dispatch — rebind the result or snapshot what you "
+                    f"need BEFORE the donating call", token=ref))
+        return findings

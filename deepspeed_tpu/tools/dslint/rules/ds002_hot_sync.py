@@ -1,0 +1,216 @@
+"""DS002 — host sync in a registered hot path.
+
+Generalizes the original ``tests/test_no_hot_sync.py`` AST tripwire to
+every function in the hot-path registry (``hotpath.HOT_PATHS``): the
+per-step/per-tick fast paths must never regrow ``float()``, ``.item()``,
+``jax.device_get``, ``block_until_ready`` or friends — one sync silently
+re-serializes the whole pipeline while every timing test keeps passing.
+
+Three enforcement shapes per registry spec:
+
+  hot_functions   any forbidden call anywhere in the function is a finding
+  guard_branches  only ``if ...<guard_attr>`` branches are checked (async
+                  fan-in points whose synchronous fallback may sync)
+  confine         a call (e.g. ``.device_get``) is allowed ONLY in the
+                  listed functions of that file; anywhere else it fires
+
+A registered function that no longer exists is ALSO a finding (registry
+drift) — renaming a hot function without updating the registry must not
+silently retire the tripwire.
+"""
+
+import ast
+import os
+from typing import Optional, Tuple
+
+from deepspeed_tpu.tools.dslint import astutil
+from deepspeed_tpu.tools.dslint.engine import FileContext, Rule
+from deepspeed_tpu.tools.dslint.hotpath import HOT_PATHS, HotPathSpec
+
+
+def _matches(call: ast.Call, matcher: str) -> bool:
+    """``"float"`` = bare-name call; ``".item"`` = attribute call with that
+    attr on any receiver; ``"np.asarray"`` = exact dotted name."""
+    if matcher.startswith("."):
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr == matcher[1:])
+    if "." in matcher:
+        return astutil.call_name(call) == matcher
+    return isinstance(call.func, ast.Name) and call.func.id == matcher
+
+
+def _forbidden_calls(node: ast.AST, forbidden: Tuple[str, ...]):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            for m in forbidden:
+                if _matches(n, m):
+                    yield n, m
+                    break
+
+
+def _stmt_span(stmts) -> set:
+    lines = set()
+    for s in stmts:
+        hi = max((getattr(x, "end_lineno", None) or s.lineno)
+                 for x in ast.walk(s))
+        lines.update(range(s.lineno, hi + 1))
+    return lines
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _guard_negated(test: ast.expr, guard_attr: str) -> bool:
+    return any(
+        isinstance(x, ast.UnaryOp) and isinstance(x.op, ast.Not)
+        and any(isinstance(y, ast.Attribute) and y.attr == guard_attr
+                for y in ast.walk(x.operand))
+        for x in ast.walk(test))
+
+
+def _sync_only_lines(fn: ast.AST, branches, guard_attr: str) -> set:
+    """Lines that provably execute ONLY when the guard is false (the
+    designed synchronous fallback): the body of a ``not guard`` If, the
+    else of a positive-guard If, and — when the async side early-returns —
+    the tail of the enclosing statement list. Everything else (shared code
+    + the async side) can run in async mode and must stay sync-free."""
+    stmt_lists = []
+    for node in ast.walk(fn):
+        for field in ("body", "orelse", "finalbody"):
+            lst = getattr(node, field, None)
+            if isinstance(lst, list) and lst \
+                    and all(isinstance(s, ast.stmt) for s in lst):
+                stmt_lists.append(lst)
+    sync = set()
+    for br in branches:
+        negated = _guard_negated(br.test, guard_attr)
+        sync_side = br.body if negated else br.orelse
+        async_side = br.orelse if negated else br.body
+        sync.update(_stmt_span(sync_side))
+        if _terminates(async_side):
+            # the async side never falls through: whatever follows this If
+            # in its statement list only runs in synchronous mode
+            for lst in stmt_lists:
+                if br in lst:
+                    sync.update(_stmt_span(lst[lst.index(br) + 1:]))
+    return sync
+
+
+class HotPathSyncRule(Rule):
+    id = "DS002"
+    name = "host-sync-in-hot-path"
+    description = ("host synchronization (float()/.item()/device_get/"
+                   "block_until_ready) inside a registered hot path")
+
+    def __init__(self, specs: Tuple[HotPathSpec, ...] = HOT_PATHS):
+        self.specs = specs
+
+    # ------------------------------------------------------------------
+    def check(self, ctx: FileContext):
+        findings = []
+        # match on the ABSOLUTE path (full-component suffix), not the
+        # run-relative one: `cd deepspeed_tpu && dslint .` or an unusual
+        # --root must not silently un-register the tripwire
+        abspath = os.path.abspath(ctx.abspath).replace(os.sep, "/")
+        for spec in self.specs:
+            if not (abspath.endswith("/" + spec.path)
+                    or abspath == spec.path or ctx.relpath == spec.path):
+                continue
+            findings.extend(self._check_spec(ctx, spec))
+        return findings
+
+    def _scope(self, ctx: FileContext, spec: HotPathSpec
+               ) -> Optional[ast.AST]:
+        if spec.cls is None:
+            return ctx.tree
+        for cls in astutil.classes_of(ctx.tree):
+            if cls.name == spec.cls:
+                return cls
+        return None
+
+    def _check_spec(self, ctx: FileContext, spec: HotPathSpec):
+        findings = []
+        scope = self._scope(ctx, spec)
+        if scope is None:
+            findings.append(ctx.finding(
+                self.id, ctx.tree,
+                f"hot-path registry drift: class `{spec.cls}` not found in "
+                f"{spec.path} — update deepspeed_tpu/tools/dslint/hotpath.py "
+                f"alongside the refactor", token=f"registry:{spec.cls}"))
+            return findings
+        methods = {n.name: n for n in astutil.functions_of(scope)}
+
+        for name in spec.hot_functions:
+            fn = methods.get(name)
+            if fn is None:
+                findings.append(ctx.finding(
+                    self.id, scope,
+                    f"hot-path registry drift: `{name}` not found — update "
+                    f"hotpath.py alongside the rename/removal",
+                    token=f"registry:{name}"))
+                continue
+            for call, m in _forbidden_calls(fn, spec.forbidden):
+                findings.append(ctx.finding(
+                    self.id, call,
+                    f"`{m}` in hot path `{name}`: a host sync here "
+                    f"serializes every step — route readback through the "
+                    f"designated drain", token=f"{name}:{m}"))
+
+        for name, guard_attr in spec.guard_branches:
+            fn = methods.get(name)
+            if fn is None:
+                findings.append(ctx.finding(
+                    self.id, scope,
+                    f"hot-path registry drift: guarded function `{name}` "
+                    f"not found — update hotpath.py",
+                    token=f"registry:{name}"))
+                continue
+            branches = [
+                n for n in ast.walk(fn)
+                if isinstance(n, ast.If)
+                and any(isinstance(x, ast.Attribute) and x.attr == guard_attr
+                        for x in ast.walk(n.test))]
+            if not branches:
+                findings.append(ctx.finding(
+                    self.id, fn,
+                    f"hot-path registry drift: `{name}` lost its "
+                    f"`{guard_attr}` branch — update hotpath.py",
+                    token=f"registry:{name}:{guard_attr}"))
+                continue
+            # scan everything that can execute in async mode: the whole
+            # function MINUS the statements provably on the sync-only side
+            # (the negated-guard body, the positive guard's else branch,
+            # and — when a guard branch early-returns — the tail after it).
+            # Early-return refactors therefore cannot retire the tripwire.
+            sync_lines = _sync_only_lines(fn, branches, guard_attr)
+            for call, m in _forbidden_calls(fn, spec.forbidden):
+                if call.lineno in sync_lines:
+                    continue         # the designed synchronous fallback
+                findings.append(ctx.finding(
+                    self.id, call,
+                    f"`{m}` on the `{guard_attr}` (async) side of "
+                    f"`{name}`: this push path queues device arrays "
+                    f"verbatim — a transfer here re-serializes every step",
+                    token=f"{name}:{guard_attr}:{m}"))
+
+        for matcher, allowed in (spec.confine or {}).items():
+            # confinement is FILE-wide: module functions plus every class's
+            # methods (a helper class added later must not dodge the net)
+            fns = list(astutil.functions_of(ctx.tree))
+            for cls in astutil.classes_of(ctx.tree):
+                fns += list(astutil.functions_of(cls))
+            for fn in fns:
+                if fn.name in allowed:
+                    continue
+                for call, m in _forbidden_calls(fn, (matcher,)):
+                    findings.append(ctx.finding(
+                        self.id, call,
+                        f"`{m}` outside its designated functions "
+                        f"(allowed: {', '.join(sorted(allowed))}) in "
+                        f"`{fn.name}` — route readback through the drain or "
+                        f"add a deliberate exemption to hotpath.py with a "
+                        f"comment explaining why it cannot lag",
+                        token=f"confine:{fn.name}:{m}"))
+        return findings

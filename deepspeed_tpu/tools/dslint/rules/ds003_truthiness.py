@@ -1,0 +1,103 @@
+"""DS003 — 0-d array truthiness: wrap array reductions in ``bool(...)``.
+
+``np.all(x)``/``np.isfinite(x).all()`` return 0-d numpy bool ARRAYS, not
+Python bools. Used directly as a flag they *appear* to work in an ``if``,
+then bite downstream: ``is True`` comparisons fail, ``json.dump`` chokes,
+``jnp`` variants raise ``TracerBoolConversionError`` under jit, and a
+0-d array stored where a bool is expected silently changes the meaning of
+identity checks (PR 3's guards bug: 0-d bool arrays were flag VALUES being
+re-interpreted as finiteness reports). The mechanical discipline: convert
+at the boundary — ``bool(np.all(x))``.
+
+Flags array-reduction expressions used where Python evaluates truthiness
+(``if``/``while``/``assert``/``and``/``or``/``not``/ternary/comprehension
+conditions) and in ``return`` position of bool-shaped functions
+(``-> bool`` annotation or ``is_``/``has_``/``can_``/``should_`` prefix)
+unless wrapped in ``bool(...)``.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.dslint import astutil
+from deepspeed_tpu.tools.dslint.engine import FileContext, Rule
+
+_NUMPY_MODULES = {"np", "numpy", "jnp", "jax.numpy"}
+_REDUCER_FUNCS = {"all", "any", "isfinite", "isnan", "isinf", "isclose",
+                  "logical_and", "logical_or", "logical_not", "equal",
+                  "greater", "less", "array_equal"}
+_REDUCER_METHODS = {"all", "any"}
+_BOOL_FN_PREFIXES = ("is_", "has_", "can_", "should_")
+
+
+def _offending_call(expr: ast.expr):
+    """Return (node, description) when ``expr`` is an array-returning
+    reduction used bare (module function or ``.all()``/``.any()``)."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = astutil.call_name(expr)
+    if name:
+        parts = name.split(".")
+        if (len(parts) >= 2 and parts[-1] in _REDUCER_FUNCS
+                and ".".join(parts[:-1]) in _NUMPY_MODULES):
+            return expr, name
+    if (isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in _REDUCER_METHODS
+            and not expr.args and not expr.keywords):
+        # x.all() / jnp.isfinite(x).all() — but not builtins all(...)/any(...)
+        return expr, f".{expr.func.attr}()"
+    return None
+
+
+class ArrayTruthinessRule(Rule):
+    id = "DS003"
+    name = "0-d-array-truthiness"
+    description = ("numpy/jax array reduction used as a Python bool "
+                   "without bool(...) conversion")
+
+    def check(self, ctx: FileContext):
+        findings = []
+
+        def flag(expr: ast.expr, where: str):
+            hit = _offending_call(expr)
+            if hit is None:
+                return
+            node, name = hit
+            findings.append(ctx.finding(
+                self.id, node,
+                f"`{name}` used as a Python bool in {where}: it returns a "
+                f"0-d array (and a tracer error under jit) — wrap it in "
+                f"bool(...) at the boundary", token=name))
+
+        for node in ast.walk(ctx.tree):
+            roots = []
+            if isinstance(node, (ast.If, ast.While)):
+                roots.append((node.test, "a condition"))
+            elif isinstance(node, ast.Assert):
+                roots.append((node.test, "an assert"))
+            elif isinstance(node, ast.IfExp):
+                roots.append((node.test, "a ternary condition"))
+            elif isinstance(node, ast.comprehension):
+                roots.extend((i, "a comprehension filter") for i in node.ifs)
+            elif isinstance(node, ast.BoolOp):
+                roots.extend((v, "a boolean expression")
+                             for v in node.values)
+            elif isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                              ast.Not):
+                roots.append((node.operand, "a `not` expression"))
+            for root, where in roots:
+                # only the root needs flagging here: nested BoolOp/Not
+                # operands are themselves visited as nodes by the walk
+                flag(root, where)
+
+            if isinstance(node, astutil.FunctionNode):
+                returns_bool = (
+                    (isinstance(node.returns, ast.Name)
+                     and node.returns.id == "bool")
+                    or node.name.startswith(_BOOL_FN_PREFIXES))
+                if returns_bool:
+                    for n in ast.walk(node):
+                        if isinstance(n, ast.Return) and n.value is not None:
+                            flag(n.value,
+                                 f"the return of bool-shaped "
+                                 f"`{node.name}()`")
+        return findings

@@ -1,0 +1,157 @@
+"""DS004 — thread-shared state without a lock.
+
+Every background thread this repo grew (``PrefetchLoader`` worker, the
+serve loop, the step watchdog, checkpoint finalizers) shares instance
+attributes with the main thread; each PR has hand-fixed at least one
+unsynchronized access. The rule mechanizes the review question: *which
+attributes cross the thread boundary, and does an unprotected WRITE sit on
+either side?*
+
+Per class:
+
+  * thread-side methods = targets of ``threading.Thread(target=self._x)``
+    plus their intra-class call closure, plus methods escaping as
+    callbacks (``self._m`` passed as a call argument — watchdog
+    ``on_flag=...`` shape) which may run on a foreign thread
+  * an attribute fires when one side WRITES it without holding a lock
+    (``with self._lock`` / explicit ``.acquire()``) and the other side
+    touches it at all — ``__init__`` writes are exempt (they happen before
+    the thread starts), and attributes holding synchronization primitives
+    (Lock/Event/Queue/deque) are exempt (their methods are thread-safe)
+
+Deliberate lock-free flags (GIL-atomic booleans, sticky one-way latches)
+are fine — mark them ``# dslint: disable=DS004 -- <why it is safe>`` so
+the review decision is recorded at the access.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, List, Set
+
+from deepspeed_tpu.tools.dslint import astutil
+from deepspeed_tpu.tools.dslint.engine import FileContext, Rule
+
+_SAFE_TYPES = {"Lock", "RLock", "Event", "Condition", "Semaphore",
+               "BoundedSemaphore", "Barrier", "Queue", "LifoQueue",
+               "PriorityQueue", "SimpleQueue", "deque", "local"}
+
+
+@dataclasses.dataclass
+class _Access:
+    method: str
+    node: ast.AST
+    is_store: bool
+    protected: bool
+
+
+def _thread_target_methods(cls: ast.ClassDef) -> Set[str]:
+    """Methods started as Thread targets or escaping as callbacks."""
+    out: Set[str] = set()
+    method_names = set(astutil.methods_of(cls))
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node) or ""
+        is_thread = name.split(".")[-1] == "Thread"
+        candidates = []
+        if is_thread:
+            kw = astutil.keyword_arg(node, "target")
+            if kw is not None:
+                candidates.append(kw)
+        else:
+            # escape analysis: self._m handed to any call (on_flag=...,
+            # register(...)) may be invoked from a foreign thread
+            candidates.extend(node.args)
+            candidates.extend(kw.value for kw in node.keywords)
+        for cand in candidates:
+            attr = astutil.self_attr(cand)
+            if attr and attr in method_names:
+                out.add(attr)
+    return out
+
+
+def _closure(cls: ast.ClassDef, seeds: Set[str]) -> Set[str]:
+    methods = astutil.methods_of(cls)
+    seen, frontier = set(seeds), list(seeds)
+    while frontier:
+        m = methods.get(frontier.pop())
+        if m is None:
+            continue
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call):
+                attr = astutil.self_attr(node.func)
+                if attr and attr in methods and attr not in seen:
+                    seen.add(attr)
+                    frontier.append(attr)
+    return seen
+
+
+def _safe_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes initialized to synchronization primitives."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            tname = (astutil.call_name(node.value) or "").split(".")[-1]
+            if tname in _SAFE_TYPES:
+                for t in node.targets:
+                    attr = astutil.self_attr(t)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+class ThreadSharedStateRule(Rule):
+    id = "DS004"
+    name = "thread-shared-state"
+    description = ("instance attribute written without a lock on one side "
+                   "of a thread boundary and touched on the other")
+
+    def check(self, ctx: FileContext):
+        findings = []
+        for cls in astutil.classes_of(ctx.tree):
+            findings.extend(self._check_class(ctx, cls))
+        return findings
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef):
+        thread_side = _closure(cls, _thread_target_methods(cls))
+        if not thread_side:
+            return []
+        safe = _safe_attrs(cls)
+        methods = astutil.methods_of(cls)
+
+        accesses: Dict[str, List[_Access]] = {}
+        for mname, m in methods.items():
+            locked = astutil.lock_protected_lines(m)
+            for node in ast.walk(m):
+                attr = astutil.self_attr(node)
+                if attr is None or attr in safe:
+                    continue
+                is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+                accesses.setdefault(attr, []).append(_Access(
+                    method=mname, node=node, is_store=is_store,
+                    protected=node.lineno in locked))
+
+        findings = []
+        for attr, acc in sorted(accesses.items()):
+            t_side = [a for a in acc if a.method in thread_side]
+            o_side = [a for a in acc
+                      if a.method not in thread_side
+                      and a.method != "__init__"]
+            if not t_side or not o_side:
+                continue
+            bad_writes = (
+                [a for a in t_side if a.is_store and not a.protected]
+                or [a for a in o_side if a.is_store and not a.protected])
+            if not bad_writes:
+                continue
+            w = bad_writes[0]
+            other = (o_side if w.method in thread_side else t_side)[0]
+            findings.append(ctx.finding(
+                self.id, w.node,
+                f"`self.{attr}` written in `{w.method}` without a lock but "
+                f"shared across the thread boundary (also touched in "
+                f"`{other.method}`): guard both sides with a Lock, use a "
+                f"threading.Event/queue.Queue, or record why lock-free is "
+                f"safe with `# dslint: disable=DS004 -- reason`",
+                token=attr))
+        return findings

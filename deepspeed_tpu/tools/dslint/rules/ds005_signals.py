@@ -1,0 +1,111 @@
+"""DS005 — signal-handler safety: handlers set flags, nothing else.
+
+A handler registered via ``signal.signal`` runs *between two arbitrary
+bytecodes of the main thread* — re-entering I/O, allocating heavily, or
+taking a lock the interrupted code may already hold is how preemption
+turns into a torn checkpoint or a deadlock (the exact failure the
+resilience subsystem exists to kill; its own handler deliberately only
+sets ``_preempt_signal`` and defers the autosave to the step boundary).
+
+The rule resolves each registered handler (lambda inline, module function
+by name, ``self._method``) and flags non-reentrant work in its body:
+file/OS I/O, ``json``/``pickle`` dumps, subprocess spawns, lock
+acquisition, thread joins, jax calls (allocation + dispatch), and logging
+(the logging module takes a module-level lock). ``os.kill``/``sys.exit``/
+``Event.set``/attribute flag writes are fine — that IS the pattern.
+
+A deliberate exception (e.g. one best-effort log line) is recorded at the
+call site with ``# dslint: disable=DS005 -- <why>``.
+"""
+
+import ast
+from typing import Iterable, Optional
+
+from deepspeed_tpu.tools.dslint import astutil
+from deepspeed_tpu.tools.dslint.engine import FileContext, Rule
+
+_FORBIDDEN_NAME_CALLS = {"open", "print", "exec", "eval", "input"}
+_FORBIDDEN_DOTTED_PREFIXES = ("os.", "json.", "pickle.", "shutil.",
+                              "subprocess.", "jax.", "logging.", "logger.",
+                              "faulthandler.")
+# os-level calls that ARE async-signal-safe-ish and idiomatic in handlers
+_ALLOWED_DOTTED = {"os.kill", "os.getpid", "sys.exit", "os._exit",
+                   "signal.signal", "os.write"}
+_FORBIDDEN_ATTR_CALLS = {"write", "flush", "acquire", "join", "dump",
+                         "save", "makedirs", "rename", "replace", "remove",
+                         "unlink", "device_get", "block_until_ready",
+                         "send", "sendall", "put", "connect",
+                         "debug", "info", "warning", "error", "exception",
+                         "critical", "log"}
+
+
+def _handler_findings(rule, ctx: FileContext, handler_body: ast.AST,
+                      handler_desc: str):
+    for n in ast.walk(handler_body):
+        if not isinstance(n, ast.Call):
+            continue
+        name = astutil.call_name(n)
+        reason = None
+        if isinstance(n.func, ast.Name) and n.func.id in _FORBIDDEN_NAME_CALLS:
+            reason = f"{n.func.id}()"
+        elif name and name in _ALLOWED_DOTTED:
+            continue
+        elif name and name.startswith(_FORBIDDEN_DOTTED_PREFIXES):
+            reason = name
+        elif (isinstance(n.func, ast.Attribute)
+              and n.func.attr in _FORBIDDEN_ATTR_CALLS):
+            reason = f".{n.func.attr}()"
+        if reason:
+            yield ctx.finding(
+                rule.id, n,
+                f"signal handler {handler_desc} does non-reentrant work "
+                f"(`{reason}`): it can fire between any two bytecodes — "
+                f"set a flag here and do the work at a safe point (step "
+                f"boundary / main loop)", token=f"{handler_desc}:{reason}")
+
+
+class SignalHandlerRule(Rule):
+    id = "DS005"
+    name = "signal-handler-safety"
+    description = ("signal.signal handler doing non-reentrant work "
+                   "(I/O, allocation, lock acquisition, logging)")
+
+    def check(self, ctx: FileContext):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if astutil.call_name(node) != "signal.signal":
+                continue
+            if len(node.args) < 2:
+                continue
+            handler = node.args[1]
+            body, desc = self._resolve(ctx, handler)
+            if body is None:
+                continue
+            findings.extend(_handler_findings(self, ctx, body, desc))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _resolve(self, ctx: FileContext, handler: ast.expr):
+        if isinstance(handler, ast.Lambda):
+            return handler.body, f"<lambda:{handler.lineno}>"
+        if isinstance(handler, ast.Name):
+            fn = self._find_def(ctx.tree, handler.id)
+            if fn is not None:
+                return fn, f"`{handler.id}`"
+            return None, None
+        attr = astutil.self_attr(handler)
+        if attr:
+            for cls in astutil.classes_of(ctx.tree):
+                fn = astutil.methods_of(cls).get(attr)
+                if fn is not None:
+                    return fn, f"`{cls.name}.{attr}`"
+        return None, None
+
+    @staticmethod
+    def _find_def(tree: ast.Module, name: str) -> Optional[ast.AST]:
+        for n in ast.walk(tree):
+            if isinstance(n, astutil.FunctionNode) and n.name == name:
+                return n
+        return None

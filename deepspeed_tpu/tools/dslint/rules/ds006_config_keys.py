@@ -1,0 +1,153 @@
+"""DS006 — config-key drift between ``config/constants.py`` and reality.
+
+The constants module exists so every config key has exactly one spelling;
+drift shows up two ways and both have bitten:
+
+  * a raw string key read straight off the user config dict
+    (``self._raw.get("resilience")``) — invisible to rename refactors and
+    to anyone grepping the constant
+  * a constant nothing references — usually a key whose reader was
+    refactored away while the constant (and the docs pointing at it)
+    survived, advertising config surface that silently does nothing
+
+This is a project-wide rule: it parses the constants module once, then
+(a) flags snake_case string keys read from config-dict receivers
+(``_raw``/``ds_config``/``config_dict``/...) that are not values in the
+constants module, and (b) flags constants no other file references.
+Group-internal subkeys (``"enabled"`` etc.) parsed by dataclass kwargs are
+exempt via ``_SUBKEY_ALLOWLIST``.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, Set
+
+from deepspeed_tpu.tools.dslint import astutil
+from deepspeed_tpu.tools.dslint.engine import (FileContext, ProjectContext,
+                                               Rule)
+
+_CONSTANTS_SUFFIX = "config/constants.py"
+#: receiver leaf names treated as "the raw user config dict"
+_CONFIG_RECEIVERS = {"_raw", "ds_config", "config_dict", "user_config",
+                     "raw_config"}
+#: keys that live INSIDE a config group (dataclass kwargs), not at top level
+_SUBKEY_ALLOWLIST = {"enabled", "type", "params"}
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+class ConfigKeyDriftRule(Rule):
+    id = "DS006"
+    name = "config-key-drift"
+    description = ("raw config keys missing from config/constants.py, and "
+                   "constants nothing references")
+
+    def __init__(self):
+        self._reads = []          # (ctx, node, key) raw string key reads
+        self._refs: Set[str] = set()   # constant NAMES referenced anywhere
+
+    def begin_run(self):
+        self._reads = []
+        self._refs = set()
+
+    def check(self, ctx: FileContext):
+        if ctx.relpath.endswith(_CONSTANTS_SUFFIX):
+            return []
+        for node in ast.walk(ctx.tree):
+            # references to constants: bare NAME loads and module-attr reads
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                self._refs.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                self._refs.add(node.attr)
+            elif isinstance(node, ast.ImportFrom):
+                self._refs.update(a.name for a in node.names)
+
+            # raw key reads: recv.get("key"...) / recv["key"] / "key" in recv
+            if isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("get", "pop", "setdefault")
+                        and self._is_config_receiver(node.func.value)
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    self._reads.append((ctx, node.args[0],
+                                        node.args[0].value))
+            elif isinstance(node, ast.Subscript):
+                if (self._is_config_receiver(node.value)
+                        and isinstance(node.slice, ast.Constant)
+                        and isinstance(node.slice.value, str)):
+                    self._reads.append((ctx, node.slice, node.slice.value))
+            elif isinstance(node, ast.Compare):
+                if (len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                        and isinstance(node.left, ast.Constant)
+                        and isinstance(node.left.value, str)
+                        and self._is_config_receiver(node.comparators[0])):
+                    self._reads.append((ctx, node.left, node.left.value))
+        return []
+
+    @staticmethod
+    def _is_config_receiver(expr: ast.expr) -> bool:
+        name = astutil.dotted_name(expr)
+        return bool(name) and name.split(".")[-1] in _CONFIG_RECEIVERS
+
+    # ------------------------------------------------------------------
+    def finalize(self, project: ProjectContext):
+        const_ctx = next((f for f in project.files
+                          if f.relpath.endswith(_CONSTANTS_SUFFIX)), None)
+        if const_ctx is None:
+            return []           # nothing to check against in this run
+        key_values: Set[str] = set()
+        const_defs: Dict[str, ast.AST] = {}
+        for node in const_ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.isupper():
+                    const_defs[t.id] = t
+                    if (isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)):
+                        key_values.add(node.value.value)
+                    elif isinstance(node.value, ast.Call):
+                        # frozenset({...}) of keys: every member is a key
+                        for el in ast.walk(node.value):
+                            if (isinstance(el, ast.Constant)
+                                    and isinstance(el.value, str)):
+                                key_values.add(el.value)
+
+        findings = []
+        for ctx, node, key in self._reads:
+            if key in key_values or key in _SUBKEY_ALLOWLIST:
+                continue
+            if not _SNAKE_RE.match(key):
+                continue
+            findings.append(ctx.finding(
+                self.id, node,
+                f'raw config key "{key}" has no constant in '
+                f"config/constants.py: add one (single spelling, greppable, "
+                f"rename-safe) and read through it", token=f"key:{key}"))
+
+        # "referenced nowhere" is only meaningful when the run actually saw
+        # the whole package the constants serve — on a partial run (single
+        # file / subpackage) every constant would look unused
+        if self._run_covers_package(project, const_ctx):
+            for name, node in sorted(const_defs.items()):
+                if name in self._refs:
+                    continue
+                findings.append(const_ctx.finding(
+                    self.id, node,
+                    f"constant `{name}` is referenced nowhere outside "
+                    f"constants.py: dead config surface — wire it to its "
+                    f"reader or remove it", token=f"unused:{name}"))
+        return findings
+
+    @staticmethod
+    def _run_covers_package(project: ProjectContext,
+                            const_ctx: FileContext) -> bool:
+        """True when every .py under the constants module's package root
+        (the directory containing ``config/``) is in this run's file set."""
+        from deepspeed_tpu.tools.dslint.engine import iter_python_files
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(const_ctx.abspath)))
+        in_run = {os.path.abspath(f.abspath) for f in project.files}
+        return all(p in in_run for p in iter_python_files([root]))

@@ -1,0 +1,23 @@
+"""DS001 clean twin: rebind-in-the-same-statement and snapshot-before —
+the two blessed donation patterns. Must NOT fire."""
+
+import jax
+
+
+def ring_capture(state, batch, ring):
+    step = jax.jit(lambda s, b: (s, 0.0), donate_argnums=(0,))
+    scale = state.loss_scale          # snapshot BEFORE the donating call
+    state, out = step(state, batch)   # rebound by the same statement
+    ring.append(scale)
+    return state, out
+
+
+class Engine:
+    def __init__(self, state):
+        self.state = state
+        self._fn = jax.jit(lambda s: s, donate_argnums=(0,))
+
+    def capture_after_dispatch(self):
+        params = self.state.params    # snapshot first
+        self.state = self._fn(self.state)
+        return params, self.state

@@ -1,0 +1,20 @@
+"""DS001 fixture: reads a pytree AFTER donating it — must fire twice."""
+
+import jax
+
+
+def ring_capture(state, batch, ring):
+    step = jax.jit(lambda s, b: (s, 0.0), donate_argnums=(0,))
+    new_state, out = step(state, batch)
+    ring.append(state.loss_scale)     # read of donated `state` -> DS001
+    return new_state, out
+
+
+class Engine:
+    def __init__(self, state):
+        self.state = state
+        self._fn = jax.jit(lambda s: s, donate_argnums=(0,))
+
+    def capture_after_dispatch(self):
+        out = self._fn(self.state)
+        return self.state.params, out  # read of donated self.state -> DS001

@@ -1,0 +1,20 @@
+"""DS002 clean twin: same hot-path shape, readback only in the drain."""
+
+import jax
+
+
+class FakeEngine:
+    def train_batch(self, batch):
+        loss = self._fn(batch)
+        self.ring.append(loss)                   # device array, no transfer
+        return loss
+
+    def record(self, out):
+        if self._async_enabled:
+            self.ring.append(out)                # queued verbatim
+
+    def helper(self, x):
+        return x
+
+    def drain(self):
+        return jax.device_get(self.ring)         # THE designated drain

@@ -1,0 +1,21 @@
+"""DS002 fixture (linted with a spec naming FakeEngine's hot path):
+float() in the hot function, a transfer in the async-guarded branch, and
+device_get outside its confined functions — must fire for each."""
+
+import jax
+
+
+class FakeEngine:
+    def train_batch(self, batch):
+        loss = self._fn(batch)
+        return float(loss)                       # sync in hot path -> DS002
+
+    def record(self, out):
+        if self._async_enabled:
+            self.ring.append(jax.device_get(out))  # sync in async branch
+
+    def helper(self, x):
+        return jax.device_get(x)                 # outside confine allowlist
+
+    def drain(self):
+        return jax.device_get(self.ring)         # the designated drain: ok

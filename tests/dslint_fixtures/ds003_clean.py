@@ -1,0 +1,15 @@
+"""DS003 clean twin: converted to Python bool at the boundary."""
+
+import numpy as np
+
+
+def admit(mask):
+    if bool(np.all(mask > 0)):
+        return 1
+    while not bool(mask.any()):
+        mask = mask[1:]
+    return 0
+
+
+def is_healthy(x):
+    return bool(np.isfinite(x).all())

@@ -1,0 +1,16 @@
+"""DS003 fixture: array reductions used bare as Python bools — must fire
+in condition, `not`, and bool-shaped-return positions."""
+
+import numpy as np
+
+
+def admit(mask):
+    if np.all(mask > 0):              # 0-d array as condition -> DS003
+        return 1
+    while not mask.any():             # .any() under `not` -> DS003
+        mask = mask[1:]
+    return 0
+
+
+def is_healthy(x):
+    return np.isfinite(x).all()       # bool-shaped return -> DS003

@@ -1,0 +1,24 @@
+"""DS004 clean twin: an Event for the flag, a Lock around the shared
+value — must NOT fire."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._latest = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._latest = object()
+
+    def stop(self):
+        self._stop.set()
+
+    def latest(self):
+        with self._lock:
+            return self._latest

@@ -1,0 +1,22 @@
+"""DS004 fixture: attributes crossing the thread boundary with unlocked
+writes on either side — must fire for `_stop` (main writes, thread reads)
+and `_latest` (thread writes, main reads)."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._stop = False
+        self._latest = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop:          # thread-side read
+            self._latest = object()    # unlocked thread-side write -> DS004
+
+    def stop(self):
+        self._stop = True              # unlocked main-side write -> DS004
+
+    def latest(self):
+        return self._latest            # main-side read

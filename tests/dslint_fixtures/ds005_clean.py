@@ -1,0 +1,25 @@
+"""DS005 clean twin: handlers only set flags / deliver signals — the
+blessed shape (work happens later at a safe point)."""
+
+import os
+import signal
+import threading
+
+_STOP = threading.Event()
+
+
+def _handler(signum, frame):
+    _STOP.set()
+
+
+class Server:
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+        signal.signal(signal.SIGINT, lambda *_: _STOP.set())
+
+    def _on_term(self, signum, frame):
+        self._preempt_signal = signum
+        os.kill(os.getpid(), 0)        # os-level probe: async-signal-safe
+
+
+signal.signal(signal.SIGTERM, _handler)

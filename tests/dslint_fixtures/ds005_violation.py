@@ -1,0 +1,25 @@
+"""DS005 fixture: signal handlers doing I/O / logging / lock work — must
+fire for the named-function, method, and lambda registration shapes."""
+
+import json
+import signal
+import threading
+
+_LOCK = threading.Lock()
+
+
+def _handler(signum, frame):
+    with open("/tmp/preempt.json", "w") as f:   # open() in handler -> DS005
+        json.dump({"sig": signum}, f)           # json.dump -> DS005
+
+
+class Server:
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+        signal.signal(signal.SIGINT, lambda *_: _LOCK.acquire())  # -> DS005
+
+    def _on_term(self, signum, frame):
+        self.log.warning("terminating")          # logging lock -> DS005
+
+
+signal.signal(signal.SIGTERM, _handler)

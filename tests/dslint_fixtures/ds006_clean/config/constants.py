@@ -1,0 +1,5 @@
+"""DS006 clean-twin constants: every constant referenced, every key
+constant-mediated."""
+
+ALPHA = "alpha"
+BETA = "beta"

@@ -1,0 +1,10 @@
+"""DS006 clean twin: all config reads go through constants."""
+
+from .config.constants import ALPHA, BETA
+
+
+class Config:
+    def __init__(self, ds_config):
+        self._raw = dict(ds_config)
+        self.alpha = self._raw.get(ALPHA, 0)
+        self.beta = self._raw.get(BETA, 0)

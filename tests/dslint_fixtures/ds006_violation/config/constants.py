@@ -1,0 +1,5 @@
+"""DS006 fixture constants module: `ORPHANED` is referenced nowhere
+(dead config surface -> DS006); `ALPHA` is healthy."""
+
+ALPHA = "alpha"
+ORPHANED = "orphaned_key"

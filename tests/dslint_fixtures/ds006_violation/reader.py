@@ -1,0 +1,11 @@
+"""DS006 fixture reader: one constant-mediated read (fine) and one raw
+string key with no constant — must fire for `"beta"`."""
+
+from .config.constants import ALPHA
+
+
+class Config:
+    def __init__(self, ds_config):
+        self._raw = dict(ds_config)
+        self.alpha = self._raw.get(ALPHA, 0)
+        self.beta = self._raw.get("beta", 0)     # raw key -> DS006

@@ -48,6 +48,46 @@ def test_merge_config_nested():
     assert out == {"a": {"x": 1, "y": 9}, "b": 3, "c": 4}
 
 
+def test_autotuning_config_group_overrides_kwargs(mesh_dp8):
+    """The ds-config "autotuning" group configures the tuner (single-JSON
+    contract): group values beat constructor defaults; unknown keys warn
+    and are ignored."""
+    cfg = {**BASE, "autotuning": {
+        "metric": "latency", "tuner_type": "gridsearch",
+        "zero_stages": [0, 1], "max_micro_batch": 4,
+        "num_tuning_trials": 7, "bogus_knob": True}}
+    tuner = Autotuner(SimpleModel(hidden_dim=32), cfg,
+                      batch_fn=random_batch, mesh=mesh_dp8)
+    assert tuner.metric == "latency"
+    assert tuner.tuner_type == "gridsearch"
+    assert tuner.zero_stages == [0, 1]
+    assert tuner.max_micro_batch == 4
+    assert tuner.n_trials == 7
+    with pytest.raises(ValueError):
+        Autotuner(SimpleModel(hidden_dim=32),
+                  {**BASE, "autotuning": {"metric": "nope"}},
+                  batch_fn=random_batch, mesh=mesh_dp8)
+    # enabled=false: tune() is a pass-through, no trials burned
+    off = Autotuner(SimpleModel(hidden_dim=32),
+                    {**BASE, "autotuning": {"enabled": False}},
+                    batch_fn=random_batch, mesh=mesh_dp8)
+    best_cfg, metrics = off.tune()
+    assert metrics == {} and best_cfg == off.base_config
+    assert off.records == []
+    # bare-bool shorthand: `"autotuning": false` disables, `true` enables
+    assert not Autotuner(SimpleModel(hidden_dim=32),
+                         {**BASE, "autotuning": False},
+                         batch_fn=random_batch, mesh=mesh_dp8).enabled
+    assert Autotuner(SimpleModel(hidden_dim=32),
+                     {**BASE, "autotuning": True},
+                     batch_fn=random_batch, mesh=mesh_dp8).enabled
+    # any other non-dict is a config error, not a cryptic TypeError
+    with pytest.raises(ValueError, match="must be a dict"):
+        Autotuner(SimpleModel(hidden_dim=32),
+                  {**BASE, "autotuning": "yes"},
+                  batch_fn=random_batch, mesh=mesh_dp8)
+
+
 def test_grid_search_finds_best():
     exps = _mk_exps([1, 2, 4, 8, 16, 32])
     t = GridSearchTuner(exps, _synthetic_runner(), metric="throughput")

@@ -1,0 +1,362 @@
+"""dslint engine + rule tests (tier-1, `lint` marker).
+
+Three layers:
+
+  * per-rule fixture pairs — every rule fires on its seeded violation file
+    and stays quiet on the clean twin (and a rule without a pair fails
+    ``test_every_rule_has_fixture_pair``)
+  * engine mechanics — inline suppression parsing, baseline
+    grandfather/stale round-trip, CLI exit codes and JSON output
+  * self-enforcement — ``deepspeed_tpu/`` lints clean against the
+    checked-in ``dslint_baseline.json``; a new unsuppressed finding
+    anywhere in the package fails tier-1
+"""
+
+import dataclasses
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.tools.dslint import (get_rules, lint_paths, load_baseline,
+                                        write_baseline)
+from deepspeed_tpu.tools.dslint.engine import LintEngine, parse_suppressions
+from deepspeed_tpu.tools.dslint.hotpath import HotPathSpec
+from deepspeed_tpu.tools.dslint.rules import ALL_RULES
+from deepspeed_tpu.tools.dslint.rules.ds002_hot_sync import HotPathSyncRule
+
+pytestmark = pytest.mark.lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "dslint_fixtures"
+
+
+def _lint(paths, **kw):
+    return lint_paths([str(p) for p in paths], root=str(FIXTURES), **kw)
+
+
+def _rules_of(result):
+    return {f.rule for f in result.findings}
+
+
+# ----------------------------------------------------------------------
+# per-rule fixture pairs
+# ----------------------------------------------------------------------
+_DS002_SPEC = HotPathSpec(
+    path="{name}.py", cls="FakeEngine",
+    hot_functions=("train_batch",),
+    guard_branches=(("record", "_async_enabled"),),
+    confine={".device_get": ("drain",)})
+
+
+def _ds002_rules(name):
+    spec = dataclasses.replace(_DS002_SPEC, path=f"{name}.py")
+    return [HotPathSyncRule(specs=(spec,))]
+
+
+@pytest.mark.parametrize("rule_id,min_findings", [
+    ("DS001", 2), ("DS002", 3), ("DS003", 3), ("DS004", 2), ("DS005", 4),
+    ("DS006", 2),
+])
+def test_rule_fires_on_violation_and_not_on_clean(rule_id, min_findings):
+    low = rule_id.lower()
+    if rule_id == "DS006":          # project-shaped fixture (dir with
+        bad = [FIXTURES / f"{low}_violation"]        # config/constants.py)
+        good = [FIXTURES / f"{low}_clean"]
+        kw_bad = kw_good = {}
+    elif rule_id == "DS002":        # registry-driven: point a spec at the
+        bad = [FIXTURES / f"{low}_violation.py"]     # fixture file
+        good = [FIXTURES / f"{low}_clean.py"]
+        kw_bad = {"rules": _ds002_rules(f"{low}_violation")}
+        kw_good = {"rules": _ds002_rules(f"{low}_clean")}
+    else:
+        bad = [FIXTURES / f"{low}_violation.py"]
+        good = [FIXTURES / f"{low}_clean.py"]
+        kw_bad = kw_good = {"select": [rule_id]}
+
+    fired = _lint(bad, **kw_bad)
+    hits = [f for f in fired.findings if f.rule == rule_id]
+    assert len(hits) >= min_findings, (
+        f"{rule_id} fixture expected >= {min_findings} findings, got "
+        f"{[f.render() for f in fired.findings]}")
+
+    quiet = _lint(good, **kw_good)
+    assert not [f for f in quiet.findings if f.rule == rule_id], (
+        f"{rule_id} fired on its clean twin: "
+        f"{[f.render() for f in quiet.findings]}")
+
+
+def test_every_rule_has_fixture_pair():
+    """A new rule cannot land without a fires/doesn't-fire pair."""
+    for cls in ALL_RULES:
+        low = cls.id.lower()
+        has_file_pair = ((FIXTURES / f"{low}_violation.py").exists()
+                         and (FIXTURES / f"{low}_clean.py").exists())
+        has_dir_pair = ((FIXTURES / f"{low}_violation").is_dir()
+                        and (FIXTURES / f"{low}_clean").is_dir())
+        assert has_file_pair or has_dir_pair, (
+            f"rule {cls.id} has no fixture pair under tests/dslint_fixtures/")
+
+
+def test_ds002_registry_drift_is_a_finding(tmp_path):
+    """Renaming a registered hot function without updating the registry
+    must fire, not silently retire the tripwire."""
+    f = tmp_path / "engine_like.py"
+    f.write_text("class FakeEngine:\n    def renamed(self):\n        pass\n")
+    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
+                       hot_functions=("train_batch",))
+    res = lint_paths([str(f)], root=str(tmp_path),
+                     rules=[HotPathSyncRule(specs=(spec,))])
+    assert any("registry drift" in f.message for f in res.findings)
+
+
+# ----------------------------------------------------------------------
+# engine mechanics
+# ----------------------------------------------------------------------
+def test_suppression_parsing_trailing_and_standalone():
+    src = (
+        "x = 1  # dslint: disable=DS003 -- trailing\n"
+        "# dslint: disable=DS004, DS005 -- standalone,\n"
+        "# continuation of the reason comment\n"
+        "y = 2\n"
+        "z = 3\n")
+    sup = parse_suppressions(src)
+    assert sup[1] == {"DS003"}
+    assert sup[4] == {"DS004", "DS005"}      # binds past comment lines
+    assert 5 not in sup
+
+
+def test_inline_suppression_kills_finding(tmp_path):
+    bad = (FIXTURES / "ds003_violation.py").read_text()
+    unsup = tmp_path / "unsup.py"
+    unsup.write_text(bad)
+    res = lint_paths([str(unsup)], root=str(tmp_path), select=["DS003"])
+    assert res.findings
+    sup_text = bad.replace(
+        "if np.all(mask > 0):",
+        "if np.all(mask > 0):  # dslint: disable=DS003 -- fixture")
+    sup = tmp_path / "sup.py"
+    sup.write_text(sup_text)
+    res2 = lint_paths([str(sup)], root=str(tmp_path), select=["DS003"])
+    assert len(res2.findings) == len(res.findings) - 1
+    assert len(res2.suppressed) == 1
+
+
+def test_baseline_roundtrip_add_then_expire(tmp_path):
+    """violation -> write-baseline -> clean run; fix -> stale entry
+    surfaces -> re-write -> empty baseline."""
+    work = tmp_path / "mod.py"
+    shutil.copyfile(FIXTURES / "ds003_violation.py", work)
+    bl = tmp_path / "dslint_baseline.json"
+
+    first = lint_paths([str(work)], root=str(tmp_path), select=["DS003"])
+    assert first.findings
+    write_baseline(str(bl), first.findings)
+
+    second = lint_paths([str(work)], baseline_path=str(bl),
+                        root=str(tmp_path), select=["DS003"])
+    assert not second.findings and len(second.baselined) == len(first.findings)
+    assert not second.stale_baseline
+
+    # a NEW violation at a different anchor is NOT shielded by the baseline
+    work.write_text(work.read_text()
+                    + "\n\ndef extra(y):\n    return bool(1) if y.any() "
+                      "else False\n")
+    third = lint_paths([str(work)], baseline_path=str(bl),
+                       root=str(tmp_path), select=["DS003"])
+    assert len(third.findings) == 1
+
+    # fix everything -> every entry goes stale; --write-baseline expires it
+    shutil.copyfile(FIXTURES / "ds003_clean.py", work)
+    fourth = lint_paths([str(work)], baseline_path=str(bl),
+                        root=str(tmp_path), select=["DS003"])
+    assert not fourth.findings
+    assert len(fourth.stale_baseline) == len(
+        {f.key for f in first.findings})
+    write_baseline(str(bl), fourth.findings)
+    assert load_baseline(str(bl))["entries"] == []
+
+
+def test_partial_runs_do_not_judge_uncovered_baseline_entries(tmp_path):
+    """A single-file or --select run neither reports unrelated baseline
+    entries as stale nor truncates them on --write-baseline."""
+    a, b = tmp_path / "a.py", tmp_path / "b.py"
+    shutil.copyfile(FIXTURES / "ds003_violation.py", a)
+    shutil.copyfile(FIXTURES / "ds003_violation.py", b)
+    bl = tmp_path / "dslint_baseline.json"
+    full = lint_paths([str(a), str(b)], root=str(tmp_path), select=["DS003"])
+    write_baseline(str(bl), full.findings)
+
+    # a-only run: b's entries are not covered -> not stale, exit 0
+    part = lint_paths([str(a)], baseline_path=str(bl), root=str(tmp_path),
+                      select=["DS003"])
+    assert not part.findings and not part.stale_baseline
+    assert part.exit_code == 0
+
+    # rule-subset run: DS003 not active -> its entries are not judged
+    other = lint_paths([str(a), str(b)], baseline_path=str(bl),
+                       root=str(tmp_path), select=["DS001"])
+    assert not other.findings and not other.stale_baseline
+
+    # merge-write over an a-only run (baseline-free lint, as the CLI does
+    # for --write-baseline) rewrites a's entries and keeps b's verbatim
+    part_nb = lint_paths([str(a)], root=str(tmp_path), select=["DS003"])
+    write_baseline(str(bl), part_nb.findings, prior=load_baseline(str(bl)),
+                   covered_paths=set(part_nb.linted_paths),
+                   active_rules=set(part_nb.active_rules))
+    kept = load_baseline(str(bl))["entries"]
+    assert {e["path"] for e in kept} == {"a.py", "b.py"}
+    assert len(kept) == len(full.findings)
+
+
+def test_parse_error_is_a_finding_and_never_grandfathered(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def f(:\n")
+    res = lint_paths([str(f)], root=str(tmp_path))
+    assert any(x.rule == "DS000" for x in res.findings)
+    # an unparseable file is an UNLINTED file: --write-baseline must not
+    # hide it — the entry list stays free of DS000
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), res.findings)
+    assert load_baseline(str(bl))["entries"] == []
+
+
+def test_ds002_confine_covers_helper_classes(tmp_path):
+    """A second class in the same file cannot dodge the confinement net."""
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "import jax\n\n"
+        "class FakeEngine:\n"
+        "    def drain(self):\n"
+        "        return jax.device_get(self.ring)\n\n"
+        "class Helper:\n"
+        "    def peek(self):\n"
+        "        return jax.device_get(self.x)\n")
+    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
+                       confine={".device_get": ("drain",)})
+    res = lint_paths([str(f)], root=str(tmp_path),
+                     rules=[HotPathSyncRule(specs=(spec,))])
+    assert len(res.findings) == 1 and "peek" in res.findings[0].message
+
+
+def test_suppression_reaches_multiline_statement_continuation(tmp_path):
+    """A standalone disable before a multi-line statement suppresses a
+    finding anchored on a continuation line of that statement."""
+    f = tmp_path / "m.py"
+    f.write_text(
+        "import jax\n\n"
+        "def g(state, batch, ring):\n"
+        "    step = jax.jit(lambda s, b: s, donate_argnums=(0,))\n"
+        "    out = step(state, batch)\n"
+        "    # dslint: disable=DS001 -- snapshot is provably pre-dispatch\n"
+        "    ring.append({\n"
+        "        'scale': state.loss_scale,\n"
+        "    })\n"
+        "    return out\n")
+    res = lint_paths([str(f)], root=str(tmp_path), select=["DS001"])
+    assert not res.findings and len(res.suppressed) == 1
+
+
+def test_ds002_early_return_guard_still_scans_the_async_tail(tmp_path):
+    """Refactoring the guard to early-return form must not retire the
+    tripwire: the tail after `if not <guard>: ...; return` IS the async
+    push path and stays sync-free."""
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "import jax\n\n"
+        "class FakeEngine:\n"
+        "    def record(self, out):\n"
+        "        if not self._async_enabled:\n"
+        "            self.last = float(out)    # sync fallback: allowed\n"
+        "            return\n"
+        "        self.ring.append(jax.device_get(out))  # async tail: fires\n")
+    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
+                       guard_branches=(("record", "_async_enabled"),))
+    res = lint_paths([str(f)], root=str(tmp_path),
+                     rules=[HotPathSyncRule(specs=(spec,))])
+    assert len(res.findings) == 1
+    assert ".device_get" in res.findings[0].message
+
+
+def test_ds004_acquire_only_protects_the_acquired_span(tmp_path):
+    """An unrelated .acquire() later in a method must not silence an
+    unprotected thread-shared write before it."""
+    f = tmp_path / "w.py"
+    f.write_text(
+        "import threading\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._shared = None\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "    def _loop(self):\n"
+        "        x = self._shared\n"
+        "    def poke(self):\n"
+        "        self._shared = 1          # BEFORE the acquire: unprotected\n"
+        "        self._sem.acquire()\n"
+        "        self._sem.release()\n")
+    res = lint_paths([str(f)], root=str(tmp_path), select=["DS004"])
+    assert len(res.findings) == 1 and "_shared" in res.findings[0].message
+
+
+def test_ds002_inverted_guard_checks_the_async_side(tmp_path):
+    """`if not <guard>: <sync fallback>` must not flag the fallback — the
+    async side (the else branch) is what stays sync-free."""
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "import jax\n\n"
+        "class FakeEngine:\n"
+        "    def record(self, out):\n"
+        "        if not self._async_enabled:\n"
+        "            return float(out)      # sync fallback: allowed\n"
+        "        else:\n"
+        "            self.ring.append(jax.device_get(out))  # async: fires\n")
+    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
+                       guard_branches=(("record", "_async_enabled"),))
+    res = lint_paths([str(f)], root=str(tmp_path),
+                     rules=[HotPathSyncRule(specs=(spec,))])
+    assert len(res.findings) == 1
+    assert ".device_get" in res.findings[0].message
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    cli = str(REPO / "bin" / "dslint")
+    bad = subprocess.run(
+        [sys.executable, cli, "--baseline", "none", "--select", "DS003",
+         str(FIXTURES / "ds003_violation.py")],
+        capture_output=True, text=True)
+    assert bad.returncode == 1, bad.stderr
+    good = subprocess.run(
+        [sys.executable, cli, "--baseline", "none", "--select", "DS003",
+         "--format", "json", str(FIXTURES / "ds003_clean.py")],
+        capture_output=True, text=True)
+    assert good.returncode == 0, good.stderr
+    payload = json.loads(good.stdout)
+    assert payload["findings"] == [] and payload["files_checked"] == 1
+
+
+# ----------------------------------------------------------------------
+# self-enforcement: the whole package lints clean vs the checked-in baseline
+# ----------------------------------------------------------------------
+def test_self_lint_package_clean_vs_baseline():
+    baseline = REPO / "dslint_baseline.json"
+    assert baseline.exists(), "checked-in dslint_baseline.json is missing"
+    res = lint_paths([str(REPO / "deepspeed_tpu")],
+                     baseline_path=str(baseline))
+    assert not res.findings, (
+        "dslint found new unsuppressed findings in deepspeed_tpu/ — fix "
+        "them, add an inline `# dslint: disable=RULE -- reason`, or (for a "
+        "deliberate grandfather) regenerate dslint_baseline.json:\n  "
+        + "\n  ".join(f.render() for f in res.findings))
+    assert not res.stale_baseline, (
+        "stale dslint baseline entries (the violation was fixed — expire "
+        "them with `bin/dslint --write-baseline deepspeed_tpu/`):\n  "
+        + "\n  ".join(str(e) for e in res.stale_baseline))
+
+
+def test_rule_count_matches_catalog():
+    assert len(get_rules()) >= 6
+    engine = LintEngine(get_rules())
+    assert len(engine.rules) == len(ALL_RULES)

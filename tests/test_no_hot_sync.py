@@ -1,112 +1,59 @@
-"""Static regression gate for the async step pipeline (AST, no jax import
-needed): the ``train_batch`` hot path must never regrow a host
-synchronization on step outputs — ``float(...)``, ``jax.device_get``, or
-``block_until_ready`` belong ONLY in the designated drain
-(``_drain_metric_ring``) and in the explicitly host-synchronous paths
-(offload step, accessors). A new sync sneaking into the hot path would
-silently serialize the pipeline while every timing test keeps passing —
-this file is the tripwire.
+"""Static regression gate for the hot paths — now a thin wrapper over the
+dslint DS002 rule, so this tripwire and ``bin/dslint`` can never drift
+apart: both read the SAME registry (``deepspeed_tpu/tools/dslint/hotpath
+.HOT_PATHS``).
+
+What the registry enforces (see hotpath.py for the full spec):
+
+  * ``train_batch`` + the per-step fused path never regrow ``float()``/
+    ``.item()``/``device_get``/``block_until_ready`` — step-output
+    readback belongs in ``_drain_metric_ring`` (the designated drain)
+  * the ``_async_enabled`` push branch of ``_record_metrics`` queues
+    device arrays verbatim (a transfer there re-serializes every step)
+  * ``jax.device_get`` in engine.py stays confined to the drain and the
+    explicitly host-synchronous paths
+  * the serving tick and the prefetch worker stay sync-free too
+
+A registered function disappearing (renamed without a registry update) is
+itself a DS002 finding, preserving the old test's rename detection.
 """
 
-import ast
 import pathlib
 
-ENGINE_PATH = (pathlib.Path(__file__).resolve().parent.parent
-               / "deepspeed_tpu" / "runtime" / "engine.py")
+import pytest
 
-# the per-step fused path: everything that runs on EVERY train_batch call
-HOT_FUNCS = {
-    "train_batch",
-    "stack_microbatches",
-    "_shard_batch",
-    "_advance_data_schedules",
-    "_ensure_prefetcher",
-}
+from deepspeed_tpu.tools.dslint import lint_paths
+from deepspeed_tpu.tools.dslint.hotpath import HOT_PATHS
+from deepspeed_tpu.tools.dslint.rules.ds002_hot_sync import HotPathSyncRule
 
-FORBIDDEN_ATTRS = {"device_get", "block_until_ready", "copy_to_host_async"}
+pytestmark = pytest.mark.lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-def _engine_class(tree):
-    for node in tree.body:
-        if isinstance(node, ast.ClassDef) and node.name == "DeepSpeedTPUEngine":
-            return node
-    raise AssertionError("DeepSpeedTPUEngine not found in engine.py")
+def test_registry_still_covers_the_engine_hot_path():
+    """The registry content IS the contract: shrinking it must be loud."""
+    spec = next(s for s in HOT_PATHS
+                if s.path == "deepspeed_tpu/runtime/engine.py")
+    assert spec.cls == "DeepSpeedTPUEngine"
+    assert {"train_batch", "stack_microbatches", "_shard_batch",
+            "_advance_data_schedules",
+            "_ensure_prefetcher"} <= set(spec.hot_functions)
+    assert ("_record_metrics", "_async_enabled") in spec.guard_branches
+    assert "_drain_metric_ring" in spec.confine[".device_get"]
 
 
-def _methods(cls):
-    return {n.name: n for n in cls.body
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-
-
-def _forbidden_calls(node):
-    bad = []
-    for n in ast.walk(node):
-        if not isinstance(n, ast.Call):
-            continue
-        f = n.func
-        if isinstance(f, ast.Name) and f.id == "float":
-            bad.append(("float()", n.lineno))
-        elif isinstance(f, ast.Attribute) and f.attr in FORBIDDEN_ATTRS:
-            bad.append((f.attr, n.lineno))
-    return bad
-
-
-def test_train_batch_hot_path_has_no_host_sync():
-    tree = ast.parse(ENGINE_PATH.read_text())
-    methods = _methods(_engine_class(tree))
-    missing = HOT_FUNCS - set(methods)
-    assert not missing, (
-        f"hot-path functions renamed/removed: {sorted(missing)} — update "
-        "tests/test_no_hot_sync.py alongside the refactor")
-    for name in sorted(HOT_FUNCS):
-        bad = _forbidden_calls(methods[name])
-        assert not bad, (
-            f"engine.{name} gained host synchronization {bad}: step-output "
-            "readback belongs in _drain_metric_ring (the designated drain), "
-            "not the per-step hot path")
-
-
-def test_deferred_record_branch_has_no_host_sync():
-    """The async push branch of ``_record_metrics`` (everything guarded by
-    ``_async_enabled``) queues device arrays verbatim — any transfer there
-    would re-serialize every step."""
-    tree = ast.parse(ENGINE_PATH.read_text())
-    methods = _methods(_engine_class(tree))
-    rec = methods["_record_metrics"]
-    async_branches = [
-        n for n in ast.walk(rec)
-        if isinstance(n, ast.If)
-        and any(isinstance(x, ast.Attribute) and x.attr == "_async_enabled"
-                for x in ast.walk(n.test))]
-    assert async_branches, "_record_metrics lost its _async_enabled branch"
-    for branch in async_branches:
-        bad = [b for stmt in branch.body for b in _forbidden_calls(stmt)]
-        assert not bad, (
-            f"_record_metrics deferred branch gained host sync {bad}")
-
-
-def test_drain_is_the_designated_device_get():
-    """``jax.device_get`` in engine.py stays confined to the drain and the
-    explicitly host-synchronous paths — growing the list is a conscious
-    decision, not an accident."""
-    allowed = {
-        "_drain_metric_ring",           # THE drain
-        "_offload_host_update",         # host optimizer is synchronous by design
-        "_train_batch_param_offload",   # ditto (streamed host step)
-        "_host_init_params",            # init-time, not per-step
-        "__init__",                     # offload master construction (init)
-        "get_lr", "get_global_grad_norm", "cur_scale", "skipped_steps",
-        "module_state_dict",            # accessors: sync on request
-    }
-    tree = ast.parse(ENGINE_PATH.read_text())
-    methods = _methods(_engine_class(tree))
-    offenders = {}
-    for name, node in methods.items():
-        hits = [ln for attr, ln in _forbidden_calls(node)
-                if attr == "device_get"]
-        if hits and name not in allowed:
-            offenders[name] = hits
-    assert not offenders, (
-        f"device_get appeared outside the designated functions: {offenders} "
-        "— route readback through the drain or add a deliberate exemption "
-        "here with a comment explaining why it cannot lag")
+def test_hot_paths_have_no_host_sync():
+    """Lint every registered hot-path file with DS002 only; any finding —
+    including registry drift from a rename — fails."""
+    paths = sorted({str(REPO / s.path) for s in HOT_PATHS})
+    for p in paths:
+        assert pathlib.Path(p).exists(), f"registered hot-path file gone: {p}"
+    result = lint_paths(paths, root=str(REPO),
+                        rules=[HotPathSyncRule()])
+    assert not result.findings, (
+        "hot path gained host synchronization (or the registry drifted):\n  "
+        + "\n  ".join(f.render() for f in result.findings)
+        + "\nroute readback through the designated drain, or update "
+          "deepspeed_tpu/tools/dslint/hotpath.py alongside a deliberate "
+          "refactor")
