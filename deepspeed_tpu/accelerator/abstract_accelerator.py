@@ -91,9 +91,11 @@ class Accelerator(abc.ABC):
 
     # --- profiler / tracing ------------------------------------------------
     def range_push(self, name: str):
-        """Named trace annotation (reference: nvtx range_push)."""
-        import jax
-        return jax.profiler.TraceAnnotation(name)
+        """Named trace annotation (reference: nvtx range_push). Routed
+        through ``utils.nvtx.annotate`` so the range also lands in the
+        dstrace timeline when tracing is on."""
+        from deepspeed_tpu.utils.nvtx import annotate
+        return annotate(name)
 
     # --- op-builder dir (kept for API parity; see deepspeed_tpu.ops) -------
     def op_builder_dir(self) -> str:

@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from deepspeed_tpu.comm.comms_logging import get_comms_logger
+from deepspeed_tpu.comm.comms_logging import emit_comm_instant, get_comms_logger
+from deepspeed_tpu.telemetry.tracer import get_tracer
 
 
 class ReduceOp(enum.Enum):
@@ -48,12 +49,20 @@ def _axis_size(axis_name) -> int:
 
 def _record(op_name: str, x, axis_name, world: Optional[int] = None):
     logger_ = get_comms_logger()
+    tracer = get_tracer()
+    if not (logger_.enabled or tracer.enabled):
+        return
+    try:
+        world = world or _axis_size(axis_name)
+    except Exception:
+        world = world or 1
+    nbytes = _nbytes(x)
     if logger_.enabled:
-        try:
-            world = world or _axis_size(axis_name)
-        except Exception:
-            world = world or 1
-        logger_.record_traced(op_name, _nbytes(x), world)
+        logger_.record_traced(op_name, nbytes, world)   # also traces
+    else:
+        # tracing without the comms logger: emit the trace-time instant
+        # through the shared helper, skip the volume-accounting tables
+        emit_comm_instant(op_name, nbytes, world)
 
 
 # --- trace-safe collectives (usable under jit/shard_map with named axes) ----

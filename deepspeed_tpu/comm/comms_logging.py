@@ -13,8 +13,9 @@ Reference analog: ``deepspeed/utils/comms_logging.py:67`` (``CommsLogger``) and
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -23,11 +24,19 @@ def calc_bw(op_name: str, size_bytes: int, duration_s: float, world: int):
 
     busbw scales algbw by the ring-collective traffic factor: allreduce 2(n-1)/n,
     allgather/reduce_scatter/all_to_all (n-1)/n.
+
+    Degenerate inputs are guarded, not propagated: a zero/negative duration
+    (clock granularity on a fast op) or a negative size yields (0, 0)
+    instead of inf/garbage, and ``world <= 1`` reports busbw == algbw — the
+    ring factor would otherwise multiply a single-member op down to a 0
+    busbw that reads as "link dead" on a dashboard.
     """
-    if duration_s <= 0:
+    if duration_s <= 0 or size_bytes < 0:
         return 0.0, 0.0
     algbw = size_bytes / duration_s
     n = max(world, 1)
+    if n == 1:
+        return algbw, algbw     # no inter-member traffic to scale by
     if "all_reduce" in op_name:
         busbw = algbw * (2 * (n - 1) / n)
     elif any(k in op_name for k in ("all_gather", "reduce_scatter", "all_to_all")):
@@ -35,6 +44,17 @@ def calc_bw(op_name: str, size_bytes: int, duration_s: float, world: int):
     else:
         busbw = algbw
     return algbw, busbw
+
+
+def emit_comm_instant(op_name: str, nbytes: int, world: int) -> None:
+    """Trace-time analytic comm record: an instant event (no runtime duration
+    exists under XLA scheduling) carrying op/bytes/world. THE single emission
+    point — both ``CommsLogger.record_traced`` and the collective facade's
+    logger-off path route through here so the trace args can never drift."""
+    tracer = get_tracer()
+    if tracer.enabled:
+        tracer.instant(f"comm/{op_name}", cat="comm", bytes=int(nbytes),
+                       world=int(world))
 
 
 class CommsLogger:
@@ -59,20 +79,28 @@ class CommsLogger:
         rec = self.traced[op_name]
         rec["count"] += 1
         rec["bytes"] += nbytes
+        emit_comm_instant(op_name, nbytes, world)
         if self.verbose:
             logger.info(f"[comms][trace] {op_name}: {nbytes / 1e6:.2f} MB over {world} members")
 
     @contextmanager
     def timed(self, op_name: str, nbytes: int, world: int):
-        if not self.enabled:
+        tracer = get_tracer()
+        if not (self.enabled or tracer.enabled):
             yield
             return
         start = time.time()
         yield
         dur = time.time() - start
+        algbw, busbw = calc_bw(op_name, nbytes, dur, world)
+        if tracer.enabled:
+            tracer.complete(f"comm/{op_name}", dur, cat="comm",
+                            bytes=int(nbytes), world=int(world),
+                            algbw_gbps=algbw / 1e9, busbw_gbps=busbw / 1e9)
+        if not self.enabled:
+            return
         self.timed_records[op_name].append((nbytes, dur, world))
         if self.verbose:
-            algbw, busbw = calc_bw(op_name, nbytes, dur, world)
             logger.info(f"[comms] {op_name}: {nbytes / 1e6:.2f} MB in {dur * 1e3:.2f} ms | "
                         f"algbw {algbw / 1e9:.2f} GB/s busbw {busbw / 1e9:.2f} GB/s")
 
@@ -90,6 +118,38 @@ class CommsLogger:
                          f"{tot_t * 1e3:.1f} ms, algbw {algbw / 1e9:.2f} GB/s")
         logger.info("\n".join(lines))
         return lines
+
+    def per_op_totals(self) -> Dict[str, Dict[str, float]]:
+        """Merged per-op volume/time totals across both recording modes —
+        the summary ``env_report`` and tests consume without parsing log
+        lines: ``{op: {count, bytes, seconds}}`` (seconds only for eager
+        timed ops; traced ops are scheduled by XLA)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for op, rec in self.traced.items():
+            out[op] = {"count": int(rec["count"]),
+                       "bytes": float(rec["bytes"]), "seconds": 0.0}
+        for op, recs in self.timed_records.items():
+            e = out.setdefault(op, {"count": 0, "bytes": 0.0, "seconds": 0.0})
+            e["count"] += len(recs)
+            e["bytes"] += float(sum(r[0] for r in recs))
+            e["seconds"] += float(sum(r[1] for r in recs))
+        return out
+
+    def env_report_rows(self) -> List[Tuple[str, str]]:
+        """(key, value) rows for the ``dstpu_report`` environment report."""
+        totals = self.per_op_totals()
+        if not totals:
+            return [("comms ops", "none recorded in this process")]
+        rows = []
+        for op, t in sorted(totals.items()):
+            val = f"{int(t['count'])} calls, {t['bytes'] / 1e6:.2f} MB"
+            if t["seconds"] > 0:
+                # volume/duration only: bus bandwidth needs the per-op world
+                # size, which totals deliberately do not aggregate over
+                val += (f", {t['seconds'] * 1e3:.1f} ms, "
+                        f"{t['bytes'] / t['seconds'] / 1e9:.2f} GB/s")
+            rows.append((f"comms[{op}]", val))
+        return rows
 
     def reset(self):
         self.traced.clear()

@@ -67,7 +67,35 @@ def debug_report():
     except Exception as e:  # no devices available
         rows.append(("jax backend", f"unavailable ({e})"))
     rows.extend(dslint_report())
+    rows.extend(trace_report())
+    rows.extend(comms_report())
     return rows
+
+
+def trace_report():
+    """dstrace status: whether tracing is active (DSTPU_TRACE or
+    programmatic) and how full the event ring is."""
+    try:
+        from deepspeed_tpu.telemetry import TRACE_ENV, get_tracer
+        import os
+        t = get_tracer()
+        if not t.enabled:
+            return [("dstrace", f"off (set {TRACE_ENV}=trace.json)")]
+        dest = os.environ.get(TRACE_ENV, "<programmatic>")
+        return [("dstrace", f"on -> {dest} ({len(t.events_snapshot())}/"
+                            f"{t.capacity} events, {t.dropped()} dropped)")]
+    except Exception as e:
+        return [("dstrace", f"unavailable ({e})")]
+
+
+def comms_report():
+    """Per-op communication totals recorded by the CommsLogger in THIS
+    process (traced analytic volume + eager timed ops)."""
+    try:
+        from deepspeed_tpu.comm.comms_logging import get_comms_logger
+        return get_comms_logger().env_report_rows()
+    except Exception as e:
+        return [("comms", f"unavailable ({e})")]
 
 
 def dslint_report():

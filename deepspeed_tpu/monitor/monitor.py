@@ -149,3 +149,11 @@ class MonitorMaster(Monitor):
         for b in self.backends:
             if b.enabled:
                 b.write_events(events)
+
+    # ---- events sink (tracer instant-events) -----------------------------
+    def write_instant(self, name: str, step: int):
+        """One tracer instant-event (guard trip, chaos injection, watchdog
+        flag) as a unit-valued gauge under ``Events/`` — so the rare events
+        land in TensorBoard/CSV on the same step axis as the metrics they
+        explain. This is the hook ``Tracer.attach_sink`` takes."""
+        self.write_events([(f"Events/{name}", 1.0, int(step))])

@@ -30,6 +30,7 @@ from typing import FrozenSet, Optional
 
 import numpy as np
 
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -123,6 +124,7 @@ class ChaosMonkey:
         if not self.nan_due(step):
             return batch
         self.injected["nan"] += 1
+        get_tracer().instant("chaos/nan", cat="resilience", step=step)
         logger.warning(f"chaos: injecting NaN into batch at step {step}")
         poisoned = [False]
 
@@ -153,6 +155,8 @@ class ChaosMonkey:
             and self._roll("ckpt", step, salt=attempt) < c.ckpt_fail_prob)
         if fail:
             self.injected["ckpt"] += 1
+            get_tracer().instant("chaos/ckpt_io_fail", cat="resilience",
+                                 step=step, attempt=attempt)
             raise ChaosInjectedIOError(
                 f"chaos: injected checkpoint I/O failure "
                 f"(step {step}, attempt {attempt})")
@@ -168,6 +172,8 @@ class ChaosMonkey:
             self.injected["slow"] += 1
             logger.warning(f"chaos: stalling step {step} for {c.slow_s:.2f}s")
             time.sleep(c.slow_s)
+            get_tracer().complete("chaos/stall", c.slow_s, cat="resilience",
+                                  step=step)
             return c.slow_s
         return 0.0
 
@@ -182,6 +188,9 @@ class ChaosMonkey:
             # restart+resume path actually completes
             return
         logger.warning(f"chaos: SIGKILL self at step {step}")
+        # breadcrumb only: SIGKILL is uncatchable, so no dump follows — a
+        # relaunched worker's trace starts fresh
+        get_tracer().instant("chaos/die", cat="resilience", step=step)
         os.kill(os.getpid(), signal.SIGKILL)
 
 

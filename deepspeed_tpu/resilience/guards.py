@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.resilience.config import StepGuardConfig
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -164,6 +165,10 @@ class StepGuard:
         logger.warning(
             f"step guard: non-finite step detected "
             f"(consecutive={self.consecutive_bad}, total={self.total_bad})")
+        get_tracer().instant("resilience/bad_step", cat="resilience",
+                             step=self.engine.global_steps,
+                             consecutive=self.consecutive_bad,
+                             total=self.total_bad)
         if self.cfg.policy == "abort":
             raise BadStepError(
                 f"non-finite loss/grads at global step "
@@ -173,6 +178,9 @@ class StepGuard:
             self._backoff_lr()
         if (self.cfg.quarantine_after
                 and self.consecutive_bad >= self.cfg.quarantine_after):
+            get_tracer().instant("resilience/quarantine", cat="resilience",
+                                 step=self.engine.global_steps,
+                                 consecutive=self.consecutive_bad)
             raise QuarantineError(
                 f"{self.consecutive_bad} consecutive non-finite steps "
                 f"(quarantine_after={self.cfg.quarantine_after}); "
@@ -233,6 +241,9 @@ class StepGuard:
                 "(fused host optimizer owns the schedule); lr unchanged")
             return
         self.lr_scale = float(scale)
+        get_tracer().instant("resilience/lr_backoff", cat="resilience",
+                             step=self.engine.global_steps,
+                             lr_scale=self.lr_scale)
         base = self._base_lr_schedule
         s = self.lr_scale
         self._wrap_tx()

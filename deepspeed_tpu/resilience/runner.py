@@ -44,7 +44,8 @@ from deepspeed_tpu.resilience.config import (ResilienceConfig,
                                              resolve_resilience_config)
 from deepspeed_tpu.resilience.guards import (BadStepError, QuarantineError,
                                              StepGuard)
-from deepspeed_tpu.resilience.watchdog import StepWatchdog
+from deepspeed_tpu.resilience.watchdog import TRACE_TAIL_S, StepWatchdog
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 _CLIENT_STATE_KEY = "_resilience"
@@ -119,6 +120,11 @@ class FaultTolerantRunner:
         # bytecodes; taking a lock here could deadlock against the code it
         # interrupted, so a GIL-atomic int store is the only safe write
         self._preempt_signal = signum
+        # fanout=False = append-only breadcrumb (no sink, no I/O, no locks)
+        # — the signal-safe emission form; the trace itself is dumped later,
+        # at the step-boundary autosave, never from handler context
+        get_tracer().instant("resilience/preempt_signal", cat="resilience",
+                             fanout=False, signum=signum)
         # dslint: disable=DS005 -- one best-effort log line: logging's RLock
         # is re-entrant on this same (main) thread, and operators need the
         # "preemption acknowledged" breadcrumb exactly at signal time
@@ -490,5 +496,15 @@ class FaultTolerantRunner:
             json.dump(diag, f, indent=2, default=str)
         with open(os.path.join(d, "stacks.txt"), "w") as f:
             faulthandler.dump_traceback(file=f, all_threads=True)
+        # unified-timeline slice: the last minute of spans/instants (guard
+        # trips, chaos injections, dispatch/drain cadence) before the
+        # quarantine/abort — Perfetto-loadable straight from the bundle
+        tracer = get_tracer()
+        if tracer.enabled:
+            try:
+                tracer.export_chrome(os.path.join(d, "trace_tail.json"),
+                                     tail_s=TRACE_TAIL_S)
+            except Exception:
+                logger.exception("resilience: trace-tail embed failed")
         logger.error(f"resilience: diagnostic bundle written -> {d}")
         return d

@@ -27,7 +27,11 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from deepspeed_tpu.resilience.config import WatchdogConfig
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
+
+#: trailing trace slice embedded in hang/quarantine diagnostic bundles
+TRACE_TAIL_S = 60.0
 
 
 @dataclass
@@ -94,6 +98,9 @@ class StepWatchdog:
             self._flag(step, elapsed)
 
     def _flag(self, step: int, elapsed: float):
+        get_tracer().instant("resilience/watchdog_flag", cat="resilience",
+                             step=step, elapsed_s=round(elapsed, 3),
+                             policy=self.cfg.policy)
         snapshot = None
         try:
             snapshot = self._dump_snapshot(step, elapsed)
@@ -143,6 +150,12 @@ class StepWatchdog:
                 context["context_error"] = repr(e)
         with open(os.path.join(d, "context.json"), "w") as f:
             json.dump(context, f, indent=2, default=str)
+        # the last minute of the unified timeline ("what led up to the
+        # hang"), Perfetto-loadable straight out of the bundle
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.export_chrome(os.path.join(d, "trace_tail.json"),
+                                 tail_s=TRACE_TAIL_S)
         return d
 
 

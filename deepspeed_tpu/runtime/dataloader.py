@@ -13,6 +13,8 @@ from typing import Any, Callable, Iterator, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from deepspeed_tpu.telemetry.tracer import get_tracer
+
 
 class StagedBatch(NamedTuple):
     """A batch already placed on the mesh (device-resident, correctly
@@ -42,6 +44,7 @@ class PrefetchLoader:
                  depth: int = 2):
         self._source = iter(source)
         self._stage_fn = stage_fn
+        self._tracer = get_tracer()
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
         self._closed = threading.Event()   # set by close(), read by worker
         self._done = False
@@ -60,14 +63,17 @@ class PrefetchLoader:
                     continue
             return False
 
+        tr = self._tracer
         try:
             while not self._closed.is_set():
                 try:
-                    item = next(self._source)
+                    with tr.span("prefetch/next", cat="data"):
+                        item = next(self._source)
                 except StopIteration:
                     break
                 if self._stage_fn is not None:
-                    item = self._stage_fn(item)
+                    with tr.span("prefetch/stage", cat="data"):
+                        item = self._stage_fn(item)
                 if not _put(item):   # blocks while `depth` batches are ready
                     return
             _put(self._DONE)

@@ -23,6 +23,7 @@ import collections
 import functools
 import os
 import time
+import weakref
 from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -42,6 +43,7 @@ from deepspeed_tpu.runtime.zero.partition import (
     build_param_shardings,
     build_secondary_shardings,
 )
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.runtime.dataloader import PrefetchLoader, StagedBatch
 from deepspeed_tpu.utils.timer import (
@@ -527,6 +529,7 @@ class DeepSpeedTPUEngine:
                      "serialize the pipeline they are measuring", ranks=[0])
 
         # --- bookkeeping / observability -------------------------------------
+        self.tracer = get_tracer()     # dstrace span tracer (DSTPU_TRACE)
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
@@ -542,6 +545,21 @@ class DeepSpeedTPUEngine:
                 or config.wandb.enabled):
             from deepspeed_tpu.monitor.monitor import MonitorMaster
             self.monitor = MonitorMaster(config)
+            if self.monitor.enabled:
+                # tracer instant-events (guard trips, chaos injections, ...)
+                # fan out to the monitor's `events` sink alongside gauges.
+                # Bound through a weakref: the process-global tracer outlives
+                # any engine, and a strong bound method would pin a torn-down
+                # engine's monitor (open TB/CSV handles) for the process
+                # lifetime and keep routing events to its stale writers.
+                mon_ref = weakref.ref(self.monitor)
+
+                def _events_sink(name, step):
+                    mon = mon_ref()
+                    if mon is not None:
+                        mon.write_instant(name, step)
+
+                self.tracer.attach_sink(_events_sink)
 
         # --- data efficiency (curriculum learning + random-LTD) --------------
         # reference: engine.py curriculum hooks + runtime/data_pipeline/
@@ -869,7 +887,11 @@ class DeepSpeedTPUEngine:
             if multi_host:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)
-        return jax.tree.map(place, batch)
+        tr = self.tracer
+        nbytes = sum(int(getattr(x, "nbytes", 0))
+                     for x in jax.tree.leaves(batch)) if tr.enabled else 0
+        with tr.span("comm/h2d", cat="comm", bytes=nbytes):
+            return jax.tree.map(place, batch)
 
     def train_batch(self, data_iter: Optional[Iterator] = None,
                     batch: Optional[Any] = None, stacked: Optional[bool] = None) -> jnp.ndarray:
@@ -921,7 +943,15 @@ class DeepSpeedTPUEngine:
             self._last_drain_time = time.time()
         self.tput_timer.start()
         step_timer.start()
-        self.state, out = self._train_batch_fn(self.state, device_batch, step_rng)
+        # dispatch span: host time spent LAUNCHING the fused step (no
+        # completion wait — in async mode the reconciled step time shows up
+        # as engine/steps_reconciled at the drain; comparing the two is the
+        # dispatch-gap-vs-step-time view the async pipeline is tuned by)
+        with self.tracer.span("engine/dispatch", cat="train",
+                              step=self.global_steps,
+                              mode="async" if self._async_enabled else "sync"):
+            self.state, out = self._train_batch_fn(self.state, device_batch,
+                                                   step_rng)
         step_timer.stop()
         self.tput_timer.stop(global_step=True)
 
@@ -951,8 +981,10 @@ class DeepSpeedTPUEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         applied_step = self.global_steps   # the step the offload optimizer
-        loss, norm = self._param_offload.train_batch(  # evaluates lr at
-            batch_host, step=applied_step)
+        with self.tracer.span("engine/train_step", cat="train",
+                              step=applied_step, mode="param_offload"):
+            loss, norm = self._param_offload.train_batch(  # evaluates lr at
+                batch_host, step=applied_step)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         self.state = self.state._replace(step=self.state.step + 1)
@@ -1007,9 +1039,12 @@ class DeepSpeedTPUEngine:
         device_batch = self._shard_batch(batch, stacked=True)
         self._rng, r = jax.random.split(self._rng)
         self.tput_timer.start()
-        loss, grads, norm, overflow = self._offload_grad_fn(
-            self.state.params, device_batch, r, self.state.loss_scale.scale)
-        self._offload_host_update(loss, grads, norm, overflow)
+        with self.tracer.span("engine/train_step", cat="train",
+                              step=self.global_steps, mode="offload"):
+            loss, grads, norm, overflow = self._offload_grad_fn(
+                self.state.params, device_batch, r,
+                self.state.loss_scale.scale)
+            self._offload_host_update(loss, grads, norm, overflow)
         self.tput_timer.stop(global_step=True)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
@@ -1057,6 +1092,20 @@ class DeepSpeedTPUEngine:
             self._reset_compiled_fns()
             log_dist(f"non-finite step guard {'armed' if enabled else 'off'}",
                      ranks=[0])
+
+    def dump_trace(self, path: Optional[str] = None,
+                   tail_s: Optional[float] = None) -> Dict[str, Any]:
+        """Write (and return) the dstrace Chrome-trace dump — dispatch /
+        drain / prefetch / checkpoint / comm spans plus resilience instant
+        events, loadable in ui.perfetto.dev. ``tail_s`` restricts to the
+        trailing slice. Also reachable hands-off via ``DSTPU_TRACE=path``
+        (dump at exit). See docs/observability.md."""
+        return self.tracer.export_chrome(path, tail_s=tail_s)
+
+    def trace_summary(self, prefix: Optional[str] = None) -> Dict[str, Any]:
+        """Per-span aggregate (count/total/mean/max/p50/p99 seconds) of the
+        tracer ring — the quick in-process look before dumping a trace."""
+        return self.tracer.summary(prefix=prefix)
 
     def start_profile_trace(self, log_dir: str) -> None:
         """Start an XLA/TPU profiler trace (reference: NVTX ranges + torch
@@ -1180,7 +1229,8 @@ class DeepSpeedTPUEngine:
         ring, self._metric_ring = self._metric_ring, []
         # the LIVE loss scale rides the same transfer (exact at sync_every=1;
         # for lagged fp16 entries the monitor shows the drain-time scale)
-        host, scale = jax.device_get((ring, self.state.loss_scale.scale))
+        with self.tracer.span("engine/drain", cat="train", steps=len(ring)):
+            host, scale = jax.device_get((ring, self.state.loss_scale.scale))
         now = time.time()
         scale = float(scale)
         entries = [{"step": int(e["step"]), "samples": int(e["samples"]),
@@ -1200,6 +1250,15 @@ class DeepSpeedTPUEngine:
             window = max(now - self._last_drain_time, 0.0)
             self.timers(TRAIN_BATCH_TIMER).record_external(
                 window, count=len(entries))
+            # retro span covering the reconciled window: the TRUE step time
+            # of the drained steps (dispatch spans only show launch cost)
+            self.tracer.complete("engine/steps_reconciled", window,
+                                 cat="train", steps=len(entries),
+                                 last_step=last["step"])
+        for e in entries:
+            if e["overflow"]:
+                self.tracer.instant("engine/overflow_step", cat="train",
+                                    step=e["step"])
         self.tput_timer.mark_edge()
         if self.monitor and self.monitor.enabled:
             events = []
@@ -1544,18 +1603,22 @@ class DeepSpeedTPUEngine:
         checkpoint (every rank participates; reshape-on-load by construction)."""
         # checkpoint boundary = drain boundary: pending deferred metrics land
         # (monitor/timers/guard consumers) before the state is snapshotted
-        self.flush_metrics()
-        from deepspeed_tpu.checkpoint.engine import save_engine_checkpoint
-        return save_engine_checkpoint(self, save_dir, tag=tag,
-                                      client_state=client_state or {})
+        with self.tracer.span("ckpt/save", cat="ckpt", step=self.global_steps,
+                              tag=tag or "auto"):
+            self.flush_metrics()
+            from deepspeed_tpu.checkpoint.engine import save_engine_checkpoint
+            return save_engine_checkpoint(self, save_dir, tag=tag,
+                                          client_state=client_state or {})
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True):
         """reference: engine.load_checkpoint:2763 (+_get_all_zero_checkpoints
         world-size-change handling — free here: the checkpoint is topology-free)."""
         from deepspeed_tpu.checkpoint.engine import load_engine_checkpoint
-        out = load_engine_checkpoint(self, load_dir, tag=tag,
-                                     load_optimizer_states=load_optimizer_states)
+        with self.tracer.span("ckpt/load", cat="ckpt", tag=tag or "latest"):
+            out = load_engine_checkpoint(
+                self, load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states)
         # resync data-efficiency schedules to the restored global step; replay the
         # random-LTD token accounting so consumed_layer_tokens survives resume
         if self.random_ltd_scheduler is not None:

@@ -12,6 +12,7 @@ import threading
 from typing import List
 
 from deepspeed_tpu.monitor import Event
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.timer import RateTracker
 
 # bounded sample reservoirs: serving runs indefinitely, metric memory must not
@@ -192,4 +193,9 @@ class ServingMetrics:
             kind = "counter" if key in counters else "gauge"
             lines.append(f"# TYPE {full} {kind}")
             lines.append(f"{full} {snap[key]:.9g}")
+        # tracer-backed span summaries (request phase latencies straight
+        # from the dstrace ring: serve/queued, serve/prefill, serve/decode)
+        tracer = get_tracer()
+        if tracer.enabled:
+            lines.extend(tracer.prometheus_lines(prefix="serve/"))
         return "\n".join(lines) + "\n"

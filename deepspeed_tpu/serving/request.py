@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Iterator, List, Optional, Sequence
 
+from deepspeed_tpu.telemetry.tracer import get_tracer, request_tid
+
 
 class RequestState(enum.Enum):
     QUEUED = "queued"          # accepted, waiting for engine admission
@@ -109,8 +111,37 @@ class Request:
         self.finish_reason = reason
         self.error = error
         self.finish_ts = time.monotonic()
+        tracer = get_tracer()
+        if tracer.enabled:
+            self._trace_lifecycle(tracer)
         self._stream.put(_END)
         self._done.set()
+
+    def _trace_lifecycle(self, tracer):
+        """Emit the request's phase spans retroactively from the lifecycle
+        timestamps (same monotonic clock as the tracer), one synthetic track
+        per uid: queued (arrival→admit), prefill (admit→first token), decode
+        (first token→finish), plus a terminal instant. TTFT/TPOT are
+        derivable from the trace alone: TTFT = queued.dur + prefill.dur,
+        TPOT = decode.dur / (tokens - 1)."""
+        tid = request_tid(self.uid)
+        if self.admit_ts is not None:
+            tracer.complete("serve/queued", self.admit_ts - self.arrival_ts,
+                            cat="serve", end_ts=self.admit_ts, tid=tid,
+                            uid=self.uid)
+            if self.first_token_ts is not None:
+                tracer.complete("serve/prefill",
+                                self.first_token_ts - self.admit_ts,
+                                cat="serve", end_ts=self.first_token_ts,
+                                tid=tid, uid=self.uid,
+                                prompt_tokens=len(self.prompt_tokens))
+        if self.first_token_ts is not None and self.finish_ts is not None:
+            tracer.complete("serve/decode",
+                            self.finish_ts - self.first_token_ts,
+                            cat="serve", end_ts=self.finish_ts, tid=tid,
+                            uid=self.uid, tokens=len(self.tokens))
+        tracer.instant(f"serve/{self.state.value}", cat="serve", tid=tid,
+                       uid=self.uid, reason=self.finish_reason)
 
     # ---- derived metrics -------------------------------------------------
     @property

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence
 
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -218,6 +219,8 @@ class InferenceServer:
                     "new requests")
             if len(self._queue) >= cfg.max_queue_depth:
                 self.metrics.on_reject()
+                get_tracer().instant("serve/backpressure", cat="serve",
+                                     kind="queue_full")
                 raise BackpressureError(
                     f"admission queue full ({cfg.max_queue_depth}); retry "
                     f"after {cfg.retry_after_s:.1f}s", cfg.retry_after_s)
@@ -231,6 +234,8 @@ class InferenceServer:
                          + self._blocks_for(req))
             if projected / total_blocks > cfg.kv_high_watermark:
                 self.metrics.on_reject()
+                get_tracer().instant("serve/backpressure", cat="serve",
+                                     kind="kv_watermark")
                 raise BackpressureError(
                     f"projected KV occupancy {projected}/{total_blocks} over "
                     f"watermark {cfg.kv_high_watermark:.2f}; retry after "
@@ -268,6 +273,8 @@ class InferenceServer:
                 # and the replica must stop advertising itself healthy
                 logger.exception("serve loop: engine step failed; failing "
                                  "in-flight requests")
+                get_tracer().instant("serve/degraded", cat="serve",
+                                     reason="engine_step_failed")
                 with self._lock:
                     self._degraded = f"engine step failed: {e}"
                 self._fail_all("engine step raised")
@@ -289,7 +296,8 @@ class InferenceServer:
         worked = False
         if self.engine.has_work():
             try:
-                out = self.engine.step()
+                with get_tracer().span("serve/engine_step", cat="serve"):
+                    out = self.engine.step()
             except Exception as e:
                 raise _EngineStepError(str(e)) from e
             self.metrics.on_step()

@@ -1,0 +1,19 @@
+"""deepspeed_tpu.telemetry — dstrace structured tracing.
+
+One low-overhead span tracer unifying train, serving, comm, and resilience
+telemetry (see ``docs/observability.md``). Import surface::
+
+    from deepspeed_tpu.telemetry import get_tracer, configure_tracing
+    configure_tracing(enabled=True)
+    with get_tracer().span("my/phase", step=7):
+        ...
+    engine.dump_trace("trace.json")        # -> ui.perfetto.dev
+"""
+
+from deepspeed_tpu.telemetry.tracer import (DEFAULT_CAPACITY,
+                                            REQUEST_TID_BASE, TRACE_ENV,
+                                            Tracer, configure_tracing,
+                                            get_tracer, request_tid)
+
+__all__ = ["Tracer", "get_tracer", "configure_tracing", "TRACE_ENV",
+           "DEFAULT_CAPACITY", "REQUEST_TID_BASE", "request_tid"]

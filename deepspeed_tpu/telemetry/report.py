@@ -1,0 +1,106 @@
+"""``dstpu_trace`` — top-spans text report from a dstrace dump.
+
+Reads a Chrome-trace JSON written by ``engine.dump_trace`` / ``DSTPU_TRACE``
+and renders the aggregate view an oncall wants before opening Perfetto:
+per-span-name count / total / mean / max / share of traced wall time, plus
+instant-event counts (guard trips, chaos injections, preemption signals).
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    return [e for e in events if isinstance(e, dict)]
+
+
+def aggregate(events: List[dict], cat: str = None):
+    """(span_rows, instant_rows, wall_us). Span rows are per-name
+    aggregates of "X" events; wall is the end-to-end traced interval."""
+    spans: Dict[str, List[float]] = defaultdict(list)
+    instants: Dict[str, int] = defaultdict(int)
+    lo, hi = None, None
+    for e in events:
+        ph = e.get("ph")
+        if cat and e.get("cat") != cat:
+            continue
+        if ph == "X":
+            ts, dur = float(e.get("ts", 0)), float(e.get("dur", 0))
+            spans[e.get("name", "?")].append(dur)
+            lo = ts if lo is None else min(lo, ts)
+            hi = ts + dur if hi is None else max(hi, ts + dur)
+        elif ph == "i":
+            instants[e.get("name", "?")] += 1
+            ts = float(e.get("ts", 0))
+            lo = ts if lo is None else min(lo, ts)
+            hi = ts if hi is None else max(hi, ts)
+    wall = (hi - lo) if (lo is not None and hi is not None) else 0.0
+    rows = []
+    for name, durs in spans.items():
+        total = sum(durs)
+        rows.append({"name": name, "count": len(durs), "total_us": total,
+                     "mean_us": total / len(durs), "max_us": max(durs),
+                     "share": (total / wall) if wall > 0 else 0.0})
+    rows.sort(key=lambda r: r["total_us"], reverse=True)
+    return rows, dict(instants), wall
+
+
+def render(rows, instants, wall_us: float, top: int = 20) -> str:
+    out = []
+    out.append(f"traced wall time: {wall_us / 1e3:.2f} ms")
+    out.append("")
+    out.append(f"{'span':<36} {'count':>7} {'total ms':>10} "
+               f"{'mean ms':>9} {'max ms':>9} {'% wall':>7}")
+    out.append("-" * 82)
+    for r in rows[:top]:
+        out.append(f"{r['name']:<36} {r['count']:>7} "
+                   f"{r['total_us'] / 1e3:>10.2f} {r['mean_us'] / 1e3:>9.3f} "
+                   f"{r['max_us'] / 1e3:>9.3f} {r['share'] * 100:>6.1f}%")
+    if len(rows) > top:
+        out.append(f"... {len(rows) - top} more span names (--top N)")
+    if instants:
+        out.append("")
+        out.append(f"{'instant event':<46} {'count':>7}")
+        out.append("-" * 54)
+        for name in sorted(instants, key=instants.get, reverse=True):
+            out.append(f"{name:<46} {instants[name]:>7}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu_trace",
+        description="top-spans report from a dstrace Chrome-trace dump "
+                    "(produce one with DSTPU_TRACE=trace.json or "
+                    "engine.dump_trace)")
+    parser.add_argument("trace", help="Chrome-trace JSON file")
+    parser.add_argument("--top", type=int, default=20,
+                        help="span names to show (default 20)")
+    parser.add_argument("--cat", default=None,
+                        help="restrict to one category "
+                             "(train/comm/serve/ckpt/data/resilience)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable aggregate instead of a table")
+    args = parser.parse_args(argv)
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"dstpu_trace: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
+    rows, instants, wall = aggregate(events, cat=args.cat)
+    if args.json:
+        print(json.dumps({"wall_us": wall, "spans": rows,
+                          "instants": instants}, indent=2))
+    else:
+        print(render(rows, instants, wall, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

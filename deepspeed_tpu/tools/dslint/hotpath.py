@@ -107,4 +107,19 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         hot_functions=("_worker", "__next__"),
         forbidden=ENGINE_FORBIDDEN,
     ),
+    # the dstrace emit helpers run INSIDE every registered hot path above
+    # (train_batch dispatch, serve tick, prefetch worker) — registering them
+    # here is what PROVES "always-on tracing never adds a host sync": any
+    # device readback, float() coercion, or numpy materialization growing
+    # into the emit path is a DS002 finding
+    HotPathSpec(
+        path="deepspeed_tpu/telemetry/tracer.py",
+        cls="Tracer",
+        hot_functions=("span", "instant", "complete", "_emit"),
+    ),
+    HotPathSpec(
+        path="deepspeed_tpu/telemetry/tracer.py",
+        cls="_Span",
+        hot_functions=("__enter__", "__exit__"),
+    ),
 )

@@ -10,11 +10,19 @@ in XLA/TPU profiler traces under the op hierarchy) plus
 traces captured by ``jax.profiler.trace``). One decorator serves both: inside
 jit the named_scope tags the emitted ops; outside it the TraceAnnotation times
 the Python call.
+
+Single source of span truth: every range ALSO lands in the dstrace tracer
+(``deepspeed_tpu.telemetry``) when tracing is on, so annotated hot functions
+show up in the same Chrome-trace timeline as the engine's dispatch/drain/
+checkpoint spans — without a second capture mechanism. When tracing is off
+the extra cost is one attribute read (the no-op fast path).
 """
 
 import functools
 
 import jax
+
+from deepspeed_tpu.telemetry.tracer import get_tracer
 
 
 def instrument(fn=None, *, name: str = None):
@@ -27,7 +35,8 @@ def instrument(fn=None, *, name: str = None):
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
+        with get_tracer().span(label, cat="annotate"), \
+                jax.profiler.TraceAnnotation(label), jax.named_scope(label):
             return fn(*args, **kwargs)
 
     return wrapped
@@ -37,18 +46,47 @@ def instrument(fn=None, *, name: str = None):
 instrument_w_nvtx = instrument
 
 
+class _Annotation:
+    """``annotate``/``range_push`` context: one jax TraceAnnotation + (when
+    tracing is on) one dstrace span, entered and exited together."""
+    __slots__ = ("_name", "_jax_ctx", "_span")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._jax_ctx = None
+        self._span = None
+
+    def __enter__(self):
+        tracer = get_tracer()
+        self._span = tracer.span(self._name, cat="annotate") \
+            if tracer.enabled else None
+        self._jax_ctx = jax.profiler.TraceAnnotation(self._name)
+        self._jax_ctx.__enter__()
+        if self._span is not None:
+            self._span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is not None:
+            self._span.__exit__(exc_type, exc, tb)
+            self._span = None
+        ctx, self._jax_ctx = self._jax_ctx, None
+        return ctx.__exit__(exc_type, exc, tb)
+
+
+def annotate(name: str):
+    """``with annotate("step"): ...`` — host-side profiler span (jax
+    TraceAnnotation + dstrace span when tracing is enabled)."""
+    return _Annotation(name)
+
+
 def range_push(name: str):
     """Manual range begin (reference accelerator.range_push). Returns a context
     object; prefer ``with annotate(name):``."""
-    ctx = jax.profiler.TraceAnnotation(name)
+    ctx = annotate(name)
     ctx.__enter__()
     return ctx
 
 
 def range_pop(ctx) -> None:
     ctx.__exit__(None, None, None)
-
-
-def annotate(name: str):
-    """``with annotate("step"): ...`` — host-side profiler span."""
-    return jax.profiler.TraceAnnotation(name)
