@@ -47,18 +47,18 @@ def main():
               "synchronous baseline) — add e.g. --sync-every 1,8",
               file=sys.stderr)
     sweep_requested = sweep_values != [1] or args.prefetch
-    from bench_util import guard_device_discovery
+    from bench_util import bounded_device_discovery
     # per-preset metric names: a wedged 8b run must NOT replay the banked
     # 697m headline as its own (cross-measurement substitution)
     _preset = os.environ.get("DSTPU_BENCH_MODEL", "697m")
     metric_name = "llama_train_tokens_per_sec_per_chip" if _preset == "697m" \
         else f"llama_{_preset}_train_tokens_per_sec_per_chip"
-    disarm = guard_device_discovery("bench", stale_metric=metric_name)
+    # bounded-init path: deadline + backoff retries + classified rc/diagnosis
+    # (tunnel wedge vs no devices vs auth) — BENCH runs never hang silently
+    bounded_device_discovery("bench", stale_metric=metric_name)
     import jax
     import jax.numpy as jnp
     import numpy as np
-    jax.devices()
-    disarm()
 
     import deepspeed_tpu
     from deepspeed_tpu.accelerator import get_accelerator

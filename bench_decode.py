@@ -299,14 +299,13 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", 1)
-    from bench_util import guard_device_discovery
+    from bench_util import bounded_device_discovery
     # wedged tunnel: replay the banked decode headline (never a train one —
-    # wrong-metric records are rejected by the fallback)
-    disarm = guard_device_discovery(
+    # wrong-metric records are rejected by the fallback); bounded-init path
+    # adds backoff retries + classified rc (wedge vs no devices vs auth)
+    bounded_device_discovery(
         "bench_decode", stale_metric="llama_decode_tokens_per_sec")
     import jax
-    jax.devices()
-    disarm()
     on_tpu = jax.default_backend() == "tpu"
     impl = "kernel" if on_tpu else "gather"
     tps = run(impl, batch, prompt_len, steps)

@@ -1,4 +1,6 @@
-"""Shared bench-script utilities (stdlib only — imported before jax)."""
+"""Shared bench-script utilities (stdlib at import time — safe to import
+before jax; ``bounded_device_discovery`` pulls in ``comm.guard`` lazily,
+inside the call)."""
 
 import datetime
 import glob
@@ -146,3 +148,89 @@ def guard_device_discovery(name: str, timeout: float = 180.0,
 
     threading.Thread(target=_watchdog, daemon=True).start()
     return discovered.set
+
+
+# classified discovery exit codes (distinct from rc 3 "wedged, nothing
+# banked" and rc 7 stale replay): the exit status alone names the failure
+# family, so a BENCH driver log is a diagnosis even when stderr was lost
+DISCOVERY_NO_DEVICES_EXIT_CODE = 4
+DISCOVERY_AUTH_EXIT_CODE = 5
+
+
+def bounded_device_discovery(name, timeout=180.0, retries=2, backoff_s=2.0,
+                             stale_metric=None, devices_fn=None):
+    """TPU device discovery under ``comm.guard.bounded_init`` — the
+    wedge-proof replacement for ``guard_device_discovery``.
+
+    Runs ``jax.devices()`` on a watched thread with a deadline and
+    exponential-backoff retries for TRANSIENT control-plane failures
+    (coordinator not up, connection refused/reset), then exits with a
+    distinct rc and a ONE-LINE stderr diagnosis instead of ever hanging:
+
+      tunnel wedge   no response inside ``timeout`` (or transient retries
+                     exhausted) -> stale-replay path when ``stale_metric``
+                     is banked (rc 7, or rc 0 under DSTPU_STALE_REPLAY_RC0
+                     — unchanged), else rc 3
+      auth           credential/permission failure -> rc 5 (never replayed:
+                     a stale headline must not paper over a revoked token)
+      no devices     backend initialized but found nothing / no backend
+                     -> rc 4
+
+    Returns the device list on success. ``devices_fn`` overrides the
+    discovery callable for tests.
+    """
+    from deepspeed_tpu.comm.guard import (CommInitError, CommOutcome,
+                                          CommWedgeError, bounded_init)
+
+    if devices_fn is None:
+        def devices_fn():
+            import jax
+            return jax.devices()
+
+    def _hard_exit(rc):
+        # after a wedge the discovery worker thread is still stuck inside
+        # native PJRT init; interpreter finalization (atexit handlers, jax
+        # teardown) can re-wedge on the half-initialized backend — the exact
+        # silent BENCH hang this path exists to kill. Flush what the driver
+        # reads, then exit without finalization (the old watchdog's os._exit
+        # guarantee, kept).
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+
+    def _wedge_exit(diagnosis):
+        print(f"{name}: device discovery failed: {diagnosis}",
+              file=sys.stderr)
+        if stale_metric is not None and emit_stale_banked(name, stale_metric):
+            rc0 = os.environ.get("DSTPU_STALE_REPLAY_RC0", "") not in ("", "0")
+            _hard_exit(0 if rc0 else STALE_REPLAY_EXIT_CODE)
+        _hard_exit(3)
+
+    try:
+        devices = bounded_init(devices_fn, name=f"{name}_discovery",
+                               deadline_s=timeout, retries=retries,
+                               backoff_s=backoff_s)
+    except CommWedgeError:
+        _wedge_exit(f"tunnel wedge — no response from PJRT init in "
+                    f"{timeout:.0f}s")
+    except CommInitError as e:
+        text = repr(e.__cause__ if e.__cause__ is not None else e).lower()
+        if any(m in text for m in ("permission", "unauthenticated",
+                                   "forbidden", "credential", "oauth",
+                                   "authentication")):
+            print(f"{name}: device discovery failed: auth — credentials "
+                  f"rejected by the control plane ({e.__cause__!r})",
+                  file=sys.stderr)
+            sys.exit(DISCOVERY_AUTH_EXIT_CODE)
+        if e.outcome is CommOutcome.TRANSIENT:
+            _wedge_exit(f"tunnel wedge — transient control-plane failures "
+                        f"exhausted {e.attempts} attempt(s) "
+                        f"({e.__cause__!r})")
+        print(f"{name}: device discovery failed: no devices — backend "
+              f"init failed ({e.__cause__!r})", file=sys.stderr)
+        sys.exit(DISCOVERY_NO_DEVICES_EXIT_CODE)
+    if not devices:
+        print(f"{name}: device discovery failed: no devices — PJRT "
+              f"returned an empty device list", file=sys.stderr)
+        sys.exit(DISCOVERY_NO_DEVICES_EXIT_CODE)
+    return devices

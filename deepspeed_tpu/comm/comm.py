@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.comm.comms_logging import emit_comm_instant, get_comms_logger
+from deepspeed_tpu.comm.guard import guarded, note_comm_op
 from deepspeed_tpu.telemetry.tracer import get_tracer
 
 
@@ -48,6 +49,9 @@ def _axis_size(axis_name) -> int:
 
 
 def _record(op_name: str, x, axis_name, world: Optional[int] = None):
+    # membership feed: the active heartbeat carries "last-completed comm op"
+    # per worker (one attribute read when no heartbeat is running)
+    note_comm_op(op_name)
     logger_ = get_comms_logger()
     tracer = get_tracer()
     if not (logger_.enabled or tracer.enabled):
@@ -126,5 +130,12 @@ def barrier(axis_name):
 
 def device_broadcast(x, mesh: Mesh):
     """Replicate a host array to every device (reference: _broadcast_model
-    engine.py:1101 — params replicated from rank 0)."""
-    return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+    engine.py:1101 — params replicated from rank 0).
+
+    Eager and host-driven, so it runs under the active ``CommGuard`` when a
+    ``FaultTolerantRunner`` with the ``"comm_guard"`` group is live: a sick
+    device/fabric becomes a ``CommWedgeError`` inside ``op_deadline_s``
+    instead of blocking this thread forever (no guard installed -> plain
+    inline call, one global read of overhead)."""
+    return guarded("device_broadcast",
+                   lambda: jax.device_put(x, NamedSharding(mesh, PartitionSpec())))

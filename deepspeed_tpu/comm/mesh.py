@@ -254,11 +254,22 @@ def _slurm_head_node(nodelist: str) -> str:
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     deadline_s: Optional[float] = None,
+                     retries: Optional[int] = None,
+                     backoff_s: Optional[float] = None) -> None:
     """Multi-host bring-up (reference: comm.init_distributed env:// rendezvous,
     comm/comm.py:619). On TPU pods JAX auto-discovers peers from the TPU metadata;
     explicit args support DCN/CPU clusters; env discovery covers torchrun/MPI/
-    SLURM launches (``discover_cluster_env``). No-op when single-process."""
+    SLURM launches (``discover_cluster_env``). No-op when single-process.
+
+    The rendezvous is WEDGE-PROOF: it runs under ``comm.guard.bounded_init``
+    — a deadline (``deadline_s``, default 300s, env override
+    ``DSTPU_COMM_INIT_DEADLINE_S``, 0 = unbounded) turns a hung coordinator
+    into a ``CommWedgeError`` instead of an infinite hang, and TRANSIENT
+    failures (coordinator not up yet, connection refused/reset) are retried
+    with exponential backoff instead of crashing the worker the platform
+    just relaunched a second before its peers."""
     disc = discover_cluster_env()
     if num_processes is None:
         num_processes = disc.get("num_processes", 1)
@@ -275,5 +286,26 @@ def init_distributed(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+
+    from deepspeed_tpu.comm.guard import (INIT_BACKOFF_ENV, INIT_DEADLINE_ENV,
+                                          INIT_RETRIES_ENV, bounded_init)
+
+    def _env(name, cast, default):
+        try:
+            return cast(os.environ.get(name, default))
+        except ValueError:
+            return cast(default)
+
+    # explicit args win; else the DSTPU_COMM_INIT_* env (exported by the
+    # elastic agent from the "comm_guard" config group) configures the
+    # rendezvous budget for relaunched workers
+    if deadline_s is None:
+        deadline_s = _env(INIT_DEADLINE_ENV, float, 300.0)
+    if retries is None:
+        retries = _env(INIT_RETRIES_ENV, int, 3)
+    if backoff_s is None:
+        backoff_s = _env(INIT_BACKOFF_ENV, float, 1.0)
+    bounded_init(lambda: jax.distributed.initialize(**kwargs),
+                 name="jax_distributed", deadline_s=deadline_s,
+                 retries=retries, backoff_s=backoff_s)
     log_dist(f"jax.distributed initialized: {jax.process_count()} processes", ranks=[0])

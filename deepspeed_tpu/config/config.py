@@ -369,6 +369,13 @@ class DeepSpeedTPUConfig:
         from deepspeed_tpu.resilience.config import ResilienceConfig
         self.resilience = ResilienceConfig(**self._raw.get(C.RESILIENCE, {}))
         self.resilience_explicit: bool = C.RESILIENCE in self._raw
+        # comm fault-tolerance (deadline-bounded collectives/init, heartbeat
+        # membership, straggler detection); consumed by comm/guard.py and
+        # resilience/membership.py — presence of the group enables the guard
+        from deepspeed_tpu.comm.guard import CommGuardConfig
+        _cg = self._raw.get(C.COMM_GUARD, {})
+        self.comm_guard = CommGuardConfig(**{"enabled": C.COMM_GUARD
+                                             in self._raw, **_cg})
 
         self.gradient_clipping: float = float(
             self._raw.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

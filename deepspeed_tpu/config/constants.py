@@ -46,6 +46,8 @@ BF16_LEGACY = "bfloat16"
 # TPU-native keys — no reference analog
 ASYNC_PIPELINE = "async_pipeline"   # latency-hiding step pipeline group
 RESILIENCE = "resilience"           # fault-tolerance group (guards/autosave)
+COMM_GUARD = "comm_guard"           # comm fault-tolerance group (deadlines/
+#                                     heartbeat/membership; comm/guard.py)
 DEBUG_NANS = "debug_nans"           # jax_debug_nans for the compiled step
 
 # Defaults (mirroring reference semantics)

@@ -49,6 +49,11 @@ class WorkerSpec:
     # worker crashed": SIGTERM/SIGINT deaths (negative Popen returncodes) and
     # their 128+N shell-convention forms
     preemption_exit_codes: tuple = (-15, -2, 143, 130)
+    # classified comm-fault exits (comm.guard.COMM_FAULT_EXIT_CODE): the
+    # worker detected a wedged collective / lost peer, autosaved, and exited
+    # deliberately — the fabric's fault, so the relaunch is free like a
+    # preemption, not budgeted like a crash
+    comm_fault_exit_codes: tuple = (75,)
     # relaunches get DSTPU_RESUME=latest so workers resume from the newest
     # committed checkpoint (resilience.resume_from_latest) instead of step 0
     resume_env: bool = True
@@ -86,10 +91,25 @@ class ElasticAgent:
         coordinator = f"{hosts[0]}:{self.spec.coordinator_port}"
         logger.info(f"elastic launch: world={world} batch={final_batch} "
                     f"coordinator={coordinator} (restart #{self.restart_count})")
+        # the "comm_guard" group's init budget rides to every worker as env:
+        # a relaunched worker's rendezvous honors the configured
+        # deadline/retries/backoff (comm.mesh.init_distributed reads these;
+        # operator-set env and spec.env win over the config)
+        from deepspeed_tpu.comm.guard import (INIT_BACKOFF_ENV,
+                                              INIT_DEADLINE_ENV,
+                                              INIT_RETRIES_ENV)
+        from deepspeed_tpu.config.constants import COMM_GUARD
+        cg = self.ds_config.get(COMM_GUARD) or {}
+        init_env = {var: str(cg[key]) for key, var in
+                    (("init_deadline_s", INIT_DEADLINE_ENV),
+                     ("init_retries", INIT_RETRIES_ENV),
+                     ("init_backoff_s", INIT_BACKOFF_ENV)) if key in cg}
         self.procs = []
         for pid, host in enumerate(hosts):
             env = dict(os.environ)
             env.update(self.spec.env)
+            for var, val in init_env.items():
+                env.setdefault(var, val)
             env[ENV_COORDINATOR] = coordinator
             env[ENV_NUM_PROCESSES] = str(world)
             env[ENV_PROCESS_ID] = str(pid)
@@ -120,10 +140,25 @@ class ElasticAgent:
         (SIGTERM/SIGINT or their 128+N forms) — the platform reclaimed
         capacity; nobody's code crashed, so the restart budget is untouched.
         A SIGKILL/OOM/traceback in ANY worker makes the generation a crash."""
+        return self._all_failed_in(self.spec.preemption_exit_codes, status)
+
+    def _is_comm_fault(self, status: Optional[int]) -> bool:
+        """True when every failed worker exited in a free-relaunch class
+        (preemption or classified comm fault) and at least one was a comm
+        fault — relaunch is free. A comm fault in one worker alongside a
+        real crash in another is still a crash generation."""
+        free = tuple(self.spec.preemption_exit_codes) + \
+            tuple(self.spec.comm_fault_exit_codes)
+        bad = [c for c in getattr(self, "_last_codes", [])
+               if c not in (None, 0)]
+        return (self._all_failed_in(free, status)
+                and any(c in self.spec.comm_fault_exit_codes for c in bad))
+
+    def _all_failed_in(self, codes, status: Optional[int]) -> bool:
         bad = [c for c in getattr(self, "_last_codes", [])
                if c not in (None, 0)]
         return (status is not None and status != 0 and bool(bad)
-                and all(c in self.spec.preemption_exit_codes for c in bad))
+                and all(c in codes for c in bad))
 
     def _terminate_all(self):
         """SIGTERM the group, give each worker ``term_grace_s`` to autosave
@@ -174,8 +209,9 @@ class ElasticAgent:
             if status == 0 and not scale_change:
                 logger.info("elastic agent: all workers finished")
                 return 0
+            comm_fault = self._is_comm_fault(status)
             crash = (status is not None and status != 0
-                     and not self._is_preemption(status))
+                     and not self._is_preemption(status) and not comm_fault)
             uptime = time.monotonic() - self._launch_time
             # failure or membership change → restart the group at new scale
             self._terminate_all()
@@ -202,7 +238,9 @@ class ElasticAgent:
                     time.sleep(backoff)
             else:
                 self.consecutive_crashes = 0
-                why = "scale change" if scale_change else f"preemption (exit {status})"
+                why = ("scale change" if scale_change else
+                       f"comm fault (exit {status})" if comm_fault else
+                       f"preemption (exit {status})")
                 logger.info(f"elastic agent: {why}; relaunching immediately "
                             "(budget untouched)")
             hosts = current_hosts

@@ -42,14 +42,15 @@ def main(argv=None):
     import sys
     sys.path.insert(0, os.getcwd())
     try:
-        from bench_util import guard_device_discovery
-        disarm = guard_device_discovery("dstpu_pipe_bench")
+        from bench_util import bounded_device_discovery
+        # bounded-init path: deadline + backoff retries + classified rc and
+        # one-line diagnosis (tunnel wedge vs no devices vs auth)
+        bounded_device_discovery("dstpu_pipe_bench")
     except ImportError:       # installed outside the repo root
-        disarm = lambda: None  # noqa: E731
+        pass
 
     import jax
     jax.devices()
-    disarm()
     import jax.numpy as jnp
     import numpy as np
 

@@ -16,6 +16,9 @@ from deepspeed_tpu.resilience.config import (AutosaveConfig, ResilienceConfig,
                                              StepGuardConfig, WatchdogConfig)
 from deepspeed_tpu.resilience.guards import (BadStepError, QuarantineError,
                                              StepGuard)
+from deepspeed_tpu.resilience.membership import (Heartbeat, MembershipView,
+                                                 PeerHealth, StragglerDetector,
+                                                 default_membership_dir)
 from deepspeed_tpu.resilience.runner import FaultTolerantRunner, RunResult
 from deepspeed_tpu.resilience.watchdog import StepWatchdog, WatchdogEvent
 
@@ -28,14 +31,19 @@ __all__ = [
     "ChaosMonkey",
     "CheckpointSaveError",
     "FaultTolerantRunner",
+    "Heartbeat",
+    "MembershipView",
+    "PeerHealth",
     "QuarantineError",
     "ResilienceConfig",
     "RunResult",
     "StepGuard",
     "StepGuardConfig",
     "StepWatchdog",
+    "StragglerDetector",
     "WatchdogConfig",
     "WatchdogEvent",
+    "default_membership_dir",
     "find_latest_committed",
     "list_tags",
     "monkey_from_env",

@@ -12,6 +12,10 @@ Faults covered (the failure modes the resilience subsystem exists for):
   - ``slow``  : stall a step past the watchdog deadline
   - ``die``   : SIGKILL this worker at a step boundary (exercises the
                 elastic agent's restart + resume-latest path)
+  - ``comm``  : delay or wedge a guarded collective (``comm/guard.py``
+                deadline + CommWedgeError + coordinated-abort path), or
+                silence a rank's heartbeat (``peer_dead`` — membership
+                marks it lost)
 
 Knobs come from an explicit ``ChaosConfig`` or from the environment
 (``ChaosConfig.from_env``), so a launcher can chaos-test an unmodified
@@ -59,13 +63,29 @@ class ChaosConfig:
     # exercised once instead of crash-looping until the restart budget dies
     die_step: int = -1
     die_once: bool = True
+    # comm faults (consumed by comm/guard.py CommGuard + membership
+    # Heartbeat). Call indices count GUARDED ops per CommGuard instance;
+    # op patterns are exact names, "" / "*" match any op.
+    comm_wedge_op: str = ""
+    comm_wedge_call: int = -1         # guarded-call index that wedges
+    comm_wedge_once: bool = True      # relaunched worker (DSTPU_RESUME) spared
+    comm_delay_op: str = ""
+    comm_delay_calls: FrozenSet[int] = frozenset()
+    comm_delay_prob: float = 0.0
+    comm_delay_s: float = 0.0
+    # ranks whose heartbeat is silenced (membership marks them lost)
+    peer_dead_ranks: FrozenSet[int] = frozenset()
 
     @property
     def active(self) -> bool:
         return bool(self.nan_steps or self.nan_every or self.nan_prob
                     or self.ckpt_fail_first or self.ckpt_fail_prob
                     or self.slow_steps or self.slow_prob
-                    or self.die_step >= 0)
+                    or self.die_step >= 0
+                    or self.comm_wedge_call >= 0
+                    or (self.comm_delay_s > 0
+                        and (self.comm_delay_calls or self.comm_delay_prob))
+                    or self.peer_dead_ranks)
 
     @classmethod
     def from_env(cls, env=os.environ) -> "ChaosConfig":
@@ -82,6 +102,15 @@ class ChaosConfig:
             slow_s=float(g("DSTPU_CHAOS_SLOW_S", "0")),
             die_step=int(g("DSTPU_CHAOS_DIE_STEP", "-1")),
             die_once=g("DSTPU_CHAOS_DIE_ONCE", "1") not in ("0", "false"),
+            comm_wedge_op=g("DSTPU_CHAOS_COMM_WEDGE_OP", ""),
+            comm_wedge_call=int(g("DSTPU_CHAOS_COMM_WEDGE_CALL", "-1")),
+            comm_wedge_once=g("DSTPU_CHAOS_COMM_WEDGE_ONCE", "1")
+            not in ("0", "false"),
+            comm_delay_op=g("DSTPU_CHAOS_COMM_DELAY_OP", ""),
+            comm_delay_calls=_parse_steps(g("DSTPU_CHAOS_COMM_DELAY_CALLS", "")),
+            comm_delay_prob=float(g("DSTPU_CHAOS_COMM_DELAY_PROB", "0")),
+            comm_delay_s=float(g("DSTPU_CHAOS_COMM_DELAY_S", "0")),
+            peer_dead_ranks=_parse_steps(g("DSTPU_CHAOS_PEER_DEAD_RANKS", "")),
         )
 
 
@@ -96,7 +125,8 @@ class ChaosMonkey:
 
     def __init__(self, config: Optional[ChaosConfig] = None):
         self.config = config if config is not None else ChaosConfig.from_env()
-        self.injected = {"nan": 0, "ckpt": 0, "slow": 0}
+        self.injected = {"nan": 0, "ckpt": 0, "slow": 0,
+                         "comm_wedge": 0, "comm_delay": 0}
 
     # ------------------------------------------------------------------
     def _roll(self, kind: str, step: int, salt: int = 0) -> float:
@@ -176,6 +206,48 @@ class ChaosMonkey:
                                   step=step)
             return c.slow_s
         return 0.0
+
+    # ------------------------------------------------------------------
+    # comm faults (CommGuard asks per guarded call; Heartbeat per publish)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _op_match(pattern: str, op: str) -> bool:
+        return pattern in ("", "*") or pattern == op
+
+    def comm_fault(self, op: str, call_index: int) -> Optional[str]:
+        """``"wedge"`` / ``"delay"`` / None for one guarded comm op.
+        Wedge wins over delay (it is the fault being drilled); a
+        relaunched worker (DSTPU_RESUME set) is spared the wedge under
+        ``comm_wedge_once`` so the abort→restart→resume loop completes."""
+        c = self.config
+        if (c.comm_wedge_call >= 0 and call_index == c.comm_wedge_call
+                and self._op_match(c.comm_wedge_op, op)
+                and not (c.comm_wedge_once and os.environ.get("DSTPU_RESUME"))):
+            self.injected["comm_wedge"] += 1
+            get_tracer().instant("chaos/comm_wedge", cat="resilience", op=op,
+                                 call=call_index)
+            logger.warning(f"chaos: wedging guarded comm op '{op}' "
+                           f"(call #{call_index})")
+            return "wedge"
+        if c.comm_delay_s > 0 and self._op_match(c.comm_delay_op, op):
+            due = call_index in c.comm_delay_calls or (
+                c.comm_delay_prob > 0
+                and self._roll("comm_delay", call_index) < c.comm_delay_prob)
+            if due:
+                self.injected["comm_delay"] += 1
+                get_tracer().instant("chaos/comm_delay", cat="resilience",
+                                     op=op, call=call_index,
+                                     delay_s=c.comm_delay_s)
+                logger.warning(f"chaos: delaying guarded comm op '{op}' "
+                               f"{c.comm_delay_s:.2f}s (call #{call_index})")
+                return "delay"
+        return None
+
+    def peer_dead(self, rank: int) -> bool:
+        """True when this rank's heartbeat is chaos-silenced (the
+        membership view will see its file go stale — a simulated dead
+        peer with no unpublish protocol to cheat through)."""
+        return rank in self.config.peer_dead_ranks
 
     # ------------------------------------------------------------------
     # worker death

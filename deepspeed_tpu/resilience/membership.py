@@ -1,0 +1,292 @@
+"""Distributed health: per-worker heartbeats, a membership view, and
+rank-relative straggler detection.
+
+The comm guard (``comm/guard.py``) bounds individual host-driven ops; this
+module answers the cluster-level question a bounded op cannot — *which
+worker is the problem?* Each worker runs a ``Heartbeat`` thread publishing
+liveness + its last-completed comm op into a shared directory (one JSON
+file per rank, written atomically); any process — the serve loop, the
+elastic agent, an oncall shell — reads the same files through
+``MembershipView`` and classifies peers alive / lost by heartbeat age.
+
+A filesystem store is deliberate: it needs no extra rendezvous (the thing
+that is wedged when you need membership most), works identically for the
+single-host MULTICHIP harness, gcsfuse-mounted pods, and CPU tests, and a
+dead worker's file going stale is exactly the failure signal — no
+unpublish protocol to get wrong. Heartbeat age is measured from the rank
+file's **mtime** (the store's own clock, assigned by the filesystem on
+every atomic replace), never from the writer's embedded wall-clock — N
+workers' clock skew cannot fake a dead peer or hide one.
+
+Straggler detection is separate from liveness: a slow peer still
+heartbeats. ``StragglerDetector`` consumes per-op per-rank durations
+(from dstrace comm spans, or synthetic timings in tests) and flags
+rank-relative outliers with a ``comm/straggler`` instant + counter.
+"""
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.comm.guard import (clear_comm_op_listener,
+                                      set_comm_op_listener)
+from deepspeed_tpu.telemetry.tracer import get_tracer
+from deepspeed_tpu.utils.logging import logger
+
+MEMBERSHIP_DIR_ENV = "DSTPU_MEMBERSHIP_DIR"
+_RANK_FILE = "rank_{rank}.json"
+
+
+def default_membership_dir() -> str:
+    return os.environ.get(MEMBERSHIP_DIR_ENV,
+                          os.path.join(os.getcwd(), "membership"))
+
+
+class Heartbeat:
+    """Per-worker liveness publisher.
+
+    A daemon thread writes ``rank_<N>.json`` every ``interval_s`` with the
+    wall-clock timestamp, beat counter, and the last comm op this worker
+    completed (fed lock-free-for-the-producer via ``note_op``, which the
+    collective facade calls through ``comm.guard.note_comm_op``).
+
+    Chaos: a duck-typed monkey with ``peer_dead(rank) -> bool`` silences
+    this rank's publisher — the membership view then sees the file go
+    stale, exactly like a real dead worker.
+    """
+
+    def __init__(self, rank: int, directory: Optional[str] = None,
+                 interval_s: float = 1.0, chaos=None,
+                 listen_comm_ops: bool = True):
+        self.rank = int(rank)
+        self.directory = directory or default_membership_dir()
+        self.interval_s = float(interval_s)
+        self.chaos = chaos
+        self._listen = listen_comm_ops
+        self._lock = threading.Lock()     # guards _last_op/_op_seq across
+        self._last_op: Optional[str] = None   # producer vs publisher thread
+        self._op_seq = 0
+        self._beats = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- producer side (registered DS002 hot path: no host sync) ---------
+    def note_op(self, op_name: str) -> None:
+        with self._lock:
+            self._last_op = op_name
+            self._op_seq += 1
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Heartbeat":
+        if self._thread is None:
+            os.makedirs(self.directory, exist_ok=True)
+            if self._listen:
+                set_comm_op_listener(self.note_op)
+            self.publish_now()            # visible before the first interval
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name=f"dstpu-heartbeat-r{self.rank}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._listen:
+            # conditional clear: when heartbeat lifetimes overlap (rolling
+            # runner replacement, training + serving in one process) a
+            # stopped heartbeat must never sever the NEWER one's feed
+            clear_comm_op_listener(self.note_op)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- publisher side --------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.interval_s):
+            try:
+                self.publish_now()
+            except OSError:
+                # the membership dir being briefly unwritable must not kill
+                # the worker; a missed beat is the degraded signal itself
+                logger.exception("heartbeat: publish failed")
+
+    def publish_now(self) -> None:
+        """One atomic publish (tmp + rename so readers never see a torn
+        JSON). Silenced when chaos declares this rank dead."""
+        if self.chaos is not None and self.chaos.peer_dead(self.rank):
+            return
+        with self._lock:
+            last_op, op_seq = self._last_op, self._op_seq
+        self._beats += 1
+        rec = {"rank": self.rank, "pid": os.getpid(), "ts": time.time(),
+               "beat": self._beats, "last_op": last_op, "op_seq": op_seq,
+               "interval_s": self.interval_s}
+        path = os.path.join(self.directory, _RANK_FILE.format(rank=self.rank))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+
+@dataclass(frozen=True)
+class PeerHealth:
+    rank: int
+    alive: bool
+    age_s: float
+    beat: int
+    last_op: Optional[str]
+    op_seq: int
+    pid: int
+
+
+class MembershipView:
+    """Read-side of the membership store: classify every published rank
+    alive / lost by heartbeat age. Stateless per call — each ``snapshot``
+    re-reads the rank files, so any process can hold a view."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 lost_after_s: float = 10.0,
+                 expected_ranks: Optional[Sequence[int]] = None):
+        self.directory = directory or default_membership_dir()
+        self.lost_after_s = float(lost_after_s)
+        self.expected_ranks = tuple(expected_ranks) if expected_ranks else None
+        # expected-but-never-published ranks get the same staleness budget
+        # from view creation before counting as lost — without this grace a
+        # fast worker would declare its still-booting peers dead at startup
+        self._created = time.monotonic()
+        self._next_poll = 0.0
+
+    def snapshot(self) -> Dict[int, PeerHealth]:
+        out: Dict[int, PeerHealth] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        now = time.time()
+        for name in names:
+            if not (name.startswith("rank_") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                # age by the file's mtime — the store's single clock (set by
+                # the filesystem at every atomic replace), immune to writer
+                # wall-clock skew; the record's own ts is informational
+                ts = os.stat(path).st_mtime
+            except (OSError, ValueError):
+                continue              # mid-replace race or junk file
+            age = max(0.0, now - ts)
+            rank = int(rec.get("rank", -1))
+            out[rank] = PeerHealth(
+                rank=rank, alive=age <= self.lost_after_s, age_s=age,
+                beat=int(rec.get("beat", 0)), last_op=rec.get("last_op"),
+                op_seq=int(rec.get("op_seq", 0)),
+                pid=int(rec.get("pid", 0)))
+        return out
+
+    def _lost(self, snap: Dict[int, PeerHealth]) -> List[int]:
+        lost = [r for r, h in snap.items() if not h.alive]
+        if self.expected_ranks is not None and \
+                time.monotonic() - self._created > self.lost_after_s:
+            lost.extend(r for r in self.expected_ranks if r not in snap)
+        return sorted(set(lost))
+
+    def lost_peers(self) -> List[int]:
+        """Ranks that published once and then went silent past
+        ``lost_after_s`` — plus expected ranks that never published at
+        all, when an expected set was given."""
+        return self._lost(self.snapshot())
+
+    def poll_lost(self) -> Optional[List[int]]:
+        """Throttled ``lost_peers`` — THE form for hot callers (the
+        runner's step boundary, the serve tick): at most one directory
+        scan per half the ``lost_after_s`` window (floor 0.5 s), so the
+        view owns its own cadence instead of every caller re-deriving it.
+        Returns ``None`` between polls, the lost list when one ran."""
+        now = time.monotonic()
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + max(self.lost_after_s / 2.0, 0.5)
+        return self.lost_peers()
+
+    def healthy(self) -> bool:
+        return not self.lost_peers()
+
+    def summary(self) -> dict:
+        """The ``/healthz`` payload fragment: per-rank age/last-op plus the
+        lost list (derived from ONE directory scan — this runs per health
+        request, possibly against a remote-mounted store)."""
+        snap = self.snapshot()
+        return {
+            "ranks": {str(r): {"alive": h.alive, "age_s": round(h.age_s, 3),
+                               "beat": h.beat, "last_op": h.last_op,
+                               "op_seq": h.op_seq}
+                      for r, h in sorted(snap.items())},
+            "lost": self._lost(snap),
+        }
+
+
+class StragglerDetector:
+    """Rank-relative comm-duration outliers.
+
+    Feed one op's per-rank durations (``observe``) or a batch of dstrace
+    comm span events carrying a ``rank`` arg (``ingest_spans``); a rank
+    whose duration exceeds ``median * factor`` (and the excess exceeds
+    ``min_s``, filtering clock noise on fast ops) emits a
+    ``comm/straggler`` instant and bumps ``count`` — the deterministic
+    proof counter the tier-1 drill asserts on.
+    """
+
+    def __init__(self, factor: float = 3.0, min_s: float = 0.0):
+        if factor <= 1.0:
+            raise ValueError("straggler factor must be > 1.0")
+        self.factor = float(factor)
+        self.min_s = float(min_s)
+        self.count = 0
+        self.flagged: List[Tuple[str, int, float, float]] = []
+
+    def observe(self, op: str, durations_by_rank: Dict[int, float]
+                ) -> List[int]:
+        """Returns the outlier ranks for this op (possibly empty)."""
+        if len(durations_by_rank) < 2:
+            return []
+        durs = sorted(durations_by_rank.values())
+        median = durs[len(durs) // 2]
+        if median <= 0:
+            return []
+        outliers = []
+        tracer = get_tracer()
+        for rank, d in sorted(durations_by_rank.items()):
+            if d > median * self.factor and (d - median) > self.min_s:
+                outliers.append(rank)
+                self.count += 1
+                self.flagged.append((op, rank, d, median))
+                tracer.instant("comm/straggler", cat="comm", op=op,
+                               rank=rank, duration_s=round(d, 6),
+                               median_s=round(median, 6))
+        return outliers
+
+    def ingest_spans(self, events) -> List[int]:
+        """Consume dstrace event tuples (the ``Tracer.events_snapshot``
+        layout): complete ``comm/*`` spans whose args carry ``rank`` are
+        grouped per op name and judged together."""
+        by_op: Dict[str, Dict[int, float]] = {}
+        for eid, name, cat, ph, ts, dur, tid, args in events:
+            if ph != "X" or not name.startswith("comm/") or not args:
+                continue
+            if "rank" not in args:
+                continue
+            by_op.setdefault(name, {})[int(args["rank"])] = float(dur)
+        flagged: List[int] = []
+        for op, durs in sorted(by_op.items()):
+            flagged.extend(self.observe(op, durs))
+        return flagged

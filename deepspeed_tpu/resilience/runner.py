@@ -17,8 +17,11 @@ Typical worker::
                                            # maybe_resume() to resume only on
                                            # agent relaunches: DSTPU_RESUME)
     result = runner.run(num_steps=N, batch_fn=lambda step: next_batch(step))
-    if result.preempted:                   # agent will relaunch with
-        sys.exit(0)                        # DSTPU_RESUME=latest
+    sys.exit(result.exit_code)             # classified status: 0 completed,
+                                           # 128+sig preempted, 75 comm fault
+                                           # — the elastic agent relaunches
+                                           # non-zero exits with
+                                           # DSTPU_RESUME=latest, for free
 
 Chaos testing: pass a ``ChaosMonkey`` (or set DSTPU_CHAOS_* env knobs) and
 the runner injects NaN batches, checkpoint I/O failures, stalls, and worker
@@ -38,7 +41,13 @@ from typing import Any, Dict, Iterator, Optional
 
 import jax
 
+from deepspeed_tpu.comm.guard import (COMM_FAULT_EXIT_CODE, CommFaultError,
+                                      CommGuard, CommPeerLostError,
+                                      clear_active_guard, set_active_guard)
 from deepspeed_tpu.resilience import checkpointing as ckpt
+from deepspeed_tpu.resilience.membership import (Heartbeat, MembershipView,
+                                                 StragglerDetector,
+                                                 default_membership_dir)
 from deepspeed_tpu.resilience.chaos import ChaosMonkey, monkey_from_env
 from deepspeed_tpu.resilience.config import (ResilienceConfig,
                                              resolve_resilience_config)
@@ -54,13 +63,33 @@ _CLIENT_STATE_KEY = "_resilience"
 @dataclass
 class RunResult:
     steps_completed: int = 0
-    stop_reason: str = "completed"    # completed | preempted | watchdog
+    # completed | preempted | watchdog | comm_fault
+    stop_reason: str = "completed"
     last_loss: Optional[float] = None
     saved_tags: list = field(default_factory=list)
+    # the signal that caused a "preempted" stop (SIGTERM/SIGINT), when known
+    preempt_signal: Optional[int] = None
 
     @property
     def preempted(self) -> bool:
-        return self.stop_reason in ("preempted", "watchdog")
+        """True when the agent should relaunch this worker with resume —
+        the platform's fault (preemption/hang/comm wedge), not the code's."""
+        return self.stop_reason in ("preempted", "watchdog", "comm_fault")
+
+    @property
+    def exit_code(self) -> int:
+        """The classified exit status a worker should use (the module
+        docstring's ``sys.exit(result.exit_code)`` idiom): comm faults get
+        ``COMM_FAULT_EXIT_CODE`` (75) and preemption/watchdog stops the
+        128+signal shell convention (default 143 = SIGTERM) — both land in
+        the elastic agent's free-relaunch classes
+        (``comm_fault_exit_codes`` / ``preemption_exit_codes``) so restart
+        accounting treats them like preemptions, not budgeted crashes."""
+        if self.stop_reason == "comm_fault":
+            return COMM_FAULT_EXIT_CODE
+        if self.stop_reason in ("preempted", "watchdog"):
+            return 128 + (self.preempt_signal or signal.SIGTERM)
+        return 0
 
 
 class FaultTolerantRunner:
@@ -87,10 +116,40 @@ class FaultTolerantRunner:
                 on_flag=self._on_watchdog_flag,
                 context_fn=self._watchdog_context).start()
 
+        # comm fault-tolerance (the "comm_guard" config group): a CommGuard
+        # for the engine's eager collectives, a heartbeat publishing this
+        # worker's liveness + last comm op, and a membership view the step
+        # boundary polls — a lost peer becomes CommPeerLostError BEFORE the
+        # next collective wedges on it
+        self.comm_guard: Optional[CommGuard] = None
+        self.heartbeat: Optional[Heartbeat] = None
+        self.membership: Optional[MembershipView] = None
+        self.straggler: Optional[StragglerDetector] = None
+        self._straggler_eid = 0        # last dstrace event id already judged
+        gc = getattr(getattr(engine, "config", None), "comm_guard", None)
+        if gc is not None and gc.enabled:
+            self.comm_guard = CommGuard(gc, chaos=self.chaos)
+            # the facade's eager host-driven ops (device_broadcast, ...)
+            # route through the active guard with no caller change — the
+            # chaos comm drill works against an unmodified training script
+            set_active_guard(self.comm_guard)
+            self.straggler = StragglerDetector(gc.straggler_factor,
+                                               gc.straggler_min_s)
+            mdir = gc.membership_dir or default_membership_dir()
+            rank = jax.process_index()
+            self.heartbeat = Heartbeat(
+                rank, mdir, interval_s=gc.heartbeat_interval_s,
+                chaos=self.chaos).start()
+            expected = range(jax.process_count()) \
+                if jax.process_count() > 1 else None
+            self.membership = MembershipView(
+                mdir, lost_after_s=gc.lost_after_s, expected_ranks=expected)
+
         self.history = collections.deque(maxlen=self.cfg.history_steps)
         self._last_host: Dict[str, Any] = {}
         self._dispatch_durations: Dict[int, float] = {}
         self.saved_tags: list = []
+        self._comm_fault: Optional[CommFaultError] = None
         self._preempt_signal: Optional[int] = None
         self._preemption_saved = False
         self._closed = False
@@ -142,6 +201,10 @@ class FaultTolerantRunner:
         except Exception:
             logger.exception("resilience: final metric drain failed")
         self.guard.detach()            # engine regains default NaN semantics
+        if self.comm_guard is not None:
+            clear_active_guard(self.comm_guard)
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
         for sig, old in self._old_handlers.items():
@@ -245,6 +308,7 @@ class FaultTolerantRunner:
         bad updates at the step they happen."""
         if self._closed:
             raise RuntimeError("runner is closed")
+        self._check_peers()
         engine = self.engine
         step_idx = engine.global_steps
         batch, stacked, feed_iter = self._prepare_batch(batch, data_iter,
@@ -282,6 +346,40 @@ class FaultTolerantRunner:
             self._observe_guarded(host["loss"], host)
         self._maybe_save(engine.global_steps)
         return loss
+
+    def _check_peers(self):
+        """Step-boundary membership poll (the view throttles itself to half
+        the lost_after window so the file reads stay off the hot cadence):
+        a stale peer heartbeat raises ``CommPeerLostError`` HERE, on the
+        host, instead of letting the next collective wedge on the dead rank
+        forever."""
+        if self.membership is None:
+            return
+        lost = self.membership.poll_lost()
+        if lost is None:               # throttled — no scan this step
+            return
+        self._judge_stragglers()
+        if lost:
+            raise CommPeerLostError(
+                f"peer rank(s) {lost} lost (heartbeat stale past "
+                f"{self.membership.lost_after_s:.1f}s)", ranks=lost)
+
+    def _judge_stragglers(self):
+        """Feed fresh rank-tagged dstrace comm spans (e.g. the MULTICHIP
+        harness's merged per-rank timings) to the config-tuned straggler
+        detector (``straggler_factor`` / ``straggler_min_s``). Each event id
+        is judged exactly once — overlapping tail windows never double-count
+        an outlier."""
+        if self.straggler is None:
+            return
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        fresh = [e for e in tracer.tail(self.comm_guard.cfg.trace_tail_s)
+                 if e[0] > self._straggler_eid]
+        if fresh:
+            self._straggler_eid = max(e[0] for e in fresh)
+            self.straggler.ingest_spans(fresh)
 
     def _observe_guarded(self, loss, host: Dict[str, Any]):
         """guard.observe with the runner's bundle-on-raise contract."""
@@ -410,6 +508,26 @@ class FaultTolerantRunner:
                 self._maybe_save(self.engine.global_steps)
                 result.stop_reason = self._stop_reason()
                 break
+            except CommFaultError as e:
+                # coordinated recovery (the comm guard detected a wedge or
+                # peer loss): the communicator is suspect but this host is
+                # healthy, so drain the async ring WITHOUT letting a guard
+                # verdict mask the primary fault, bundle the evidence,
+                # commit an autosave, and stop with a classified reason —
+                # the worker exits COMM_FAULT_EXIT_CODE and the elastic
+                # agent relaunches it for free (preemption-style accounting)
+                self._comm_fault = e
+                logger.error(f"resilience: comm fault at step "
+                             f"{self.engine.global_steps}: {e}")
+                get_tracer().instant("resilience/comm_fault",
+                                     cat="resilience",
+                                     step=self.engine.global_steps,
+                                     op=e.op, outcome=e.outcome.value)
+                self.write_diagnostic_bundle("comm_fault", error=e)
+                self.flush(raise_guard=False)
+                self.save(reason="comm_fault")
+                result.stop_reason = "comm_fault"
+                break
             result.steps_completed += 1
             if "loss" in self._last_host:
                 result.last_loss = float(self._last_host["loss"])
@@ -425,6 +543,7 @@ class FaultTolerantRunner:
             self.save(reason="preemption")
         if "loss" in self._last_host:
             result.last_loss = float(self._last_host["loss"])
+        result.preempt_signal = self._preempt_signal
         result.saved_tags = list(self.saved_tags)
         return result
 
@@ -492,6 +611,15 @@ class FaultTolerantRunner:
             "chaos_injected": dict(self.chaos.injected)
             if self.chaos is not None else None,
         }
+        if isinstance(error, CommFaultError):
+            # the comm-span tail rides in diag.json too (not only in the
+            # Perfetto trace slice): a wedge diagnosis must survive even
+            # when tracing was off and trace_tail.json is absent
+            diag["comm_fault"] = {
+                "op": error.op, "outcome": error.outcome.value,
+                "elapsed_s": round(error.elapsed_s, 3),
+                "comm_tail": getattr(error, "comm_tail", []),
+            }
         with open(os.path.join(d, "diag.json"), "w") as f:
             json.dump(diag, f, indent=2, default=str)
         with open(os.path.join(d, "stacks.txt"), "w") as f:

@@ -69,9 +69,13 @@ class InferenceServer:
     recreate the engine, start a fresh server)."""
 
     def __init__(self, engine, config: Optional[ServingConfig] = None,
-                 monitor=None):
+                 monitor=None, membership=None):
         self.engine = engine
         self.config = config or ServingConfig()
+        # optional resilience.membership.MembershipView: a wedged/lost peer
+        # flips this replica to degraded (503) BEFORE the serve tick walks
+        # into a collective that would hang it forever
+        self.membership = membership
         if not 0.0 < self.config.kv_high_watermark <= 1.0:
             # the watermark IS the no-mid-decode-exhaustion invariant: the
             # sum of accepted requests' worst-case blocks never exceeds
@@ -172,6 +176,8 @@ class InferenceServer:
                "kv_occupancy": self.engine.kv_occupancy()}
         if degraded:
             out["degraded_reason"] = degraded
+        if self.membership is not None:
+            out["membership"] = self.membership.summary()
         return out
 
     # ------------------------------------------------------------------
@@ -291,6 +297,9 @@ class InferenceServer:
                 self._wake.clear()
 
     def _serve_once(self) -> bool:
+        if self.membership is not None and self._degraded is None:
+            if not self._check_membership():
+                return False
         self._expire_and_cancel()
         self._admit_from_queue()
         worked = False
@@ -315,6 +324,30 @@ class InferenceServer:
             except Exception:
                 logger.exception("serve loop: monitor export failed")
         return worked
+
+    def _check_membership(self) -> bool:
+        """Poll the membership view — the view throttles its own directory
+        scans (``poll_lost``: half the lost_after window, same cadence the
+        training runner uses), so this is cheap to call every serve tick.
+        A lost peer means the next engine collective would wedge the tick
+        forever: flip to sticky degraded (503) and fail in-flight requests
+        NOW, while this thread can still run."""
+        try:
+            lost = self.membership.poll_lost()
+        except Exception:
+            logger.exception("serve loop: membership check failed")
+            return True
+        if not lost:                   # healthy, or throttled (None)
+            return True
+        reason = f"comm peer(s) lost: {lost}"
+        logger.error(f"serve loop: {reason}; degrading replica instead of "
+                     "stepping into a wedged collective")
+        get_tracer().instant("serve/degraded", cat="serve",
+                             reason="peer_lost", ranks=str(lost))
+        with self._lock:
+            self._degraded = reason
+        self._fail_all(reason)
+        return False
 
     def _admit_from_queue(self):
         """FIFO admission while the engine currently has room for the

@@ -122,4 +122,19 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         cls="_Span",
         hot_functions=("__enter__", "__exit__"),
     ),
+    # the comm-op listener runs inside the collective facade's _record —
+    # trace time for jit collectives, per call when eager. Registering it
+    # (and the heartbeat producer it fans into) PROVES the comm guard's
+    # membership feed adds no host sync to the per-step path: emission is
+    # one attribute read + one locked int/str store, never a device touch
+    HotPathSpec(
+        path="deepspeed_tpu/comm/guard.py",
+        cls=None,
+        hot_functions=("note_comm_op",),
+    ),
+    HotPathSpec(
+        path="deepspeed_tpu/resilience/membership.py",
+        cls="Heartbeat",
+        hot_functions=("note_op",),
+    ),
 )
