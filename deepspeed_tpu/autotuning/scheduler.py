@@ -47,6 +47,15 @@ class ExperimentRunner:
     ``batch_fn(global_batch_size) -> batch`` supplies data shaped for the candidate's
     batch size. Metrics recorded: ``latency`` (s/step) and ``throughput``
     (samples/s).
+
+    ``trace_counters=True`` (the plan-verification mode — see
+    ``Autotuner(plan=...)``) additionally runs the measured segment under
+    the dstrace tracer and records deterministic span counts:
+    ``trace_dispatch_spans`` (steps actually dispatched),
+    ``trace_drain_spans`` (readback transfers — the async ring's
+    designated ``device_get``s, including the closing flush), and
+    ``trace_h2d_spans``. These are the counters profile-guided proposals
+    are verified against on hosts where wall-clock A/B is noise.
     """
 
     METRICS = ("latency", "throughput")
@@ -54,7 +63,8 @@ class ExperimentRunner:
     def __init__(self, model, batch_fn: Callable[[int], Any],
                  base_config: Dict[str, Any], mesh=None,
                  loss_fn: Optional[Callable] = None,
-                 warmup_steps: int = 1, measure_steps: int = 3):
+                 warmup_steps: int = 1, measure_steps: int = 3,
+                 trace_counters: bool = False):
         self.model = model
         self.batch_fn = batch_fn
         self.base_config = base_config
@@ -62,6 +72,7 @@ class ExperimentRunner:
         self.loss_fn = loss_fn
         self.warmup_steps = warmup_steps
         self.measure_steps = measure_steps
+        self.trace_counters = trace_counters
 
     def __call__(self, exp: Experiment) -> Experiment:
         import deepspeed_tpu  # late import: avoid cycle at package init
@@ -70,6 +81,13 @@ class ExperimentRunner:
         cfg = merge_config(self.base_config, exp.overrides)
         # autotuner owns the batch triple: derive train_batch from mbs x gas x dp
         cfg.pop("train_batch_size", None)
+        tracer = None
+        tracer_was_enabled = False
+        if self.trace_counters:
+            from deepspeed_tpu.telemetry import get_tracer
+            tracer = get_tracer()
+            tracer_was_enabled = tracer.enabled
+            tracer.configure(enabled=True)
         try:
             engine, _, _, _ = deepspeed_tpu.initialize(
                 model=self.model, config=cfg, mesh=self.mesh,
@@ -78,7 +96,10 @@ class ExperimentRunner:
             batch = self.batch_fn(engine.train_batch_size)
             for _ in range(self.warmup_steps):
                 engine.train_batch(batch=batch)
+            if hasattr(engine, "flush_metrics"):
+                engine.flush_metrics()   # ring empty: exact drain counting
             jax.block_until_ready(engine.state.params)
+            mark = _last_event_id(tracer)
             t0 = time.perf_counter()
             for _ in range(self.measure_steps):
                 loss = engine.train_batch(batch=batch)
@@ -89,6 +110,12 @@ class ExperimentRunner:
                 "throughput": engine.train_batch_size / dt,
                 "train_batch_size": float(engine.train_batch_size),
             }
+            if tracer is not None:
+                # the closing flush is the measured segment's final
+                # readback transfer — count it, don't time it
+                if hasattr(engine, "flush_metrics"):
+                    engine.flush_metrics()
+                exp.metrics.update(_span_counts(tracer, mark))
             exp.status = "done"
         except Exception as e:  # noqa: BLE001 — any candidate may legally fail
             msg = str(e)
@@ -98,7 +125,36 @@ class ExperimentRunner:
             exp.status = "oom" if oom else "failed"
             logger.warning(f"autotuning experiment {exp.name} {exp.status}: "
                            f"{msg.splitlines()[0] if msg else e!r}")
+        finally:
+            if tracer is not None and not tracer_was_enabled:
+                tracer.configure(enabled=False)
         return exp
+
+
+def _last_event_id(tracer) -> int:
+    """High-water event id of the tracer ring (0 when disabled/empty) —
+    the measured-segment boundary for ``_span_counts``."""
+    if tracer is None:
+        return 0
+    from deepspeed_tpu.telemetry.tracer import _EID
+    snap = tracer.events_snapshot()
+    return max((e[_EID] for e in snap), default=0)
+
+
+def _span_counts(tracer, mark: int) -> Dict[str, float]:
+    """Deterministic span counters over events emitted after ``mark``."""
+    from deepspeed_tpu.telemetry.tracer import _EID, _NAME, _PH
+    counts = {"engine/dispatch": 0, "engine/train_step": 0,
+              "engine/drain": 0, "comm/h2d": 0}
+    for e in tracer.events_snapshot():
+        if e[_EID] > mark and e[_PH] == "X" and e[_NAME] in counts:
+            counts[e[_NAME]] += 1
+    return {
+        "trace_dispatch_spans": float(counts["engine/dispatch"]
+                                      + counts["engine/train_step"]),
+        "trace_drain_spans": float(counts["engine/drain"]),
+        "trace_h2d_spans": float(counts["comm/h2d"]),
+    }
 
 
 _EXP_BOOTSTRAP = r"""

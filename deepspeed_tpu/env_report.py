@@ -68,6 +68,7 @@ def debug_report():
         rows.append(("jax backend", f"unavailable ({e})"))
     rows.extend(dslint_report())
     rows.extend(trace_report())
+    rows.extend(plan_report())
     rows.extend(comms_report())
     return rows
 
@@ -86,6 +87,51 @@ def trace_report():
                             f"{t.capacity} events, {t.dropped()} dropped)")]
     except Exception as e:
         return [("dstrace", f"unavailable ({e})")]
+
+
+def plan_report():
+    """Step-time planning status: the last ``dstpu plan`` artifact (path +
+    headline attribution) and how many stages the regression baseline
+    ratchets — the measurement-discipline counterpart to the dstrace row."""
+    import json
+    import os
+    rows = []
+    try:
+        from deepspeed_tpu.telemetry.attribution import (
+            PLAN_ARTIFACT_ENV, DEFAULT_PLAN_ARTIFACT, PLAN_BASELINE_NAME,
+            STAGES, find_plan_baseline, load_plan_baseline)
+        artifact = os.environ.get(PLAN_ARTIFACT_ENV) or (
+            DEFAULT_PLAN_ARTIFACT if os.path.exists(DEFAULT_PLAN_ARTIFACT)
+            else None)
+        if artifact and os.path.exists(artifact):
+            with open(artifact) as f:
+                rep = json.load(f)
+            agg = rep.get("aggregate", {})
+            if agg:
+                dominant = max(
+                    (s for s in STAGES if s in agg),
+                    key=lambda s: agg[s].get("share", 0.0))
+                rows.append(("dstpu plan", f"{artifact} ({dominant} "
+                             f"{agg[dominant]['share'] * 100:.0f}% of step "
+                             f"time, p50 step {rep.get('step_ms_p50')}ms, "
+                             f"{len(rep.get('proposals', []))} proposals)"))
+            else:
+                rows.append(("dstpu plan", f"{artifact} (no aggregate)"))
+        else:
+            rows.append(("dstpu plan",
+                         f"no artifact (bin/dstpu plan trace.json --out "
+                         f"{DEFAULT_PLAN_ARTIFACT}, or set "
+                         f"${PLAN_ARTIFACT_ENV})"))
+        bl = find_plan_baseline(os.path.dirname(os.path.abspath(__file__)))
+        if bl is None:
+            rows.append(("plan baseline", f"not found ({PLAN_BASELINE_NAME})"))
+        else:
+            n = len(load_plan_baseline(bl).get("entries", {}))
+            rows.append(("plan baseline",
+                         f"{n} stage{'s' if n != 1 else ''} ratcheted ({bl})"))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("dstpu plan", f"unavailable ({e})")]
 
 
 def comms_report():

@@ -1103,8 +1103,9 @@ class DeepSpeedTPUEngine:
         return self.tracer.export_chrome(path, tail_s=tail_s)
 
     def trace_summary(self, prefix: Optional[str] = None) -> Dict[str, Any]:
-        """Per-span aggregate (count/total/mean/max/p50/p99 seconds) of the
-        tracer ring — the quick in-process look before dumping a trace."""
+        """Per-span aggregate (count/total/mean/max/p50/p95/p99 seconds) of
+        the tracer ring — the quick in-process look before dumping a
+        trace; ``dstpu plan`` on a dump is the full attribution view."""
         return self.tracer.summary(prefix=prefix)
 
     def start_profile_trace(self, log_dir: str) -> None:

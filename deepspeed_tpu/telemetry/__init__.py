@@ -14,6 +14,22 @@ from deepspeed_tpu.telemetry.tracer import (DEFAULT_CAPACITY,
                                             REQUEST_TID_BASE, TRACE_ENV,
                                             Tracer, configure_tracing,
                                             get_tracer, request_tid)
-
 __all__ = ["Tracer", "get_tracer", "configure_tracing", "TRACE_ENV",
-           "DEFAULT_CAPACITY", "REQUEST_TID_BASE", "request_tid"]
+           "DEFAULT_CAPACITY", "REQUEST_TID_BASE", "request_tid",
+           "analyze_path", "attribute", "events_from_tracer", "load_events"]
+
+#: offline trace replay (``dstpu plan``) — re-exported LAZILY (PEP 562):
+#: every hot-path file imports this package for ``get_tracer``, and the
+#: OFFLINE_ONLY_MODULES contract (tools/dslint/hotpath.py) says no hot
+#: path may reach attribution, transitively included — so the module loads
+#: only when someone actually asks for the replay API.
+_ATTRIBUTION_EXPORTS = ("analyze_path", "attribute", "events_from_tracer",
+                        "load_events")
+
+
+def __getattr__(name):
+    if name in _ATTRIBUTION_EXPORTS:
+        from deepspeed_tpu.telemetry import attribution
+        return getattr(attribution, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

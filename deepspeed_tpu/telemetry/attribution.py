@@ -1,0 +1,850 @@
+"""``dstpu plan`` — trace-driven step-time attribution and config planning.
+
+The read side of dstrace: PR 5 made every subsystem emit correlated spans
+and PR 6 attached comm health to them, but nothing could yet *replay* a
+trace and say where a step's time went or what config change would buy it
+back. This module closes that loop (DeepCompile-style profile-guided
+planning, arxiv 2504.09983):
+
+1. **Attribution** — each training step window (the ``engine/
+   steps_reconciled`` retro-spans in async mode, synthesized dispatch runs
+   in sync mode) is decomposed into *exclusive* stages on the main track:
+   dispatch-gap, drain/host-sync, h2d staging, comm, checkpoint I/O,
+   inline prefetch, and an unattributed residual. Exclusivity comes from a
+   priority interval sweep (innermost span wins), so the per-window ledger
+   provably ties out: ``sum(stages) + residual == window`` by
+   construction, and ``sum(stages) <= window`` within a small clock-skew
+   tolerance is asserted rather than assumed.
+2. **Ledger + aggregates** — per-window stage times normalized to
+   per-step milliseconds, with p50/p95/p99 across windows and share of
+   total traced step time. Comm spans roll up bytes/algbw/busbw per op
+   and world size.
+3. **Regression ledger** — ``plan_baseline.json`` (same ratchet idiom as
+   dslint's baseline): per-stage per-step quantiles are recorded once,
+   regressions beyond a tolerance factor fail the CLI with exit code 1 —
+   a deterministic "drain time grew 2x" tripwire on hosts where
+   wall-clock A/B is noise. Improvements surface as *stale* entries so
+   the baseline ratchets down via ``--write-baseline``.
+4. **Proposals** — a rule table maps dominant stages to concrete config
+   overrides ({sync_every, prefetch, gas, micro_batch, zero_stage,
+   offload tier}) with a machine-checkable predicted win;
+   ``Autotuner(plan=...)`` executes exactly these and verifies the
+   prediction against the resulting trace (autotuning/autotuner.py).
+
+Offline-only, by contract: this module never imports jax and never runs
+on a hot path — ``tools/dslint/hotpath.py`` lists it in
+``OFFLINE_ONLY_MODULES`` and tests/test_plan.py proves no registered
+hot-path file can reach it.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_UNREADABLE = 2
+
+PLAN_VERSION = 1
+PLAN_BASELINE_VERSION = 1
+PLAN_BASELINE_NAME = "plan_baseline.json"
+PLAN_ARTIFACT_ENV = "DSTPU_PLAN_ARTIFACT"
+DEFAULT_PLAN_ARTIFACT = "plan.json"
+
+#: stage keys, in ledger/report order. ``residual`` is always last: it is
+#: the remainder of the window the sweep could not attribute (device-bound
+#: compute in sync mode, untraced host work in async mode).
+STAGES = ("dispatch", "drain", "h2d", "comm", "ckpt", "prefetch", "residual")
+
+#: exclusive-sweep priority — at any instant the HIGHEST-priority covering
+#: span owns the time, which resolves nesting (drain inside ckpt/save goes
+#: to drain; comm/h2d inside a dispatch span goes to h2d). dispatch is the
+#: outermost catch-all of the attributable stages.
+_PRIORITY = {"drain": 6, "h2d": 5, "comm": 4, "ckpt": 3, "prefetch": 2,
+             "dispatch": 1}
+
+#: per-window tie-out tolerance: exclusive stage sums may exceed the
+#: reconciled window by at most this fraction (the reconciled retro-span is
+#: stamped from ``time.time()`` deltas while spans use ``time.monotonic()``
+#: — sub-ms skew, never 5%).
+TIE_OUT_TOLERANCE = 0.05
+
+_DISPATCH_NAMES = ("engine/dispatch", "engine/train_step")
+
+#: sync-mode window synthesis splits at inter-dispatch gaps larger than
+#: ``median gap x FACTOR`` (with an absolute floor so a uniform sub-ms
+#: loop never fragments): gaps that big are pauses BETWEEN training
+#: phases, not step cost.
+SYNC_SPLIT_GAP_FACTOR = 10.0
+SYNC_SPLIT_GAP_MIN_US = 1_000.0
+
+
+class PlanError(Exception):
+    """Unreadable/empty trace input — maps to CLI exit code 2."""
+
+
+# ---------------------------------------------------------------------------
+# event loading / normalization
+# ---------------------------------------------------------------------------
+class Ev:
+    """One normalized trace event (Chrome-trace microsecond clock)."""
+    __slots__ = ("name", "cat", "ph", "ts", "dur", "tid", "args")
+
+    def __init__(self, name, cat, ph, ts, dur, tid, args):
+        self.name = name
+        self.cat = cat
+        self.ph = ph
+        self.ts = float(ts)
+        self.dur = float(dur)
+        self.tid = tid
+        self.args = args or {}
+
+    @property
+    def end(self) -> float:
+        return self.ts + self.dur
+
+
+def events_from_chrome(obj: Any) -> List[Ev]:
+    """Normalize a Chrome-trace object (dict with ``traceEvents`` or a bare
+    event list) into ``Ev`` records; metadata ("M") events are dropped."""
+    if isinstance(obj, dict):
+        raw = obj.get("traceEvents")
+        if raw is None:
+            raise PlanError("not a Chrome trace: no 'traceEvents' key")
+    elif isinstance(obj, list):
+        raw = obj
+    else:
+        raise PlanError(f"not a Chrome trace: top-level {type(obj).__name__}")
+    out = []
+    for e in raw:
+        if not isinstance(e, dict) or e.get("ph") == "M":
+            continue
+        try:
+            out.append(Ev(e.get("name", "?"), e.get("cat", ""), e.get("ph"),
+                          float(e.get("ts", 0.0)), float(e.get("dur", 0.0)),
+                          e.get("tid"), e.get("args")))
+        except (TypeError, ValueError):
+            continue   # malformed row: skip, never die mid-replay
+    return out
+
+
+def load_events(path: str) -> List[Ev]:
+    """Load + normalize a dstrace Chrome-trace JSON dump."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        raise PlanError(f"cannot read trace {path}: {e}") from e
+    return events_from_chrome(obj)
+
+
+def events_from_tracer(tracer) -> List[Ev]:
+    """Normalize the live tracer ring (``get_tracer()``) — the in-process
+    replay path the Autotuner's verification uses."""
+    return events_from_chrome(tracer.to_chrome())
+
+
+def quantile(sorted_vals: List[float], q: float) -> float:
+    """Exact sample quantile, same rule everywhere in the repo (serving
+    ``_LatencyStat.quantile`` / ``Tracer.summary``): value at index
+    ``min(int(q*n), n-1)`` of the sorted samples. Deliberately a local
+    copy, NOT an import: this module must load standalone via
+    ``bin/dstpu plan``'s file loader on jax-less hosts, so it may import
+    nothing from the package; tests/test_plan.py pins the copies equal."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(q * len(sorted_vals)), len(sorted_vals) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# stage classification + step windows
+# ---------------------------------------------------------------------------
+def stage_of(name: str, cat: str) -> Optional[str]:
+    if name == "engine/drain":
+        return "drain"
+    if name == "comm/h2d":
+        return "h2d"
+    if name.startswith("ckpt/"):
+        return "ckpt"
+    if name.startswith("prefetch/"):
+        return "prefetch"
+    if cat == "comm" or name.startswith("comm/"):
+        return "comm"
+    if name in _DISPATCH_NAMES:
+        return "dispatch"
+    return None
+
+
+def main_track(events: List[Ev]) -> Optional[Any]:
+    """The tid that emits the dispatch spans — the train loop's track."""
+    counts: Dict[Any, int] = {}
+    for e in events:
+        if e.ph == "X" and e.name in _DISPATCH_NAMES:
+            counts[e.tid] = counts.get(e.tid, 0) + 1
+    if not counts:
+        return None
+    return max(sorted(counts, key=str), key=counts.get)
+
+
+def step_windows(events: List[Ev]) -> Tuple[List[Dict[str, Any]], str]:
+    """The step windows to attribute, plus the trace's mode.
+
+    Async traces carry ``engine/steps_reconciled`` retro-spans: each IS a
+    window (the TRUE step time of its drained steps — dispatch spans only
+    show launch cost). Sync traces have no reconciled spans; each contiguous
+    run of dispatch spans is synthesized into one window (first dispatch
+    start -> last dispatch end), so inter-step host work still attributes.
+    """
+    rec = sorted((e for e in events if e.ph == "X"
+                  and e.name == "engine/steps_reconciled"),
+                 key=lambda e: e.ts)
+    if rec:
+        wins = []
+        for e in rec:
+            steps = int(e.args.get("steps", 1) or 1)
+            wins.append({"start_us": e.ts, "end_us": e.end, "steps": steps,
+                         "last_step": e.args.get("last_step")})
+        return wins, "async"
+    disp = sorted((e for e in events if e.ph == "X"
+                   and e.name in _DISPATCH_NAMES), key=lambda e: e.ts)
+    if not disp:
+        raise PlanError("no step spans in trace (engine/steps_reconciled, "
+                        "engine/dispatch, engine/train_step all absent) — "
+                        "was the run traced with DSTPU_TRACE?")
+    # contiguous runs only: an inter-dispatch gap much larger than the
+    # loop's typical cadence (an eval phase, a pause, untraced work between
+    # loops) starts a NEW window, so the idle time never inflates any
+    # window's residual or the per-step quantiles the baseline ratchets
+    gaps = sorted(max(b.ts - a.end, 0.0) for a, b in zip(disp, disp[1:]))
+    med_gap = gaps[len(gaps) // 2] if gaps else 0.0
+    cut = max(med_gap * SYNC_SPLIT_GAP_FACTOR, SYNC_SPLIT_GAP_MIN_US)
+    runs = [[disp[0]]]
+    for prev, cur in zip(disp, disp[1:]):
+        if cur.ts - prev.end > cut:
+            runs.append([])
+        runs[-1].append(cur)
+    return [{"start_us": r[0].ts, "end_us": r[-1].end, "steps": len(r),
+             "last_step": r[-1].args.get("step")} for r in runs], "sync"
+
+
+def _exclusive_sweep(intervals: List[Tuple[float, float, str]],
+                     w0: float, w1: float) -> Dict[str, float]:
+    """Exclusive per-stage time over [w0, w1]: at every instant the
+    highest-priority covering interval owns it. Intervals are pre-clipped.
+    O(points x intervals) — windows hold tens of spans, not thousands."""
+    out = {s: 0.0 for s in STAGES if s != "residual"}
+    if not intervals:
+        return out
+    pts = sorted({w0, w1, *(i[0] for i in intervals),
+                  *(i[1] for i in intervals)})
+    for a, b in zip(pts, pts[1:]):
+        if b <= a:
+            continue
+        mid = (a + b) / 2.0
+        best = None
+        for s, e, stage in intervals:
+            if s <= mid < e and (best is None
+                                 or _PRIORITY[stage] > _PRIORITY[best]):
+                best = stage
+        if best is not None:
+            out[best] += b - a
+    return out
+
+
+def _union(intervals: List[Tuple[float, float]]) -> float:
+    total, cur_s, cur_e = 0.0, None, None
+    for s, e in sorted(intervals):
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+def attribute(events: List[Ev], source: str = "<events>") -> Dict[str, Any]:
+    """Replay a trace into the plan report: per-window exclusive stage
+    ledger (ties out to the window within ``TIE_OUT_TOLERANCE``), aggregate
+    per-step quantiles, comm rollups, observed config, and proposals."""
+    windows, mode = step_windows(events)
+    track = main_track(events)
+    spans = [e for e in events if e.ph == "X"]
+    ledger = []
+    for i, w in enumerate(windows):
+        w0, w1 = w["start_us"], w["end_us"]
+        on_track, off_track = [], []
+        for e in spans:
+            if e.name == "engine/steps_reconciled":
+                continue
+            st = stage_of(e.name, e.cat)
+            if st is None or e.end <= w0 or e.ts >= w1:
+                continue
+            clipped = (max(e.ts, w0), min(e.end, w1))
+            if track is None or e.tid == track:
+                on_track.append((clipped[0], clipped[1], st))
+            else:
+                off_track.append((clipped[0], clipped[1], st))
+        excl = _exclusive_sweep(on_track, w0, w1)
+        dur = w1 - w0
+        attributed = sum(excl.values())
+        residual = dur - attributed
+        # overlapped (informational, NOT in the exclusive sum): work other
+        # threads did under this window — the prefetch worker's staging is
+        # the latency hiding working as designed, not step cost
+        overlapped: Dict[str, float] = {}
+        for st in set(s for _, _, s in off_track):
+            overlapped[st] = _union([(a, b) for a, b, s in off_track
+                                     if s == st])
+        stages_us = {s: excl.get(s, 0.0) for s in STAGES if s != "residual"}
+        stages_us["residual"] = max(residual, 0.0)
+        ledger.append({
+            "index": i,
+            "start_us": round(w0, 3),
+            "dur_us": round(dur, 3),
+            "steps": w["steps"],
+            "last_step": w["last_step"],
+            "stages_us": {k: round(v, 3) for k, v in stages_us.items()},
+            "overlapped_us": {k: round(v, 3)
+                              for k, v in sorted(overlapped.items())},
+            # tie-out proof: attributed time never exceeds the window
+            # beyond clock skew; residual is the exact remainder
+            "tie_out_error": round(max(attributed - dur, 0.0)
+                                   / dur if dur > 0 else 0.0, 6),
+        })
+    total_us = sum(w["dur_us"] for w in ledger) or 1.0
+    steps_total = sum(w["steps"] for w in ledger)
+    aggregate: Dict[str, Dict[str, float]] = {}
+    for s in STAGES:
+        per_step_ms = sorted((w["stages_us"][s] / w["steps"]) / 1e3
+                             for w in ledger)
+        total_stage = sum(w["stages_us"][s] for w in ledger)
+        aggregate[s] = {
+            "total_ms": round(total_stage / 1e3, 3),
+            "share": round(total_stage / total_us, 4),
+            "mean_step_ms": round(sum(per_step_ms) / len(per_step_ms), 4),
+            "p50_step_ms": round(quantile(per_step_ms, 0.5), 4),
+            "p95_step_ms": round(quantile(per_step_ms, 0.95), 4),
+            "p99_step_ms": round(quantile(per_step_ms, 0.99), 4),
+        }
+    report = {
+        "version": PLAN_VERSION,
+        "source": source,
+        "mode": mode,
+        "windows": ledger,
+        "steps_total": steps_total,
+        "window_ms_total": round(total_us / 1e3, 3),
+        "step_ms_p50": round(quantile(
+            sorted(w["dur_us"] / w["steps"] / 1e3 for w in ledger), 0.5), 4),
+        "aggregate": aggregate,
+        "comm": comm_rollup(events),
+        "config_observed": observed_config(events, windows, mode),
+    }
+    report["proposals"] = propose(report)
+    return report
+
+
+def comm_rollup(events: List[Ev]) -> Dict[str, Dict[str, Any]]:
+    """Per-op comm volume/bandwidth rollup over the whole trace, keyed
+    ``op@world`` (world size is the mesh-axis span the collective ran
+    over). Spans carry measured algbw/busbw; in-jit instants carry only
+    analytic bytes — both count toward volume."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if not (e.cat == "comm" or e.name.startswith("comm/")):
+            continue
+        if e.name == "comm/h2d" or "bytes" not in e.args:
+            continue
+        op = e.name[len("comm/"):] if e.name.startswith("comm/") else e.name
+        world = e.args.get("world", 1)
+        key = f"{op}@{world}"
+        rec = out.setdefault(key, {"op": op, "world": world, "count": 0,
+                                   "bytes": 0, "timed": 0,
+                                   "algbw_gbps_sum": 0.0,
+                                   "busbw_gbps_sum": 0.0})
+        rec["count"] += 1
+        rec["bytes"] += int(e.args.get("bytes", 0) or 0)
+        if e.ph == "X" and "algbw_gbps" in e.args:
+            rec["timed"] += 1
+            rec["algbw_gbps_sum"] += float(e.args["algbw_gbps"])
+            rec["busbw_gbps_sum"] += float(e.args["busbw_gbps"])
+    for rec in out.values():
+        n = rec.pop("timed")
+        rec["algbw_gbps_mean"] = round(rec.pop("algbw_gbps_sum") / n, 3) \
+            if n else None
+        rec["busbw_gbps_mean"] = round(rec.pop("busbw_gbps_sum") / n, 3) \
+            if n else None
+    return dict(sorted(out.items()))
+
+
+def observed_config(events: List[Ev], windows: List[Dict[str, Any]],
+                    mode: str) -> Dict[str, Any]:
+    """The async-pipeline config the trace itself reveals — what `plan`
+    proposes *against* (never trusts a config file that may have drifted
+    from the run)."""
+    drains = [e for e in events if e.ph == "X" and e.name == "engine/drain"]
+    sync_every = None
+    if mode == "async" and drains:
+        per = [int(e.args.get("steps", 0) or 0) for e in drains]
+        per = [p for p in per if p > 0]
+        if per:
+            sync_every = max(per)   # flushes shorten windows; cadence = max
+    prefetch = any(e.name.startswith("prefetch/") for e in events)
+    return {"mode": mode, "sync_every": sync_every, "prefetch": prefetch,
+            "transfers_observed": len(drains) if mode == "async" else
+            sum(w["steps"] for w in windows)}
+
+
+# ---------------------------------------------------------------------------
+# proposals: dominant stage -> config override with a predicted win
+# ---------------------------------------------------------------------------
+#: minimum share of traced step time a stage needs before its rule fires
+_SHARE_FLOOR = {"dispatch": 0.25, "drain": 0.20, "h2d": 0.15, "comm": 0.20,
+                "ckpt": 0.15, "prefetch": 0.15, "residual": 0.60}
+
+
+def propose(report: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The rule table: each entry maps a dominant stage to ONE concrete
+    config override plus a prediction the Autotuner can execute and verify
+    (docs/observability.md carries the prose version). Deterministic:
+    ordered by stage share, ties by rule id."""
+    agg = report["aggregate"]
+    cfg = report["config_observed"]
+    steps = max(report["steps_total"], 1)
+    props: List[Dict[str, Any]] = []
+
+    def share(stage):
+        return agg[stage]["share"]
+
+    # sync-mode per-step readback -> async pipeline. Prediction: readback
+    # transfers drop N -> ceil(N / sync_every), countable as engine/drain
+    # spans in the verifying run's trace.
+    if cfg["mode"] == "sync" and share("dispatch") >= _SHARE_FLOOR["dispatch"]:
+        se = 8
+        props.append({
+            "id": "enable_async_pipeline",
+            "stage": "dispatch",
+            "share": share("dispatch"),
+            "knob": "sync_every",
+            "overrides": {"async_pipeline": {"enabled": True,
+                                             "sync_every": se}},
+            "reason": f"sync-mode dispatch is {share('dispatch'):.0%} of "
+                      "step time: per-step readback serializes host and "
+                      "device — defer it behind the async ring",
+            "predicted": {
+                "metric": "readback_transfers",
+                "sync_every": se,
+                "baseline_sync_every": 1,     # sync: a transfer per step
+                "per_steps": steps,
+                "current": steps,
+                "proposed": math.ceil(steps / se),
+            },
+        })
+    # async but draining too often -> double the cadence. Same countable
+    # prediction, halved transfers.
+    elif cfg["mode"] == "async" and share("drain") >= _SHARE_FLOOR["drain"] \
+            and (cfg["sync_every"] or 1) < 64:
+        cur = max(int(cfg["sync_every"] or 1), 1)
+        se = cur * 2
+        props.append({
+            "id": "raise_sync_every",
+            "stage": "drain",
+            "share": share("drain"),
+            "knob": "sync_every",
+            "overrides": {"async_pipeline": {"enabled": True,
+                                             "sync_every": se}},
+            "reason": f"drain/host-sync is {share('drain'):.0%} of step "
+                      f"time at sync_every={cur}: halve the drain count",
+            "predicted": {
+                "metric": "readback_transfers",
+                "sync_every": se,
+                "baseline_sync_every": cur,
+                "per_steps": steps,
+                "current": math.ceil(steps / cur),
+                "proposed": math.ceil(steps / se),
+            },
+        })
+    if share("h2d") >= _SHARE_FLOOR["h2d"] and not cfg["prefetch"]:
+        props.append({
+            "id": "enable_prefetch",
+            "stage": "h2d",
+            "share": share("h2d"),
+            "knob": "prefetch",
+            "overrides": {"async_pipeline": {"enabled": True,
+                                             "prefetch": True}},
+            "reason": f"inline h2d staging is {share('h2d'):.0%} of step "
+                      "time with no prefetch worker in the trace: stage "
+                      "batch N+1 during batch N's compute",
+            "predicted": {
+                "metric": "h2d_off_main_track",
+                "current_main_track_ms": agg["h2d"]["total_ms"],
+                "proposed_main_track_ms": 0.0,
+            },
+        })
+    if share("prefetch") >= _SHARE_FLOOR["prefetch"]:
+        props.append({
+            "id": "raise_prefetch_depth",
+            "stage": "prefetch",
+            "share": share("prefetch"),
+            "knob": "prefetch_depth",
+            "overrides": {"async_pipeline": {"enabled": True,
+                                             "prefetch": True,
+                                             "prefetch_depth": 4}},
+            "reason": f"main-track prefetch stall is "
+                      f"{share('prefetch'):.0%} of step time: the worker "
+                      "can't stay ahead — deepen the staging buffer",
+            "predicted": {"metric": "prefetch_stall_share",
+                          "current": share("prefetch"), "proposed": 0.0},
+        })
+    if share("comm") >= _SHARE_FLOOR["comm"]:
+        props.append({
+            "id": "raise_gas",
+            "stage": "comm",
+            "share": share("comm"),
+            "knob": "gas",
+            "overrides": {"gradient_accumulation_steps": 2},
+            "reason": f"comm is {share('comm'):.0%} of step time: "
+                      "accumulate more microbatches per optimizer sync so "
+                      "each gradient reduction amortizes over more tokens",
+            "predicted": {"metric": "comm_ops_per_sample",
+                          "current": 1.0, "proposed": 0.5},
+        })
+    if share("ckpt") >= _SHARE_FLOOR["ckpt"]:
+        props.append({
+            "id": "relax_ckpt_cadence",
+            "stage": "ckpt",
+            "share": share("ckpt"),
+            "knob": "checkpoint_cadence",
+            "overrides": {},    # advisory: cadence lives in the runner
+            "reason": f"checkpoint I/O is {share('ckpt'):.0%} of step "
+                      "time: halve the save cadence (or move saves to the "
+                      "host-RAM tier) — resilience costs a bounded replay, "
+                      "not every step",
+            "predicted": {"metric": "ckpt_share",
+                          "current": share("ckpt"),
+                          "proposed": share("ckpt") / 2},
+        })
+    if share("residual") >= _SHARE_FLOOR["residual"] \
+            and cfg["mode"] == "sync":
+        props.append({
+            "id": "raise_micro_batch",
+            "stage": "residual",
+            "share": share("residual"),
+            "knob": "micro_batch",
+            "overrides": {},    # advisory: the absolute mbs is model-bound
+            "reason": f"unattributed residual is {share('residual'):.0%} "
+                      "of a sync-mode window: the step is device-bound — "
+                      "raise micro_batch toward the HBM ceiling, or drop "
+                      "zero_stage / the offload tier if state headroom "
+                      "allows (run the Autotuner sweep)",
+            "predicted": {"metric": "mfu", "current": None,
+                          "proposed": None},
+        })
+    props.sort(key=lambda p: (-p["share"], p["id"]))
+    return props
+
+
+# ---------------------------------------------------------------------------
+# regression baseline (dslint ratchet idiom)
+# ---------------------------------------------------------------------------
+def load_plan_baseline(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != PLAN_BASELINE_VERSION:
+        raise ValueError(f"unsupported plan baseline version "
+                         f"{data.get('version')!r} in {path} "
+                         f"(expected {PLAN_BASELINE_VERSION})")
+    return data
+
+
+def find_plan_baseline(start: str) -> Optional[str]:
+    """Walk up from ``start`` looking for the checked-in plan baseline
+    (same discovery rule as dslint's)."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, PLAN_BASELINE_NAME)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def write_plan_baseline(path: str, report: Dict[str, Any],
+                        tolerance: float = 2.0,
+                        min_abs_ms: float = 0.05) -> dict:
+    """Record the report's per-stage quantiles as the new baseline. The
+    ``workload`` tag (the trace's basename) scopes DISCOVERED baselines:
+    auto-discovery only compares traces of the same workload, so a real
+    run's trace saved inside the repo never gets judged against the
+    micro-fixture baseline (explicit ``--baseline`` always compares)."""
+    data = {
+        "version": PLAN_BASELINE_VERSION,
+        "workload": os.path.basename(str(report.get("source", ""))),
+        "tolerance": float(tolerance),
+        "min_abs_ms": float(min_abs_ms),
+        "entries": {
+            s: {"p50_step_ms": report["aggregate"][s]["p50_step_ms"],
+                "p95_step_ms": report["aggregate"][s]["p95_step_ms"]}
+            for s in STAGES},
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def check_baseline(report: Dict[str, Any], baseline: dict,
+                   tolerance: Optional[float] = None
+                   ) -> Tuple[List[dict], List[dict]]:
+    """(regressions, stale). A stage REGRESSES when its current p50
+    per-step ms exceeds baseline * tolerance AND by more than the absolute
+    floor (sub-floor stages are noise, not signal, on a 2-core host). A
+    baseline entry is STALE when the stage improved past the same margin —
+    expire it with ``--write-baseline`` so the win is locked in (the dslint
+    ratchet: fixed findings must not silently shield a future regression).
+    ``tolerance`` overrides the factor stored in the baseline (the CLI's
+    ``--tolerance``).
+    """
+    tol = float(tolerance if tolerance is not None
+                else baseline.get("tolerance", 2.0))
+    floor = float(baseline.get("min_abs_ms", 0.05))
+    regressions, stale = [], []
+    for stage, entry in sorted(baseline.get("entries", {}).items()):
+        agg = report["aggregate"].get(stage)
+        if agg is None:
+            continue
+        for metric in ("p50_step_ms", "p95_step_ms"):
+            base = float(entry.get(metric, 0.0))
+            cur = float(agg[metric])
+            row = {"stage": stage, "metric": metric, "baseline_ms": base,
+                   "current_ms": cur,
+                   "ratio": round(cur / base, 3) if base > 0 else None}
+            if cur > base * tol and (cur - base) > floor:
+                regressions.append(row)
+            elif base > cur * tol and (base - cur) > floor:
+                stale.append(row)
+    return regressions, stale
+
+
+# ---------------------------------------------------------------------------
+# rendering + CLI
+# ---------------------------------------------------------------------------
+def render(report: Dict[str, Any], top_windows: int = 8) -> str:
+    out = []
+    cfg = report["config_observed"]
+    out.append(f"dstpu plan — {report['source']}")
+    out.append(f"mode={cfg['mode']} sync_every={cfg['sync_every']} "
+               f"prefetch={cfg['prefetch']} | "
+               f"{report['steps_total']} steps over "
+               f"{len(report['windows'])} windows, "
+               f"{report['window_ms_total']:.1f} ms traced step time, "
+               f"p50 step {report['step_ms_p50']:.3f} ms")
+    out.append("")
+    hdr = f"{'win':>4} {'steps':>5} {'ms':>9}"
+    for s in STAGES:
+        hdr += f" {s[:8]:>9}"
+    out.append(hdr + "   tie-out")
+    out.append("-" * len(hdr))
+    for w in report["windows"][:top_windows]:
+        row = f"{w['index']:>4} {w['steps']:>5} {w['dur_us'] / 1e3:>9.2f}"
+        for s in STAGES:
+            row += f" {w['stages_us'][s] / 1e3:>9.3f}"
+        row += f"   {w['tie_out_error'] * 100:.2f}%"
+        out.append(row)
+    if len(report["windows"]) > top_windows:
+        out.append(f"... {len(report['windows']) - top_windows} more "
+                   "windows (--top N)")
+    out.append("")
+    out.append(f"{'stage':<10} {'share':>7} {'p50/step':>10} {'p95/step':>10}"
+               f" {'p99/step':>10}")
+    out.append("-" * 51)
+    for s in STAGES:
+        a = report["aggregate"][s]
+        out.append(f"{s:<10} {a['share'] * 100:>6.1f}% "
+                   f"{a['p50_step_ms']:>9.3f}ms {a['p95_step_ms']:>9.3f}ms "
+                   f"{a['p99_step_ms']:>9.3f}ms")
+    if report["comm"]:
+        out.append("")
+        out.append("comm rollup (op@world: count, MB, mean algbw/busbw GB/s)")
+        for key, r in report["comm"].items():
+            bw = "analytic (in-jit)" if r["algbw_gbps_mean"] is None else \
+                f"{r['algbw_gbps_mean']:.2f}/{r['busbw_gbps_mean']:.2f}"
+            out.append(f"  {key:<28} {r['count']:>6} {r['bytes'] / 1e6:>9.2f}"
+                       f" {bw}")
+    out.append("")
+    if report["proposals"]:
+        out.append("proposals (dominant stage -> config override):")
+        for p in report["proposals"]:
+            out.append(f"  [{p['id']}] {p['reason']}")
+            if p["overrides"]:
+                out.append(f"      overrides: {json.dumps(p['overrides'])}")
+            pred = p["predicted"]
+            if pred.get("metric") == "readback_transfers":
+                out.append(f"      predicted: {pred['current']} -> "
+                           f"{pred['proposed']} readback transfers per "
+                           f"{pred['per_steps']} steps (verify with "
+                           f"Autotuner(plan=...))")
+    else:
+        out.append("proposals: none — no stage clears its share floor "
+                   "(the step spends its time on attributed, already-"
+                   "pipelined work)")
+    return "\n".join(out)
+
+
+def analyze_path(trace_path: str) -> Dict[str, Any]:
+    """Load + attribute in one call (the API tests and env_report use)."""
+    return attribute(load_events(trace_path), source=trace_path)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu plan",
+        description="trace-driven step-time attribution, regression ledger "
+                    "and profile-guided config proposals (produce a trace "
+                    "with DSTPU_TRACE=trace.json or engine.dump_trace)")
+    parser.add_argument("trace", help="dstrace Chrome-trace JSON dump")
+    parser.add_argument("--baseline", default=None,
+                        help=f"plan baseline path (default: walk up from "
+                             f"the trace for {PLAN_BASELINE_NAME})")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record this report as the new baseline "
+                             "(ratchet: also how stale entries expire)")
+    parser.add_argument("--tolerance", type=float, default=None,
+                        help="regression factor vs baseline (default: the "
+                             "factor stored in the baseline, 2.0 when "
+                             "writing a fresh one)")
+    parser.add_argument("--out", default=None,
+                        help="write the full plan artifact JSON here "
+                             f"(env_report reads ${PLAN_ARTIFACT_ENV} or "
+                             f"./{DEFAULT_PLAN_ARTIFACT})")
+    parser.add_argument("--json", action="store_true",
+                        help="print the report as JSON instead of a table")
+    parser.add_argument("--top", type=int, default=8,
+                        help="ledger windows to show (default 8)")
+    args = parser.parse_args(argv)
+
+    try:
+        report = analyze_path(args.trace)
+    except PlanError as e:
+        print(f"dstpu plan: {e}", file=sys.stderr)
+        return EXIT_UNREADABLE
+
+    # discovery anchors at the TRACE path (dslint walks up from the linted
+    # files, same idea): a trace outside the repo is a different workload —
+    # comparing it against the checked-in fixture baseline would flag
+    # meaningless "regressions"; pass --baseline to compare anyway
+    bl_path = args.baseline or find_plan_baseline(args.trace)
+    regressions, stale = [], []
+    effective_tol = args.tolerance if args.tolerance is not None else 2.0
+    if args.write_baseline:
+        trace_dir = os.path.dirname(os.path.abspath(args.trace))
+        target = bl_path or os.path.join(trace_dir, PLAN_BASELINE_NAME)
+        if args.baseline is None and os.path.exists(target):
+            try:    # never clobber a DISCOVERED baseline of another
+                existing_wl = load_plan_baseline(target).get("workload")
+            except (OSError, ValueError):
+                existing_wl = None
+            if existing_wl and existing_wl != os.path.basename(args.trace):
+                redirected = os.path.join(trace_dir, PLAN_BASELINE_NAME)
+                if os.path.abspath(redirected) == os.path.abspath(target):
+                    # nowhere safe to redirect: the other workload's
+                    # baseline lives right next to this trace
+                    print(f"# refusing --write-baseline: {target} "
+                          f"ratchets workload {existing_wl!r} — pass "
+                          "--baseline PATH to overwrite it deliberately "
+                          "or to name a new file", file=sys.stderr)
+                    target = None
+                else:
+                    print(f"# note: {target} ratchets workload "
+                          f"{existing_wl!r} — starting this workload's "
+                          f"baseline at {redirected} instead (pass "
+                          "--baseline to overwrite deliberately)",
+                          file=sys.stderr)
+                    target = redirected
+        if target is not None:
+            if args.tolerance is None and os.path.exists(target):
+                try:    # ratchet rewrite: keep the factor the team chose
+                    effective_tol = float(load_plan_baseline(target)
+                                          .get("tolerance", 2.0))
+                except (OSError, ValueError):
+                    pass
+            write_plan_baseline(target, report, tolerance=effective_tol)
+            print(f"# plan baseline written -> {target}", file=sys.stderr)
+        bl_path = target
+    elif bl_path:
+        try:
+            baseline = load_plan_baseline(bl_path)
+        except (OSError, ValueError) as e:
+            print(f"dstpu plan: bad baseline {bl_path}: {e}",
+                  file=sys.stderr)
+            return EXIT_UNREADABLE
+        bl_workload = baseline.get("workload")
+        trace_workload = os.path.basename(args.trace)
+        if args.baseline is None and bl_workload \
+                and bl_workload != trace_workload:
+            # discovered, different workload: its quantiles say nothing
+            # about this trace — note it instead of fabricating a verdict
+            print(f"# note: discovered baseline {bl_path} is for workload "
+                  f"{bl_workload!r}, not {trace_workload!r} — comparison "
+                  "skipped (pass --baseline to compare anyway, or "
+                  "--write-baseline to start ratcheting this workload)",
+                  file=sys.stderr)
+            bl_path = None
+        else:
+            regressions, stale = check_baseline(report, baseline,
+                                                tolerance=args.tolerance)
+            effective_tol = args.tolerance if args.tolerance is not None \
+                else float(baseline.get("tolerance", 2.0))
+    report["baseline"] = {"path": bl_path, "regressions": regressions,
+                          "stale": stale}
+
+    # the tie-out contract is CHECKED, not assumed: over-attribution past
+    # the clock-skew tolerance marks a window's ledger row untrustworthy
+    # (overlapping duplicate spans, clock skew) — warned on stderr in every
+    # output mode and carried in the artifact
+    violations = [w["index"] for w in report["windows"]
+                  if w["tie_out_error"] > TIE_OUT_TOLERANCE]
+    report["tie_out_violations"] = violations
+    for idx in violations:
+        w = report["windows"][idx]
+        print(f"WARNING: window {idx} over-attributes "
+              f"{w['tie_out_error'] * 100:.1f}% of its span "
+              f"(> {TIE_OUT_TOLERANCE * 100:.0f}% tolerance) — "
+              "overlapping or skewed spans; treat its ledger row as "
+              "suspect", file=sys.stderr)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report, top_windows=args.top))
+        for r in regressions:
+            print(f"REGRESSION: {r['stage']} {r['metric']} "
+                  f"{r['baseline_ms']:.3f} -> {r['current_ms']:.3f} ms "
+                  f"({r['ratio']}x, tolerance "
+                  f"{effective_tol}x) vs {bl_path}", file=sys.stderr)
+        for r in stale:
+            print(f"stale baseline entry (improved): {r['stage']} "
+                  f"{r['metric']} {r['baseline_ms']:.3f} -> "
+                  f"{r['current_ms']:.3f} ms — re-run with "
+                  f"--write-baseline to ratchet", file=sys.stderr)
+    return EXIT_REGRESSION if regressions else EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

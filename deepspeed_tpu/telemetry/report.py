@@ -10,7 +10,10 @@ import argparse
 import json
 import sys
 from collections import defaultdict
-from typing import Dict, List
+from typing import Dict, List, Tuple
+
+#: step spans that anchor --step-range slicing to wall time
+_STEP_SPAN_NAMES = ("engine/dispatch", "engine/train_step")
 
 
 def load_events(path: str) -> List[dict]:
@@ -18,6 +21,97 @@ def load_events(path: str) -> List[dict]:
         trace = json.load(f)
     events = trace["traceEvents"] if isinstance(trace, dict) else trace
     return [e for e in events if isinstance(e, dict)]
+
+
+def track_names(events: List[dict]) -> Dict[int, str]:
+    """tid -> label from the thread_name metadata rows the dump carries."""
+    return {e.get("tid"): e.get("args", {}).get("name", "")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+
+
+def filter_track(events: List[dict], track: str) -> List[dict]:
+    """Keep one Perfetto track: ``track`` matches the thread label
+    (``MainThread``, ``prefetch``, ``request-7``, ...) or a raw tid.
+    Metadata rows ride along so the slice stays labeled."""
+    names = track_names(events)
+    keep = {tid for tid, label in names.items() if label == track}
+    if not keep and track.lstrip("-").isdigit():
+        keep = {int(track)}
+    if not keep:
+        known = sorted(set(names.values()))
+        raise ValueError(f"no track named {track!r} in trace "
+                         f"(known: {known})")
+    return [e for e in events
+            if e.get("ph") == "M" or e.get("tid") in keep]
+
+
+def step_time_bounds(events: List[dict],
+                     lo_step: int, hi_step: int) -> Tuple[float, float]:
+    """Wall-time window [lo, hi] (trace us) covering steps lo..hi: from
+    the first dispatch of step ``lo_step`` to the last dispatch end of
+    step ``hi_step``, extended through any reconciled drain window whose
+    step range intersects — so the slice keeps the drain/h2d/comm spans
+    that carry no per-step arg but belong to those steps."""
+    lo = hi = None
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name, args = e.get("name"), e.get("args", {})
+        ts, dur = float(e.get("ts", 0)), float(e.get("dur", 0))
+        if name in _STEP_SPAN_NAMES and "step" in args:
+            s = int(args["step"])
+            if lo_step <= s <= hi_step:
+                lo = ts if lo is None else min(lo, ts)
+                hi = ts + dur if hi is None else max(hi, ts + dur)
+    if lo is None:
+        raise ValueError(f"no step spans in [{lo_step}:{hi_step}] "
+                         "(engine/dispatch carries the step arg)")
+    for e in events:    # extend through intersecting reconciled windows
+        if e.get("ph") != "X" or e.get("name") != "engine/steps_reconciled":
+            continue
+        args = e.get("args", {})
+        last = args.get("last_step")
+        steps = args.get("steps")
+        if last is None or steps is None:
+            continue
+        first = int(last) - int(steps) + 1
+        if first <= hi_step and int(last) >= lo_step:
+            hi = max(hi, float(e.get("ts", 0)) + float(e.get("dur", 0)))
+            lo = min(lo, float(e.get("ts", 0)))
+    return lo, hi
+
+
+def filter_step_range(events: List[dict], spec: str) -> List[dict]:
+    """``--step-range A:B`` — keep every event intersecting the wall-time
+    window those steps occupied (NOT just events carrying a step arg: the
+    drain/h2d/comm spans of those steps have none)."""
+    try:
+        a, _, b = spec.partition(":")
+        lo_step, hi_step = int(a), int(b if b else a)
+    except ValueError:
+        raise ValueError(f"--step-range wants A:B (got {spec!r})")
+    lo, hi = step_time_bounds(events, lo_step, hi_step)
+    out = []
+    for e in events:
+        if e.get("ph") == "M":
+            out.append(e)
+            continue
+        ts = float(e.get("ts", 0))
+        end = ts + float(e.get("dur", 0))
+        if end >= lo and ts <= hi:
+            out.append(e)
+    return out
+
+
+def write_slice(path: str, events: List[dict]):
+    """Write a filtered event set back out as Chrome-trace JSON — the
+    sliced dump feeds ``dstpu plan`` or a bug report without shipping the
+    whole ring."""
+    obj = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"sliced": True, "events": len(events)}}
+    with open(path, "w") as f:
+        json.dump(obj, f, default=str)
 
 
 def aggregate(events: List[dict], cat: str = None):
@@ -87,12 +181,33 @@ def main(argv=None) -> int:
                              "(train/comm/serve/ckpt/data/resilience)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable aggregate instead of a table")
+    parser.add_argument("--step-range", default=None, metavar="A:B",
+                        help="slice to the wall-time window steps A..B "
+                             "occupied (keeps their drain/h2d/comm spans)")
+    parser.add_argument("--track", default=None, metavar="NAME",
+                        help="slice to one Perfetto track by thread label "
+                             "(e.g. MainThread, request-7) or raw tid")
+    parser.add_argument("--out", default=None, metavar="PATH",
+                        help="write the sliced events as Chrome-trace JSON "
+                             "(feeds `dstpu plan` / bug reports)")
     args = parser.parse_args(argv)
     try:
         events = load_events(args.trace)
     except (OSError, ValueError, KeyError) as e:
         print(f"dstpu_trace: cannot read {args.trace}: {e}", file=sys.stderr)
         return 2
+    try:
+        if args.step_range:
+            events = filter_step_range(events, args.step_range)
+        if args.track:
+            events = filter_track(events, args.track)
+    except ValueError as e:
+        print(f"dstpu_trace: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        write_slice(args.out, events)
+        print(f"# sliced trace ({len(events)} events) -> {args.out}",
+              file=sys.stderr)
     rows, instants, wall = aggregate(events, cat=args.cat)
     if args.json:
         print(json.dumps({"wall_us": wall, "spans": rows,

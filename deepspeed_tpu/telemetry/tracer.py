@@ -59,6 +59,16 @@ def request_tid(uid: int) -> int:
 _EID, _NAME, _CAT, _PH, _TS, _DUR, _TID, _ARGS = range(8)
 
 
+def _quantile(sorted_vals, q: float) -> float:
+    """Exact sample quantile over pre-sorted values — the repo-wide rule
+    (serving ``_LatencyStat.quantile`` / ``attribution.quantile``): the
+    value at index ``min(int(q*n), n-1)``. The step-time attribution of
+    ``dstpu plan`` consumes these, so the rule must not drift per caller."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(q * len(sorted_vals)), len(sorted_vals) - 1)]
+
+
 class _NoopSpan:
     """Shared do-nothing context — THE fast path when tracing is off (one
     attribute read + one identity return per ``span()`` call)."""
@@ -302,8 +312,8 @@ class Tracer:
     # ------------------------------------------------------------------
     def summary(self, prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
         """Per-span-name aggregate over the ring's complete events:
-        count / total_s / mean_s / max_s / p50_s / p99_s. ``prefix``
-        filters span names (e.g. ``"serve/"``)."""
+        count / total_s / mean_s / max_s / p50_s / p95_s / p99_s.
+        ``prefix`` filters span names (e.g. ``"serve/"``)."""
         buckets: Dict[str, List[float]] = {}
         for e in self.events_snapshot():
             if e[_PH] != "X":
@@ -321,8 +331,9 @@ class Tracer:
                 "total_s": sum(durs),
                 "mean_s": sum(durs) / n,
                 "max_s": durs[-1],
-                "p50_s": durs[min(n // 2, n - 1)],
-                "p99_s": durs[min(int(0.99 * n), n - 1)],
+                "p50_s": _quantile(durs, 0.5),
+                "p95_s": _quantile(durs, 0.95),
+                "p99_s": _quantile(durs, 0.99),
             }
         return out
 
@@ -347,7 +358,7 @@ class Tracer:
                  "# TYPE dstpu_trace_span_seconds summary"]
         for name in sorted(summ):
             s = summ[name]
-            for q, key in ((0.5, "p50_s"), (0.99, "p99_s")):
+            for q, key in ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s")):
                 lines.append(f'dstpu_trace_span_seconds{{span="{name}",'
                              f'quantile="{q}"}} {s[key]:.9g}')
             lines.append(f'dstpu_trace_span_seconds_sum{{span="{name}"}} '

@@ -138,3 +138,14 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         hot_functions=("note_op",),
     ),
 )
+
+#: the inverse registry: modules that must NEVER run on (or be imported
+#: by) a registered hot path. ``dstpu plan``'s trace replay is offline by
+#: contract — it re-reads whole dumps, builds interval sweeps, and does
+#: unbounded host work, any of which would wreck a per-step path.
+#: tests/test_plan.py proves both directions: no HOT_PATHS file references
+#: these modules, and the modules themselves never import jax (an offline
+#: analyzer has no business touching the device runtime at all).
+OFFLINE_ONLY_MODULES: Tuple[str, ...] = (
+    "deepspeed_tpu/telemetry/attribution.py",
+)

@@ -1,0 +1,110 @@
+"""Regenerate the checked-in dstrace fixtures for tests/test_plan.py.
+
+Run from the repo root (CPU is fine — the fixtures are frozen so the
+golden attribution assertions stay deterministic across hosts):
+
+    JAX_PLATFORMS=cpu python tests/plan_fixtures/make_fixtures.py
+
+Two fixtures, both from the same SimpleModel micro workload:
+
+  micro_sync_trace.json   async pipeline OFF — per-step readback, dispatch
+                          dominates; `dstpu plan` must propose enabling the
+                          async pipeline (the sync_every proposal the
+                          Autotuner acceptance drill verifies). No
+                          checkpoint here: on this model a save is ~50x a
+                          step and would drown every other stage — ckpt
+                          attribution is pinned by the synthetic-trace
+                          golden test instead
+  micro_async_trace.json  async pipeline ON (sync_every=4) with a mid-run
+                          checkpoint — reconciled windows, drain spans, and
+                          ckpt I/O for the full-ledger golden test
+
+Also regenerates the repo-root ``plan_baseline.json`` from the async
+fixture's attribution — fixtures and baseline are one artifact set and
+must move together (the golden test pins their agreement byte-for-byte).
+
+The regression-variant traces used by the exit-code matrix are derived
+in-test (drain/dispatch durations scaled up) — never checked in.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _fresh_engine(extra=None):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 4}
+    if extra:
+        cfg.update(extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=cfg,
+        example_batch=random_batch(4), seed=7)
+    return engine
+
+
+def _batches(n):
+    from deepspeed_tpu.models.simple import random_batch
+    return iter([random_batch(8, seed=i) for i in range(n)])
+
+
+def main():
+    from deepspeed_tpu.telemetry import get_tracer
+    tracer = get_tracer()
+
+    # --- sync-mode fixture -------------------------------------------------
+    import tempfile
+    engine = _fresh_engine()
+    warm = _batches(1)
+    engine.train_batch(data_iter=warm)          # compile outside the trace
+    tracer.clear()
+    tracer.configure(enabled=True)
+    it = _batches(8)
+    for _ in range(8):
+        engine.train_batch(data_iter=it)
+    tracer.configure(enabled=False)
+    path = os.path.join(HERE, "micro_sync_trace.json")
+    with open(path, "w") as f:
+        json.dump(tracer.to_chrome(), f, default=str)
+    print(f"wrote {path} ({len(tracer.events_snapshot())} events)")
+
+    # --- async-mode fixture ------------------------------------------------
+    engine = _fresh_engine(extra={
+        "async_pipeline": {"enabled": True, "sync_every": 4}})
+    warm = _batches(1)
+    engine.train_batch(data_iter=warm)
+    engine.flush_metrics()
+    tracer.clear()
+    tracer.configure(enabled=True)
+    it = _batches(12)
+    for step in range(12):
+        engine.train_batch(data_iter=it)
+        if step == 7:
+            with tempfile.TemporaryDirectory() as d:
+                engine.save_checkpoint(d, tag="fixture")
+    engine.flush_metrics()
+    tracer.configure(enabled=False)
+    path = os.path.join(HERE, "micro_async_trace.json")
+    with open(path, "w") as f:
+        json.dump(tracer.to_chrome(), f, default=str)
+    print(f"wrote {path} ({len(tracer.events_snapshot())} events)")
+    tracer.clear()
+
+    # --- regression baseline (ratchet anchor for the async fixture) --------
+    from deepspeed_tpu.telemetry import attribution
+    report = attribution.analyze_path(path)
+    bl = os.path.join(REPO, attribution.PLAN_BASELINE_NAME)
+    attribution.write_plan_baseline(bl, report)
+    print(f"wrote {bl}")
+
+
+if __name__ == "__main__":
+    main()
