@@ -439,7 +439,11 @@ class Autotuner:
                  "metrics": best.metrics},
             "experiments": [
                 {"name": e.name, "status": e.status, "metrics": e.metrics,
-                 "overrides": e.overrides, "error": e.error}
+                 "overrides": e.overrides, "error": e.error,
+                 # dsmem forensics for oom-classified candidates (live
+                 # stats + analytic ledger + observed peak)
+                 **({"memory": e.memory}
+                    if getattr(e, "memory", None) else {})}
                 for e in self.records],
         }
         if self.plan_verifications:

@@ -81,6 +81,7 @@ class ExperimentRunner:
         cfg = merge_config(self.base_config, exp.overrides)
         # autotuner owns the batch triple: derive train_batch from mbs x gas x dp
         cfg.pop("train_batch_size", None)
+        engine = None
         tracer = None
         tracer_was_enabled = False
         if self.trace_counters:
@@ -118,17 +119,57 @@ class ExperimentRunner:
                 exp.metrics.update(_span_counts(tracer, mark))
             exp.status = "done"
         except Exception as e:  # noqa: BLE001 — any candidate may legally fail
+            from deepspeed_tpu.telemetry.memory import is_oom_message
             msg = str(e)
             exp.error = msg
-            oom = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                   or "out of memory" in msg)
+            oom = is_oom_message(msg)
             exp.status = "oom" if oom else "failed"
+            if oom:
+                # forensics, not just a string match: the live device stats
+                # at death, the candidate's analytic ledger, and the
+                # observed peak as a first-class metric — what the next
+                # sweep iteration prunes against
+                exp.memory = _oom_forensics(cfg, engine)
+                peak = exp.memory.get("peak_bytes_in_use")
+                if peak:
+                    exp.metrics = dict(exp.metrics or {},
+                                       peak_bytes_in_use=float(peak))
             logger.warning(f"autotuning experiment {exp.name} {exp.status}: "
                            f"{msg.splitlines()[0] if msg else e!r}")
         finally:
             if tracer is not None and not tracer_was_enabled:
                 tracer.configure(enabled=False)
         return exp
+
+
+def _oom_forensics(cfg: Dict[str, Any], engine=None) -> Dict[str, Any]:
+    """What an oom-classified experiment records beyond the string match:
+    live device/host stats at death, the candidate config's analytic dsmem
+    ledger (engine-exact when the engine got built, config-only when init
+    itself OOMed), and the observed peak bytes."""
+    out: Dict[str, Any] = {}
+    try:
+        from deepspeed_tpu.utils.memory import get_memory_stats
+        stats = get_memory_stats()
+        out["stats"] = stats
+        out["peak_bytes_in_use"] = int(max(
+            (s.get("peak_bytes_in_use_gb", 0.0) * 1e9
+             for d, s in stats.items() if d != "host"), default=0))
+    except Exception:
+        logger.exception("autotuning: oom memory stats capture failed")
+    try:
+        if engine is not None and hasattr(engine, "memory_ledger"):
+            out["ledger"] = engine.memory_ledger().to_dict()
+        else:
+            from deepspeed_tpu.telemetry.memory import MemoryLedger
+            out["ledger"] = MemoryLedger.from_config(
+                cfg, num_params=0).to_dict()
+            out["ledger"]["notes"].append(
+                "engine never constructed (init-time OOM): ledger built "
+                "from config only, num_params unknown")
+    except Exception:
+        logger.exception("autotuning: oom ledger capture failed")
+    return out
 
 
 def _last_event_id(tracer) -> int:
@@ -183,7 +224,8 @@ runner = ExperimentRunner(
 exp = runner(Experiment(os.environ["DSTPU_TUNE_NAME"],
                         json.loads(os.environ["DSTPU_TUNE_OVERRIDES"])))
 print("DSTPU_EXP_RESULT " + json.dumps(
-    {"status": exp.status, "metrics": exp.metrics, "error": exp.error}),
+    {"status": exp.status, "metrics": exp.metrics, "error": exp.error,
+     "memory": exp.memory}),
     flush=True)
 """
 
@@ -273,12 +315,21 @@ class ProcessIsolatedRunner:
                 exp.status = res["status"]
                 exp.metrics = res["metrics"]
                 exp.error = res["error"]
+                exp.memory = res.get("memory")
                 return exp
         # child died before reporting (hard OOM kill, segfault, ...)
+        from deepspeed_tpu.telemetry.memory import is_oom_message
         tail = "\n".join(out.splitlines()[-5:])
-        oom = ("RESOURCE_EXHAUSTED" in out or "out of memory" in out.lower()
-               or proc.returncode in (-9, 137))
+        oom = is_oom_message(out) or proc.returncode in (-9, 137)
         exp.status = "oom" if oom else "failed"
+        if oom:
+            # the child is gone: no in-process stats to read, but the
+            # candidate's analytic ledger is still computable parent-side
+            exp.memory = _oom_forensics(
+                merge_config(self.base_config, exp.overrides))
+            exp.memory["note"] = ("child killed before reporting — stats "
+                                  "are the PARENT process's, ledger is the "
+                                  "candidate's analytic plan")
         exp.error = (f"child exited {proc.returncode} without reporting; "
                      f"tail:\n{tail}")
         logger.warning(f"autotuning experiment {exp.name} child died "

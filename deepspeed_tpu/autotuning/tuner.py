@@ -25,6 +25,10 @@ class Experiment:
         self.status = "pending"    # pending | running | done | failed | oom
         self.metrics: Dict[str, float] = {}
         self.error: Optional[str] = None
+        # dsmem forensics for oom-classified failures: live device stats +
+        # the analytic ledger of the candidate config (scheduler.py fills
+        # it; autotuning_results.json carries it per experiment)
+        self.memory: Optional[Dict[str, Any]] = None
 
     def metric(self, key: str) -> Optional[float]:
         return self.metrics.get(key)

@@ -307,6 +307,37 @@ class AsyncPipelineConfig(DeepSpeedTPUConfigModel):
         return self
 
 
+class MemoryConfig(DeepSpeedTPUConfigModel):
+    """dsmem (TPU-native; ``deepspeed_tpu/telemetry/memory.py``): analytic
+    memory-plan preflight plus live HBM/RSS watermark sampling into the
+    dstrace timeline. With the group absent the engine still samples at
+    drain boundaries whenever tracing is on (counter tracks ride every
+    ``DSTPU_TRACE`` dump for free); enabling the group adds the analytic
+    preflight and the background cadence thread."""
+    enabled: bool = False
+    # sample at the async drain / sync steps_per_print boundary (points
+    # that already host-sync — sampling there adds zero new syncs)
+    sample_on_drain: bool = True
+    # background sampler thread period in seconds (0 = off); for serve /
+    # idle stretches with no drain cadence
+    cadence_s: float = 0.0
+    # bounded in-memory sample ring (diagnostic bundles embed the tail)
+    window: int = 512
+    # analytic ledger vs device bytes_limit at engine init:
+    # off | warn | refuse (refuse raises MemoryPreflightError)
+    preflight: str = "warn"
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.preflight not in ("off", "warn", "refuse"):
+            raise ValueError(f"memory.preflight must be off|warn|refuse, "
+                             f"got {self.preflight!r}")
+        if self.cadence_s < 0:
+            raise ValueError(f"memory.cadence_s must be >= 0, "
+                             f"got {self.cadence_s}")
+        return self
+
+
 class DeepSpeedTPUConfig:
     """Parses the single JSON/dict config (reference: DeepSpeedConfig,
     runtime/config.py). Performs the batch-size triple reconciliation with
@@ -355,6 +386,7 @@ class DeepSpeedTPUConfig:
         self.data_types = DataTypesConfig(**self._raw.get(C.DATA_TYPES, {}))
         self.async_pipeline = AsyncPipelineConfig(
             **self._raw.get(C.ASYNC_PIPELINE, {}))
+        self.memory = MemoryConfig(**self._raw.get(C.MEMORY, {}))
         self.pld = PLDConfig(**self._raw.get(C.PROGRESSIVE_LAYER_DROP, {}))
         # single schema shared with the implementation (no parallel copy to
         # keep in sync): reference get_eigenvalue_config (runtime/config.py:565)

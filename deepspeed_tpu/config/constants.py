@@ -49,6 +49,8 @@ RESILIENCE = "resilience"           # fault-tolerance group (guards/autosave)
 COMM_GUARD = "comm_guard"           # comm fault-tolerance group (deadlines/
 #                                     heartbeat/membership; comm/guard.py)
 DEBUG_NANS = "debug_nans"           # jax_debug_nans for the compiled step
+MEMORY = "memory"                   # dsmem group (ledger preflight + live
+#                                     HBM/RSS sampling; telemetry/memory.py)
 
 # Defaults (mirroring reference semantics)
 STEPS_PER_PRINT_DEFAULT = 10

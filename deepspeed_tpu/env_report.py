@@ -69,7 +69,52 @@ def debug_report():
     rows.extend(dslint_report())
     rows.extend(trace_report())
     rows.extend(plan_report())
+    rows.extend(memory_report())
     rows.extend(comms_report())
+    return rows
+
+
+def memory_report():
+    """dsmem status: per-device limit/in-use/peak, host RSS, ledger
+    availability, and the watermark baseline's ratchet size — the memory
+    counterpart of the dstrace/plan rows."""
+    import os
+    rows = []
+    try:
+        # single source for stat collection (utils.memory, the reference
+        # see_memory_usage substrate) — this report only renders it
+        from deepspeed_tpu.utils.memory import get_memory_stats
+        stats = get_memory_stats()
+        dev_rows = [
+            (f"memory {dev}",
+             f"{s['bytes_in_use_gb']:.2f}GB in use / "
+             f"peak {s['peak_bytes_in_use_gb']:.2f}GB / "
+             f"limit {s['bytes_limit_gb']:.2f}GB")
+            for dev, s in stats.items()
+            if dev != "host" and any(v > 0 for v in s.values())]
+        rows.extend(dev_rows or [("memory devices",
+                                  "no allocator stats (CPU backend)")])
+        if "host" in stats:
+            rows.append(("memory host rss",
+                         f"{stats['host']['rss_gb']:.2f}GB"))
+    except Exception as e:
+        rows.append(("memory devices", f"unavailable ({e})"))
+    try:
+        from deepspeed_tpu.telemetry.memory import (MEM_BASELINE_NAME,
+                                                    find_mem_baseline,
+                                                    load_mem_baseline)
+        rows.append(("mem ledger", "available (bin/dstpu mem --preflight "
+                                   "CONFIG --params N)"))
+        bl = find_mem_baseline(os.path.dirname(os.path.abspath(__file__)))
+        if bl is None:
+            rows.append(("mem baseline", f"not found ({MEM_BASELINE_NAME})"))
+        else:
+            n = len(load_mem_baseline(bl).get("entries", {}))
+            rows.append(("mem baseline",
+                         f"{n} phase{'s' if n != 1 else ''} ratcheted "
+                         f"({bl})"))
+    except Exception as e:   # the report must never die on tooling drift
+        rows.append(("dsmem", f"unavailable ({e})"))
     return rows
 
 

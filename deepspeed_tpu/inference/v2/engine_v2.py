@@ -325,6 +325,21 @@ class InferenceEngineV2:
         usable = self.kv_usable_blocks()
         return (usable - self.kv.free_blocks) / max(usable, 1)
 
+    def kv_reserved_blocks(self) -> int:
+        """Blocks currently reserved by live sequences — the *observed*
+        side of the serving layer's projected-vs-observed reconciliation."""
+        return self.kv_usable_blocks() - self.kv.free_blocks
+
+    def kv_block_bytes(self) -> int:
+        """Device bytes per KV block across all layers/heads (metadata
+        arithmetic on the cache array — never a transfer): the conversion
+        the serving gauges use to state occupancy in bytes instead of
+        blocks."""
+        nbytes = int(getattr(self.kv.data, "nbytes", 0))
+        if self.kv.scales is not None:
+            nbytes += int(getattr(self.kv.scales, "nbytes", 0))
+        return nbytes // max(self.kv.cfg.num_blocks, 1)
+
     def generate(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
                  uid: int = 0) -> List[int]:
         """Convenience serial generation loop over the continuous-batching

@@ -63,6 +63,11 @@ class ChaosConfig:
     # exercised once instead of crash-looping until the restart budget dies
     die_step: int = -1
     die_once: bool = True
+    # device OOM (the catchable RESOURCE_EXHAUSTED case) at a step boundary
+    # — drills the dsmem forensics path: engine classification, ledger +
+    # sample embedding, the runner's oom diagnostic bundle
+    oom_step: int = -1
+    oom_once: bool = True
     # comm faults (consumed by comm/guard.py CommGuard + membership
     # Heartbeat). Call indices count GUARDED ops per CommGuard instance;
     # op patterns are exact names, "" / "*" match any op.
@@ -82,6 +87,7 @@ class ChaosConfig:
                     or self.ckpt_fail_first or self.ckpt_fail_prob
                     or self.slow_steps or self.slow_prob
                     or self.die_step >= 0
+                    or self.oom_step >= 0
                     or self.comm_wedge_call >= 0
                     or (self.comm_delay_s > 0
                         and (self.comm_delay_calls or self.comm_delay_prob))
@@ -102,6 +108,8 @@ class ChaosConfig:
             slow_s=float(g("DSTPU_CHAOS_SLOW_S", "0")),
             die_step=int(g("DSTPU_CHAOS_DIE_STEP", "-1")),
             die_once=g("DSTPU_CHAOS_DIE_ONCE", "1") not in ("0", "false"),
+            oom_step=int(g("DSTPU_CHAOS_OOM_STEP", "-1")),
+            oom_once=g("DSTPU_CHAOS_OOM_ONCE", "1") not in ("0", "false"),
             comm_wedge_op=g("DSTPU_CHAOS_COMM_WEDGE_OP", ""),
             comm_wedge_call=int(g("DSTPU_CHAOS_COMM_WEDGE_CALL", "-1")),
             comm_wedge_once=g("DSTPU_CHAOS_COMM_WEDGE_ONCE", "1")
@@ -119,13 +127,19 @@ class ChaosInjectedIOError(OSError):
     I/O error in logs, indistinguishable to the retry machinery)."""
 
 
+class ChaosInjectedOOMError(RuntimeError):
+    """An injected RESOURCE_EXHAUSTED (distinguishable in logs; its message
+    classifies as OOM to ``telemetry.memory.is_oom_error`` exactly like a
+    real XLA allocation failure)."""
+
+
 class ChaosMonkey:
     """Stateless-roll injector; the only mutable state is bookkeeping
     counters so tests can assert exactly what fired."""
 
     def __init__(self, config: Optional[ChaosConfig] = None):
         self.config = config if config is not None else ChaosConfig.from_env()
-        self.injected = {"nan": 0, "ckpt": 0, "slow": 0,
+        self.injected = {"nan": 0, "ckpt": 0, "slow": 0, "oom": 0,
                          "comm_wedge": 0, "comm_delay": 0}
 
     # ------------------------------------------------------------------
@@ -248,6 +262,28 @@ class ChaosMonkey:
         membership view will see its file go stale — a simulated dead
         peer with no unpublish protocol to cheat through)."""
         return rank in self.config.peer_dead_ranks
+
+    # ------------------------------------------------------------------
+    # device OOM (catchable RESOURCE_EXHAUSTED)
+    # ------------------------------------------------------------------
+    def maybe_oom(self, step: int) -> None:
+        """Raise a RESOURCE_EXHAUSTED-shaped error at ``oom_step`` — the
+        XLA message shape the dsmem classifier keys on, injected at the
+        host layer so the whole forensics path (engine classification →
+        ledger + samples stash → runner oom bundle) is exercised without
+        actually exhausting a device. ``oom_once`` spares DSTPU_RESUME
+        relaunches, mirroring ``die_once``."""
+        if self.config.oom_step < 0 or step != self.config.oom_step:
+            return
+        if self.config.oom_once and os.environ.get("DSTPU_RESUME"):
+            return
+        self.injected["oom"] += 1
+        get_tracer().instant("chaos/oom", cat="resilience", step=step)
+        logger.warning(f"chaos: injecting RESOURCE_EXHAUSTED at step {step}")
+        raise ChaosInjectedOOMError(
+            f"RESOURCE_EXHAUSTED: chaos-injected out of memory allocating "
+            f"16.00G at step {step} (fake buffer dump: this is the dsmem "
+            "forensics drill)")
 
     # ------------------------------------------------------------------
     # worker death

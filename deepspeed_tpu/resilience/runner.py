@@ -54,6 +54,7 @@ from deepspeed_tpu.resilience.config import (ResilienceConfig,
 from deepspeed_tpu.resilience.guards import (BadStepError, QuarantineError,
                                              StepGuard)
 from deepspeed_tpu.resilience.watchdog import TRACE_TAIL_S, StepWatchdog
+from deepspeed_tpu.telemetry.memory import is_oom_error
 from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
@@ -322,6 +323,9 @@ class FaultTolerantRunner:
             if self.chaos is not None:
                 # inside the watchdog window: a chaos stall IS a hung step
                 self.chaos.maybe_stall(step_idx)
+                # the dsmem drill: a RESOURCE_EXHAUSTED-shaped raise that
+                # exercises classify -> forensics -> oom bundle end to end
+                self.chaos.maybe_oom(step_idx)
             loss = engine.train_batch(batch=batch, data_iter=feed_iter,
                                       stacked=stacked)
         finally:
@@ -528,6 +532,20 @@ class FaultTolerantRunner:
                 self.save(reason="comm_fault")
                 result.stop_reason = "comm_fault"
                 break
+            except Exception as e:
+                # OOM forensics (dsmem): a RESOURCE_EXHAUSTED means the
+                # device cannot run THIS config — bundle the evidence
+                # (ledger + live samples + per-phase deltas + trace tail)
+                # and re-raise; unlike a preemption there is nothing to
+                # resume into, the config itself must change (the bundle's
+                # ledger says which component to offload/shard)
+                if not is_oom_error(e):
+                    raise
+                logger.error(f"resilience: OOM at step "
+                             f"{self.engine.global_steps}: "
+                             f"{str(e).splitlines()[0]}")
+                self.write_diagnostic_bundle("oom", error=e)
+                raise
             result.steps_completed += 1
             if "loss" in self._last_host:
                 result.last_loss = float(self._last_host["loss"])
@@ -620,6 +638,20 @@ class FaultTolerantRunner:
                 "elapsed_s": round(error.elapsed_s, 3),
                 "comm_tail": getattr(error, "comm_tail", []),
             }
+        # dsmem forensics: the ledger + last live samples + per-phase
+        # plan-vs-observed deltas ride EVERY bundle (an OOM bundle's whole
+        # point; for quarantine/watchdog it is the free context an oncall
+        # checks first — "was the device near its limit when this died")
+        try:
+            if error is not None and is_oom_error(error) \
+                    and getattr(engine, "last_oom", None):
+                # the engine already snapshotted at the moment of failure
+                diag["memory"] = engine.last_oom
+            elif hasattr(engine, "memory_forensics"):
+                diag["memory"] = engine.memory_forensics(
+                    error=repr(error) if error is not None else None)
+        except Exception:
+            logger.exception("resilience: memory forensics embed failed")
         with open(os.path.join(d, "diag.json"), "w") as f:
             json.dump(diag, f, indent=2, default=str)
         with open(os.path.join(d, "stacks.txt"), "w") as f:

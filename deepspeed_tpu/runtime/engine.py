@@ -592,6 +592,24 @@ class DeepSpeedTPUEngine:
             self.compressor.maybe_freeze_masks(self.state.params)
             self._compression_key = self.compressor.schedule_key()
 
+        # --- dsmem: memory observability + analytic preflight ------------------
+        # the sampler rides every traced run for free (HBM/RSS counter
+        # tracks in the DSTPU_TRACE dump); the "memory" config group adds
+        # the analytic preflight and the background cadence thread
+        self._mem_sampler = None
+        self.last_oom: Optional[Dict[str, Any]] = None
+        if config.memory.enabled or self.tracer.enabled:
+            from deepspeed_tpu.telemetry.memory import MemorySampler
+            self._mem_sampler = MemorySampler(tracer=self.tracer,
+                                              window=config.memory.window)
+            if config.memory.enabled and config.memory.cadence_s > 0:
+                self._mem_sampler.start(config.memory.cadence_s)
+        if config.memory.enabled and config.memory.preflight != "off":
+            self._memory_preflight(config.memory.preflight)
+        if self._mem_sampler is not None:
+            # the init watermark: params + optimizer state are resident now
+            self._mem_sampler.sample(step=0, phase="init")
+
     @staticmethod
     def _host_init_params(model, example_batch, init_rng):
         """Initialize params in HOST memory (CPU backend): under offload_param
@@ -947,11 +965,35 @@ class DeepSpeedTPUEngine:
         # completion wait — in async mode the reconciled step time shows up
         # as engine/steps_reconciled at the drain; comparing the two is the
         # dispatch-gap-vs-step-time view the async pipeline is tuned by)
-        with self.tracer.span("engine/dispatch", cat="train",
-                              step=self.global_steps,
-                              mode="async" if self._async_enabled else "sync"):
-            self.state, out = self._train_batch_fn(self.state, device_batch,
-                                                   step_rng)
+        if self._mem_sampler is not None:
+            # phase transition is attribute stores (hot-path safe): the
+            # first dispatched step carries compile workspace the analytic
+            # plan does not model, so it gets its own observation bucket.
+            # In async mode the first SAMPLE happens at the first drain
+            # (up to sync_every steps later) — hold "first_step" until one
+            # sample lands in it, else the bucket would be overwritten to
+            # "steady" before it was ever observed; the 2x-sync_every step
+            # guard bounds the hold for cadence-thread-only configs
+            sampler = self._mem_sampler
+            if self.global_steps == 0:
+                sampler.phase = "first_step"
+            elif sampler.phase == "first_step" and (
+                    sampler.seen("first_step")
+                    or self.global_steps >= 2 * max(self._sync_every or 1,
+                                                    1)):
+                sampler.phase = "steady"
+        try:
+            with self.tracer.span(
+                    "engine/dispatch", cat="train", step=self.global_steps,
+                    mode="async" if self._async_enabled else "sync"):
+                self.state, out = self._train_batch_fn(self.state,
+                                                       device_batch,
+                                                       step_rng)
+        except Exception as e:
+            # compile-time RESOURCE_EXHAUSTED raises at dispatch: classify
+            # and stash forensics before the error unwinds (no-op otherwise)
+            self._note_oom(e)
+            raise
         step_timer.stop()
         self.tput_timer.stop(global_step=True)
 
@@ -1108,6 +1150,142 @@ class DeepSpeedTPUEngine:
         trace; ``dstpu plan`` on a dump is the full attribution view."""
         return self.tracer.summary(prefix=prefix)
 
+    # ------------------------------------------------------------------
+    # dsmem: analytic ledger, live watermarks, OOM forensics
+    # ------------------------------------------------------------------
+    def _param_count(self) -> int:
+        """Model parameter count from host-side metadata (leaf shapes —
+        never a device transfer). Under offload_param the device params
+        tuple is empty; count the host masters instead."""
+        if self._param_offload is not None:
+            import math
+            try:
+                return sum(math.prod(leaf.shape)
+                           for leaf in self._param_offload.opt.leaves)
+            except Exception:
+                return 0
+        return sum(int(getattr(x, "size", 0))
+                   for x in jax.tree_util.tree_leaves(self.state.params))
+
+    def memory_ledger(self):
+        """The analytic dsmem plan for THIS engine's config + mesh (see
+        ``deepspeed_tpu/telemetry/memory.py``): per-component bytes and
+        per-phase expected HBM/host watermarks. Activation terms need
+        shape hints the engine cannot infer generically — model states
+        (the dominant preflight term) are exact."""
+        from deepspeed_tpu.telemetry.memory import MemoryLedger
+        return MemoryLedger.from_config(
+            self.config.raw(), num_params=self._param_count(),
+            mesh_shape={str(k): int(v) for k, v in self.mesh.shape.items()})
+
+    def _memory_preflight(self, policy: str) -> None:
+        """Analytic plan vs device ``bytes_limit`` BEFORE training: a plan
+        that cannot fit warns (or raises, ``preflight: refuse``) with the
+        next offload tier instead of dying minutes later in XLA with a
+        RESOURCE_EXHAUSTED. Skipped on backends without allocator stats
+        (CPU: ``memory_stats() is None``)."""
+        from deepspeed_tpu.telemetry.memory import (MemoryPreflightError,
+                                                    preflight)
+        try:
+            ledger = self.memory_ledger()
+        except Exception:
+            logger.exception("dsmem: preflight ledger construction failed")
+            return
+        limit = 0
+        try:
+            for s in self.accelerator.memory_stats().values():
+                limit = max(limit, int(s.get("bytes_limit", 0)))
+        except Exception:
+            pass
+        if not limit:
+            log_dist("dsmem: device reports no bytes_limit (CPU backend?) "
+                     "— analytic preflight skipped", ranks=[0])
+            return
+        verdict = preflight(ledger, limit)
+        if verdict["fits"] and not verdict["tight"]:
+            return
+        sug = verdict.get("suggestion") or {}
+        msg = (f"dsmem preflight: plan needs "
+               f"{verdict['required_bytes'] / 1e9:.2f}GB HBM at the "
+               f"'{verdict['worst_phase']}' watermark vs device limit "
+               f"{limit / 1e9:.2f}GB")
+        if sug:
+            msg += (f"; next tier: {sug['suggestion']} "
+                    f"(overrides: {sug['overrides']})")
+        if not verdict["fits"] and policy == "refuse":
+            raise MemoryPreflightError(msg)
+        log_dist(("WARNING: " if not verdict["fits"]
+                  else "dsmem preflight (tight headroom): ") + msg,
+                 ranks=[0])
+
+    def memory_forensics(self, error: Optional[str] = None,
+                         samples: int = 32) -> Dict[str, Any]:
+        """Everything the OOM diagnostic bundle embeds: the analytic
+        ledger, the last N live samples, per-phase observed watermarks,
+        and plan-vs-observed deltas."""
+        out: Dict[str, Any] = {
+            "error": (error or "")[:2000] or None,
+            "global_steps": self.global_steps,
+        }
+        plan: Dict[str, Any] = {}
+        try:
+            ledger = self.memory_ledger()
+            out["ledger"] = ledger.to_dict()
+            plan = ledger.phase_bytes()
+        except Exception:
+            logger.exception("dsmem: forensics ledger failed")
+        if self._mem_sampler is not None:
+            # one last observation so the bundle carries the dying state
+            try:
+                self._mem_sampler.sample(step=self.global_steps)
+            except Exception:
+                pass
+            wm = self._mem_sampler.watermarks()
+            out["watermarks"] = wm
+            out["samples"] = self._mem_sampler.tail(samples)
+            deltas = {}
+            for phase, obs in wm.items():
+                p = plan.get(phase, {}).get("hbm_bytes")
+                o = obs.get("hbm_peak_bytes") or obs.get("hbm_bytes_in_use")
+                if p and o:
+                    deltas[phase] = round(o / p - 1.0, 4)
+            out["plan_vs_observed_delta_frac"] = deltas
+        return out
+
+    def _note_oom(self, exc: BaseException) -> None:
+        """Dispatch/drain error hook: when the failure classifies as
+        RESOURCE_EXHAUSTED, stamp the timeline and stash the forensics
+        dict on ``engine.last_oom`` (the resilience runner folds it into
+        the diagnostic bundle). Non-OOM errors pass through untouched."""
+        from deepspeed_tpu.telemetry.memory import is_oom_error
+        if not is_oom_error(exc):
+            return
+        self.tracer.instant("mem/oom", cat="mem", step=self.global_steps)
+        self.last_oom = self.memory_forensics(error=str(exc))
+        logger.error("engine: RESOURCE_EXHAUSTED at step %d — memory "
+                     "forensics stashed on engine.last_oom",
+                     self.global_steps)
+
+    def dump_memory_report(self, path: Optional[str] = None
+                           ) -> Dict[str, Any]:
+        """Write (and return) the dsmem report artifact — plan + observed
+        per-phase watermarks — the input of ``bin/dstpu mem`` (tie-out +
+        watermark ratchet vs ``mem_baseline.json``)."""
+        from deepspeed_tpu.telemetry.memory import MemorySampler
+        sampler = self._mem_sampler
+        if sampler is None:
+            sampler = MemorySampler(tracer=self.tracer)
+        if not sampler.samples:
+            sampler.sample(step=self.global_steps)
+        try:
+            ledger = self.memory_ledger()
+        except Exception:
+            logger.exception("dsmem: report ledger failed")
+            ledger = None
+        if path:
+            return sampler.export(path, ledger=ledger)
+        return sampler.report(ledger=ledger)
+
     def start_profile_trace(self, log_dir: str) -> None:
         """Start an XLA/TPU profiler trace (reference: NVTX ranges + torch
         profiler hooks; here jax.profiler writes a TensorBoard-viewable trace
@@ -1194,6 +1372,19 @@ class DeepSpeedTPUEngine:
             return
         self._last_metrics = {"lr": out.lr, "grad_norm": out.grad_norm,
                               "loss": out.loss, "overflow": out.overflow}
+        if self._mem_sampler is not None \
+                and self.config.memory.sample_on_drain:
+            # sync/host-offload paths reach here after the step counter
+            # incremented — derive the phase from it (the fused path set it
+            # at dispatch; offload paths never dispatch through there)
+            self._mem_sampler.phase = ("first_step" if self.global_steps <= 1
+                                       else "steady")
+            if (self.global_steps % self.config.steps_per_print == 0
+                    or not self._mem_sampler.seen(self._mem_sampler.phase)):
+                # the print boundary is sync mode's step-boundary sampling
+                # cadence (already a host-visible boundary), plus each
+                # phase's first step so short runs cover every bucket
+                self._mem_sampler.on_drain(step=self.global_steps)
         if self.monitor and self.monitor.enabled:
             events = self._monitor_step_events(
                 self.global_steps, self.global_samples, out.loss, out.lr,
@@ -1230,8 +1421,16 @@ class DeepSpeedTPUEngine:
         ring, self._metric_ring = self._metric_ring, []
         # the LIVE loss scale rides the same transfer (exact at sync_every=1;
         # for lagged fp16 entries the monitor shows the drain-time scale)
-        with self.tracer.span("engine/drain", cat="train", steps=len(ring)):
-            host, scale = jax.device_get((ring, self.state.loss_scale.scale))
+        try:
+            with self.tracer.span("engine/drain", cat="train",
+                                  steps=len(ring)):
+                host, scale = jax.device_get((ring,
+                                              self.state.loss_scale.scale))
+        except Exception as e:
+            # execution-time OOM of an async step surfaces HERE, at the
+            # designated readback — same classify-and-stash contract
+            self._note_oom(e)
+            raise
         now = time.time()
         scale = float(scale)
         entries = [{"step": int(e["step"]), "samples": int(e["samples"]),
@@ -1272,6 +1471,10 @@ class DeepSpeedTPUEngine:
                                len(entries) / window, last["samples"]))
             if events:
                 self.monitor.write_events(events)
+        if self._mem_sampler is not None and self.config.memory.sample_on_drain:
+            # the drain already paid a host sync; the dsmem sample here adds
+            # allocator-stat dict reads only (DS002-registered hook)
+            self._mem_sampler.on_drain(step=last["step"])
         dropped = (len(self._drained_metrics) + len(entries)
                    - self._drained_metrics.maxlen)
         if dropped > 0:
@@ -1604,12 +1807,25 @@ class DeepSpeedTPUEngine:
         checkpoint (every rank participates; reshape-on-load by construction)."""
         # checkpoint boundary = drain boundary: pending deferred metrics land
         # (monitor/timers/guard consumers) before the state is snapshotted
-        with self.tracer.span("ckpt/save", cat="ckpt", step=self.global_steps,
-                              tag=tag or "auto"):
-            self.flush_metrics()
-            from deepspeed_tpu.checkpoint.engine import save_engine_checkpoint
-            return save_engine_checkpoint(self, save_dir, tag=tag,
-                                          client_state=client_state or {})
+        sampler = self._mem_sampler
+        prev_phase = None
+        if sampler is not None:
+            prev_phase = sampler.phase
+            sampler.phase = "ckpt"     # drain-hook samples land in "ckpt"
+        try:
+            with self.tracer.span("ckpt/save", cat="ckpt",
+                                  step=self.global_steps, tag=tag or "auto"):
+                self.flush_metrics()
+                from deepspeed_tpu.checkpoint.engine import \
+                    save_engine_checkpoint
+                return save_engine_checkpoint(self, save_dir, tag=tag,
+                                              client_state=client_state or {})
+        finally:
+            if sampler is not None:
+                # the save-time watermark (stage-3 gather buffers, orbax
+                # staging) is the "ckpt" phase's ledger counterpart
+                sampler.sample(step=self.global_steps)
+                sampler.phase = prev_phase
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True):

@@ -71,6 +71,13 @@ class ServingMetrics:
         self.inflight = 0
         self.kv_occupancy = 0.0
         self.kv_occupancy_peak = 0.0
+        # projected-vs-observed KV reconciliation (dsmem satellite):
+        # projected = admission control's worst-case byte sum, observed =
+        # blocks the engine actually reserved; drift events count the
+        # >10% divergence EDGES (episodes, not ticks)
+        self.kv_projected_bytes = 0
+        self.kv_observed_bytes = 0
+        self.kv_drift_events = 0
         # rolling throughput
         self.token_rate = RateTracker(window_s=rate_window_s)
         self.request_rate = RateTracker(window_s=rate_window_s)
@@ -120,6 +127,15 @@ class ServingMetrics:
             self.kv_occupancy = kv_occupancy
             self.kv_occupancy_peak = max(self.kv_occupancy_peak, kv_occupancy)
 
+    def set_kv_bytes(self, projected: int, observed: int):
+        with self._lock:
+            self.kv_projected_bytes = int(projected)
+            self.kv_observed_bytes = int(observed)
+
+    def on_kv_drift(self):
+        with self._lock:
+            self.kv_drift_events += 1
+
     # ---- export -----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -136,6 +152,9 @@ class ServingMetrics:
                 "inflight": self.inflight,
                 "kv_occupancy": self.kv_occupancy,
                 "kv_occupancy_peak": self.kv_occupancy_peak,
+                "kv_projected_bytes": self.kv_projected_bytes,
+                "kv_observed_bytes": self.kv_observed_bytes,
+                "kv_drift_events": self.kv_drift_events,
                 "ttft_mean_s": self.ttft.mean(),
                 "ttft_p50_s": self.ttft.quantile(0.5),
                 "ttft_p99_s": self.ttft.quantile(0.99),
@@ -164,7 +183,7 @@ class ServingMetrics:
         counters = {"requests_submitted", "requests_rejected",
                     "requests_completed", "requests_cancelled",
                     "requests_timed_out", "requests_failed",
-                    "tokens_generated", "engine_steps"}
+                    "tokens_generated", "engine_steps", "kv_drift_events"}
         lines = []
         with self._lock:
             summaries = [
@@ -188,6 +207,8 @@ class ServingMetrics:
                     "requests_timed_out", "requests_failed",
                     "tokens_generated", "engine_steps", "queue_depth",
                     "inflight", "kv_occupancy", "kv_occupancy_peak",
+                    "kv_projected_bytes", "kv_observed_bytes",
+                    "kv_drift_events",
                     "tokens_per_sec", "requests_per_sec"):
             full = f"dstpu_serving_{key}"
             kind = "counter" if key in counters else "gauge"
@@ -197,5 +218,8 @@ class ServingMetrics:
         # from the dstrace ring: serve/queued, serve/prefill, serve/decode)
         tracer = get_tracer()
         if tracer.enabled:
-            lines.extend(tracer.prometheus_lines(prefix="serve/"))
+            # ONE call covering both families (serve spans + dsmem memory
+            # tracks): two calls would emit the HELP/TYPE metadata block
+            # twice, which the Prometheus text parser rejects wholesale
+            lines.extend(tracer.prometheus_lines(prefix=("serve/", "mem/")))
         return "\n".join(lines) + "\n"

@@ -93,6 +93,7 @@ class InferenceServer:
         self._draining = False
         self._stopped = False
         self._degraded: Optional[str] = None   # sticky engine-failure reason
+        self._kv_drifted = False   # edge detector for the kv_drift instant
         self._wake = threading.Event()         # submit() nudges the loop
         self._thread: Optional[threading.Thread] = None
 
@@ -315,6 +316,13 @@ class InferenceServer:
         self._reap()
         with self._lock:
             queued, inflight = len(self._queue), len(self._inflight)
+            # the admission model's worst-case projection, re-derived at
+            # tick time over everything still live (same sum submit()
+            # admits against)
+            projected_blocks = (sum(self._blocks_for(r) for r in self._queue)
+                                + sum(self._blocks_for(r)
+                                      for r in self._inflight.values()))
+        self._reconcile_kv(projected_blocks)
         self.metrics.set_gauges(queue_depth=queued, inflight=inflight,
                                 kv_occupancy=self.engine.kv_occupancy())
         every = self.config.monitor_export_every
@@ -324,6 +332,39 @@ class InferenceServer:
             except Exception:
                 logger.exception("serve loop: monitor export failed")
         return worked
+
+    def _reconcile_kv(self, projected_blocks: int) -> None:
+        """Reconcile the projected KV watermark (admission control's model
+        of memory) against what the engine actually reserved — so the
+        model itself is observable: ``kv_projected_bytes`` vs
+        ``kv_observed_bytes`` gauges on ``/metrics``, a ``serve/kv_bytes``
+        counter track on the dstrace timeline, and an edge-triggered
+        ``serve/kv_drift`` instant when they diverge >10% (the projection
+        over-reserving is expected mid-decode; *sustained* divergence
+        means admission is turning work away on memory it actually has).
+        Pure host-int arithmetic — the serve tick stays sync-free."""
+        block_bytes = getattr(self.engine, "kv_block_bytes", None)
+        if block_bytes is None:
+            return
+        bb = block_bytes()
+        projected = projected_blocks * bb
+        observed = self.engine.kv_reserved_blocks() * bb
+        self.metrics.set_kv_bytes(projected, observed)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.counter("serve/kv_bytes", cat="mem",
+                           projected=projected, observed=observed)
+        drifted = (max(projected, observed) > 0
+                   and abs(projected - observed)
+                   / max(projected, observed) > 0.10)
+        if drifted and not self._kv_drifted:
+            self.metrics.on_kv_drift()
+            tracer.instant(
+                "serve/kv_drift", cat="serve",
+                projected_bytes=projected, observed_bytes=observed,
+                drift_frac=round(abs(projected - observed)
+                                 / max(projected, observed), 4))
+        self._kv_drifted = drifted
 
     def _check_membership(self) -> bool:
         """Poll the membership view — the view throttles its own directory

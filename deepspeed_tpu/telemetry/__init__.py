@@ -16,7 +16,10 @@ from deepspeed_tpu.telemetry.tracer import (DEFAULT_CAPACITY,
                                             get_tracer, request_tid)
 __all__ = ["Tracer", "get_tracer", "configure_tracing", "TRACE_ENV",
            "DEFAULT_CAPACITY", "REQUEST_TID_BASE", "request_tid",
-           "analyze_path", "attribute", "events_from_tracer", "load_events"]
+           "analyze_path", "attribute", "events_from_tracer", "load_events",
+           "MemoryLedger", "MemorySampler", "is_oom_error",
+           "estimate_zero2_model_states_mem_needs",
+           "estimate_zero3_model_states_mem_needs"]
 
 #: offline trace replay (``dstpu plan``) — re-exported LAZILY (PEP 562):
 #: every hot-path file imports this package for ``get_tracer``, and the
@@ -26,10 +29,20 @@ __all__ = ["Tracer", "get_tracer", "configure_tracing", "TRACE_ENV",
 _ATTRIBUTION_EXPORTS = ("analyze_path", "attribute", "events_from_tracer",
                         "load_events")
 
+#: dsmem (memory ledger + sampler + OOM classification) — also lazy: the
+#: module is stdlib-only but pulling it into every ``get_tracer`` importer
+#: would be pure dead weight on the hot-path import chain
+_MEMORY_EXPORTS = ("MemoryLedger", "MemorySampler", "is_oom_error",
+                   "estimate_zero2_model_states_mem_needs",
+                   "estimate_zero3_model_states_mem_needs")
+
 
 def __getattr__(name):
     if name in _ATTRIBUTION_EXPORTS:
         from deepspeed_tpu.telemetry import attribution
         return getattr(attribution, name)
+    if name in _MEMORY_EXPORTS:
+        from deepspeed_tpu.telemetry import memory
+        return getattr(memory, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

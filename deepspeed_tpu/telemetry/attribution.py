@@ -347,6 +347,7 @@ def attribute(events: List[Ev], source: str = "<events>") -> Dict[str, Any]:
         "aggregate": aggregate,
         "comm": comm_rollup(events),
         "config_observed": observed_config(events, windows, mode),
+        "memory": memory_observed(events),
     }
     report["proposals"] = propose(report)
     return report
@@ -383,6 +384,54 @@ def comm_rollup(events: List[Ev]) -> Dict[str, Dict[str, Any]]:
         rec["busbw_gbps_mean"] = round(rec.pop("busbw_gbps_sum") / n, 3) \
             if n else None
     return dict(sorted(out.items()))
+
+
+#: dsmem counter names (must match telemetry/memory.py — a literal, not an
+#: import: this module loads standalone by contract)
+_MEM_IN_USE = "mem/hbm_bytes_in_use"
+_MEM_PEAK = "mem/hbm_peak_bytes"
+_MEM_LIMIT = "mem/hbm_bytes_limit"
+
+
+def memory_observed(events: List[Ev]) -> Optional[Dict[str, Any]]:
+    """The dsmem HBM counter tracks, rolled up per device: peak bytes in
+    use, the device limit, and the headroom fraction — the memory input to
+    the proposal rule table (a trace that carries memory counters makes
+    its own case for raising micro_batch or escalating the offload
+    tier). None when the trace has no memory tracks (untraced or a
+    backend without allocator stats)."""
+    devices: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        if e.ph != "C" or not e.args:
+            continue
+        if e.name not in (_MEM_IN_USE, _MEM_PEAK, _MEM_LIMIT):
+            continue
+        for dev, val in e.args.items():
+            try:
+                v = float(val)
+            except (TypeError, ValueError):
+                continue
+            d = devices.setdefault(dev, {"peak_bytes_in_use": 0.0,
+                                         "bytes_limit": 0.0})
+            if e.name == _MEM_LIMIT:
+                d["bytes_limit"] = max(d["bytes_limit"], v)
+            else:          # in-use samples fold into the observed peak too
+                d["peak_bytes_in_use"] = max(d["peak_bytes_in_use"], v)
+    if not devices:
+        return None
+    out: Dict[str, Any] = {"devices": {}}
+    headrooms = []
+    for dev, d in sorted(devices.items()):
+        row = {"peak_bytes_in_use": int(d["peak_bytes_in_use"]),
+               "bytes_limit": int(d["bytes_limit"]),
+               "headroom_frac": None}
+        if d["bytes_limit"] > 0:
+            row["headroom_frac"] = round(
+                1.0 - d["peak_bytes_in_use"] / d["bytes_limit"], 4)
+            headrooms.append(row["headroom_frac"])
+        out["devices"][dev] = row
+    out["min_headroom_frac"] = min(headrooms) if headrooms else None
+    return out
 
 
 def observed_config(events: List[Ev], windows: List[Dict[str, Any]],
@@ -532,8 +581,16 @@ def propose(report: Dict[str, Any]) -> List[Dict[str, Any]]:
                           "current": share("ckpt"),
                           "proposed": share("ckpt") / 2},
         })
+    mem = report.get("memory") or {}
+    headroom = mem.get("min_headroom_frac")
     if share("residual") >= _SHARE_FLOOR["residual"] \
-            and cfg["mode"] == "sync":
+            and cfg["mode"] == "sync" \
+            and (headroom is None or headroom >= 0.10):
+        # the dsmem counter tracks turn "toward the HBM ceiling" from a
+        # guess into a number; under 10% observed headroom the rule yields
+        # to raise_offload_tier below instead of proposing an OOM
+        head_txt = "" if headroom is None else (
+            f" (dsmem observed {headroom:.0%} HBM headroom)")
         props.append({
             "id": "raise_micro_batch",
             "stage": "residual",
@@ -542,11 +599,32 @@ def propose(report: Dict[str, Any]) -> List[Dict[str, Any]]:
             "overrides": {},    # advisory: the absolute mbs is model-bound
             "reason": f"unattributed residual is {share('residual'):.0%} "
                       "of a sync-mode window: the step is device-bound — "
-                      "raise micro_batch toward the HBM ceiling, or drop "
-                      "zero_stage / the offload tier if state headroom "
-                      "allows (run the Autotuner sweep)",
+                      "raise micro_batch toward the HBM ceiling"
+                      f"{head_txt}, or drop zero_stage / the offload tier "
+                      "if state headroom allows (run the Autotuner sweep)",
             "predicted": {"metric": "mfu", "current": None,
-                          "proposed": None},
+                          "proposed": None,
+                          "hbm_headroom_frac": headroom},
+        })
+    if headroom is not None and headroom < 0.05:
+        # memory, not time, is the binding constraint: the run finished
+        # within 5% of the device limit — the next perturbation (longer
+        # seq, one more request, a fragmentation spike) is an OOM. Escalate
+        # the offload ladder one rung (`dstpu mem --preflight` on the
+        # config names the exact tier).
+        props.append({
+            "id": "raise_offload_tier",
+            "stage": "memory",
+            "share": round(1.0 - headroom, 4),
+            "knob": "offload_optimizer",
+            "overrides": {"zero_optimization": {
+                "offload_optimizer": {"device": "cpu"}}},
+            "reason": f"observed HBM peak is within {headroom:.1%} of the "
+                      "device limit: offload optimizer state to host RAM "
+                      "before the next run OOMs (verify the exact tier "
+                      "with `dstpu mem --preflight`)",
+            "predicted": {"metric": "hbm_headroom_frac",
+                          "current": headroom, "proposed": None},
         })
     props.sort(key=lambda p: (-p["share"], p["id"]))
     return props
@@ -683,6 +761,15 @@ def render(report: Dict[str, Any], top_windows: int = 8) -> str:
                 f"{r['algbw_gbps_mean']:.2f}/{r['busbw_gbps_mean']:.2f}"
             out.append(f"  {key:<28} {r['count']:>6} {r['bytes'] / 1e6:>9.2f}"
                        f" {bw}")
+    if report.get("memory"):
+        out.append("")
+        out.append("memory (dsmem counter tracks: peak in-use / limit / "
+                   "headroom)")
+        for dev, d in report["memory"]["devices"].items():
+            head = "-" if d["headroom_frac"] is None \
+                else f"{d['headroom_frac'] * 100:.1f}%"
+            out.append(f"  {dev:<28} {d['peak_bytes_in_use'] / 1e9:>7.2f}GB"
+                       f" {d['bytes_limit'] / 1e9:>7.2f}GB {head:>7}")
     out.append("")
     if report["proposals"]:
         out.append("proposals (dominant stage -> config override):")

@@ -1,0 +1,1002 @@
+"""dsmem — analytic memory ledger, live HBM watermark tracks, OOM forensics.
+
+The memory axis of observability, built on the dstrace idioms (PR 5/7):
+deterministic numbers as proof, checked-in ratchet baselines, dslint-proven
+hot-path cleanliness. Three parts:
+
+1. **MemoryLedger** — an analytic, jax-free memory *plan* computed from
+   engine config + mesh: per-component byte accounting (params / grads /
+   optimizer state by dtype, zero_stage and offload tier; activation-
+   checkpoint working set; KV-cache pages) with per-phase expected
+   watermarks (``init`` / ``first_step`` / ``steady`` / ``ckpt``). The
+   reference ``estimate_zero*_model_states_mem_needs`` APIs are reproduced
+   on top of it.
+2. **MemorySampler** — live device HBM stats (``Device.memory_stats()``:
+   bytes_in_use / peak / limit) plus host RSS, read strictly OFF the hot
+   path (the engine's step-boundary drain hook and an optional background
+   cadence thread) and emitted as Chrome-trace **counter** events
+   (``"ph":"C"``) into the dstrace ring — Perfetto shows HBM/RSS tracks
+   time-aligned with the dispatch/drain/comm spans. Registered in
+   ``tools/dslint/hotpath.py`` so the linter *proves* sampling never adds
+   a host sync to the train/serve paths.
+3. **Tie-out + forensics** — the mem report artifact compares plan vs
+   observed watermarks per phase against a checked-in, workload-scoped
+   ``mem_baseline.json`` (the dslint/plan ratchet contract: regression →
+   exit 1, improvements expired only via ``--write-baseline``); an
+   analytic *preflight* refuses/warns when the plan exceeds
+   ``bytes_limit`` and suggests the next offload tier; and the OOM
+   handlers in the engine and ``FaultTolerantRunner`` turn a
+   RESOURCE_EXHAUSTED into a diagnostic bundle embedding the ledger, the
+   last N memory samples, per-phase deltas, and the trace tail.
+
+Module-level contract: **stdlib-only imports** (mirroring attribution.py)
+so ``bin/dstpu mem`` can file-load this module on jax-less hosts and the
+ledger math is replayable anywhere. The sampler late-imports jax inside
+its collection helpers and takes the tracer as a constructor argument —
+nothing at import time touches the device runtime.
+"""
+
+import argparse
+import collections
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("deepspeed_tpu")
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_UNREADABLE = 2
+
+MEM_REPORT_VERSION = 1
+MEM_BASELINE_VERSION = 1
+MEM_BASELINE_NAME = "mem_baseline.json"
+
+#: ledger/observation phases, in lifecycle order. ``first_step`` exists as
+#: a separate observation bucket because the first step carries compile
+#: workspace the analytic plan does not model; the *plan* values for
+#: first_step and steady are identical by construction.
+PHASES = ("init", "first_step", "steady", "ckpt")
+
+#: counter-event names the sampler emits (and attribution/plan consumes)
+HBM_IN_USE_COUNTER = "mem/hbm_bytes_in_use"
+HBM_PEAK_COUNTER = "mem/hbm_peak_bytes"
+HBM_LIMIT_COUNTER = "mem/hbm_bytes_limit"
+HOST_RSS_COUNTER = "mem/host_rss_bytes"
+KV_BYTES_COUNTER = "serve/kv_bytes"
+
+_DTYPE_BYTES = {
+    None: 4, "fp32": 4, "float32": 4, "fp16": 2, "float16": 2,
+    "bf16": 2, "bfloat16": 2, "fp8": 1, "float8_e4m3fn": 1, "int8": 1,
+}
+
+#: saved-activation working set per layer, as a multiple of one
+#: [micro_batch, seq, hidden] activation in compute dtype. Derived from the
+#: docs/memory_plan.md arithmetic (q + k,v + gate,up + wo/down saves ≈ 7
+#: hidden-sized tensors per layer for the dot-saving policies on a llama
+#: block); boundaries-only policies save one.
+_REMAT_POLICY_FACTOR = {
+    "nothing_saveable": 1.0,
+    "checkpoint_dots": 7.0,
+    "dots_saveable": 7.0,
+    "dots_with_no_batch_dims_saveable": 7.0,
+    "everything_saveable": 12.0,
+    "save_named": 3.0,
+    "offload_dots_to_host": 7.0,       # same saves, host tier (see ledger)
+}
+
+
+class MemoryPreflightError(RuntimeError):
+    """The analytic plan cannot fit the device (``memory.preflight:
+    refuse``): raised at engine init, with the next offload tier in the
+    message, instead of dying minutes later in XLA."""
+
+
+def _dtype_bytes(name) -> int:
+    if isinstance(name, int):
+        return name
+    return _DTYPE_BYTES.get(str(name).lower() if name is not None else None,
+                            4)
+
+
+def is_oom_message(msg: str) -> bool:
+    """OOM classification shared by the engine handler, the resilience
+    runner, and the autotuner (previously three drifting string matches)."""
+    if not msg:
+        return False
+    low = msg.lower()
+    return "resource_exhausted" in low or "out of memory" in low \
+        or "out of host memory" in low
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    return is_oom_message(str(exc))
+
+
+# ---------------------------------------------------------------------------
+# part 1: the analytic ledger
+# ---------------------------------------------------------------------------
+class MemoryLedger:
+    """Analytic per-device memory plan from config-shaped inputs.
+
+    All sizes are **bytes per device**. ``zero_world`` is the ZeRO sharding
+    world (the ``fsdp * fsdp_outer`` mesh span); replicated state divides
+    by 1, sharded state by ``zero_world`` per the configured stage:
+
+      stage 0: params + grads + optimizer state replicated
+      stage 1: optimizer state sharded
+      stage 2: + gradient accumulation buffer sharded
+      stage 3: + parameters sharded
+
+    Offload tiers move bytes to the host column: ``offload_optimizer``
+    moves ``ratio`` of the optimizer state (Twin-Flow partial offload),
+    ``offload_param`` moves the fp32 masters to host and leaves only the
+    streamed layer-group working set in HBM.
+
+    Activation/logits terms need shape hints (``micro_batch`` / ``seq_len``
+    / ``hidden_size`` / ``num_layers`` / ``vocab_size``); without them
+    those components are 0 and ``notes`` records the omission — model
+    states (the preflight's dominant term) never need shapes.
+    """
+
+    def __init__(self, num_params: int,
+                 zero_stage: int = 0,
+                 zero_world: int = 1,
+                 compute_dtype: str = "bf16",
+                 master_dtype: Optional[str] = "fp32",
+                 optimizer_moments: int = 2,
+                 grad_accum_dtype: Optional[str] = None,
+                 offload_optimizer: str = "none",
+                 offload_optimizer_ratio: float = 1.0,
+                 offload_param: str = "none",
+                 layers_per_group: int = 1,
+                 num_layers: Optional[int] = None,
+                 micro_batch: Optional[int] = None,
+                 seq_len: Optional[int] = None,
+                 hidden_size: Optional[int] = None,
+                 vocab_size: Optional[int] = None,
+                 remat_policy: str = "nothing_saveable",
+                 loss_chunked: bool = False,
+                 gather_on_save: bool = True,
+                 kv_bytes: int = 0):
+        self.num_params = int(num_params)
+        self.zero_stage = int(zero_stage)
+        self.zero_world = max(int(zero_world), 1)
+        self.compute_dtype = compute_dtype
+        self.master_dtype = master_dtype
+        self.optimizer_moments = int(optimizer_moments)
+        self.grad_accum_dtype = grad_accum_dtype
+        self.offload_optimizer = offload_optimizer
+        self.offload_optimizer_ratio = min(max(
+            float(offload_optimizer_ratio), 0.0), 1.0)
+        self.offload_param = offload_param
+        self.layers_per_group = max(int(layers_per_group), 1)
+        self.num_layers = num_layers
+        self.micro_batch = micro_batch
+        self.seq_len = seq_len
+        self.hidden_size = hidden_size
+        self.vocab_size = vocab_size
+        self.remat_policy = remat_policy
+        self.loss_chunked = bool(loss_chunked)
+        self.gather_on_save = bool(gather_on_save)
+        self.kv_bytes = int(kv_bytes)
+        self.notes: List[str] = []
+
+    # -- component accounting ----------------------------------------------
+    def components(self) -> Dict[str, Dict[str, int]]:
+        """``{component: {"hbm_bytes", "host_bytes"}}`` — the itemized plan.
+        Components: params, masters, opt_state, grads, activations, logits,
+        kv_cache."""
+        p = self.num_params
+        zw = self.zero_world
+        comp = _dtype_bytes(self.compute_dtype)
+        out: Dict[str, Dict[str, int]] = {}
+        self.notes = []
+
+        param_shard = zw if self.zero_stage >= 3 else 1
+        if self.offload_param != "none":
+            # masters pinned/streamed from the host tier; HBM holds only the
+            # streamed layer-group working set (compute dtype)
+            if self.num_layers:
+                hbm_params = comp * p * self.layers_per_group \
+                    // self.num_layers
+            else:
+                hbm_params = 0
+                self.notes.append(
+                    "offload_param without num_layers: streamed HBM "
+                    "working set unknown, planned as 0")
+            out["params"] = {"hbm_bytes": hbm_params, "host_bytes": 0}
+            out["masters"] = {"hbm_bytes": 0, "host_bytes": 4 * p}
+        else:
+            # the dense path keeps fp32 masters resident (compute-dtype
+            # casts are transient); fp32 compute folds masters into params
+            if self.master_dtype is None or comp == 4:
+                out["params"] = {"hbm_bytes": 4 * p // param_shard,
+                                 "host_bytes": 0}
+                out["masters"] = {"hbm_bytes": 0, "host_bytes": 0}
+            else:
+                out["params"] = {
+                    "hbm_bytes":
+                        _dtype_bytes(self.master_dtype) * p // param_shard,
+                    "host_bytes": 0}
+                out["masters"] = {"hbm_bytes": 0, "host_bytes": 0}
+
+        opt_bytes = self.optimizer_moments * 4 * p \
+            // (zw if self.zero_stage >= 1 else 1)
+        if self.offload_optimizer != "none":
+            host_share = int(opt_bytes * self.offload_optimizer_ratio)
+            out["opt_state"] = {"hbm_bytes": opt_bytes - host_share,
+                                "host_bytes": host_share}
+        else:
+            out["opt_state"] = {"hbm_bytes": opt_bytes, "host_bytes": 0}
+
+        grad_bytes = _dtype_bytes(self.grad_accum_dtype) * p \
+            // (zw if self.zero_stage >= 2 else 1)
+        if self.offload_optimizer != "none" or self.offload_param != "none":
+            # host-optimizer paths accumulate grads host-side per group
+            out["grads"] = {"hbm_bytes": 0, "host_bytes": grad_bytes}
+        else:
+            out["grads"] = {"hbm_bytes": grad_bytes, "host_bytes": 0}
+
+        act = {"hbm_bytes": 0, "host_bytes": 0}
+        if self.micro_batch and self.seq_len and self.hidden_size \
+                and self.num_layers:
+            factor = _REMAT_POLICY_FACTOR.get(self.remat_policy, 1.0)
+            per_layer = int(factor * self.micro_batch * self.seq_len
+                            * self.hidden_size * comp)
+            total = per_layer * self.num_layers
+            if self.remat_policy == "offload_dots_to_host":
+                act = {"hbm_bytes": per_layer, "host_bytes": total}
+            else:
+                act = {"hbm_bytes": total, "host_bytes": 0}
+        else:
+            self.notes.append("activation shapes not provided: "
+                              "activations planned as 0")
+        out["activations"] = act
+
+        logits = 0
+        if self.micro_batch and self.seq_len and self.vocab_size \
+                and not self.loss_chunked:
+            # the log_softmax chain materializes fp32 logits + exp temps
+            logits = 2 * 4 * self.micro_batch * self.seq_len \
+                * self.vocab_size
+        out["logits"] = {"hbm_bytes": logits, "host_bytes": 0}
+        out["kv_cache"] = {"hbm_bytes": self.kv_bytes, "host_bytes": 0}
+        return out
+
+    # -- phase watermarks ---------------------------------------------------
+    def phase_bytes(self) -> Dict[str, Dict[str, int]]:
+        """Expected per-phase watermarks, ``{phase: {"hbm_bytes",
+        "host_bytes"}}``. ``init`` is model state only; ``first_step`` and
+        ``steady`` add the per-step working set (identical by plan — the
+        observed first_step additionally carries compile workspace, which
+        is why they are separate *observation* buckets); ``ckpt`` adds the
+        stage-3 save-time gather buffer."""
+        c = self.components()
+
+        def tot(names, col):
+            return sum(c[n][col] for n in names)
+
+        model_state = ("params", "masters", "opt_state")
+        working = ("grads", "activations", "logits", "kv_cache")
+        init_hbm = tot(model_state, "hbm_bytes")
+        init_host = tot(model_state, "host_bytes")
+        step_hbm = init_hbm + tot(working, "hbm_bytes")
+        step_host = init_host + tot(working, "host_bytes")
+        gather = 0
+        if self.zero_stage >= 3 and self.gather_on_save \
+                and self.offload_param == "none":
+            gather = _dtype_bytes(self.compute_dtype) * self.num_params
+        return {
+            "init": {"hbm_bytes": init_hbm, "host_bytes": init_host},
+            "first_step": {"hbm_bytes": step_hbm, "host_bytes": step_host},
+            "steady": {"hbm_bytes": step_hbm, "host_bytes": step_host},
+            "ckpt": {"hbm_bytes": step_hbm + gather,
+                     "host_bytes": step_host},
+        }
+
+    def max_hbm_bytes(self) -> int:
+        return max(v["hbm_bytes"] for v in self.phase_bytes().values())
+
+    def to_dict(self) -> Dict[str, Any]:
+        comps = self.components()     # also refreshes notes
+        return {
+            "inputs": {
+                "num_params": self.num_params,
+                "zero_stage": self.zero_stage,
+                "zero_world": self.zero_world,
+                "compute_dtype": str(self.compute_dtype),
+                "grad_accum_dtype": self.grad_accum_dtype,
+                "optimizer_moments": self.optimizer_moments,
+                "offload_optimizer": self.offload_optimizer,
+                "offload_optimizer_ratio": self.offload_optimizer_ratio,
+                "offload_param": self.offload_param,
+                "remat_policy": self.remat_policy,
+                "micro_batch": self.micro_batch,
+                "seq_len": self.seq_len,
+                "hidden_size": self.hidden_size,
+                "num_layers": self.num_layers,
+                "vocab_size": self.vocab_size,
+                "kv_bytes": self.kv_bytes,
+            },
+            "components": comps,
+            "phases": self.phase_bytes(),
+            "notes": list(self.notes),
+        }
+
+    # -- construction from the single-JSON config ---------------------------
+    @classmethod
+    def from_config(cls, raw: Dict[str, Any], num_params: int,
+                    mesh_shape: Optional[Dict[str, int]] = None,
+                    **shape_hints) -> "MemoryLedger":
+        """Build the plan from a raw ds-config dict (stdlib-only: reads the
+        JSON keys directly, never the pydantic tree). ``mesh_shape`` is the
+        named-axis mesh (``dict(mesh.shape)``); the ZeRO world is its
+        ``fsdp * fsdp_out`` span."""
+        zc = raw.get("zero_optimization", {}) or {}
+        opt_off = zc.get("offload_optimizer", {}) or {}
+        par_off = zc.get("offload_param", {}) or {}
+        mesh_shape = mesh_shape or raw.get("mesh", {}) or {}
+        zw = int(mesh_shape.get("fsdp", 1) or 1) \
+            * int(mesh_shape.get("fsdp_out",
+                                 mesh_shape.get("fsdp_outer", 1)) or 1)
+        if raw.get("bf16", raw.get("bfloat16", {})).get("enabled"):
+            compute = "bf16"
+        elif raw.get("fp16", {}).get("enabled"):
+            compute = "fp16"
+        else:
+            compute = "fp32"
+        opt_type = (raw.get("optimizer", {}) or {}).get("type", "adamw")
+        moments = 1 if str(opt_type).lower() in ("sgd", "momentum") else 2
+        ac = raw.get("activation_checkpointing", {}) or {}
+        hints = dict(
+            micro_batch=raw.get("train_micro_batch_size_per_gpu"),
+            remat_policy=ac.get("policy", "nothing_saveable"),
+            loss_chunked=bool(raw.get("loss_chunk_size", 0)),
+        )
+        hints.update(shape_hints)
+        return cls(
+            num_params=num_params,
+            zero_stage=int(zc.get("stage", 0) or 0),
+            zero_world=zw,
+            compute_dtype=compute,
+            optimizer_moments=moments,
+            grad_accum_dtype=(raw.get("data_types", {}) or {}
+                              ).get("grad_accum_dtype"),
+            offload_optimizer=opt_off.get("device", "none") or "none",
+            offload_optimizer_ratio=float(opt_off.get("ratio", 1.0) or 1.0),
+            offload_param=par_off.get("device", "none") or "none",
+            layers_per_group=int(par_off.get("layers_per_group", 1) or 1),
+            gather_on_save=bool(zc.get("gather_16bit_weights_on_model_save",
+                                       True)),
+            **hints)
+
+
+# -- reference estimator APIs (deepspeed.runtime.zero.stage_1_and_2 /
+#    stage3 ``estimate_zero*_model_states_mem_needs``) ----------------------
+def estimate_zero2_model_states_mem_needs(
+        total_params: int, num_gpus_per_node: int = 1, num_nodes: int = 1,
+        cpu_offload: bool = True,
+        additional_buffer_factor: float = 1.5) -> Tuple[int, int]:
+    """Reference-shaped ZeRO-2 estimator: returns ``(device_bytes,
+    host_bytes)`` per device. With offload the device keeps only the
+    fp16/bf16 params (2 bytes/param) and the host carries masters + Adam
+    moments (+ the reference's buffer factor); without it the device adds
+    the 16-bytes/param optimizer block sharded over the world."""
+    world = max(num_gpus_per_node * num_nodes, 1)
+    p = int(total_params)
+    if cpu_offload:
+        gpu = 2 * p
+        cpu = int(p * max(4 * world, 16) * additional_buffer_factor)
+    else:
+        gpu = 4 * p + 16 * p // world
+        cpu = int(p * 4 * num_gpus_per_node * additional_buffer_factor)
+    return gpu, cpu
+
+
+def estimate_zero3_model_states_mem_needs(
+        total_params: int, largest_layer_params: int = 0,
+        num_gpus_per_node: int = 1, num_nodes: int = 1,
+        cpu_offload: bool = True, cpu_offload_params: bool = False,
+        additional_buffer_factor: float = 1.5) -> Tuple[int, int]:
+    """Reference-shaped ZeRO-3 estimator (``(device_bytes, host_bytes)``):
+    stage 3 shards everything, so the device floor is the largest layer's
+    gathered params; offload tiers move the 16-18 bytes/param state host-
+    side."""
+    world = max(num_gpus_per_node * num_nodes, 1)
+    p = int(total_params)
+    largest = 4 * int(largest_layer_params)
+    if cpu_offload:
+        if cpu_offload_params:
+            gpu = largest
+            cpu = int(p * max(4 * world, 18 // max(num_nodes, 1))
+                      * additional_buffer_factor)
+        else:
+            gpu = largest + 2 * p // world
+            cpu = int(p * max(4 * world, 16 // max(num_nodes, 1))
+                      * additional_buffer_factor)
+    else:
+        gpu = largest + 18 * p // world
+        cpu = int(4 * largest_layer_params * num_gpus_per_node
+                  * additional_buffer_factor)
+    return gpu, cpu
+
+
+# ---------------------------------------------------------------------------
+# part 2: the live sampler
+# ---------------------------------------------------------------------------
+class MemorySampler:
+    """Bounded-window device-HBM + host-RSS sampler feeding the dstrace
+    ring as Chrome-trace counter tracks.
+
+    Strictly off the hot path: the engine calls ``on_drain`` at the async
+    ring's designated drain (the step boundary that already host-syncs)
+    and at ``steps_per_print`` boundaries in sync mode; ``start()`` adds a
+    background cadence thread for long idle/serve stretches. Both entry
+    points are DS002-registered (``tools/dslint/hotpath.py``) so the
+    linter proves sampling never grows a device sync — collection is
+    allocator-stat dict reads and one ``/proc`` line, never a transfer.
+
+    On backends without allocator stats (CPU: ``memory_stats() is None``)
+    the device series are empty and host RSS still tracks."""
+
+    def __init__(self, tracer=None, window: int = 512,
+                 devices_fn: Optional[Callable[[], List[Any]]] = None):
+        self._tracer = tracer
+        #: deque append/iteration is GIL-atomic — the cadence thread and the
+        #: drain hook never contend on a lock for the common path
+        self.samples: collections.deque = collections.deque(
+            maxlen=max(int(window), 8))
+        self.phase = "init"
+        self._lock = threading.Lock()          # phase_peaks merges only
+        self._phase_peaks: Dict[str, Dict[str, int]] = {}
+        self._devices_fn = devices_fn
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._page_size = 4096
+        try:
+            self._page_size = os.sysconf("SC_PAGE_SIZE")
+        except (ValueError, OSError, AttributeError):
+            pass
+
+    # -- collection (registered hot path: must never device-sync) ----------
+    def _collect(self) -> Dict[str, Any]:
+        devices: Dict[str, Dict[str, int]] = {}
+        try:
+            if self._devices_fn is not None:
+                devs = self._devices_fn()
+            else:
+                import jax                      # late: module stays jax-free
+                devs = jax.local_devices()
+            for d in devs:
+                stats = d.memory_stats()
+                if stats:
+                    devices[str(d)] = {
+                        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                        "peak_bytes_in_use":
+                            int(stats.get("peak_bytes_in_use", 0)),
+                        "bytes_limit": int(stats.get("bytes_limit", 0)),
+                    }
+        except Exception:                       # stats are best-effort
+            pass
+        rss = 0
+        try:
+            with open(f"/proc/{os.getpid()}/statm") as f:
+                rss = int(f.read().split()[1]) * self._page_size
+        except (OSError, ValueError, IndexError):
+            pass
+        return {"ts": time.time(), "phase": self.phase,
+                "devices": devices, "host_rss_bytes": rss}
+
+    def sample(self, step: Optional[int] = None,
+               phase: Optional[str] = None) -> Dict[str, Any]:
+        """One observation: collect, fold into the per-phase watermarks,
+        and emit counter events (when a tracer is attached and enabled)."""
+        if phase is not None:
+            self.phase = phase
+        s = self._collect()
+        if step is not None:
+            s["step"] = int(step)
+        self.samples.append(s)
+        with self._lock:
+            peaks = self._phase_peaks.setdefault(
+                s["phase"], {"hbm_bytes_in_use": 0, "hbm_peak_bytes": 0,
+                             "host_rss_bytes": 0, "samples": 0})
+            peaks["samples"] += 1
+            for d in s["devices"].values():
+                if d["bytes_in_use"] > peaks["hbm_bytes_in_use"]:
+                    peaks["hbm_bytes_in_use"] = d["bytes_in_use"]
+                if d["peak_bytes_in_use"] > peaks["hbm_peak_bytes"]:
+                    peaks["hbm_peak_bytes"] = d["peak_bytes_in_use"]
+            if s["host_rss_bytes"] > peaks["host_rss_bytes"]:
+                peaks["host_rss_bytes"] = s["host_rss_bytes"]
+        tr = self._tracer
+        if tr is not None and tr.enabled:
+            if s["devices"]:
+                tr.counter(HBM_IN_USE_COUNTER, cat="mem",
+                           **{k: v["bytes_in_use"]
+                              for k, v in s["devices"].items()})
+                tr.counter(HBM_PEAK_COUNTER, cat="mem",
+                           **{k: v["peak_bytes_in_use"]
+                              for k, v in s["devices"].items()})
+                tr.counter(HBM_LIMIT_COUNTER, cat="mem",
+                           **{k: v["bytes_limit"]
+                              for k, v in s["devices"].items()})
+            if s["host_rss_bytes"]:
+                tr.counter(HOST_RSS_COUNTER, cat="mem",
+                           rss=s["host_rss_bytes"])
+        return s
+
+    def seen(self, phase: str) -> bool:
+        """Whether ``phase`` has at least one observation (dict membership
+        — GIL-atomic, safe from the hot path): the engine's sync-mode hook
+        samples each phase's FIRST step even off the print boundary, so
+        short runs still populate every lifecycle bucket."""
+        return phase in self._phase_peaks
+
+    def on_drain(self, step: Optional[int] = None) -> None:
+        """The engine's step-boundary hook (called from the designated
+        drain / the sync-mode print boundary — points that already pay a
+        host sync, so sampling here adds zero new synchronization)."""
+        self.sample(step=step)
+
+    # -- background cadence -------------------------------------------------
+    def start(self, cadence_s: float) -> "MemorySampler":
+        if self._thread is not None:
+            return self
+        cadence_s = max(float(cadence_s), 0.05)
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(cadence_s):
+                try:
+                    self.sample()
+                except Exception:
+                    logger.exception("dsmem: background sample failed")
+
+        self._thread = threading.Thread(target=_loop, name="dstpu-mem",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    # -- read side -----------------------------------------------------------
+    def watermarks(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._phase_peaks.items()}
+
+    def tail(self, n: int = 32) -> List[Dict[str, Any]]:
+        return list(self.samples)[-max(int(n), 0):]
+
+    def bytes_limit(self) -> int:
+        """Largest per-device ``bytes_limit`` seen (0 when the backend has
+        no allocator stats)."""
+        limit = 0
+        for s in self.samples:
+            for d in s["devices"].values():
+                if d["bytes_limit"] > limit:
+                    limit = d["bytes_limit"]
+        return limit
+
+    def report(self, ledger: Optional[MemoryLedger] = None,
+               source: str = "<live>") -> Dict[str, Any]:
+        """The mem report artifact ``dstpu mem`` consumes: plan (when a
+        ledger is given) + observed per-phase watermarks + latest device
+        stats."""
+        last_devices: Dict[str, Dict[str, int]] = {}
+        for s in self.samples:
+            if s["devices"]:
+                last_devices = s["devices"]
+        return {
+            "version": MEM_REPORT_VERSION,
+            "source": source,
+            "bytes_limit": self.bytes_limit(),
+            "plan": ledger.to_dict() if ledger is not None else None,
+            "observed": {"phases": self.watermarks(),
+                         "num_samples": len(self.samples)},
+            "devices": last_devices,
+        }
+
+    def export(self, path: str, ledger: Optional[MemoryLedger] = None,
+               source: Optional[str] = None) -> Dict[str, Any]:
+        rep = self.report(ledger=ledger,
+                          source=source or os.path.basename(path))
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(rep, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return rep
+
+
+# ---------------------------------------------------------------------------
+# part 3a: plan-vs-observed tie-out
+# ---------------------------------------------------------------------------
+def tie_out(report: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-phase plan-vs-observed rows. ``delta_frac`` is observed/plan - 1
+    (positive = the plan under-estimated). Rows without both sides carry
+    None deltas — informational, never a verdict (the ratchet baseline is
+    the deterministic gate)."""
+    plan = (report.get("plan") or {}).get("phases", {})
+    observed = (report.get("observed") or {}).get("phases", {})
+    rows = []
+    for phase in PHASES:
+        p = plan.get(phase, {}).get("hbm_bytes")
+        o = observed.get(phase, {}).get("hbm_peak_bytes")
+        if o in (None, 0):
+            o = observed.get(phase, {}).get("hbm_bytes_in_use")
+        delta = None
+        if p and o:
+            delta = round(o / p - 1.0, 4)
+        rows.append({"phase": phase, "plan_hbm_bytes": p,
+                     "observed_hbm_bytes": o, "delta_frac": delta,
+                     "observed_host_rss_bytes":
+                         observed.get(phase, {}).get("host_rss_bytes")})
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# part 3b: the ratchet baseline (dslint/plan idiom)
+# ---------------------------------------------------------------------------
+#: baseline metrics per phase — device watermark and host RSS watermark
+_BASELINE_METRICS = ("hbm_peak_bytes", "host_rss_bytes")
+
+
+def load_mem_baseline(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != MEM_BASELINE_VERSION:
+        raise ValueError(f"unsupported mem baseline version "
+                         f"{data.get('version')!r} in {path} "
+                         f"(expected {MEM_BASELINE_VERSION})")
+    return data
+
+
+def find_mem_baseline(start: str) -> Optional[str]:
+    """Walk up from ``start`` for the checked-in baseline (dslint/plan
+    discovery rule — anchored at the artifact, never the cwd)."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, MEM_BASELINE_NAME)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def write_mem_baseline(path: str, report: Dict[str, Any],
+                       tolerance: float = 1.25,
+                       min_abs_bytes: int = 1 << 20) -> dict:
+    """Record the report's observed per-phase watermarks as the baseline.
+    ``workload`` (the report's source basename) scopes discovered
+    baselines exactly like the plan ledger's."""
+    phases = (report.get("observed") or {}).get("phases", {})
+    data = {
+        "version": MEM_BASELINE_VERSION,
+        "workload": os.path.basename(str(report.get("source", ""))),
+        "tolerance": float(tolerance),
+        "min_abs_bytes": int(min_abs_bytes),
+        "entries": {
+            phase: {m: int(phases[phase].get(m, 0))
+                    for m in _BASELINE_METRICS}
+            for phase in PHASES if phase in phases},
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def check_mem_baseline(report: Dict[str, Any], baseline: dict,
+                       tolerance: Optional[float] = None
+                       ) -> Tuple[List[dict], List[dict]]:
+    """``(regressions, stale)``. A phase REGRESSES when its observed
+    watermark exceeds baseline * tolerance AND by more than the absolute
+    floor; it is STALE when it improved past the same margin (expire via
+    ``--write-baseline`` — the ratchet)."""
+    tol = float(tolerance if tolerance is not None
+                else baseline.get("tolerance", 1.25))
+    floor = int(baseline.get("min_abs_bytes", 1 << 20))
+    phases = (report.get("observed") or {}).get("phases", {})
+    regressions, stale = [], []
+    for phase, entry in sorted(baseline.get("entries", {}).items()):
+        obs = phases.get(phase)
+        if obs is None:
+            continue
+        for metric in _BASELINE_METRICS:
+            base = int(entry.get(metric, 0))
+            cur = int(obs.get(metric, 0))
+            row = {"phase": phase, "metric": metric,
+                   "baseline_bytes": base, "current_bytes": cur,
+                   "ratio": round(cur / base, 3) if base > 0 else None}
+            if cur > base * tol and (cur - base) > floor:
+                regressions.append(row)
+            elif base > cur * tol and (base - cur) > floor:
+                stale.append(row)
+    return regressions, stale
+
+
+# ---------------------------------------------------------------------------
+# part 3c: preflight
+# ---------------------------------------------------------------------------
+#: the offload escalation ladder preflight suggests from, in order: each
+#: entry is (predicate over ledger, suggestion text, config override)
+def next_offload_tier(ledger: MemoryLedger) -> Optional[Dict[str, Any]]:
+    """The next rung of the offload ladder for a plan that does not fit:
+    shard harder first (free), then optimizer offload, then param offload,
+    then NVMe — the ZeRO-Offload escalation order."""
+    if ledger.zero_stage < 1 and ledger.zero_world > 1:
+        return {"suggestion": "shard optimizer state over the fsdp axis "
+                              "(free HBM, no host traffic)",
+                "overrides": {"zero_optimization": {"stage": 1}}}
+    if ledger.zero_stage < 3 and ledger.zero_world > 1:
+        return {"suggestion": f"raise zero_stage {ledger.zero_stage} -> 3 "
+                              "(shard params + grads over the fsdp axis)",
+                "overrides": {"zero_optimization": {"stage": 3}}}
+    if ledger.offload_optimizer == "none":
+        return {"suggestion": "offload optimizer state to host RAM "
+                              "(ZeRO-Offload tier: frees "
+                              f"{ledger.optimizer_moments * 4}"
+                              " bytes/param of HBM)",
+                "overrides": {"zero_optimization": {
+                    "offload_optimizer": {"device": "cpu"}}}}
+    if ledger.offload_param == "none":
+        return {"suggestion": "stream params from host RAM "
+                              "(offload_param: cpu — ZeRO-Infinity tier)",
+                "overrides": {"zero_optimization": {
+                    "offload_param": {"device": "cpu"}}}}
+    if "nvme" not in (ledger.offload_optimizer, ledger.offload_param):
+        return {"suggestion": "swap masters+moments to NVMe "
+                              "(offload_*.device: nvme)",
+                "overrides": {"zero_optimization": {
+                    "offload_optimizer": {"device": "nvme"}}}}
+    return None
+
+
+def preflight(ledger: MemoryLedger, bytes_limit: int,
+              headroom_frac: float = 0.05) -> Dict[str, Any]:
+    """Plan vs device limit, before any allocation: ``fits`` is the hard
+    verdict, ``tight`` flags under-headroom plans, ``suggestion`` is the
+    next offload tier when the plan must shrink."""
+    phases = ledger.phase_bytes()
+    worst_phase = max(PHASES, key=lambda ph: phases[ph]["hbm_bytes"])
+    need = phases[worst_phase]["hbm_bytes"]
+    out: Dict[str, Any] = {
+        "bytes_limit": int(bytes_limit),
+        "required_bytes": need,
+        "worst_phase": worst_phase,
+        "fits": not bytes_limit or need <= bytes_limit,
+        "tight": bool(bytes_limit)
+        and need > bytes_limit * (1.0 - headroom_frac)
+        and need <= bytes_limit,
+        "suggestion": None,
+    }
+    if bytes_limit and (not out["fits"] or out["tight"]):
+        out["suggestion"] = next_offload_tier(ledger)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI — ``bin/dstpu mem``
+# ---------------------------------------------------------------------------
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "-"
+    n = int(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.2f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return str(n)
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    out = [f"dstpu mem — {report.get('source')}"]
+    limit = report.get("bytes_limit") or 0
+    out.append(f"bytes_limit: {_fmt_bytes(limit) if limit else 'unknown'}")
+    out.append("")
+    out.append(f"{'phase':<12} {'plan HBM':>12} {'observed HBM':>14} "
+               f"{'delta':>8} {'host RSS':>12}")
+    out.append("-" * 62)
+    for row in tie_out(report):
+        delta = "-" if row["delta_frac"] is None \
+            else f"{row['delta_frac'] * 100:+.1f}%"
+        out.append(f"{row['phase']:<12} "
+                   f"{_fmt_bytes(row['plan_hbm_bytes']):>12} "
+                   f"{_fmt_bytes(row['observed_hbm_bytes']):>14} "
+                   f"{delta:>8} "
+                   f"{_fmt_bytes(row['observed_host_rss_bytes']):>12}")
+    plan = report.get("plan")
+    if plan:
+        out.append("")
+        out.append("plan components (HBM / host):")
+        for name, c in plan.get("components", {}).items():
+            out.append(f"  {name:<14} {_fmt_bytes(c['hbm_bytes']):>12} "
+                       f"{_fmt_bytes(c['host_bytes']):>12}")
+        for note in plan.get("notes", []):
+            out.append(f"  note: {note}")
+    return "\n".join(out)
+
+
+def _load_report(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ValueError(f"unreadable mem report {path}: {e}")
+    if not isinstance(data, dict) or "observed" not in data:
+        raise ValueError(f"not a mem report (no 'observed' section): {path}")
+    return data
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu mem",
+        description="memory ledger tie-out, watermark ratchet, and "
+                    "analytic preflight (artifact: engine."
+                    "dump_memory_report / MemorySampler.export)")
+    parser.add_argument("artifact", nargs="?", default=None,
+                        help="mem report JSON (plan + observed watermarks)")
+    parser.add_argument("--preflight", metavar="CONFIG",
+                        help="analytic-only mode: build the ledger from "
+                             "this ds-config JSON and check it against "
+                             "--bytes-limit (exit 1 when it cannot fit)")
+    parser.add_argument("--params", type=int, default=0,
+                        help="model parameter count for --preflight")
+    parser.add_argument("--bytes-limit", type=int, default=0,
+                        help="per-device HBM limit for --preflight "
+                             "(default: the artifact's recorded limit)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"mem baseline path (default: walk up from "
+                             f"the artifact for {MEM_BASELINE_NAME})")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record this report's watermarks as the new "
+                             "baseline (ratchet: how stale entries expire)")
+    parser.add_argument("--tolerance", type=float, default=None,
+                        help="regression factor vs baseline (default: the "
+                             "factor stored in the baseline, 1.25 fresh)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the report (+ verdicts) as JSON")
+    args = parser.parse_args(argv)
+
+    if args.preflight:
+        return _preflight_main(args)
+    if not args.artifact:
+        parser.error("an artifact path (or --preflight CONFIG) is required")
+
+    try:
+        report = _load_report(args.artifact)
+    except ValueError as e:
+        print(f"dstpu mem: {e}", file=sys.stderr)
+        return EXIT_UNREADABLE
+
+    bl_path = args.baseline or find_mem_baseline(args.artifact)
+    regressions, stale = [], []
+    effective_tol = args.tolerance if args.tolerance is not None else 1.25
+    if args.write_baseline:
+        target = bl_path or os.path.join(
+            os.path.dirname(os.path.abspath(args.artifact)),
+            MEM_BASELINE_NAME)
+        if args.tolerance is None and os.path.exists(target):
+            try:      # ratchet rewrite keeps the stored factor
+                effective_tol = float(load_mem_baseline(target)
+                                      .get("tolerance", 1.25))
+            except (OSError, ValueError):
+                pass
+        write_mem_baseline(target, report, tolerance=effective_tol)
+        print(f"# mem baseline written -> {target}", file=sys.stderr)
+        bl_path = target
+    elif bl_path:
+        try:
+            baseline = load_mem_baseline(bl_path)
+        except (OSError, ValueError) as e:
+            print(f"dstpu mem: bad baseline {bl_path}: {e}", file=sys.stderr)
+            return EXIT_UNREADABLE
+        bl_workload = baseline.get("workload")
+        workload = os.path.basename(str(report.get("source", "")))
+        if args.baseline is None and bl_workload \
+                and bl_workload != workload:
+            # discovered baseline of ANOTHER workload: its watermarks say
+            # nothing about this run — note, don't fabricate a verdict
+            print(f"# note: discovered baseline {bl_path} is for workload "
+                  f"{bl_workload!r}, not {workload!r} — comparison skipped "
+                  "(pass --baseline to compare anyway, or --write-baseline "
+                  "to start ratcheting this workload)", file=sys.stderr)
+            bl_path = None
+        else:
+            regressions, stale = check_mem_baseline(
+                report, baseline, tolerance=args.tolerance)
+            effective_tol = args.tolerance if args.tolerance is not None \
+                else float(baseline.get("tolerance", 1.25))
+    report["baseline"] = {"path": bl_path, "regressions": regressions,
+                          "stale": stale}
+
+    # informational preflight against the recorded limit: a plan that no
+    # longer fits the device it ran on deserves a loud line even when the
+    # ratchet is quiet
+    plan_pf = None
+    if report.get("plan") and (args.bytes_limit
+                               or report.get("bytes_limit")):
+        inputs = report["plan"].get("inputs", {})
+        phases = report["plan"].get("phases", {})
+        limit = args.bytes_limit or report["bytes_limit"]
+        need = max((v.get("hbm_bytes", 0) for v in phases.values()),
+                   default=0)
+        plan_pf = {"bytes_limit": limit, "required_bytes": need,
+                   "fits": need <= limit}
+        report["preflight"] = plan_pf
+        if not plan_pf["fits"]:
+            print(f"WARNING: plan needs {_fmt_bytes(need)} HBM but the "
+                  f"device limit is {_fmt_bytes(limit)} — run "
+                  f"`dstpu mem --preflight` on the config for the next "
+                  f"offload tier (inputs: {json.dumps(inputs)})",
+                  file=sys.stderr)
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_report(report))
+        for r in regressions:
+            ratio = "new watermark" if r["ratio"] is None \
+                else f"{r['ratio']}x"
+            print(f"REGRESSION: {r['phase']} {r['metric']} "
+                  f"{_fmt_bytes(r['baseline_bytes'])} -> "
+                  f"{_fmt_bytes(r['current_bytes'])} "
+                  f"({ratio}, tolerance {effective_tol}x) "
+                  f"vs {bl_path}", file=sys.stderr)
+        for r in stale:
+            print(f"stale baseline entry (improved): {r['phase']} "
+                  f"{r['metric']} {_fmt_bytes(r['baseline_bytes'])} -> "
+                  f"{_fmt_bytes(r['current_bytes'])} — re-run with "
+                  "--write-baseline to ratchet", file=sys.stderr)
+    return EXIT_REGRESSION if regressions else EXIT_OK
+
+
+def _preflight_main(args) -> int:
+    try:
+        with open(args.preflight) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"dstpu mem: unreadable config {args.preflight}: {e}",
+              file=sys.stderr)
+        return EXIT_UNREADABLE
+    ledger = MemoryLedger.from_config(raw, num_params=args.params)
+    verdict = preflight(ledger, args.bytes_limit)
+    out = {"ledger": ledger.to_dict(), "preflight": verdict}
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"plan: {_fmt_bytes(verdict['required_bytes'])} HBM at the "
+              f"'{verdict['worst_phase']}' watermark"
+              + (f" vs limit {_fmt_bytes(verdict['bytes_limit'])}"
+                 if verdict["bytes_limit"] else " (no --bytes-limit given)"))
+        if not verdict["fits"]:
+            print("verdict: DOES NOT FIT", file=sys.stderr)
+        elif verdict["tight"]:
+            print("verdict: fits, but under 5% headroom", file=sys.stderr)
+        else:
+            print("verdict: fits")
+        sug = verdict.get("suggestion")
+        if sug:
+            print(f"suggestion: {sug['suggestion']}\n  overrides: "
+                  f"{json.dumps(sug['overrides'])}", file=sys.stderr)
+    return EXIT_OK if verdict["fits"] else EXIT_REGRESSION
+
+
+if __name__ == "__main__":
+    sys.exit(main())

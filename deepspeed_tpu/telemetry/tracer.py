@@ -201,6 +201,19 @@ class Tracer:
             except Exception:
                 logger.exception("dstrace: instant sink failed")
 
+    def counter(self, name: str, cat: str = "mem",
+                tid: Optional[int] = None, **series) -> None:
+        """A Chrome-trace counter sample (``"ph":"C"``): ``series`` maps
+        series label -> numeric value, rendered by Perfetto as a stacked
+        counter track time-aligned with the spans (the dsmem HBM/RSS/KV
+        watermark tracks). Same hot-path contract as ``instant``: one
+        append, no locks, no I/O, no device touch."""
+        if not self.enabled or not series:
+            return
+        self._emit(name, cat, "C", time.monotonic(), 0.0,
+                   tid if tid is not None else threading.get_ident(),
+                   series)
+
     def complete(self, name: str, dur_s: float, cat: str = "host",
                  end_ts: Optional[float] = None, tid: Optional[int] = None,
                  **args) -> None:
@@ -274,7 +287,12 @@ class Tracer:
                 ev["dur"] = round(dur * 1e6, 3)
             elif ph == "i":
                 ev["s"] = "t"          # thread-scoped instant
-            ev["args"] = dict(args, id=eid) if args else {"id": eid}
+            if ph == "C":
+                # counter events: args ARE the series values (adding the
+                # event id would draw a bogus monotonically-rising series)
+                ev["args"] = dict(args) if args else {}
+            else:
+                ev["args"] = dict(args, id=eid) if args else {"id": eid}
             trace_events.append(ev)
         meta = [{"name": "process_name", "ph": "M", "pid": pid,
                  "args": {"name": "deepspeed_tpu"}}]
@@ -313,7 +331,8 @@ class Tracer:
     def summary(self, prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
         """Per-span-name aggregate over the ring's complete events:
         count / total_s / mean_s / max_s / p50_s / p95_s / p99_s.
-        ``prefix`` filters span names (e.g. ``"serve/"``)."""
+        ``prefix`` filters span names (e.g. ``"serve/"``; a tuple of
+        prefixes matches any — ``str.startswith`` semantics)."""
         buckets: Dict[str, List[float]] = {}
         for e in self.events_snapshot():
             if e[_PH] != "X":
@@ -348,23 +367,67 @@ class Tracer:
             out[name] = out.get(name, 0) + 1
         return out
 
+    def counter_series(self, prefix: Optional[str] = None
+                       ) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """Per-counter per-series aggregate over the ring's "C" events:
+        ``{counter: {series: {"last", "max", "count"}}}`` — the read side
+        of the dsmem HBM/RSS/KV tracks (events are id-ordered, so "last"
+        is the newest sample)."""
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for e in sorted(self.events_snapshot(), key=lambda e: e[_EID]):
+            if e[_PH] != "C" or not e[_ARGS]:
+                continue
+            name = e[_NAME]
+            if prefix and not name.startswith(prefix):
+                continue
+            bucket = out.setdefault(name, {})
+            for series, value in e[_ARGS].items():
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    continue
+                s = bucket.setdefault(series,
+                                      {"last": 0.0, "max": 0.0, "count": 0})
+                s["last"] = v
+                if v > s["max"]:
+                    s["max"] = v
+                s["count"] += 1
+        return out
+
     def prometheus_lines(self, prefix: Optional[str] = None) -> List[str]:
-        """Prometheus summary exposition of the span aggregates (the
-        serving ``/metrics`` endpoint appends these for ``serve/*``)."""
+        """Prometheus exposition of the span aggregates plus counter-track
+        gauges (the serving ``/metrics`` endpoint appends these for
+        ``serve/*`` and ``mem/*``)."""
+        lines: List[str] = []
         summ = self.summary(prefix=prefix)
-        if not summ:
-            return []
-        lines = ["# HELP dstpu_trace_span_seconds tracer span durations",
-                 "# TYPE dstpu_trace_span_seconds summary"]
-        for name in sorted(summ):
-            s = summ[name]
-            for q, key in ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s")):
-                lines.append(f'dstpu_trace_span_seconds{{span="{name}",'
-                             f'quantile="{q}"}} {s[key]:.9g}')
-            lines.append(f'dstpu_trace_span_seconds_sum{{span="{name}"}} '
-                         f'{s["total_s"]:.9g}')
-            lines.append(f'dstpu_trace_span_seconds_count{{span="{name}"}} '
-                         f'{int(s["count"])}')
+        if summ:
+            lines += ["# HELP dstpu_trace_span_seconds tracer span durations",
+                      "# TYPE dstpu_trace_span_seconds summary"]
+            for name in sorted(summ):
+                s = summ[name]
+                for q, key in ((0.5, "p50_s"), (0.95, "p95_s"),
+                               (0.99, "p99_s")):
+                    lines.append(f'dstpu_trace_span_seconds{{span="{name}",'
+                                 f'quantile="{q}"}} {s[key]:.9g}')
+                lines.append(f'dstpu_trace_span_seconds_sum{{span="{name}"}} '
+                             f'{s["total_s"]:.9g}')
+                lines.append(
+                    f'dstpu_trace_span_seconds_count{{span="{name}"}} '
+                    f'{int(s["count"])}')
+        counters = self.counter_series(prefix=prefix)
+        if counters:
+            lines += ["# HELP dstpu_trace_counter tracer counter tracks "
+                      "(last/peak per series)",
+                      "# TYPE dstpu_trace_counter gauge"]
+            for name in sorted(counters):
+                for series in sorted(counters[name]):
+                    s = counters[name][series]
+                    lines.append(f'dstpu_trace_counter{{counter="{name}",'
+                                 f'series="{series}",stat="last"}} '
+                                 f'{s["last"]:.9g}')
+                    lines.append(f'dstpu_trace_counter{{counter="{name}",'
+                                 f'series="{series}",stat="max"}} '
+                                 f'{s["max"]:.9g}')
         return lines
 
 

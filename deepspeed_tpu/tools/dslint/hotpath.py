@@ -115,7 +115,7 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
     HotPathSpec(
         path="deepspeed_tpu/telemetry/tracer.py",
         cls="Tracer",
-        hot_functions=("span", "instant", "complete", "_emit"),
+        hot_functions=("span", "instant", "complete", "counter", "_emit"),
     ),
     HotPathSpec(
         path="deepspeed_tpu/telemetry/tracer.py",
@@ -136,6 +136,17 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         path="deepspeed_tpu/resilience/membership.py",
         cls="Heartbeat",
         hot_functions=("note_op",),
+    ),
+    # the dsmem sampler's entry points: ``on_drain`` is called from the
+    # engine's designated drain / sync print boundary (points that already
+    # host-sync by design) and ``sample`` from the background cadence
+    # thread — registering collection here PROVES memory observability
+    # never adds a device sync of its own: it reads allocator-stat dicts
+    # and one /proc line, never a transfer or a float() coercion
+    HotPathSpec(
+        path="deepspeed_tpu/telemetry/memory.py",
+        cls="MemorySampler",
+        hot_functions=("on_drain", "sample", "_collect"),
     ),
 )
 

@@ -3,11 +3,20 @@
 Reference analog: ``deepspeed/runtime/utils.py see_memory_usage`` (allocator
 stats printed at engine milestones). TPU shape: per-device HBM stats from
 ``Device.memory_stats()`` (bytes_in_use / peak / limit) + host RSS.
+
+Milestone lines now land on the dstrace timeline too: ``see_memory_usage``
+emits a ``mem/see_memory_usage`` instant (which the tracer's monitor sink
+fans out as an ``Events/`` gauge when a ``step`` is given), so "before
+forward" / "after optimizer" memory marks line up with the dispatch/drain
+spans and the dsmem HBM counter tracks instead of living only in a log
+file. The log line is kept for now but is the deprecated path — consumers
+should read the timeline/monitor, not scrape logs.
 """
 
 import os
 from typing import Dict, Optional
 
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -31,15 +40,34 @@ def get_memory_stats() -> Dict[str, Dict[str, float]]:
 
 
 def see_memory_usage(message: str, force: bool = False,
-                     ranks=(0,)) -> Optional[Dict]:
-    """Log device+host memory (reference signature: see_memory_usage(msg,
-    force)). Returns the stats dict for programmatic use."""
-    import jax
+                     ranks=(0,), step: Optional[int] = None
+                     ) -> Optional[Dict]:
+    """Record device+host memory at a milestone (reference signature:
+    ``see_memory_usage(msg, force)``). Returns the stats dict for
+    programmatic use.
+
+    The ``force=False`` default is a TRUE no-op: no jax import, no device
+    enumeration — callers sprinkle this at milestones unconditionally and
+    the disabled path must cost nothing (the old version imported jax
+    before the early return, dragging the full framework into processes
+    that never wanted it)."""
     if not force:
         return None
+    import jax
     if jax.process_index() not in ranks:
         return None
     stats = get_memory_stats()
+    # the timeline is the primary sink: peak device bytes + host RSS ride
+    # a mem/ instant (with `step` it also fans out through the tracer's
+    # monitor sink as an Events/ gauge)
+    tracer = get_tracer()
+    if tracer.enabled:
+        peak = max((s.get("peak_bytes_in_use_gb", 0.0)
+                    for d, s in stats.items() if d != "host"), default=0.0)
+        tracer.instant(
+            "mem/see_memory_usage", cat="mem", step=step, message=message,
+            peak_gb=round(peak, 4),
+            rss_gb=round(stats.get("host", {}).get("rss_gb", 0.0), 4))
     parts = []
     for dev, s in stats.items():
         if dev == "host":
@@ -47,5 +75,7 @@ def see_memory_usage(message: str, force: bool = False,
         else:
             parts.append(f"{dev}: {s['bytes_in_use_gb']:.2f}GB in use "
                          f"(peak {s['peak_bytes_in_use_gb']:.2f}GB)")
+    # deprecated sink: kept for operators tailing logs, but the timeline
+    # instant above is the contract going forward
     logger.info(f"MEM {message} | " + " | ".join(parts))
     return stats

@@ -1,0 +1,112 @@
+"""Regenerate the checked-in dsmem fixtures AND the repo-root
+``mem_baseline.json`` — fixtures and baseline are ONE artifact set, pinned
+clean against each other (the plan-fixtures contract):
+
+  mem_micro.json            the clean tie-out report: micro ledger + a
+                            deterministic synthetic observation set
+                            (plan * fixed per-phase factors), exit 0 vs
+                            the baseline
+  mem_micro_regressed.json  the same workload with the steady-phase
+                            watermark grown 3x — the seeded regression the
+                            CLI exit-matrix test drives (exit 1)
+  ../../mem_baseline.json   written from the clean report via
+                            write_mem_baseline (the ratchet's anchor)
+
+Run from anywhere: ``python tests/mem_fixtures/make_fixtures.py``. The
+memory module is file-loaded (stdlib-only contract), so this script works
+on jax-less hosts too.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+
+def _load_memory():
+    spec = importlib.util.spec_from_file_location(
+        "dsmem_fixtures_memory",
+        os.path.join(REPO, "deepspeed_tpu", "telemetry", "memory.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: the micro workload: 1M params, zero-1 over a 4-way fsdp world, bf16
+#: compute, full shape hints so every ledger component is exercised
+MICRO_LEDGER_KW = dict(
+    num_params=1_000_000, zero_stage=1, zero_world=4,
+    compute_dtype="bf16", grad_accum_dtype="fp32",
+    micro_batch=4, seq_len=128, hidden_size=256, num_layers=2,
+    vocab_size=1000, remat_policy="dots_with_no_batch_dims_saveable")
+
+#: synthetic observation = plan * factor, per phase — deterministic stand-in
+#: for real allocator stats (the CPU backend has none). first_step runs
+#: hotter than plan (compile workspace, which the ledger deliberately does
+#: not model); the others track the plan closely.
+OBS_FACTOR = {"init": 0.97, "first_step": 1.08, "steady": 1.02,
+              "ckpt": 1.03}
+HOST_RSS = {"init": 400_000_000, "first_step": 430_000_000,
+            "steady": 435_000_000, "ckpt": 450_000_000}
+BYTES_LIMIT = 16_000_000_000
+SAMPLES_PER_PHASE = 4
+
+
+def build_clean_report(mem) -> dict:
+    ledger = mem.MemoryLedger(**MICRO_LEDGER_KW)
+    plan_phases = ledger.phase_bytes()
+    observed = {}
+    for phase in mem.PHASES:
+        hbm = int(plan_phases[phase]["hbm_bytes"] * OBS_FACTOR[phase])
+        observed[phase] = {
+            "hbm_bytes_in_use": int(hbm * 0.95),
+            "hbm_peak_bytes": hbm,
+            "host_rss_bytes": HOST_RSS[phase],
+            "samples": SAMPLES_PER_PHASE,
+        }
+    return {
+        "version": mem.MEM_REPORT_VERSION,
+        "source": "mem_micro.json",
+        "bytes_limit": BYTES_LIMIT,
+        "plan": ledger.to_dict(),
+        "observed": {"phases": observed,
+                     "num_samples": SAMPLES_PER_PHASE * len(mem.PHASES)},
+        "devices": {"TPU_0": {
+            "bytes_in_use": observed["steady"]["hbm_bytes_in_use"],
+            "peak_bytes_in_use": observed["steady"]["hbm_peak_bytes"],
+            "bytes_limit": BYTES_LIMIT}},
+    }
+
+
+def build_regressed_report(clean: dict) -> dict:
+    # the seeded watermark regression: steady-phase device peak grows 3x —
+    # far past the 1.25x tolerance AND the 1MB absolute floor
+    reg = copy.deepcopy(clean)
+    steady = reg["observed"]["phases"]["steady"]
+    steady["hbm_peak_bytes"] *= 3
+    steady["hbm_bytes_in_use"] *= 3
+    return reg
+
+
+def _write(path: str, obj: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def main():
+    mem = _load_memory()
+    clean = build_clean_report(mem)
+    _write(os.path.join(HERE, "mem_micro.json"), clean)
+    _write(os.path.join(HERE, "mem_micro_regressed.json"),
+           build_regressed_report(clean))
+    baseline = os.path.join(REPO, mem.MEM_BASELINE_NAME)
+    mem.write_mem_baseline(baseline, clean)
+    print(f"wrote mem_micro.json, mem_micro_regressed.json, {baseline}")
+
+
+if __name__ == "__main__":
+    main()
