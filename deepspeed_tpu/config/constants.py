@@ -51,6 +51,10 @@ COMM_GUARD = "comm_guard"           # comm fault-tolerance group (deadlines/
 DEBUG_NANS = "debug_nans"           # jax_debug_nans for the compiled step
 MEMORY = "memory"                   # dsmem group (ledger preflight + live
 #                                     HBM/RSS sampling; telemetry/memory.py)
+SERVING = "serving"                 # serving group (admission, degradation
+#                                     ladder, host KV offload tier, fault
+#                                     isolation; serving/server.py
+#                                     ServingConfig.from_ds_config)
 
 # Defaults (mirroring reference semantics)
 STEPS_PER_PRINT_DEFAULT = 10

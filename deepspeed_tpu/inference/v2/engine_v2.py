@@ -22,6 +22,7 @@ from deepspeed_tpu.inference.v2.generic_decode import (decode_step_g,
                                                        prefill_chunk_g,
                                                        verify_chunk_g)
 from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache, KVCacheConfig
+from deepspeed_tpu.inference.v2.kv_offload import HostKVEntry, HostKVStore
 from deepspeed_tpu.inference.v2.modules import policy_for
 from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor, StateManager
 from deepspeed_tpu.inference.v2.sampling import SamplingConfig, sample_tokens
@@ -123,6 +124,8 @@ class InferenceEngineV2:
         # tokens/positions are [B] ints and always refresh)
         self._table_sig = None
         self._dev_tables = None
+        # host-RAM KV offload tier (serving demotion target; kv_offload.py)
+        self.host_kv = HostKVStore()
         # speculative-decoding counters (speculative_stats)
         self._spec_steps = 0
         self._spec_proposed = 0
@@ -278,10 +281,92 @@ class InferenceEngineV2:
     # lifecycle (reference: engine_v2.flush)
     # ------------------------------------------------------------------
     def flush(self, uid: int) -> List[int]:
-        """Release a sequence's KV blocks; returns its generated tokens."""
+        """Release a sequence's KV blocks (both tiers); returns its
+        generated tokens."""
         seq = self.state.pop(uid)
         self.kv.release(seq.blocks)
+        self.host_kv.pop(uid)     # no-op unless the sequence was demoted
         return seq.generated
+
+    # ------------------------------------------------------------------
+    # host KV offload tier (serving demotion/promotion; kv_offload.py)
+    # ------------------------------------------------------------------
+    def demote_kv(self, uid: int) -> int:
+        """Spill a sequence's KV pages to host RAM and release its device
+        blocks; the sequence pauses (invisible to the step planner) until
+        ``promote_kv``. Returns host bytes now held for it (0 when the uid
+        is unknown or already demoted). A deliberate device->host copy —
+        called from the serving tier policy, never from the jitted step."""
+        seq = self.state.get(uid)
+        if seq is None or seq.paused or seq.done:
+            # a done sequence is about to be reaped — gathering its pages
+            # would be a pure wasted device->host copy
+            return 0
+        if seq.blocks:
+            data, scales = self.kv.gather_blocks(seq.blocks)
+        else:
+            data, scales = None, None
+        entry = HostKVEntry(blocks=len(seq.blocks), data=data, scales=scales,
+                            seen_tokens=seq.seen_tokens)
+        self.host_kv.put(uid, entry)
+        self.kv.release(seq.blocks)
+        seq.blocks = []
+        seq.paused = True
+        self._table_sig = None    # decode tables must rebuild
+        return entry.nbytes
+
+    def promote_kv(self, uid: int) -> Optional[int]:
+        """Bring a demoted sequence back: reserve (possibly different)
+        device blocks, scatter its host pages in, resume scheduling.
+        Returns the bytes restored, or None when the uid is unknown or the
+        device has too few free blocks right now."""
+        seq = self.state.get(uid)
+        entry = self.host_kv.get(uid)
+        if seq is None or entry is None or seq.done:
+            # a done sequence is about to be reaped (flush drops the host
+            # entry) — restoring its pages would be a wasted copy
+            return None
+        if entry.blocks > self.kv.free_blocks:
+            return None
+        blocks = self.kv.reserve(entry.blocks)
+        if entry.blocks:
+            self.kv.scatter_blocks(blocks, entry.data, entry.scales)
+        seq.blocks = list(blocks)
+        seq.paused = False
+        self.host_kv.pop(uid, promoted=True)
+        self._table_sig = None
+        return entry.nbytes
+
+    def demoted_uids(self) -> List[int]:
+        """Demotion-ordered uids currently in the host tier."""
+        return self.host_kv.uids()
+
+    def demoted_blocks(self, uid: int) -> int:
+        """Device blocks a demoted sequence will need back at promotion."""
+        entry = self.host_kv.get(uid)
+        return entry.blocks if entry is not None else 0
+
+    def kv_held_blocks(self, uid: int) -> int:
+        """Device blocks a sequence holds right now (0 when demoted)."""
+        seq = self.state.get(uid)
+        return len(seq.blocks) if seq is not None else 0
+
+    def host_kv_bytes(self) -> int:
+        return self.host_kv.total_bytes
+
+    def kv_ledger(self) -> Dict[str, int]:
+        """Both tiers' occupancy in one dict — the serving drain test's
+        "ledger returns to zero" surface and the bench_serve proof."""
+        return {
+            "device_blocks_reserved": self.kv_reserved_blocks(),
+            "device_block_bytes": self.kv_block_bytes(),
+            "host_entries": len(self.host_kv),
+            "host_bytes": self.host_kv.total_bytes,
+            "demotions": self.host_kv.demotions,
+            "promotions": self.host_kv.promotions,
+            "demoted_bytes": self.host_kv.demoted_bytes,
+            "promoted_bytes": self.host_kv.promoted_bytes,
+        }
 
     # ------------------------------------------------------------------
     # serving hooks (consumed by deepspeed_tpu/serving: the serve loop
@@ -313,7 +398,9 @@ class InferenceEngineV2:
         return {uid: self.flush(uid) for uid in self.finished_uids()}
 
     def has_work(self) -> bool:
-        return any(not s.done for s in self.state.all())
+        """Any sequence the next step plan could advance — demoted (paused)
+        sequences don't count until the tier policy promotes them."""
+        return any(not s.done and not s.paused for s in self.state.all())
 
     def kv_usable_blocks(self) -> int:
         """Blocks available to sequences (the last block is the permanent

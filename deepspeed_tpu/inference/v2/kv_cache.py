@@ -70,6 +70,34 @@ class BlockedKVCache:
             self.scales = self.scales.at[
                 :, :, :, jnp.asarray(blocks)].set(1.0)
 
+    # ------------------------------------------------------------------
+    # host offload tier (serving demotion/promotion; see kv_offload.py)
+    # ------------------------------------------------------------------
+    def gather_blocks(self, blocks: List[int]
+                      ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Copy the listed blocks' pages (and, for fp8, their scales) to
+        host ndarrays ``[L, 2, H_kv, len(blocks), bs, D]``. A deliberate
+        device->host transfer — demotion runs OFF the per-tick fast path,
+        only when the serving tier policy decides to spill."""
+        idx = np.asarray(blocks, np.int32)
+        data = np.asarray(self.data[:, :, :, idx])
+        scales = (np.asarray(self.scales[:, :, :, idx])
+                  if self.scales is not None else None)
+        return data, scales
+
+    def scatter_blocks(self, blocks: List[int], data: np.ndarray,
+                       scales: Optional[np.ndarray] = None) -> None:
+        """Write gathered pages back into (possibly different) blocks —
+        the promotion path. fp8 scales are restored alongside the pages,
+        so a promoted sequence's quantization state is bit-identical to
+        what it was at demotion."""
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        self.data = self.data.at[:, :, :, idx].set(
+            jnp.asarray(data, self.cfg.dtype))
+        if self.scales is not None and scales is not None:
+            self.scales = self.scales.at[:, :, :, idx].set(
+                jnp.asarray(scales, jnp.float32))
+
 
 FP8_MAX = 448.0     # float8_e4m3fn max finite; overflow casts become NaN
 

@@ -1,0 +1,91 @@
+"""Host-RAM KV offload store — the demotion tier under the device cache.
+
+ZeRO-Offload (PAPERS.md, arxiv 2101.06840) applied to inference state:
+when device KV blocks run hot, a sequence's pages are *demoted* to host
+RAM (freeing its device blocks for active decodes) and *promoted* back —
+possibly into different block ids, the block table is rebuilt — when the
+scheduler has room again. Overload then costs latency (a paused request
+waits in host RAM) instead of availability (a 429 at the door).
+
+This module is the storage half only: a uid-keyed container of gathered
+page tiles with exact byte accounting. Page movement lives on the engine
+(``InferenceEngineV2.demote_kv`` / ``promote_kv``); *policy* — watermarks,
+victim selection, promotion order — lives in ``serving/kv_tier.py``. The
+split keeps the inference package free of serving concerns while the
+serving tick stays free of device-array handling.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class HostKVEntry:
+    """One demoted sequence's KV state: the gathered page tiles
+    ``[L, 2, H_kv, n_blocks, block_size, D]`` (host ndarray, page dtype
+    preserved — fp8 pages stay fp8 with their per-(head, page) scales) and
+    the bookkeeping needed to re-reserve on promotion."""
+
+    blocks: int                          # device blocks held at demotion
+    data: Optional[np.ndarray]           # None when blocks == 0
+    scales: Optional[np.ndarray]         # fp8 page scales (else None)
+    seen_tokens: int                     # KV coverage at demotion
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        if self.data is not None:
+            total += int(self.data.nbytes)
+        if self.scales is not None:
+            total += int(self.scales.nbytes)
+        return total
+
+
+class HostKVStore:
+    """uid -> ``HostKVEntry`` with running byte/lifetime accounting — the
+    "host" column of the serving layer's two-tier KV ledger."""
+
+    def __init__(self):
+        self._entries: Dict[int, HostKVEntry] = {}
+        self.total_bytes = 0
+        # lifetime counters (monotone; the deterministic proof surface)
+        self.demotions = 0
+        self.promotions = 0
+        self.demoted_bytes = 0
+        self.promoted_bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, uid: int) -> bool:
+        return uid in self._entries
+
+    def get(self, uid: int) -> Optional[HostKVEntry]:
+        return self._entries.get(uid)
+
+    def uids(self) -> List[int]:
+        """Insertion (= demotion) order — the FIFO promotion order."""
+        return list(self._entries)
+
+    def put(self, uid: int, entry: HostKVEntry) -> int:
+        if uid in self._entries:
+            raise ValueError(f"uid {uid} already demoted")
+        self._entries[uid] = entry
+        self.total_bytes += entry.nbytes
+        self.demotions += 1
+        self.demoted_bytes += entry.nbytes
+        return entry.nbytes
+
+    def pop(self, uid: int, promoted: bool = False) -> Optional[HostKVEntry]:
+        """Remove an entry (promotion, or flush of a cancelled/expired
+        sequence). ``promoted=True`` counts it as a promotion."""
+        entry = self._entries.pop(uid, None)
+        if entry is None:
+            return None
+        self.total_bytes -= entry.nbytes
+        if promoted:
+            self.promotions += 1
+            self.promoted_bytes += entry.nbytes
+        return entry

@@ -19,6 +19,9 @@ class SequenceDescriptor:
     seen_tokens: int = 0                      # tokens whose KV is in cache
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # demoted to the host KV tier: holds no device blocks, invisible to the
+    # step planner until promoted back (engine_v2.demote_kv/promote_kv)
+    paused: bool = False
 
     @property
     def total_tokens(self) -> int:
@@ -74,8 +77,8 @@ class StateManager:
 
     def decoding(self) -> List[SequenceDescriptor]:
         return [s for s in self._seqs.values()
-                if not s.done and not s.in_prefill]
+                if not s.done and not s.paused and not s.in_prefill]
 
     def prefilling(self) -> List[SequenceDescriptor]:
         return [s for s in self._seqs.values()
-                if not s.done and s.in_prefill]
+                if not s.done and not s.paused and s.in_prefill]

@@ -16,6 +16,14 @@ Faults covered (the failure modes the resilience subsystem exists for):
                 deadline + CommWedgeError + coordinated-abort path), or
                 silence a rank's heartbeat (``peer_dead`` — membership
                 marks it lost)
+  - ``serve`` : serving-tick faults (``serving/server.py``): stall the
+                serve tick (``DSTPU_CHAOS_SERVE_SLOW_TICK``), steal a
+                fraction of usable KV blocks over a tick window so the
+                degradation ladder + host KV tier drill end to end
+                (``DSTPU_CHAOS_SERVE_KV_PRESSURE``), or make one request
+                uid deterministically fault the engine step so the
+                poison-quarantine path fires
+                (``DSTPU_CHAOS_SERVE_POISON_UID``)
 
 Knobs come from an explicit ``ChaosConfig`` or from the environment
 (``ChaosConfig.from_env``), so a launcher can chaos-test an unmodified
@@ -40,6 +48,30 @@ from deepspeed_tpu.utils.logging import logger
 
 def _parse_steps(raw: str) -> FrozenSet[int]:
     return frozenset(int(s) for s in raw.replace(" ", "").split(",") if s)
+
+
+def _parse_slow_tick(raw: str):
+    """``"N:SECS"`` (every Nth tick) or ``"pP:SECS"`` (probability P per
+    tick via the sha roll) -> (every, prob, seconds)."""
+    if not raw:
+        return 0, 0.0, 0.0
+    head, _, secs = raw.partition(":")
+    s = float(secs or 0.0)
+    if head.startswith("p"):
+        return 0, float(head[1:]), s
+    return int(head or 0), 0.0, s
+
+
+def _parse_kv_pressure(raw: str):
+    """``"FRAC[:FROM[:UNTIL]]"`` -> (frac, from_tick, until_tick);
+    until < 0 means the pressure never lifts."""
+    if not raw:
+        return 0.0, 0, -1
+    parts = raw.split(":")
+    frac = float(parts[0])
+    frm = int(parts[1]) if len(parts) > 1 else 0
+    until = int(parts[2]) if len(parts) > 2 else -1
+    return frac, frm, until
 
 
 @dataclass(frozen=True)
@@ -80,6 +112,18 @@ class ChaosConfig:
     comm_delay_s: float = 0.0
     # ranks whose heartbeat is silenced (membership marks them lost)
     peer_dead_ranks: FrozenSet[int] = frozenset()
+    # serving-tick faults (consumed by serving/server.py). slow_tick
+    # stalls the serve tick (every Nth tick, or per-tick probability via
+    # the sha roll); kv_pressure steals a fraction of usable KV blocks
+    # over [from, until) ticks (until < 0 = forever); poison_uid makes
+    # that request uid fault the engine step whenever it is resident
+    serve_slow_tick_every: int = 0
+    serve_slow_tick_prob: float = 0.0
+    serve_slow_tick_s: float = 0.0
+    serve_kv_pressure_frac: float = 0.0
+    serve_kv_pressure_from: int = 0
+    serve_kv_pressure_until: int = -1
+    serve_poison_uid: int = -1
 
     @property
     def active(self) -> bool:
@@ -91,7 +135,12 @@ class ChaosConfig:
                     or self.comm_wedge_call >= 0
                     or (self.comm_delay_s > 0
                         and (self.comm_delay_calls or self.comm_delay_prob))
-                    or self.peer_dead_ranks)
+                    or self.peer_dead_ranks
+                    or (self.serve_slow_tick_s > 0
+                        and (self.serve_slow_tick_every
+                             or self.serve_slow_tick_prob))
+                    or self.serve_kv_pressure_frac > 0
+                    or self.serve_poison_uid >= 0)
 
     @classmethod
     def from_env(cls, env=os.environ) -> "ChaosConfig":
@@ -119,6 +168,15 @@ class ChaosConfig:
             comm_delay_prob=float(g("DSTPU_CHAOS_COMM_DELAY_PROB", "0")),
             comm_delay_s=float(g("DSTPU_CHAOS_COMM_DELAY_S", "0")),
             peer_dead_ranks=_parse_steps(g("DSTPU_CHAOS_PEER_DEAD_RANKS", "")),
+            **dict(zip(("serve_slow_tick_every", "serve_slow_tick_prob",
+                        "serve_slow_tick_s"),
+                       _parse_slow_tick(g("DSTPU_CHAOS_SERVE_SLOW_TICK",
+                                          "")))),
+            **dict(zip(("serve_kv_pressure_frac", "serve_kv_pressure_from",
+                        "serve_kv_pressure_until"),
+                       _parse_kv_pressure(g("DSTPU_CHAOS_SERVE_KV_PRESSURE",
+                                            "")))),
+            serve_poison_uid=int(g("DSTPU_CHAOS_SERVE_POISON_UID", "-1")),
         )
 
 
@@ -133,6 +191,14 @@ class ChaosInjectedOOMError(RuntimeError):
     real XLA allocation failure)."""
 
 
+class ChaosInjectedPoisonError(RuntimeError):
+    """An injected per-request engine-step fault. The message says
+    "aborted" so ``comm.guard.classify_exception`` calls it TRANSIENT —
+    the serving layer must route it to the poison-quarantine path, NOT the
+    sticky degraded latch (that asymmetry is exactly what the drill
+    proves)."""
+
+
 class ChaosMonkey:
     """Stateless-roll injector; the only mutable state is bookkeeping
     counters so tests can assert exactly what fired."""
@@ -140,7 +206,10 @@ class ChaosMonkey:
     def __init__(self, config: Optional[ChaosConfig] = None):
         self.config = config if config is not None else ChaosConfig.from_env()
         self.injected = {"nan": 0, "ckpt": 0, "slow": 0, "oom": 0,
-                         "comm_wedge": 0, "comm_delay": 0}
+                         "comm_wedge": 0, "comm_delay": 0,
+                         "serve_slow_tick": 0, "serve_kv_pressure": 0,
+                         "serve_poison": 0}
+        self._serve_kv_pressure_on = False   # edge detector for the instant
 
     # ------------------------------------------------------------------
     def _roll(self, kind: str, step: int, salt: int = 0) -> float:
@@ -284,6 +353,64 @@ class ChaosMonkey:
             f"RESOURCE_EXHAUSTED: chaos-injected out of memory allocating "
             f"16.00G at step {step} (fake buffer dump: this is the dsmem "
             "forensics drill)")
+
+    # ------------------------------------------------------------------
+    # serving-tick faults (serving/server.py asks per serve tick)
+    # ------------------------------------------------------------------
+    def serve_slow_tick(self, tick: int) -> float:
+        """Stall this serve tick when due (cadence or sha-rolled
+        probability); returns the injected stall seconds."""
+        c = self.config
+        if c.serve_slow_tick_s <= 0:
+            return 0.0
+        due = bool(c.serve_slow_tick_every and tick > 0
+                   and tick % c.serve_slow_tick_every == 0)
+        if not due and c.serve_slow_tick_prob > 0:
+            due = self._roll("serve_slow", tick) < c.serve_slow_tick_prob
+        if not due:
+            return 0.0
+        self.injected["serve_slow_tick"] += 1
+        logger.warning(f"chaos: stalling serve tick {tick} for "
+                       f"{c.serve_slow_tick_s:.3f}s")
+        time.sleep(c.serve_slow_tick_s)
+        get_tracer().complete("chaos/serve_slow_tick", c.serve_slow_tick_s,
+                              cat="resilience", tick=tick)
+        return c.serve_slow_tick_s
+
+    def serve_kv_pressure(self, tick: int) -> float:
+        """Fraction of usable KV blocks stolen at this tick (0 outside the
+        configured window). Window edges emit a chaos instant so the whole
+        pressure episode is reconstructible from the trace."""
+        c = self.config
+        if c.serve_kv_pressure_frac <= 0:
+            return 0.0
+        on = tick >= c.serve_kv_pressure_from and (
+            c.serve_kv_pressure_until < 0
+            or tick < c.serve_kv_pressure_until)
+        if on != self._serve_kv_pressure_on:
+            self._serve_kv_pressure_on = on
+            if on:
+                self.injected["serve_kv_pressure"] += 1
+            get_tracer().instant("chaos/serve_kv_pressure", cat="resilience",
+                                 tick=tick, state="on" if on else "off",
+                                 frac=c.serve_kv_pressure_frac)
+            logger.warning(
+                f"chaos: serve KV pressure {'ON' if on else 'OFF'} at tick "
+                f"{tick} (stealing {c.serve_kv_pressure_frac:.0%} of blocks)")
+        return c.serve_kv_pressure_frac if on else 0.0
+
+    def maybe_poison_serve(self, uids) -> None:
+        """Raise when the poisoned request uid is resident in this engine
+        step — a per-request transient engine fault the serving layer must
+        isolate (evict + retry + quarantine), never latch degraded on."""
+        uid = self.config.serve_poison_uid
+        if uid < 0 or uid not in uids:
+            return
+        self.injected["serve_poison"] += 1
+        get_tracer().instant("chaos/serve_poison", cat="resilience", uid=uid)
+        logger.warning(f"chaos: poisoning engine step (request uid {uid})")
+        raise ChaosInjectedPoisonError(
+            f"chaos: poisoned request {uid} aborted the engine step")
 
     # ------------------------------------------------------------------
     # worker death

@@ -1,9 +1,13 @@
 """Continuous-batching serving layer over the v2 ragged engine (MII analog).
 
-Request lifecycle + serve loop + admission control + observability + an
-stdlib HTTP front door. See docs/serving.md.
+Request lifecycle + serve loop + tiered admission control (host-RAM KV
+offload) + degradation ladder + request-level fault isolation +
+observability + an stdlib HTTP front door + the bench_serve load harness.
+See docs/serving.md.
 """
 
+from deepspeed_tpu.serving.degradation import (DegradationLadder,
+                                               LadderConfig, ServeLevel)
 from deepspeed_tpu.serving.frontend import ServingFrontend
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState
@@ -12,9 +16,12 @@ from deepspeed_tpu.serving.server import (BackpressureError, InferenceServer,
 
 __all__ = [
     "BackpressureError",
+    "DegradationLadder",
     "InferenceServer",
+    "LadderConfig",
     "Request",
     "RequestState",
+    "ServeLevel",
     "ServerClosedError",
     "ServingConfig",
     "ServingFrontend",

@@ -1,0 +1,379 @@
+"""bench_serve — open/closed-loop synthetic load for the serving stack.
+
+ROADMAP item 1's load harness: drive an ``InferenceServer`` with
+deterministic synthetic traffic (burst, multi-turn, slow-client,
+low-priority mixes; chaos scenarios ride on ``DSTPU_CHAOS_SERVE_*``) and
+report
+
+* p50/p99 TTFT/TPOT derived STRAIGHT from the dstrace request spans
+  (``serve/queued`` + ``serve/prefill`` durations per uid; decode span /
+  (tokens-1)) — PR 5 pinned trace == metric, so the span-derived numbers
+  tie out against ``ServingMetrics``;
+* the deterministic counter set that is the real proof on a CPU container
+  where wall-clock is noise: demotions/promotions/bytes through the KV
+  tiers, sheds and ladder transitions, recomputed tokens from fault
+  evictions, quarantines, drift recalibrations, and — the availability
+  headline — ``degraded_latches`` (sticky 503s), which a healthy siege
+  run must keep at ZERO.
+
+Closed-loop mode models N concurrent users each waiting for their reply
+(lane i issues its requests sequentially); open-loop mode submits on a
+fixed arrival schedule regardless of completions (the overload generator:
+rejections are counted, not retried). Prompt/token shapes are seeded per
+request INDEX, so the workload is identical regardless of thread timing.
+
+CLI: ``bin/dstpu_bench_serve --scenario micro`` (tiny CPU llama,
+hermetic). The tier-1 ``serve_load`` test runs the micro scenario and
+asserts the counter invariants.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.serving.request import RequestState
+from deepspeed_tpu.serving.server import (BackpressureError, InferenceServer,
+                                          ServerClosedError)
+from deepspeed_tpu.telemetry.tracer import _quantile, get_tracer
+
+
+@dataclasses.dataclass
+class ServeScenario:
+    name: str = "micro"
+    mode: str = "closed"                 # "closed" | "open"
+    num_requests: int = 100
+    concurrency: int = 8                 # closed-loop lanes
+    prompt_len: Tuple[int, int] = (4, 12)       # [lo, hi) per request
+    max_new_tokens: Tuple[int, int] = (2, 5)    # [lo, hi) per request
+    turns: int = 1                       # >1: lanes carry history forward
+    arrival_interval_s: float = 0.0      # open-loop fixed interarrival
+    burst: int = 0                       # open-loop: first K back-to-back
+    slow_client_every: int = 0           # every Kth request streams slowly
+    slow_client_token_s: float = 0.005
+    low_priority_every: int = 0          # every Kth request priority=-1
+    timeout_s: Optional[float] = None
+    submit_retry_limit: int = 200        # closed-loop 429 retries/request
+    result_timeout_s: float = 300.0
+    vocab: int = 128
+    seed: int = 0
+
+
+#: named presets; chaos scenarios are the same workloads run under
+#: DSTPU_CHAOS_SERVE_* env knobs (the harness never sets env itself)
+SCENARIOS: Dict[str, ServeScenario] = {
+    "micro": ServeScenario(name="micro", num_requests=100, concurrency=8),
+    "burst": ServeScenario(name="burst", mode="open", num_requests=64,
+                           burst=32, arrival_interval_s=0.005,
+                           max_new_tokens=(2, 6)),
+    "multi_turn": ServeScenario(name="multi_turn", num_requests=48,
+                                concurrency=6, turns=4,
+                                prompt_len=(4, 10)),
+    "slow_client": ServeScenario(name="slow_client", num_requests=32,
+                                 concurrency=4, slow_client_every=2,
+                                 max_new_tokens=(4, 8)),
+    "overload": ServeScenario(name="overload", mode="open",
+                              num_requests=200, arrival_interval_s=0.001,
+                              max_new_tokens=(4, 10),
+                              low_priority_every=3),
+}
+
+
+def _stats(vals: List[float]) -> Dict[str, float]:
+    s = sorted(vals)
+    n = len(s)
+    return {"count": n,
+            "mean_s": (sum(s) / n) if n else 0.0,
+            "p50_s": _quantile(s, 0.5),
+            "p99_s": _quantile(s, 0.99),
+            "max_s": s[-1] if n else 0.0}
+
+
+def _request_shape(scenario: ServeScenario, index: int
+                   ) -> Tuple[List[int], int, int]:
+    """Deterministic (prompt, max_new, priority) for request ``index`` —
+    a pure function of (seed, index), independent of thread timing."""
+    rng = np.random.default_rng(scenario.seed * 100_003 + index)
+    lo, hi = scenario.prompt_len
+    n = int(rng.integers(lo, max(hi, lo + 1)))
+    prompt = [int(t) for t in rng.integers(1, scenario.vocab, n)]
+    mlo, mhi = scenario.max_new_tokens
+    max_new = int(rng.integers(mlo, max(mhi, mlo + 1)))
+    priority = (-1 if scenario.low_priority_every
+                and index % scenario.low_priority_every == 0 else 0)
+    return prompt, max_new, priority
+
+
+def _span_latencies(events) -> Tuple[List[float], List[float]]:
+    """Rebuild per-request TTFT/TPOT from the dstrace request spans: TTFT
+    = queued.dur + prefill.dur; TPOT = decode.dur / (tokens - 1)."""
+    queued: Dict[int, float] = {}
+    prefill: Dict[int, float] = {}
+    decode: Dict[int, Tuple[float, int]] = {}
+    for e in events:
+        _eid, name, _cat, ph, _ts, dur, _tid, args = e
+        if ph != "X" or not args or "uid" not in args:
+            continue
+        uid = args["uid"]
+        if name == "serve/queued":
+            queued[uid] = dur
+        elif name == "serve/prefill":
+            prefill[uid] = dur
+        elif name == "serve/decode":
+            decode[uid] = (dur, int(args.get("tokens", 0)))
+    ttft = [queued[u] + prefill[u] for u in prefill if u in queued]
+    tpot = [dur / (tokens - 1) for dur, tokens in decode.values()
+            if tokens > 1]
+    return ttft, tpot
+
+
+class _Lane:
+    """One closed-loop user: issues its assigned request indices in order,
+    retrying 429s with the server's own Retry-After hint (bounded), and
+    carrying multi-turn history forward."""
+
+    def __init__(self, server: InferenceServer, scenario: ServeScenario,
+                 indices: List[int], results: dict, lock: threading.Lock):
+        self.server = server
+        self.scenario = scenario
+        self.indices = indices
+        self.results = results
+        self.lock = lock
+        self.history: List[int] = []
+
+    def run(self):
+        sc = self.scenario
+        max_ctx = self.server.engine.state.max_context_length
+        for turn in range(max(sc.turns, 1)):
+            for index in self.indices:
+                prompt, max_new, priority = _request_shape(
+                    sc, index + turn * sc.num_requests)
+                if sc.turns > 1:
+                    # multi-turn: prepend the conversation so far (the
+                    # prefix the future radix cache will reuse), capped to
+                    # keep prompt + budget inside the model context
+                    room = max_ctx - max_new - len(prompt) - 1
+                    if room > 0 and self.history:
+                        prompt = self.history[-room:] + prompt
+                    else:
+                        self.history = []
+                record = self._one(index, turn, prompt, max_new, priority)
+                if sc.turns > 1 and record.get("tokens") is not None:
+                    self.history = (prompt + record["tokens"])
+                with self.lock:
+                    self.results[(turn, index)] = record
+
+    def _one(self, index: int, turn: int, prompt, max_new, priority) -> dict:
+        sc = self.scenario
+        retries = 0
+        while True:
+            try:
+                req = self.server.submit(prompt, max_new_tokens=max_new,
+                                         timeout_s=sc.timeout_s,
+                                         priority=priority)
+                break
+            except BackpressureError as e:
+                retries += 1
+                if retries > sc.submit_retry_limit:
+                    return {"state": "gave_up", "retries": retries}
+                time.sleep(min(e.retry_after_s, 0.02))
+            except ServerClosedError:
+                return {"state": "refused", "retries": retries}
+        slow = (sc.slow_client_every
+                and index % sc.slow_client_every == 0)
+        try:
+            if slow:
+                for _tok in req.stream(timeout=sc.result_timeout_s):
+                    time.sleep(sc.slow_client_token_s)
+            else:
+                req.wait(timeout=sc.result_timeout_s)
+        except Exception:
+            req.cancel()
+            req.wait(timeout=10.0)
+        return {"state": req.state.value, "uid": req.uid,
+                "tokens": list(req.tokens), "retries": retries,
+                "finish_reason": req.finish_reason}
+
+
+def run_scenario(server: InferenceServer, scenario: ServeScenario) -> dict:
+    """Drive ``server`` (already started) with the scenario; drains it at
+    the end and returns the report dict. The process-global tracer is
+    enabled for the run if it wasn't (the span-derived latency section
+    depends on it)."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        tracer.configure(enabled=True)
+    results: dict = {}
+    lock = threading.Lock()
+    t0 = time.monotonic()
+    if scenario.mode == "closed":
+        lanes = [
+            _Lane(server, scenario,
+                  list(range(i, scenario.num_requests, scenario.concurrency)),
+                  results, lock)
+            for i in range(max(scenario.concurrency, 1))]
+        threads = [threading.Thread(target=lane.run, daemon=True,
+                                    name=f"bench-lane-{i}")
+                   for i, lane in enumerate(lanes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    elif scenario.mode == "open":
+        pending = []
+        for index in range(scenario.num_requests):
+            prompt, max_new, priority = _request_shape(scenario, index)
+            if index >= scenario.burst and scenario.arrival_interval_s > 0:
+                time.sleep(scenario.arrival_interval_s)
+            try:
+                pending.append((index, server.submit(
+                    prompt, max_new_tokens=max_new,
+                    timeout_s=scenario.timeout_s, priority=priority)))
+            except BackpressureError:
+                results[(0, index)] = {"state": "rejected"}
+            except ServerClosedError:
+                results[(0, index)] = {"state": "refused"}
+        for index, req in pending:
+            req.wait(timeout=scenario.result_timeout_s)
+            results[(0, index)] = {"state": req.state.value, "uid": req.uid,
+                                   "tokens": list(req.tokens),
+                                   "finish_reason": req.finish_reason}
+    else:
+        raise ValueError(f"unknown scenario mode {scenario.mode!r}")
+    drained = server.drain(timeout=scenario.result_timeout_s)
+    wall_s = time.monotonic() - t0
+
+    snap = server.metrics.snapshot()
+    ttft, tpot = _span_latencies(tracer.events_snapshot())
+    states: Dict[str, int] = {}
+    client_tokens = 0
+    for rec in results.values():
+        states[rec["state"]] = states.get(rec["state"], 0) + 1
+        client_tokens += len(rec.get("tokens") or ())
+    ledger = (server.engine.kv_ledger()
+              if hasattr(server.engine, "kv_ledger") else {})
+    return {
+        "scenario": dataclasses.asdict(scenario),
+        "wall_s": round(wall_s, 3),
+        "drained": drained,
+        "requests": {"issued": len(results), "states": states,
+                     "client_tokens": client_tokens},
+        "metrics": snap,
+        # the deterministic proof set (see module docstring)
+        "counters": {
+            "demotions": snap["kv_demotions"],
+            "promotions": snap["kv_promotions"],
+            "demoted_bytes": snap["kv_demoted_bytes"],
+            "promoted_bytes": snap["kv_promoted_bytes"],
+            "sheds": snap["requests_shed"],
+            "rejected": snap["requests_rejected"],
+            "brownout_entries": snap["brownout_entries"],
+            "shed_entries": snap["shed_entries"],
+            "ladder_transitions": snap["ladder_transitions"],
+            "quarantined": snap["requests_quarantined"],
+            "step_faults": snap["engine_step_faults"],
+            "recomputed_tokens": snap["recomputed_tokens"],
+            "kv_drift_events": snap["kv_drift_events"],
+            "kv_recalibrations": snap["kv_recalibrations"],
+            "sticky_503": snap["degraded_latches"],
+        },
+        "kv_ledger": ledger,
+        "ladder": {"level": server.ladder.level.name.lower(),
+                   "transitions": dict(server.ladder.transitions),
+                   "entries": dict(server.ladder.entries)},
+        "latency_from_trace": {"ttft_s": _stats(ttft),
+                               "tpot_s": _stats(tpot)},
+        "latency_from_metrics": {
+            "ttft_p50_s": snap["ttft_p50_s"], "ttft_p99_s": snap["ttft_p99_s"],
+            "tpot_p50_s": snap["tpot_p50_s"],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI (bin/dstpu_bench_serve) — hermetic tiny-llama CPU run
+# ---------------------------------------------------------------------------
+def build_tiny_server(kv_num_blocks: int = 64, kv_block_size: int = 16,
+                      kv_offload: bool = True,
+                      serving_overrides: Optional[dict] = None
+                      ) -> InferenceServer:
+    """The hermetic benchmark target: tiny random-init fp32 llama +
+    small KV pool so tier/ladder behavior shows at micro request counts."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      V2EngineConfig)
+    from deepspeed_tpu.inference.v2.scheduler import SchedulerConfig
+    from deepspeed_tpu.models.llama import (TINY_LLAMA, LlamaConfig,
+                                            LlamaForCausalLM)
+    from deepspeed_tpu.serving.server import ServingConfig
+
+    cfg = LlamaConfig(**{**TINY_LLAMA.__dict__, "dtype": jnp.float32,
+                         "max_seq_len": 512})
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)})["params"]
+    engine = InferenceEngineV2(params, cfg, V2EngineConfig(
+        kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
+        scheduler=SchedulerConfig(max_tokens_per_step=64,
+                                  prefill_buckets=(16, 32, 64))))
+    overrides = {"max_queue_depth": 32, "kv_offload_enabled": kv_offload,
+                 "kv_demote_watermark": 0.5,
+                 "kv_demote_watermark_brownout": 0.3,
+                 "idle_poll_s": 0.001}
+    overrides.update(serving_overrides or {})
+    return InferenceServer(engine, ServingConfig(**overrides))
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(prog="dstpu_bench_serve",
+                                description=__doc__)
+    p.add_argument("--scenario", default="micro",
+                   choices=sorted(SCENARIOS))
+    p.add_argument("--requests", type=int, default=None,
+                   help="override the scenario's num_requests")
+    p.add_argument("--concurrency", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--kv-num-blocks", type=int, default=64)
+    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--no-kv-offload", action="store_true",
+                   help="run with the offload tier disabled (pre-tier "
+                        "admission semantics)")
+    p.add_argument("--json", default=None,
+                   help="write the full report JSON here (stdout always "
+                        "gets it too)")
+    args = p.parse_args(argv)
+
+    scenario = SCENARIOS[args.scenario]
+    patch = {}
+    if args.requests is not None:
+        patch["num_requests"] = args.requests
+    if args.concurrency is not None:
+        patch["concurrency"] = args.concurrency
+    if args.seed is not None:
+        patch["seed"] = args.seed
+    if patch:
+        scenario = dataclasses.replace(scenario, **patch)
+
+    server = build_tiny_server(kv_num_blocks=args.kv_num_blocks,
+                               kv_block_size=args.kv_block_size,
+                               kv_offload=not args.no_kv_offload).start()
+    try:
+        report = run_scenario(server, scenario)
+    finally:
+        server.stop(drain_timeout=30.0)
+    text = json.dumps(report, indent=2, default=str)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

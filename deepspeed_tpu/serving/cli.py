@@ -31,6 +31,18 @@ def main(argv=None):
     p.add_argument("--kv-block-size", type=int, default=64)
     p.add_argument("--kv-high-watermark", type=float, default=0.95)
     p.add_argument("--request-timeout-s", type=float, default=None)
+    p.add_argument("--kv-offload", action="store_true",
+                   help="enable the host-RAM KV offload tier (overload "
+                        "demotes queued/idle requests' KV pages to host "
+                        "RAM instead of rejecting)")
+    p.add_argument("--host-kv-budget-mb", type=int, default=256,
+                   help="host-RAM budget for demoted KV pages")
+    p.add_argument("--brownout-pressure", type=float, default=0.85,
+                   help="degradation-ladder brownout threshold")
+    p.add_argument("--shed-pressure", type=float, default=0.97,
+                   help="degradation-ladder shed (429) threshold")
+    p.add_argument("--brownout-max-new-tokens", type=int, default=16,
+                   help="per-request generation cap while browned out")
     args = p.parse_args(argv)
 
     import jax
@@ -71,7 +83,12 @@ def main(argv=None):
         max_queue_depth=args.max_queue_depth,
         default_max_new_tokens=args.max_new_tokens,
         default_timeout_s=args.request_timeout_s,
-        kv_high_watermark=args.kv_high_watermark)).start()
+        kv_high_watermark=args.kv_high_watermark,
+        kv_offload_enabled=args.kv_offload,
+        host_kv_budget_bytes=args.host_kv_budget_mb << 20,
+        brownout_pressure=args.brownout_pressure,
+        shed_pressure=args.shed_pressure,
+        brownout_max_new_tokens=args.brownout_max_new_tokens)).start()
     frontend = ServingFrontend(server, host=args.host, port=args.port).start()
     print(f"dstpu_serve: {frontend.url} (preset={args.preset}, "
           f"kv_blocks={args.kv_num_blocks})", flush=True)

@@ -8,13 +8,18 @@ loop directly).
 
 Endpoints:
   POST /generate  {"prompt_tokens": [..], "max_new_tokens": N,
-                   "timeout_s": S, "stream": false}
+                   "timeout_s": S, "priority": P, "stream": false}
       -> 200 {"uid", "tokens", "finish_reason", ...}
       -> with "stream": true, chunked JSON-lines: one {"token": t} per
          generated token, then a final {"done": true, ...} record
-      -> 429 + Retry-After on backpressure, 503 while draining
+      -> 429 + Retry-After on backpressure (queue/KV watermark) AND when
+         the degradation ladder sheds; 503 while draining or degraded
   GET /metrics    Prometheus text format
-  GET /healthz    200 {"status": "serving", ...} / 503 otherwise
+  GET /healthz    200 {"status": "serving", "level": "healthy" |
+                  "brownout" | "shed", ...} / 503 otherwise ("level" +
+                  "level_reason" expose the degradation ladder; brownout
+                  and shed still answer 200 — the replica is alive, it is
+                  shedding per-request, so LBs should keep it in rotation)
 """
 
 import json
@@ -88,7 +93,8 @@ class ServingFrontend:
                     req = frontend.serving.submit(
                         prompt,
                         max_new_tokens=body.get("max_new_tokens"),
-                        timeout_s=body.get("timeout_s"))
+                        timeout_s=body.get("timeout_s"),
+                        priority=body.get("priority", 0))
                 except (TypeError, ValueError) as e:
                     # type-malformed payloads (non-list prompt, string
                     # max_new_tokens, ...) are client errors, not 500s

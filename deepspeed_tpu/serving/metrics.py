@@ -56,12 +56,32 @@ class ServingMetrics:
         # counters
         self.requests_submitted = 0
         self.requests_rejected = 0       # backpressure at submit()
+        self.requests_shed = 0           # rejected BY THE LADDER (SHED)
         self.requests_completed = 0      # finished (length / eos)
         self.requests_cancelled = 0
         self.requests_timed_out = 0
         self.requests_failed = 0
+        self.requests_quarantined = 0    # poison requests past retry budget
         self.tokens_generated = 0
         self.engine_steps = 0
+        # request-level fault isolation (non-fatal engine-step failures)
+        self.engine_step_faults = 0
+        self.fault_recoveries = 0        # clean-tick recovery episodes
+        self.recomputed_tokens = 0       # KV rebuilt for evicted retries
+        self.degraded_latches = 0        # sticky-503 latches (fatal only)
+        # host KV offload tier
+        self.kv_demotions = 0
+        self.kv_promotions = 0
+        self.kv_demoted_bytes = 0
+        self.kv_promoted_bytes = 0
+        self.host_kv_bytes = 0           # gauge
+        # degradation ladder
+        self.ladder_level = 0            # gauge (ServeLevel int)
+        self.ladder_transitions = 0
+        self.brownout_entries = 0
+        self.shed_entries = 0
+        # projected-KV watermark recalibration (kv_drift satellite)
+        self.kv_recalibrations = 0
         # latency distributions (seconds)
         self.ttft = _LatencyStat()
         self.tpot = _LatencyStat()
@@ -136,18 +156,87 @@ class ServingMetrics:
         with self._lock:
             self.kv_drift_events += 1
 
+    def on_kv_recalibrate(self):
+        with self._lock:
+            self.kv_recalibrations += 1
+
+    def on_shed(self):
+        with self._lock:
+            self.requests_rejected += 1
+            self.requests_shed += 1
+
+    def on_quarantine(self):
+        with self._lock:
+            self.requests_quarantined += 1
+
+    def on_step_fault(self):
+        with self._lock:
+            self.engine_step_faults += 1
+
+    def on_recovered(self):
+        with self._lock:
+            self.fault_recoveries += 1
+
+    def on_recompute(self, tokens: int):
+        with self._lock:
+            self.recomputed_tokens += tokens
+
+    def on_degraded_latch(self):
+        with self._lock:
+            self.degraded_latches += 1
+
+    def on_demote(self, nbytes: int):
+        with self._lock:
+            self.kv_demotions += 1
+            self.kv_demoted_bytes += nbytes
+
+    def on_promote(self, nbytes: int):
+        with self._lock:
+            self.kv_promotions += 1
+            self.kv_promoted_bytes += nbytes
+
+    def on_ladder_transition(self, frm, to):
+        """Fold a ladder edge in; ``to`` is a ``ServeLevel``."""
+        with self._lock:
+            self.ladder_transitions += 1
+            if to.name == "BROWNOUT":
+                self.brownout_entries += 1
+            elif to.name == "SHED":
+                self.shed_entries += 1
+
+    def set_tier_gauges(self, ladder_level: int, host_kv_bytes: int):
+        with self._lock:
+            self.ladder_level = int(ladder_level)
+            self.host_kv_bytes = int(host_kv_bytes)
+
     # ---- export -----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "requests_submitted": self.requests_submitted,
                 "requests_rejected": self.requests_rejected,
+                "requests_shed": self.requests_shed,
                 "requests_completed": self.requests_completed,
                 "requests_cancelled": self.requests_cancelled,
                 "requests_timed_out": self.requests_timed_out,
                 "requests_failed": self.requests_failed,
+                "requests_quarantined": self.requests_quarantined,
                 "tokens_generated": self.tokens_generated,
                 "engine_steps": self.engine_steps,
+                "engine_step_faults": self.engine_step_faults,
+                "fault_recoveries": self.fault_recoveries,
+                "recomputed_tokens": self.recomputed_tokens,
+                "degraded_latches": self.degraded_latches,
+                "kv_demotions": self.kv_demotions,
+                "kv_promotions": self.kv_promotions,
+                "kv_demoted_bytes": self.kv_demoted_bytes,
+                "kv_promoted_bytes": self.kv_promoted_bytes,
+                "host_kv_bytes": self.host_kv_bytes,
+                "ladder_level": self.ladder_level,
+                "ladder_transitions": self.ladder_transitions,
+                "brownout_entries": self.brownout_entries,
+                "shed_entries": self.shed_entries,
+                "kv_recalibrations": self.kv_recalibrations,
                 "queue_depth": self.queue_depth,
                 "inflight": self.inflight,
                 "kv_occupancy": self.kv_occupancy,
@@ -181,9 +270,16 @@ class ServingMetrics:
         """Prometheus text exposition (counters + gauges + summary stats)."""
         snap = self.snapshot()
         counters = {"requests_submitted", "requests_rejected",
-                    "requests_completed", "requests_cancelled",
-                    "requests_timed_out", "requests_failed",
-                    "tokens_generated", "engine_steps", "kv_drift_events"}
+                    "requests_shed", "requests_completed",
+                    "requests_cancelled", "requests_timed_out",
+                    "requests_failed", "requests_quarantined",
+                    "tokens_generated", "engine_steps", "kv_drift_events",
+                    "engine_step_faults", "fault_recoveries",
+                    "recomputed_tokens", "degraded_latches",
+                    "kv_demotions", "kv_promotions", "kv_demoted_bytes",
+                    "kv_promoted_bytes", "ladder_transitions",
+                    "brownout_entries", "shed_entries",
+                    "kv_recalibrations"}
         lines = []
         with self._lock:
             summaries = [
@@ -202,18 +298,17 @@ class ServingMetrics:
                                  f"{stat.quantile(q):.9g}")
                 lines.append(f"{full}_sum {stat.sum:.9g}")
                 lines.append(f"{full}_count {stat.count}")
-        for key in ("requests_submitted", "requests_rejected",
-                    "requests_completed", "requests_cancelled",
-                    "requests_timed_out", "requests_failed",
-                    "tokens_generated", "engine_steps", "queue_depth",
-                    "inflight", "kv_occupancy", "kv_occupancy_peak",
-                    "kv_projected_bytes", "kv_observed_bytes",
-                    "kv_drift_events",
-                    "tokens_per_sec", "requests_per_sec"):
+        # every snapshot key renders except the latency aggregates (the
+        # *_s keys), which are exposed as proper summaries above — derived
+        # from the snapshot itself so a new counter/gauge can never be in
+        # one list but not the other
+        for key, val in snap.items():
+            if key.endswith("_s"):
+                continue
             full = f"dstpu_serving_{key}"
             kind = "counter" if key in counters else "gauge"
             lines.append(f"# TYPE {full} {kind}")
-            lines.append(f"{full} {snap[key]:.9g}")
+            lines.append(f"{full} {val:.9g}")
         # tracer-backed span summaries (request phase latencies straight
         # from the dstrace ring: serve/queued, serve/prefill, serve/decode)
         tracer = get_tracer()

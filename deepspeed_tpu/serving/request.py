@@ -43,14 +43,21 @@ class Request:
     except ``cancel()``, which only sets an event the loop polls."""
 
     def __init__(self, uid: int, prompt_tokens: Sequence[int],
-                 max_new_tokens: int, timeout_s: Optional[float] = None):
+                 max_new_tokens: int, timeout_s: Optional[float] = None,
+                 priority: int = 0):
         self.uid = uid
         self.prompt_tokens: List[int] = [int(t) for t in prompt_tokens]
         self.max_new_tokens = max_new_tokens
+        # scheduling class: < 0 is low priority — brownout pauses its
+        # engine admission (it waits in the queue; never silently dropped)
+        self.priority = priority
         self.state = RequestState.QUEUED
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
         self.tokens: List[int] = []
+        # engine-step faults attributed to this request (fault isolation:
+        # past the retry budget the request is quarantined, not retried)
+        self.fault_count = 0
 
         # lifecycle timestamps (monotonic clock; durations only)
         self.arrival_ts = time.monotonic()
@@ -97,6 +104,14 @@ class Request:
         return self._done.wait(timeout=timeout)
 
     # ---- serve-loop-side API ---------------------------------------------
+    def engine_prompt(self) -> List[int]:
+        """Tokens to (re)admit with: the original prompt plus everything
+        already generated — an evicted-and-retried request continues its
+        stream instead of restarting it (tokens already fanned out cannot
+        be unsent), at the cost of recomputing that KV (the
+        ``recomputed_tokens`` counter)."""
+        return self.prompt_tokens + self.tokens
+
     def push_token(self, tok: int, now: Optional[float] = None):
         if self.first_token_ts is None:
             self.first_token_ts = time.monotonic() if now is None else now
@@ -180,6 +195,10 @@ class Request:
             "ttft_s": self.ttft_s,
             "tpot_s": self.tpot_s,
         }
+        if self.priority:
+            out["priority"] = self.priority
+        if self.fault_count:
+            out["fault_count"] = self.fault_count
         if self.error is not None:
             out["error"] = self.error
         return out

@@ -6,6 +6,9 @@ Architecture:
 
   submit() threads --> bounded admission queue --> serve loop (ONE thread)
                                                      |-- engine.admit / step
+                                                     |-- KV tier rebalance
+                                                     |   (demote/promote)
+                                                     |-- degradation ladder
                                                      |-- token fan-out to
                                                      |   per-request streams
                                                      `-- deadline / cancel /
@@ -14,16 +17,42 @@ Architecture:
 The engine is single-threaded by construction (jit dispatch + host-side KV
 bookkeeping), so ONLY the serve loop touches it; callers interact through
 thread-safe ``Request`` objects. Admission control is two-tier: a bounded
-queue (depth) plus a projected KV-occupancy watermark — both reject at
-``submit()`` with a retry-after hint rather than buffering unboundedly.
+queue (depth) plus a projected KV watermark — with the host KV offload
+tier enabled, the projection spans BOTH tiers (device watermark + host
+budget), so overload degrades to *slower* (requests wait demoted in host
+RAM) before it degrades to *429*.
+
+Serving under siege (this file + ``degradation.py`` + ``kv_tier.py``):
+
+* the **degradation ladder** (healthy -> brownout -> shed -> degraded)
+  turns overload into explicit, hysteresis-damped, trace-instrumented
+  states — see ``degradation.py``;
+* **request-level fault isolation**: engine-step exceptions are classified
+  through ``comm.guard.classify_exception``; only FATAL classes latch the
+  sticky degraded 503. Transient faults evict a suspect request (retried
+  with its KV recomputed, quarantined past its retry budget) and health
+  auto-recovers after N clean steps;
+* the serve tick is chaos-drillable (``DSTPU_CHAOS_SERVE_*``) and every
+  transition is an edge-triggered dstrace instant, so a whole overload
+  episode reconstructs from the trace + deterministic counters alone
+  (``bench_serve``).
 """
 
+import dataclasses
 import itertools
 import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+from deepspeed_tpu.comm.guard import CommOutcome, classify_exception
+from deepspeed_tpu.config import constants as C
+from deepspeed_tpu.resilience.chaos import monkey_from_env
+from deepspeed_tpu.serving.degradation import (DegradationLadder,
+                                               LadderConfig, ServeLevel)
+from deepspeed_tpu.serving.kv_tier import (effective_usable_blocks,
+                                           plan_demotions, plan_promotions,
+                                           tier_pressure)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState
 from deepspeed_tpu.telemetry.tracer import get_tracer
@@ -31,9 +60,9 @@ from deepspeed_tpu.utils.logging import logger
 
 
 class BackpressureError(RuntimeError):
-    """Admission rejected: queue full or projected KV occupancy over the
-    watermark. ``retry_after_s`` is the client backoff hint (HTTP 429 +
-    Retry-After in the front-end)."""
+    """Admission rejected: queue full, projected KV occupancy over the
+    watermark, or the degradation ladder is shedding. ``retry_after_s`` is
+    the client backoff hint (HTTP 429 + Retry-After in the front-end)."""
 
     def __init__(self, msg: str, retry_after_s: float):
         super().__init__(msg)
@@ -41,13 +70,13 @@ class BackpressureError(RuntimeError):
 
 
 class ServerClosedError(RuntimeError):
-    """Submission refused: the server is draining or stopped."""
+    """Submission refused: the server is draining, stopped, or degraded."""
 
 
 class _EngineStepError(RuntimeError):
-    """Internal: ``engine.step`` raised — engine state is suspect, so the
-    serve loop fails every engine-resident request (other tick errors are
-    logged and survived)."""
+    """Internal: ``engine.step`` raised. Carries the original exception as
+    ``__cause__`` so the fault handler can classify it (fatal -> sticky
+    degraded; transient -> evict a suspect request and keep serving)."""
 
 
 @dataclass
@@ -61,41 +90,101 @@ class ServingConfig:
     monitor_export_every: int = 0        # engine steps between monitor
     # exports; 0 disables the fan-out even when a monitor is attached
 
+    # --- degradation ladder (degradation.py) ---
+    brownout_pressure: float = 0.85      # pressure >= this -> BROWNOUT
+    shed_pressure: float = 0.97          # pressure >= this -> SHED (429s)
+    ladder_hysteresis: float = 0.10      # descend below threshold - this
+    ladder_cooldown_ticks: int = 20      # calm ticks before descending
+    brownout_max_new_tokens: int = 16    # admission-time cap in brownout
+
+    # --- host KV offload tier (kv_tier.py; default OFF = the pre-tier
+    # admission semantics, same opt-in discipline as async_pipeline) ---
+    kv_offload_enabled: bool = False
+    host_kv_budget_bytes: int = 256 << 20   # host-RAM demotion budget
+    kv_demote_watermark: float = 0.90       # demote above this device frac
+    kv_demote_watermark_brownout: float = 0.60   # aggressive in brownout
+    min_active_requests: int = 1            # never demote below this
+
+    # --- request-level fault isolation ---
+    poison_retry_budget: int = 1         # evict+retry this many times,
+    # then quarantine (FAILED, reason "quarantined")
+    recover_clean_steps: int = 8         # clean engine steps to declare a
+    # fault episode over (serve/recovered instant + counter)
+    max_consecutive_step_faults: int = 8  # latch degraded past this many
+    # engine-step faults with no clean step in between
+
+    @classmethod
+    def from_ds_config(cls, ds_config: dict) -> "ServingConfig":
+        """Build from a DeepSpeed-style config dict's ``"serving"`` group
+        (key constant ``config.constants.SERVING``; unknown keys are an
+        error — config drift must not fail silently)."""
+        group = dict(ds_config.get(C.SERVING, {}) or {})
+        names = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(group) - names)
+        if unknown:
+            raise ValueError(
+                f"unknown '{C.SERVING}' config keys: {unknown}; "
+                f"known: {sorted(names)}")
+        return cls(**group)
+
 
 class InferenceServer:
     """Drives one ``InferenceEngineV2`` from a background thread with
-    continuous batching, streaming fan-out, admission control, and
-    graceful drain (the shutdown AND elastic-resize hook: drain, resize or
-    recreate the engine, start a fresh server)."""
+    continuous batching, streaming fan-out, tiered admission control, a
+    degradation ladder, request-level fault isolation, and graceful drain
+    (the shutdown AND elastic-resize hook: drain, resize or recreate the
+    engine, start a fresh server)."""
 
     def __init__(self, engine, config: Optional[ServingConfig] = None,
-                 monitor=None, membership=None):
+                 monitor=None, membership=None, chaos=None):
         self.engine = engine
         self.config = config or ServingConfig()
         # optional resilience.membership.MembershipView: a wedged/lost peer
         # flips this replica to degraded (503) BEFORE the serve tick walks
         # into a collective that would hang it forever
         self.membership = membership
+        # deterministic fault injection for the serve tick (chaos drills);
+        # picked up from DSTPU_CHAOS_SERVE_* env when not passed explicitly
+        self.chaos = chaos if chaos is not None else monkey_from_env()
         if not 0.0 < self.config.kv_high_watermark <= 1.0:
             # the watermark IS the no-mid-decode-exhaustion invariant: the
             # sum of accepted requests' worst-case blocks never exceeds
             # watermark * usable blocks, so lazy per-step reservation can't
-            # run dry; above 1.0 that guarantee is gone
+            # run dry; above 1.0 that guarantee is gone (with the offload
+            # tier enabled, the tier policy re-establishes it dynamically)
             raise ValueError(
                 f"kv_high_watermark must be in (0, 1], got "
                 f"{self.config.kv_high_watermark}")
         self.metrics = ServingMetrics()
         self.monitor = monitor
+        self.ladder = DegradationLadder(LadderConfig(
+            brownout_pressure=self.config.brownout_pressure,
+            shed_pressure=self.config.shed_pressure,
+            hysteresis=self.config.ladder_hysteresis,
+            cooldown_ticks=self.config.ladder_cooldown_ticks))
         self._uid = itertools.count(1)
         self._lock = threading.Lock()          # queue + tables, never engine
         self._queue: List[Request] = []        # accepted, not yet in engine
         self._inflight: Dict[int, Request] = {}  # uid -> engine-resident
+        self._demoted: List[int] = []          # uids in the host tier (FIFO)
         self._draining = False
         self._stopped = False
         self._degraded: Optional[str] = None   # sticky engine-failure reason
         self._kv_drifted = False   # edge detector for the kv_drift instant
+        self._kv_watermark_scale = 1.0   # drift-recalibrated multiplier
         self._wake = threading.Event()         # submit() nudges the loop
         self._thread: Optional[threading.Thread] = None
+        # the offload tier needs the engine-side hooks (real engines have
+        # them; minimal doubles in tests may not)
+        self._tier_capable = (self.config.kv_offload_enabled
+                              and hasattr(engine, "demote_kv"))
+        self._block_bytes_cache: Optional[int] = None
+        # fault-isolation state (serve-loop-private except the flag)
+        self._tick = 0
+        self._consecutive_faults = 0
+        self._clean_steps = 0
+        self._fault_episode = False            # read by health() under lock
+        self._admitted_since_clean: List[int] = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -163,20 +252,32 @@ class InferenceServer:
     def health(self) -> dict:
         with self._lock:
             queued, inflight = len(self._queue), len(self._inflight)
+            demoted = len(self._demoted)
             degraded = self._degraded
+            fault_episode = self._fault_episode
         state = ("stopped" if self._stopped else
-                 # an engine-step failure means the KV/sequence state is
-                 # suspect: report unhealthy (503 at /healthz) so load
+                 # a FATAL engine-step failure means the KV/sequence state
+                 # is suspect: report unhealthy (503 at /healthz) so load
                  # balancers stop routing here — sticky until the engine is
-                 # replaced (drain + recreate), not self-clearing
+                 # replaced (drain + recreate), not self-clearing.
+                 # Transient step faults do NOT land here (they run the
+                 # evict/retry/quarantine path and auto-recover).
                  "degraded" if degraded else
                  "draining" if self._draining else
                  "serving" if self.running else "not_started")
+        level = self.ladder.level
         out = {"status": state, "ok": state == "serving",
+               "level": level.name.lower(),
+               "level_reason": self.ladder.reason,
                "queued": queued, "inflight": inflight,
+               "demoted": demoted,
+               "fault_episode": fault_episode,
+               "step_faults": self.metrics.engine_step_faults,
                "kv_occupancy": self.engine.kv_occupancy()}
         if degraded:
             out["degraded_reason"] = degraded
+        if self._tier_capable:
+            out["host_kv_bytes"] = self.engine.host_kv_bytes()
         if self.membership is not None:
             out["membership"] = self.membership.summary()
         return out
@@ -185,26 +286,59 @@ class InferenceServer:
     # admission
     # ------------------------------------------------------------------
     def _blocks_for(self, req: Request) -> int:
+        # worst case AT COMPLETION: prompt + full budget. Invariant under
+        # eviction/re-admission (generated tokens move from budget to
+        # prompt, the total is unchanged)
         return self.engine.kv.blocks_needed(
             len(req.prompt_tokens) + req.max_new_tokens)
 
+    def _block_bytes(self) -> int:
+        if self._block_bytes_cache is None:
+            fn = getattr(self.engine, "kv_block_bytes", None)
+            self._block_bytes_cache = fn() if fn is not None else 0
+        return self._block_bytes_cache
+
+    def _host_budget_blocks(self) -> int:
+        """The host tier's capacity expressed in device-block equivalents
+        — what admission projects against beyond the device watermark."""
+        if not self._tier_capable:
+            return 0
+        bb = self._block_bytes()
+        if bb <= 0:
+            return 0
+        return self.config.host_kv_budget_bytes // bb
+
     def submit(self, prompt_tokens: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               timeout_s: Optional[float] = None) -> Request:
+               timeout_s: Optional[float] = None,
+               priority: int = 0) -> Request:
         """Accept a request (thread-safe) or reject synchronously.
-        Raises ``ServerClosedError`` when draining/stopped and
-        ``BackpressureError`` when the queue or the projected KV occupancy
-        is over its limit."""
+        Raises ``ServerClosedError`` when draining/stopped/degraded and
+        ``BackpressureError`` when the ladder sheds, the queue is full, or
+        the projected KV occupancy (both tiers) is over its limit.
+        ``priority < 0`` marks low-priority work whose engine admission is
+        paused during brownout."""
         cfg = self.config
         if max_new_tokens is None:
             max_new_tokens = cfg.default_max_new_tokens
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, "
                              f"got {max_new_tokens}")
+        if not isinstance(priority, int) or isinstance(priority, bool):
+            # the serve loop compares priorities every admission scan — a
+            # stringly-typed priority must be a 400 at the door, not a
+            # TypeError on the loop thread
+            raise ValueError(f"priority must be an int, got {priority!r}")
+        level = self.ladder.level
+        if level >= ServeLevel.BROWNOUT and level < ServeLevel.DEGRADED:
+            # degrade-to-slower: cap the generation budget at the door (the
+            # request still gets tokens, just fewer — 200, not 429)
+            max_new_tokens = min(max_new_tokens, cfg.brownout_max_new_tokens)
         req = Request(uid=next(self._uid), prompt_tokens=prompt_tokens,
                       max_new_tokens=max_new_tokens,
                       timeout_s=(timeout_s if timeout_s is not None
-                                 else cfg.default_timeout_s))
+                                 else cfg.default_timeout_s),
+                      priority=priority)
         if not req.prompt_tokens:
             raise ValueError("empty prompt")
         max_ctx = self.engine.state.max_context_length
@@ -224,6 +358,17 @@ class InferenceServer:
                 raise ServerClosedError(
                     f"server degraded ({self._degraded}); not accepting "
                     "new requests")
+            if level is ServeLevel.SHED:
+                # the ladder's explicit overload rung: reject with a
+                # backoff hint (429 + Retry-After) BEFORE burning queue/
+                # projection arithmetic on a request we can't take
+                self.metrics.on_shed()
+                get_tracer().instant("serve/backpressure", cat="serve",
+                                     kind="shed")
+                raise BackpressureError(
+                    f"shedding load (pressure "
+                    f"{self.ladder.last_pressure:.2f}); retry after "
+                    f"{cfg.retry_after_s:.1f}s", cfg.retry_after_s)
             if len(self._queue) >= cfg.max_queue_depth:
                 self.metrics.on_reject()
                 get_tracer().instant("serve/backpressure", cat="serve",
@@ -233,19 +378,24 @@ class InferenceServer:
                     f"after {cfg.retry_after_s:.1f}s", cfg.retry_after_s)
             # projected occupancy at completion: worst-case blocks of every
             # accepted request (queued AND in flight — an admitted request
-            # keeps reserving blocks as it decodes) + this one
+            # keeps reserving blocks as it decodes) + this one, admitted
+            # against BOTH tiers: the (drift-recalibrated) device watermark
+            # plus the host tier's budget in block equivalents
             total_blocks = max(self.engine.kv_usable_blocks(), 1)
             projected = (sum(self._blocks_for(r) for r in self._queue)
                          + sum(self._blocks_for(r)
                                for r in self._inflight.values())
                          + self._blocks_for(req))
-            if projected / total_blocks > cfg.kv_high_watermark:
+            watermark = cfg.kv_high_watermark * self._kv_watermark_scale
+            capacity = watermark * total_blocks + self._host_budget_blocks()
+            if projected > capacity:
                 self.metrics.on_reject()
                 get_tracer().instant("serve/backpressure", cat="serve",
                                      kind="kv_watermark")
                 raise BackpressureError(
-                    f"projected KV occupancy {projected}/{total_blocks} over "
-                    f"watermark {cfg.kv_high_watermark:.2f}; retry after "
+                    f"projected KV occupancy {projected} blocks over "
+                    f"two-tier capacity {capacity:.0f} (watermark "
+                    f"{watermark:.2f}); retry after "
                     f"{cfg.retry_after_s:.1f}s", cfg.retry_after_s)
             self._queue.append(req)
         self.metrics.on_submit()
@@ -275,16 +425,7 @@ class InferenceServer:
             try:
                 worked = self._serve_once()
             except _EngineStepError as e:
-                # the KV cache / sequence state may be inconsistent after a
-                # failed step: every engine-resident request is compromised
-                # and the replica must stop advertising itself healthy
-                logger.exception("serve loop: engine step failed; failing "
-                                 "in-flight requests")
-                get_tracer().instant("serve/degraded", cat="serve",
-                                     reason="engine_step_failed")
-                with self._lock:
-                    self._degraded = f"engine step failed: {e}"
-                self._fail_all("engine step raised")
+                self._on_step_fault(e)
                 worked = False
             except Exception:
                 # non-engine bookkeeping glitch: requests are still healthy,
@@ -298,21 +439,42 @@ class InferenceServer:
                 self._wake.clear()
 
     def _serve_once(self) -> bool:
+        self._tick += 1
+        if self.chaos is not None:
+            self.chaos.serve_slow_tick(self._tick)
         if self.membership is not None and self._degraded is None:
             if not self._check_membership():
                 return False
         self._expire_and_cancel()
-        self._admit_from_queue()
+        stolen_frac = (self.chaos.serve_kv_pressure(self._tick)
+                       if self.chaos is not None else 0.0)
+        if self._tier_capable:
+            self._rebalance_kv_tiers(stolen_frac)
+        self._admit_from_queue(stolen_frac)
         worked = False
         if self.engine.has_work():
             try:
+                if self.chaos is not None:
+                    self.chaos.maybe_poison_serve(self._active_uids())
                 with get_tracer().span("serve/engine_step", cat="serve"):
                     out = self.engine.step()
             except Exception as e:
                 raise _EngineStepError(str(e)) from e
             self.metrics.on_step()
+            self._note_clean_step()
             worked = True
             self._fan_out(out)
+        elif self._fault_episode:
+            # an idle server is trivially clean: age the fault episode out
+            # on empty ticks too, or a drained replica would advertise
+            # "fault_episode" on /healthz forever (recovery must not
+            # require traffic). The consecutive-fault streak is NOT reset
+            # here — only a real clean step proves the engine healthy.
+            with self._lock:
+                idle = not self._queue and not self._inflight
+            if idle:
+                self._clean_steps += 1
+                self._maybe_recover()
         self._reap()
         with self._lock:
             queued, inflight = len(self._queue), len(self._inflight)
@@ -323,6 +485,7 @@ class InferenceServer:
                                 + sum(self._blocks_for(r)
                                       for r in self._inflight.values()))
         self._reconcile_kv(projected_blocks)
+        self._observe_ladder(queued, stolen_frac)
         self.metrics.set_gauges(queue_depth=queued, inflight=inflight,
                                 kv_occupancy=self.engine.kv_occupancy())
         every = self.config.monitor_export_every
@@ -333,15 +496,308 @@ class InferenceServer:
                 logger.exception("serve loop: monitor export failed")
         return worked
 
+    def _active_uids(self) -> List[int]:
+        """Engine-resident uids the next step will actually plan (demoted
+        ones are paused)."""
+        with self._lock:
+            dem = set(self._demoted)
+            return [u for u in self._inflight if u not in dem]
+
+    # ------------------------------------------------------------------
+    # host KV offload tier (policy in kv_tier.py; movement in the engine)
+    # ------------------------------------------------------------------
+    def _rebalance_kv_tiers(self, stolen_frac: float) -> None:
+        """Watermark-driven demotion (LIFO over admit order) and
+        promotion-on-schedule (FIFO over demotion order). Bookkeeping is
+        pure host arithmetic (DS002-registered); the page copies happen
+        inside the engine demote/promote calls this decides to issue."""
+        cfg = self.config
+        usable = max(self.engine.kv_usable_blocks(), 1)
+        effective = effective_usable_blocks(usable, stolen_frac)
+        watermark = cfg.kv_high_watermark * self._kv_watermark_scale
+        capacity = watermark * effective
+        demote_wm = (cfg.kv_demote_watermark_brownout
+                     if self.ladder.level >= ServeLevel.BROWNOUT
+                     else cfg.kv_demote_watermark)
+        with self._lock:
+            dem = set(self._demoted)
+            snapshot = list(self._inflight.items())
+        # demotion candidates: engine-resident, not already demoted, and
+        # not done (a done sequence is reaped this tick — gathering its
+        # pages would be a wasted copy that skews the demotion counters)
+        active = []
+        for u, r in snapshot:
+            if u in dem:
+                continue
+            seq = self.engine.state.get(u)
+            if seq is None or seq.done:
+                continue
+            active.append(r)
+        worst = [self._blocks_for(r) for r in active]
+        held = [self.engine.kv_held_blocks(r.uid) for r in active]
+        reserved = self.engine.kv_reserved_blocks()
+        # ---- demotion (most recently admitted first), bounded by the
+        # host budget: once the host tier is full, demotion stops and the
+        # pressure has to SURFACE (ladder -> brownout/shed) instead of
+        # silently overflowing host RAM
+        plan = plan_demotions(worst, held, reserved, capacity,
+                              demote_wm * effective,
+                              cfg.min_active_requests)
+        bb = self._block_bytes()
+        demoted_now = 0
+        executed = set()
+        for i in plan:
+            victim = active[i]
+            if (self.engine.host_kv_bytes()
+                    + self.engine.kv_held_blocks(victim.uid) * bb
+                    > cfg.host_kv_budget_bytes):
+                break
+            freed = self.engine.demote_kv(victim.uid)
+            with self._lock:
+                self._demoted.append(victim.uid)
+            executed.add(i)
+            demoted_now += 1
+            self.metrics.on_demote(freed)
+            get_tracer().instant("serve/kv_demote", cat="serve",
+                                 uid=victim.uid, bytes=freed,
+                                 stolen_frac=round(stolen_frac, 3))
+        active_worst_sum = 0
+        for i, w in enumerate(worst):
+            if i not in executed:
+                active_worst_sum += w
+        # ---- promotion (longest-demoted first; done sequences are
+        # reaped this tick — restoring their pages would be a wasted
+        # host->device copy that skews the promotion counters) ----
+        with self._lock:
+            demoted_pairs = [(u, self._inflight[u]) for u in self._demoted
+                             if u in self._inflight]
+        demoted_reqs = []
+        for u, req in demoted_pairs:
+            seq = self.engine.state.get(u)
+            if seq is None or seq.done:
+                continue
+            demoted_reqs.append(req)
+        if demoted_reqs:
+            d_worst = [self._blocks_for(r) for r in demoted_reqs]
+            d_held = [self.engine.demoted_blocks(r.uid)
+                      for r in demoted_reqs]
+            n_promote = plan_promotions(d_worst, d_held, active_worst_sum,
+                                        capacity, self.engine.kv.free_blocks,
+                                        self.engine.kv_reserved_blocks(),
+                                        demote_wm * effective)
+            for r in demoted_reqs[:n_promote]:
+                restored = self.engine.promote_kv(r.uid)
+                if restored is None:
+                    break
+                with self._lock:
+                    if r.uid in self._demoted:
+                        self._demoted.remove(r.uid)
+                self.metrics.on_promote(restored)
+                get_tracer().instant("serve/kv_promote", cat="serve",
+                                     uid=r.uid, bytes=restored)
+        if demoted_now or demoted_reqs:
+            tracer = get_tracer()
+            if tracer.enabled:
+                # the dsmem counter-track idiom: tier state as a stacked
+                # Perfetto counter time-aligned with the serve spans
+                tracer.counter(
+                    "serve/kv_tier", cat="mem",
+                    device_reserved_blocks=self.engine.kv_reserved_blocks(),
+                    host_bytes=self.engine.host_kv_bytes(),
+                    demoted_requests=len(self._demoted))
+
+    # ------------------------------------------------------------------
+    # degradation ladder
+    # ------------------------------------------------------------------
+    def _observe_ladder(self, queued: int, stolen_frac: float) -> None:
+        """One pressure observation per tick. Pure host arithmetic
+        (DS002-registered); the ladder emits its own edge instants."""
+        usable = max(self.engine.kv_usable_blocks(), 1)
+        effective = effective_usable_blocks(usable, stolen_frac)
+        reserved_fn = getattr(self.engine, "kv_reserved_blocks", None)
+        if reserved_fn is not None:
+            reserved = reserved_fn()
+        else:
+            reserved = int(self.engine.kv_occupancy() * usable)
+        host_bytes = (self.engine.host_kv_bytes()
+                      if self._tier_capable else 0)
+        pressure, reason = tier_pressure(
+            reserved, effective, queued, self.config.max_queue_depth,
+            host_bytes, self.config.host_kv_budget_bytes
+            if self._tier_capable else 0)
+        edge = self.ladder.observe(pressure, reason=reason)
+        if edge is not None:
+            self.metrics.on_ladder_transition(*edge)
+        self.metrics.set_tier_gauges(int(self.ladder.level), host_bytes)
+
+    def _latch_degraded(self, reason: str) -> None:
+        """The sticky 503 — reserved for REAL engine faults (fatal
+        classification, lost peers, repeated unattributable step faults)."""
+        get_tracer().instant("serve/degraded", cat="serve", reason=reason)
+        with self._lock:
+            self._degraded = reason
+        edge = self.ladder.latch_degraded(reason)
+        if edge is not None:
+            # the ->DEGRADED edge counts like every other ladder edge, so
+            # metrics.ladder_transitions ties out against ladder.transitions
+            self.metrics.on_ladder_transition(*edge)
+        self.metrics.on_degraded_latch()
+
+    # ------------------------------------------------------------------
+    # request-level fault isolation
+    # ------------------------------------------------------------------
+    def _note_clean_step(self) -> None:
+        """A successful engine step: reset the fault window; after N clean
+        steps a fault episode is declared over (health auto-recovery — the
+        anti-sticky-503 half of the isolation story)."""
+        self._consecutive_faults = 0
+        if self._admitted_since_clean:
+            self._admitted_since_clean.clear()
+        if self._fault_episode:
+            self._clean_steps += 1
+            self._maybe_recover()
+
+    def _maybe_recover(self) -> None:
+        if self._clean_steps >= self.config.recover_clean_steps:
+            with self._lock:
+                self._fault_episode = False
+            self._clean_steps = 0
+            self.metrics.on_recovered()
+            get_tracer().instant("serve/recovered", cat="serve",
+                                 clean_steps=self.config.recover_clean_steps)
+
+    def _on_step_fault(self, err: _EngineStepError) -> None:
+        """Classify an engine-step exception through the PR 6 taxonomy:
+        FATAL latches the sticky degraded 503 (the only thing that
+        should); TRANSIENT/TIMEOUT evicts a suspect request — retried with
+        its KV recomputed, quarantined past its retry budget — so one bad
+        request cannot take the replica down."""
+        cause = err.__cause__ if err.__cause__ is not None else err
+        outcome = classify_exception(cause)
+        self.metrics.on_step_fault()
+        self._consecutive_faults += 1
+        self._clean_steps = 0
+        with self._lock:
+            self._fault_episode = True
+        get_tracer().instant("serve/step_fault", cat="serve",
+                             outcome=outcome.value,
+                             consecutive=self._consecutive_faults,
+                             error=repr(cause)[:200])
+        if outcome is CommOutcome.FATAL:
+            # the KV cache / sequence state may be inconsistent after a
+            # fatal step failure: every engine-resident request is
+            # compromised and the replica must stop advertising itself
+            logger.exception("serve loop: FATAL engine step failure; "
+                             "failing in-flight requests")
+            self._latch_degraded(f"engine step failed: {cause}")
+            self._fail_all("engine step raised (fatal)")
+            return
+        logger.warning(f"serve loop: transient engine step fault "
+                       f"#{self._consecutive_faults}: {cause!r}")
+        # the fixed fault budget only applies once isolation has run out
+        # of suspects: while every fault still evicts someone, the suspect
+        # pool strictly shrinks (evicted retries are held from
+        # re-admission during the fault window), so blame WILL reach the
+        # poison even when it was admitted first among many — latching on
+        # a raw count mid-search would 503 the replica over one bad
+        # request with a deep batch. The 4x backstop still bounds
+        # pathological churn absolutely.
+        suspect = self._pick_suspect()
+        if suspect is None or self._consecutive_faults >= \
+                4 * max(self.config.max_consecutive_step_faults, 1):
+            if self._consecutive_faults >= \
+                    self.config.max_consecutive_step_faults:
+                # nothing left to evict (or the backstop tripped) and the
+                # engine still faults — the engine itself is sick
+                self._latch_degraded(
+                    f"{self._consecutive_faults} consecutive engine step "
+                    f"faults, last: {cause}")
+                self._fail_all("engine step raised repeatedly")
+            return
+        self._evict_for_retry(suspect, cause)
+
+    def _pick_suspect(self) -> Optional[Request]:
+        """The most recently admitted ACTIVE request that has never
+        survived a clean step — the request whose arrival correlates with
+        the engine starting to fault. Falls back to the most recent active
+        admission. Demoted (paused) requests are never suspects: they are
+        not in the step plan, so they cannot have caused the fault —
+        blaming one would quarantine an innocent while the real poison
+        keeps faulting."""
+        with self._lock:
+            dem = set(self._demoted)
+            for uid in reversed(self._admitted_since_clean):
+                req = self._inflight.get(uid)
+                if (req is not None and uid not in dem
+                        and not req.state.terminal):
+                    return req
+            for uid in reversed(list(self._inflight)):
+                req = self._inflight[uid]
+                if uid not in dem and not req.state.terminal:
+                    return req
+        return None
+
+    def _evict_for_retry(self, req: Request, cause: BaseException) -> None:
+        """Remove a suspect from the engine. Within its retry budget it
+        goes back to the queue for retry (its stream continues — the
+        already-sent tokens become prompt, KV recomputed at re-admission);
+        past the budget it is quarantined (FAILED, never retried)."""
+        with self._lock:
+            self._inflight.pop(req.uid, None)
+            if req.uid in self._admitted_since_clean:
+                self._admitted_since_clean.remove(req.uid)
+            if req.uid in self._demoted:
+                self._demoted.remove(req.uid)
+        try:
+            self.engine.finish(req.uid)
+            # the reap may flush OTHER sequences already marked done this
+            # tick (cancel/timeout/eos) — settle them, or their requests
+            # leak in _inflight forever (drain would never converge)
+            self._settle_reaped(self.engine.reap_finished())
+        except Exception:
+            logger.exception("serve loop: evicting suspect %s failed",
+                             req.uid)
+        req.fault_count += 1
+        if req.fault_count > self.config.poison_retry_budget:
+            get_tracer().instant("serve/quarantine", cat="serve",
+                                 uid=req.uid, faults=req.fault_count)
+            logger.error(f"serve loop: quarantining request {req.uid} "
+                         f"after {req.fault_count} engine-step faults")
+            req.finalize(RequestState.FAILED, "quarantined",
+                         error=f"engine step fault x{req.fault_count}: "
+                               f"{cause}")
+            self.metrics.on_quarantine()
+            self.metrics.on_finish(req)
+            return
+        recompute = len(req.prompt_tokens) + len(req.tokens)
+        self.metrics.on_recompute(recompute)
+        get_tracer().instant("serve/evicted", cat="serve", uid=req.uid,
+                             faults=req.fault_count,
+                             recompute_tokens=recompute)
+        req.state = RequestState.QUEUED
+        with self._lock:
+            # BACK of the queue: co-evicted suspects rotate through
+            # re-admission order, so blame cycles across the suspect set
+            # instead of pinning the same (possibly innocent) request
+            self._queue.append(req)
+        self._wake.set()
+
+    # ------------------------------------------------------------------
+    # KV drift reconciliation (projected model vs engine reality)
+    # ------------------------------------------------------------------
     def _reconcile_kv(self, projected_blocks: int) -> None:
         """Reconcile the projected KV watermark (admission control's model
         of memory) against what the engine actually reserved — so the
         model itself is observable: ``kv_projected_bytes`` vs
         ``kv_observed_bytes`` gauges on ``/metrics``, a ``serve/kv_bytes``
         counter track on the dstrace timeline, and an edge-triggered
-        ``serve/kv_drift`` instant when they diverge >10% (the projection
-        over-reserving is expected mid-decode; *sustained* divergence
-        means admission is turning work away on memory it actually has).
+        ``serve/kv_drift`` instant when they diverge >10%. A drift edge no
+        longer passes silently: when the engine holds MORE than the model
+        projected (the unsafe direction — leaked blocks, bookkeeping bug)
+        the effective watermark is recalibrated down by the observed ratio
+        (``serve/kv_recalibrate`` instant + counter) and restored to 1.0
+        when the drift clears. The safe direction (projection worst-case >
+        current reservation, expected mid-decode) recalibrates nothing.
         Pure host-int arithmetic — the serve tick stays sync-free."""
         block_bytes = getattr(self.engine, "kv_block_bytes", None)
         if block_bytes is None:
@@ -364,6 +820,23 @@ class InferenceServer:
                 projected_bytes=projected, observed_bytes=observed,
                 drift_frac=round(abs(projected - observed)
                                  / max(projected, observed), 4))
+        # recalibration tracks the ratio EVERY tick (the drift instant is
+        # edge-triggered, the scale is not): a safe-direction episode that
+        # flips unsafe mid-drift, or an unsafe one that worsens, must move
+        # the watermark — only the >1% dead band keeps the instants from
+        # firing every tick on ratio jitter
+        if drifted and observed > projected:
+            scale = max(projected / observed, 0.5)
+        else:
+            scale = 1.0
+        if abs(scale - self._kv_watermark_scale) > 0.01:
+            with self._lock:
+                self._kv_watermark_scale = scale
+            self.metrics.on_kv_recalibrate()
+            tracer.instant("serve/kv_recalibrate", cat="serve",
+                           watermark_scale=round(scale, 4),
+                           direction=("observed_over_projected"
+                                      if scale < 1.0 else "drift_cleared"))
         self._kv_drifted = drifted
 
     def _check_membership(self) -> bool:
@@ -383,42 +856,90 @@ class InferenceServer:
         reason = f"comm peer(s) lost: {lost}"
         logger.error(f"serve loop: {reason}; degrading replica instead of "
                      "stepping into a wedged collective")
-        get_tracer().instant("serve/degraded", cat="serve",
-                             reason="peer_lost", ranks=str(lost))
-        with self._lock:
-            self._degraded = reason
+        self._latch_degraded(reason)
         self._fail_all(reason)
         return False
 
-    def _admit_from_queue(self):
-        """FIFO admission while the engine currently has room for the
-        request's FULL worst case (prompt + max_new_tokens). Note
-        ``can_schedule`` checks free blocks WITHOUT reserving — the actual
-        no-mid-decode-exhaustion guarantee is submit()'s worst-case
-        projection against the <=1.0 KV watermark."""
+    def _admit_from_queue(self, stolen_frac: float = 0.0):
+        """FIFO admission while the engine has room for the request's FULL
+        worst case (prompt + max_new_tokens) AND the active worst-case sum
+        stays under the (possibly pressure-shrunk) capacity line — the
+        dynamic form of the no-mid-decode-exhaustion invariant once the
+        offload tier lets accepted work exceed device capacity. Brownout
+        pauses low-priority admits (they wait in the queue, never silently
+        dropped)."""
+        brownout = self.ladder.level >= ServeLevel.BROWNOUT
+        if self._tier_capable:
+            # computed once, incremented per admission (the sum changes by
+            # exactly the admitted request's worst case) — rescanning the
+            # whole inflight table per admitted request would make a deep
+            # queue drain O(queue x inflight) on the serve-loop thread
+            usable = max(self.engine.kv_usable_blocks(), 1)
+            effective = effective_usable_blocks(usable, stolen_frac)
+            capacity = (self.config.kv_high_watermark
+                        * self._kv_watermark_scale * effective)
+            active_worst = self._active_worstcase()
         while True:
+            # hold evicted retries while the fault window is open AND the
+            # engine still has other work: re-admitting a retry into a
+            # faulting batch makes it the "most recent admission" again and
+            # blame-attribution would keep landing on it. When nothing
+            # else can run, the retry IS admitted — alone, which is
+            # exactly the isolation that disambiguates poison from victim
+            hold_retries = (self._consecutive_faults > 0
+                            and self.engine.has_work())
             with self._lock:
-                if not self._queue:
+                req = None
+                for cand in self._queue:
+                    if brownout and cand.priority < 0:
+                        continue
+                    if cand.fault_count > 0 and hold_retries:
+                        continue
+                    req = cand
+                    break
+                if req is None:
                     return
-                req = self._queue[0]
+            need_blocks = self._blocks_for(req)
+            if self._tier_capable and active_worst + need_blocks > capacity:
+                return
             need = len(req.prompt_tokens) + req.max_new_tokens
             if not self.engine.can_schedule([req.uid], [need]):
                 return
             with self._lock:
-                self._queue.pop(0)
+                self._queue.remove(req)
                 self._inflight[req.uid] = req
+                self._admitted_since_clean.append(req.uid)
             try:
-                self.engine.admit(req.uid, req.prompt_tokens)
+                self.engine.admit(req.uid, req.engine_prompt())
             except Exception as e:
                 # fail THIS request, not the batch (e.g. prompt longer than
                 # the engine's max context)
                 with self._lock:
                     self._inflight.pop(req.uid, None)
+                    if req.uid in self._admitted_since_clean:
+                        self._admitted_since_clean.remove(req.uid)
                 req.finalize(RequestState.FAILED, "error", error=repr(e))
                 self.metrics.on_finish(req)
                 continue
-            req.admit_ts = time.monotonic()
+            if req.admit_ts is None:
+                # first admission only: re-admissions after eviction keep
+                # the original queue-wait/TTFT edges
+                req.admit_ts = time.monotonic()
             req.state = RequestState.PREFILL
+            if self._tier_capable:
+                active_worst += need_blocks
+
+    def _active_worstcase(self) -> int:
+        """Worst-case-at-completion block sum of ACTIVE (non-demoted)
+        engine-resident requests — the left side of the dynamic admission
+        invariant."""
+        with self._lock:
+            dem = set(self._demoted)
+            total = 0
+            for uid, r in self._inflight.items():
+                if uid not in dem:
+                    total += self._blocks_for(r)
+            return total
 
     def _fan_out(self, step_out: Dict[int, int]):
         now = time.monotonic()
@@ -465,12 +986,22 @@ class InferenceServer:
             req.finalize(RequestState.TIMED_OUT, "timeout")
 
     def _reap(self):
-        """Release engine state (KV blocks, sequence slots) for every done
-        sequence and settle the owning requests."""
-        reaped = self.engine.reap_finished()
+        """Release engine state (KV blocks in EITHER tier, sequence slots)
+        for every done sequence and settle the owning requests."""
+        self._settle_reaped(self.engine.reap_finished())
+
+    def _settle_reaped(self, reaped) -> None:
+        """Settle the owning requests of reaped uids — shared by the tick
+        reap AND the fault-eviction path (whose reap_finished() may flush
+        OTHER done sequences too; dropping those uids would leak their
+        requests in ``_inflight`` forever)."""
         for uid in reaped:
             with self._lock:
                 req = self._inflight.pop(uid, None)
+                if uid in self._demoted:
+                    self._demoted.remove(uid)
+                if uid in self._admitted_since_clean:
+                    self._admitted_since_clean.remove(uid)
             if req is None:
                 continue
             if not req.state.terminal:
@@ -484,6 +1015,8 @@ class InferenceServer:
             self._queue.clear()
             inflight = list(self._inflight)
             self._inflight.clear()
+            self._demoted.clear()
+            self._admitted_since_clean.clear()
         for req in victims:
             req.finalize(RequestState.FAILED, "error", error=why)
             self.metrics.on_finish(req)

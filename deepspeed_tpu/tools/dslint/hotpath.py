@@ -91,13 +91,37 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         forbidden=ENGINE_HOT_FORBIDDEN,
     ),
     # the serving tick: one thread drives admit/step/fan-out for every live
-    # request — a sync here stalls every stream at once
+    # request — a sync here stalls every stream at once. The PR 10 siege
+    # helpers (KV tier rebalance, ladder observation, drift reconcile,
+    # fault-window bookkeeping) run EVERY tick and are registered to PROVE
+    # the ladder and KV-tier bookkeeping never host-sync the tick: the
+    # only device touches are the engine demote/promote calls the
+    # rebalance *decides* to issue, which are deliberate off-path copies
     HotPathSpec(
         path="deepspeed_tpu/serving/server.py",
         cls="InferenceServer",
         hot_functions=("_serve_once", "_admit_from_queue", "_fan_out",
-                       "_reap"),
+                       "_reap", "_settle_reaped", "_rebalance_kv_tiers",
+                       "_observe_ladder", "_reconcile_kv",
+                       "_active_worstcase", "_active_uids",
+                       "_note_clean_step"),
         forbidden=ENGINE_FORBIDDEN,
+    ),
+    # the degradation ladder's per-tick observation + edge transition:
+    # pure host arithmetic feeding edge-triggered trace instants
+    HotPathSpec(
+        path="deepspeed_tpu/serving/degradation.py",
+        cls="DegradationLadder",
+        hot_functions=("observe", "_transition"),
+    ),
+    # the KV tier planners: the decision half of the offload tier is pure
+    # int arithmetic over the request tables (page movement lives in the
+    # engine, invoked off these plans)
+    HotPathSpec(
+        path="deepspeed_tpu/serving/kv_tier.py",
+        cls=None,
+        hot_functions=("effective_usable_blocks", "plan_demotions",
+                       "plan_promotions", "tier_pressure"),
     ),
     # the prefetch worker exists to overlap H2D with compute; a host sync in
     # the worker body (outside stage_fn, which the engine owns) re-serializes
