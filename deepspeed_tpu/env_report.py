@@ -70,6 +70,7 @@ def debug_report():
     rows.extend(trace_report())
     rows.extend(plan_report())
     rows.extend(memory_report())
+    rows.extend(serving_report())
     rows.extend(comms_report())
     return rows
 
@@ -177,6 +178,42 @@ def plan_report():
         return rows
     except Exception as e:   # the report must never die on tooling drift
         return [("dstpu plan", f"unavailable ({e})")]
+
+
+def serving_report():
+    """Serving capacity-efficiency status: the prefix-cache hit ratio
+    and host-tier compression from the last bench_serve artifact
+    (``$DSTPU_SERVE_REPORT`` or ./bench_serve.json) — the serving
+    counterpart of the plan/mem artifact rows."""
+    import json
+    import os
+    artifact = os.environ.get("DSTPU_SERVE_REPORT") or (
+        "bench_serve.json" if os.path.exists("bench_serve.json") else None)
+    hint = ("no artifact (bin/dstpu_bench_serve --scenario multi_turn "
+            "--json bench_serve.json, or set $DSTPU_SERVE_REPORT)")
+    try:
+        if not artifact or not os.path.exists(artifact):
+            return [("prefix cache", hint)]
+        with open(artifact) as f:
+            rep = json.load(f)
+        prefix = rep.get("prefix") or {}
+        if not prefix:
+            return [("prefix cache",
+                     f"{artifact} (no prefix section — cache disabled?)")]
+        name = (rep.get("scenario") or {}).get("name", "?")
+        rows = [("prefix cache",
+                 f"{artifact} ({name}: hit ratio "
+                 f"{prefix.get('prefix_hit_ratio', 0.0) * 100:.0f}%, "
+                 f"{prefix.get('prefill_tokens_saved', 0)}/"
+                 f"{prefix.get('prefill_tokens_total', 0)} prefill "
+                 f"tokens saved)")]
+        comp = prefix.get("host_compression_ratio", 1.0)
+        rows.append(("host kv tier",
+                     f"compression {comp:.1f}x"
+                     f"{' (full width)' if comp == 1.0 else ''}"))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("prefix cache", f"unavailable ({e})")]
 
 
 def comms_report():

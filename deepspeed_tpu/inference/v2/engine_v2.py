@@ -22,8 +22,11 @@ from deepspeed_tpu.inference.v2.generic_decode import (decode_step_g,
                                                        prefill_chunk_g,
                                                        verify_chunk_g)
 from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache, KVCacheConfig
-from deepspeed_tpu.inference.v2.kv_offload import HostKVEntry, HostKVStore
+from deepspeed_tpu.inference.v2.kv_offload import (HostKVEntry, HostKVStore,
+                                                   dequantize_pages,
+                                                   quantize_pages)
 from deepspeed_tpu.inference.v2.modules import policy_for
+from deepspeed_tpu.inference.v2.prefix_cache import PrefixCache
 from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor, StateManager
 from deepspeed_tpu.inference.v2.sampling import SamplingConfig, sample_tokens
 from deepspeed_tpu.inference.v2.scheduler import (
@@ -69,6 +72,16 @@ class V2EngineConfig:
     # sampling)
     speculative_k: int = 0
     speculative_ngram: int = 3
+    # block-granular radix prefix cache (prefix_cache.py): admission
+    # reuses already-materialized KV blocks for the longest cached
+    # prompt prefix (refcounted pins on shared pages) and only prefills
+    # the novel suffix. Default OFF = pre-cache semantics (same opt-in
+    # discipline as kv_offload / async_pipeline); the serving group's
+    # `prefix_cache_enabled` flips it on through enable_prefix_cache()
+    prefix_cache_enabled: bool = False
+    # soft cap on UNPINNED cached blocks (0 = unlimited up to pool size);
+    # the serve tick trims the cache down to it even without pressure
+    prefix_cache_max_blocks: int = 0
 
 
 class InferenceEngineV2:
@@ -126,10 +139,29 @@ class InferenceEngineV2:
         self._dev_tables = None
         # host-RAM KV offload tier (serving demotion target; kv_offload.py)
         self.host_kv = HostKVStore()
+        # radix prefix cache over KV pages (prefix_cache.py); None = off
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.config.kv_block_size,
+                        self.config.prefix_cache_max_blocks)
+            if self.config.prefix_cache_enabled else None)
+        # prefill-work conservation counters (prefix_stats): at drain,
+        # saved + computed == total exactly (never-prefilled remainders
+        # of cancelled sequences are subtracted from total at flush)
+        self._prefill_total = 0
+        self._prefill_saved = 0
+        self._prefill_computed = 0
         # speculative-decoding counters (speculative_stats)
         self._spec_steps = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
+
+    def enable_prefix_cache(self, max_cached_blocks: int = 0) -> None:
+        """Turn the radix prefix cache on (idempotent) — the serving
+        layer's wiring point for the ``serving.prefix_cache_enabled``
+        config key when the engine wasn't constructed with it."""
+        if self.prefix_cache is None:
+            self.prefix_cache = PrefixCache(self.config.kv_block_size,
+                                            max_cached_blocks)
 
     # ------------------------------------------------------------------
     # admission control (reference: engine_v2.py:158 query, :184 can_schedule)
@@ -148,17 +180,48 @@ class InferenceEngineV2:
         for uid, n in zip(uids, lengths):
             needed, _ = self.query(uid, n)
             total += needed
-        return total <= self.kv.free_blocks and \
+        # unpinned cached prefix blocks count as schedulable capacity:
+        # they are evicted on demand the moment a reservation needs them
+        return total <= self.kv.free_blocks + self._evictable_blocks() and \
             len(self.state) + len([u for u in uids if u not in self.state]) <= \
             self.state.max_tracked_sequences
 
     # ------------------------------------------------------------------
     # block bookkeeping
     # ------------------------------------------------------------------
+    def _evictable_blocks(self) -> int:
+        return (self.prefix_cache.evictable_blocks()
+                if self.prefix_cache is not None else 0)
+
+    def _reserve(self, num_blocks: int) -> List[int]:
+        """Reserve device blocks, reclaiming unpinned prefix-cache blocks
+        on demand when the free list alone can't cover the request —
+        cached-but-unreferenced pages are capacity, not occupancy."""
+        if self.prefix_cache is not None and \
+                num_blocks > self.kv.free_blocks:
+            self.evict_prefix_blocks(num_blocks - self.kv.free_blocks)
+        return self.kv.reserve(num_blocks)
+
+    def evict_prefix_blocks(self, want: int) -> int:
+        """Evict up to ``want`` unpinned cached blocks (LRU leaf-first)
+        and release them to the allocator. Returns blocks actually
+        freed. Called on-demand by reservation and by the serving tier's
+        pressure policy (cache eviction ALWAYS precedes sequence
+        demotion — see serving/kv_tier.plan_prefix_evictions)."""
+        if self.prefix_cache is None or want <= 0:
+            return 0
+        blocks = self.prefix_cache.evict_blocks(
+            self.prefix_cache.plan_evictions(want))
+        if blocks:
+            # refs == 0 by construction: no reader left, a plain release
+            # (with its scale reset) is exactly right
+            self.kv.release(blocks)
+        return len(blocks)
+
     def _ensure_blocks(self, seq: SequenceDescriptor, up_to_tokens: int):
         need = self.kv.blocks_needed(up_to_tokens) - len(seq.blocks)
         if need > 0:
-            seq.blocks.extend(self.kv.reserve(need))
+            seq.blocks.extend(self._reserve(need))
 
     def _block_table(self, seq: SequenceDescriptor, bucket_blocks: int) -> np.ndarray:
         trash = self.kv.cfg.num_blocks - 1
@@ -188,9 +251,27 @@ class InferenceEngineV2:
                 seq.prompt_tokens = np.concatenate(
                     [seq.prompt_tokens, np.asarray(toks, np.int32)])
                 seq.done = False
+                self._prefill_total += len(toks)
             else:
-                self.state.create(uid, toks)
+                self._prefix_admit(self.state.create(uid, toks))
         return self.step()
+
+    def _prefix_admit(self, seq: SequenceDescriptor) -> int:
+        """Prefix-cache admission for a freshly created sequence: pin the
+        longest cached full-block prefix of its prompt, seed its block
+        table with the shared pages, and mark that prefix as already
+        seen — prefill then covers only the novel suffix. Returns the
+        reused token count. Pure bookkeeping; no page moves."""
+        self._prefill_total += len(seq.prompt_tokens)
+        if self.prefix_cache is None:
+            return 0
+        blocks, matched = self.prefix_cache.admit_match(
+            seq.uid, seq.prompt_tokens)
+        if matched:
+            seq.blocks = list(blocks)
+            seq.seen_tokens = matched
+            self._prefill_saved += matched
+        return matched
 
     def step(self) -> Dict[int, int]:
         plan = plan_step(self.state.decoding(), self.state.prefilling(),
@@ -218,6 +299,15 @@ class InferenceEngineV2:
                 block_size=self.kv.cfg.block_size,
                 attn_impl=self.config.attn_impl)
             seq.seen_tokens = end
+            self._prefill_computed += chunk.length
+            if self.prefix_cache is not None:
+                # register the freshly materialized FULL prompt blocks so
+                # concurrent arrivals with the same prefix reuse them
+                # (pinned for this sequence's lifetime — the pin is what
+                # keeps a shared page safe from release/demotion)
+                self.prefix_cache.insert_from_seq(
+                    seq.uid, seq.prompt_tokens, seq.blocks,
+                    min(seq.seen_tokens, len(seq.prompt_tokens)))
             if not seq.in_prefill:
                 tok = int(self._sample_batch(logits[None])[0])
                 seq.generated.append(tok)
@@ -282,21 +372,60 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------
     def flush(self, uid: int) -> List[int]:
         """Release a sequence's KV blocks (both tiers); returns its
-        generated tokens."""
+        generated tokens. With the prefix cache on, full blocks covering
+        the materialized prompt+generated history are ABSORBED into the
+        trie instead of freed (refcount 0, evictable) — the multi-turn
+        win: the next turn's prompt starts with exactly these tokens —
+        and blocks the cache owns are excluded from the allocator
+        release (pinned pages additionally excluded from the fp8 scale
+        reset inside ``BlockedKVCache.release``)."""
         seq = self.state.pop(uid)
-        self.kv.release(seq.blocks)
+        if seq.in_prefill:
+            # cancelled mid-prefill: the never-computed remainder leaves
+            # the conservation identity (saved + computed == total) exact
+            self._prefill_total -= max(
+                len(seq.prompt_tokens) - seq.seen_tokens, 0)
+        if self.prefix_cache is not None:
+            history = np.concatenate(
+                [seq.prompt_tokens,
+                 np.asarray(seq.generated, np.int32)]) if seq.generated \
+                else seq.prompt_tokens
+            self.prefix_cache.insert_from_seq(
+                uid, history, seq.blocks, seq.seen_tokens, pin=False)
+            self.prefix_cache.release_seq(uid)
+            cache = self.prefix_cache
+            # cache-owned blocks (pinned OR retained at refs 0) are
+            # excluded outright — the owns() partition is what protects
+            # shared pages and their fp8 scales here; release(pinned=)
+            # remains the contract for callers without a partition
+            self.kv.release([b for b in seq.blocks if not cache.owns(b)])
+        else:
+            self.kv.release(seq.blocks)
         self.host_kv.pop(uid)     # no-op unless the sequence was demoted
         return seq.generated
 
     # ------------------------------------------------------------------
     # host KV offload tier (serving demotion/promotion; kv_offload.py)
     # ------------------------------------------------------------------
-    def demote_kv(self, uid: int) -> int:
+    def demote_kv(self, uid: int, quantize: str = "none") -> int:
         """Spill a sequence's KV pages to host RAM and release its device
         blocks; the sequence pauses (invisible to the step planner) until
         ``promote_kv``. Returns host bytes now held for it (0 when the uid
         is unknown or already demoted). A deliberate device->host copy —
-        called from the serving tier policy, never from the jitted step."""
+        called from the serving tier policy, never from the jitted step.
+
+        ``quantize`` selects the host-tier page codec ("none"/"int8"/
+        "fp8", the serving group's ``host_kv_quantize``): the gathered
+        pages are stored narrow with per-page fp32 scales, roughly
+        doubling-to-quadrupling the host budget's effective blocks.
+        Device-fp8 pages are never re-quantized (their scales already
+        ride along; the round-trip stays bit-identical).
+
+        Prefix-cache composition: pages the cache owns are NOT discarded
+        with the sequence — this reader's pins drop, but the pages stay
+        on device for the surviving readers (or evictable at refcount 0)
+        AND travel to the host tier inside this entry, so promotion is
+        self-sufficient even if the cached copies get evicted meanwhile."""
         seq = self.state.get(uid)
         if seq is None or seq.paused or seq.done:
             # a done sequence is about to be reaped — gathering its pages
@@ -306,10 +435,24 @@ class InferenceEngineV2:
             data, scales = self.kv.gather_blocks(seq.blocks)
         else:
             data, scales = None, None
+        codec = "none"
+        qscales = None
+        raw = (int(data.nbytes) if data is not None else 0) + \
+              (int(scales.nbytes) if scales is not None else 0)
+        if data is not None and quantize != "none" and \
+                self.kv.cfg.dtype != jnp.float8_e4m3fn:
+            data, qscales = quantize_pages(data, quantize)
+            codec = quantize
         entry = HostKVEntry(blocks=len(seq.blocks), data=data, scales=scales,
-                            seen_tokens=seq.seen_tokens)
+                            seen_tokens=seq.seen_tokens, codec=codec,
+                            qscales=qscales, raw_nbytes=raw)
         self.host_kv.put(uid, entry)
-        self.kv.release(seq.blocks)
+        if self.prefix_cache is not None:
+            self.prefix_cache.release_seq(uid)
+            cache = self.prefix_cache
+            self.kv.release([b for b in seq.blocks if not cache.owns(b)])
+        else:
+            self.kv.release(seq.blocks)
         seq.blocks = []
         seq.paused = True
         self._table_sig = None    # decode tables must rebuild
@@ -326,11 +469,18 @@ class InferenceEngineV2:
             # a done sequence is about to be reaped (flush drops the host
             # entry) — restoring its pages would be a wasted copy
             return None
-        if entry.blocks > self.kv.free_blocks:
+        if entry.blocks > self.kv.free_blocks + self._evictable_blocks():
             return None
-        blocks = self.kv.reserve(entry.blocks)
+        blocks = self._reserve(entry.blocks)
         if entry.blocks:
-            self.kv.scatter_blocks(blocks, entry.data, entry.scales)
+            # quantized entries dequantize back to the device page width
+            # here (tolerance-bounded); full-width entries scatter
+            # verbatim (bit-identical round-trip)
+            data = dequantize_pages(entry.data, entry.qscales, entry.codec,
+                                    np.dtype(np.float32)
+                                    if entry.codec != "none"
+                                    else entry.data.dtype)
+            self.kv.scatter_blocks(blocks, data, entry.scales)
         seq.blocks = list(blocks)
         seq.paused = False
         self.host_kv.pop(uid, promoted=True)
@@ -356,17 +506,67 @@ class InferenceEngineV2:
 
     def kv_ledger(self) -> Dict[str, int]:
         """Both tiers' occupancy in one dict — the serving drain test's
-        "ledger returns to zero" surface and the bench_serve proof."""
+        "ledger returns to zero" surface and the bench_serve proof.
+        ``device_blocks_reserved`` excludes prefix-cache-held blocks
+        (reported separately as ``prefix_cached_blocks``): a drained
+        server legitimately keeps a warm cache, and the drain invariant
+        is "no SEQUENCE holds blocks", not "the cache is cold"."""
+        cached = (self.prefix_cache.cached_blocks()
+                  if self.prefix_cache is not None else 0)
         return {
-            "device_blocks_reserved": self.kv_reserved_blocks(),
+            "device_blocks_reserved": self.kv_reserved_blocks() - cached,
             "device_block_bytes": self.kv_block_bytes(),
+            "prefix_cached_blocks": cached,
             "host_entries": len(self.host_kv),
             "host_bytes": self.host_kv.total_bytes,
+            "host_raw_bytes": self.host_kv.raw_bytes,
             "demotions": self.host_kv.demotions,
             "promotions": self.host_kv.promotions,
             "demoted_bytes": self.host_kv.demoted_bytes,
             "promoted_bytes": self.host_kv.promoted_bytes,
+            "demoted_raw_bytes": self.host_kv.demoted_raw_bytes,
+            "host_compression_ratio": self.host_kv.compression_ratio(),
         }
+
+    # ------------------------------------------------------------------
+    # prefix cache surface (serving gauges + bench_serve proof set)
+    # ------------------------------------------------------------------
+    def resident_tokens(self) -> int:
+        """Tokens whose KV is resident in EITHER tier right now — the
+        denominator of bytes-per-resident-token. Host int arithmetic."""
+        total = 0
+        for s in self.state.all():
+            if not s.paused:
+                total += s.seen_tokens
+        for u in self.host_kv.uids():
+            entry = self.host_kv.get(u)
+            if entry is not None:
+                total += entry.seen_tokens
+        return total
+
+    def kv_resident_bytes(self) -> int:
+        """Bytes holding resident KV across both tiers (device blocks at
+        block-byte width + host entries at stored width)."""
+        return (self.kv_reserved_blocks() * self.kv_block_bytes()
+                + self.host_kv.total_bytes)
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """Prefix-cache counters + the prefill-work conservation triple:
+        ``prefill_tokens_saved + prefill_tokens_computed ==
+        prefill_tokens_total`` holds exactly once every admitted
+        sequence has either finished prefill or been flushed."""
+        out: Dict[str, float] = {
+            "prefill_tokens_total": self._prefill_total,
+            "prefill_tokens_saved": self._prefill_saved,
+            "prefill_tokens_computed": self._prefill_computed,
+        }
+        if self.prefix_cache is not None:
+            for k, v in self.prefix_cache.snapshot().items():
+                out[f"prefix_{k}"] = v
+            looked = max(self.prefix_cache.stats.lookup_tokens, 1)
+            out["prefix_hit_ratio"] = \
+                self.prefix_cache.stats.hit_tokens / looked
+        return out
 
     # ------------------------------------------------------------------
     # serving hooks (consumed by deepspeed_tpu/serving: the serve loop
@@ -380,7 +580,9 @@ class InferenceEngineV2:
         if not self.can_schedule([uid], [len(prompt_tokens)]):
             raise RuntimeError(
                 "cannot admit: out of KV blocks or sequence slots")
-        return self.state.create(uid, prompt_tokens)
+        seq = self.state.create(uid, prompt_tokens)
+        self._prefix_admit(seq)
+        return seq
 
     def finish(self, uid: int) -> None:
         """Mark a sequence done (length limit / cancel) so the scheduler

@@ -12,7 +12,7 @@ scalar prefetch) or gather a contiguous context window (CPU fallback).
 """
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +61,17 @@ class BlockedKVCache:
         """reference: kv_cache.py:144 reserve."""
         return self.allocator.allocate(num_blocks)
 
-    def release(self, blocks: List[int]) -> None:
+    def release(self, blocks: List[int],
+                pinned: Optional[Sequence[int]] = None) -> None:
+        """Free blocks back to the allocator. ``pinned`` names pages the
+        prefix cache still holds readers on (refcount > 0): those are
+        skipped ENTIRELY — not freed and, critically, not scale-reset.
+        One reader of a shared fp8 page releasing its block list must
+        not clobber the surviving readers' scales (a reset would silently
+        re-interpret their stored values under the wrong scale)."""
+        if pinned:
+            keep = set(pinned)
+            blocks = [b for b in blocks if b not in keep]
         self.allocator.free(blocks)
         if self.scales is not None and blocks:
             # reset released pages' scales: a page freed by a sequence with

@@ -7,31 +7,114 @@ possibly into different block ids, the block table is rebuilt — when the
 scheduler has room again. Overload then costs latency (a paused request
 waits in host RAM) instead of availability (a 429 at the door).
 
+Quantized host tier: the EQuARX playbook (arxiv 2506.17615 — quantize
+the wire, keep error bounded by per-group scales) applied to CACHE state
+instead of wire state. Demoted pages can be stored int8 or fp8 with one
+fp32 scale per (layer, k/v, head, page) — the exact group shape the
+device fp8 path already uses (``kv_cache.py`` per-page scales) — which
+roughly 2x (bf16→fp8) to 4x (fp32→int8) the host budget's effective
+blocks. Promotion dequantizes back to device width: bit-identical for
+full-width (``codec="none"``) entries, tolerance-bounded (one quantize
+round-trip, error <= scale/2 per element) for quantized ones. Pages that
+are ALREADY fp8 on device are never re-quantized (their scales ride
+along as before, bit-identical round-trip preserved).
+
 This module is the storage half only: a uid-keyed container of gathered
-page tiles with exact byte accounting. Page movement lives on the engine
+page tiles with exact byte accounting (stored AND raw — the compression
+ratio is a first-class counter). Page movement lives on the engine
 (``InferenceEngineV2.demote_kv`` / ``promote_kv``); *policy* — watermarks,
-victim selection, promotion order — lives in ``serving/kv_tier.py``. The
-split keeps the inference package free of serving concerns while the
-serving tick stays free of device-array handling.
+victim selection, promotion order, the quantize knob — lives in
+``serving/kv_tier.py`` + the ``serving`` config group. The split keeps
+the inference package free of serving concerns while the serving tick
+stays free of device-array handling.
+
+The codec functions are registered DS002 hot paths in the defensive
+sense: they are pure numpy over HOST arrays (the gather already
+happened) and must never grow a device touch or a ``float()`` coercion.
 """
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+#: host-tier page codecs the serving ``host_kv_quantize`` knob selects
+KV_CODECS = ("none", "int8", "fp8")
+
+_INT8_MAX = 127.0
+_FP8_MAX = 448.0       # float8_e4m3fn max finite (see kv_cache.FP8_MAX)
+
+
+def _page_absmax(data: np.ndarray) -> np.ndarray:
+    """[L, 2, H, NB, bs, D] -> per-page absmax [L, 2, H, NB] in fp32."""
+    return np.max(np.abs(data.astype(np.float32)), axis=(-1, -2))
+
+
+def quantize_pages(data: np.ndarray, codec: str
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Quantize gathered page tiles ``[L, 2, H, NB, bs, D]`` under the
+    per-page group-scale scheme. Returns ``(stored, qscales)``:
+    ``codec="none"`` passes through (qscales None); ``"int8"`` stores
+    int8 with fp32 scales ``absmax/127``; ``"fp8"`` stores
+    float8_e4m3fn (via ml_dtypes) with fp32 scales ``absmax/448``.
+    All-zero pages get scale 1.0 so the round-trip stays exact."""
+    if codec == "none":
+        return data, None
+    if codec not in KV_CODECS:
+        raise ValueError(f"unknown KV page codec {codec!r}; "
+                         f"one of {KV_CODECS}")
+    limit = _INT8_MAX if codec == "int8" else _FP8_MAX
+    scales = _page_absmax(data) / limit
+    scales = np.where(scales > 0.0, scales, 1.0).astype(np.float32)
+    scaled = data.astype(np.float32) / scales[..., None, None]
+    if codec == "int8":
+        stored = np.clip(np.rint(scaled), -_INT8_MAX, _INT8_MAX
+                         ).astype(np.int8)
+    else:
+        import ml_dtypes
+        stored = np.clip(scaled, -_FP8_MAX, _FP8_MAX
+                         ).astype(ml_dtypes.float8_e4m3fn)
+    return stored, scales
+
+
+def dequantize_pages(stored: np.ndarray, qscales: Optional[np.ndarray],
+                     codec: str, out_dtype) -> np.ndarray:
+    """Invert ``quantize_pages`` back to the device page dtype. For
+    ``codec="none"`` this is the identity (bit-identical promotion)."""
+    if codec == "none" or qscales is None:
+        return stored
+    return (stored.astype(np.float32) * qscales[..., None, None]
+            ).astype(out_dtype)
+
+
+def quantize_error_bound(qscales: Optional[np.ndarray], codec: str) -> float:
+    """The per-element absolute error bound of one quantize round-trip:
+    half a quantization step (``scale/2``) for int8 round-to-nearest;
+    for fp8 e4m3 (3 mantissa bits, half-ULP relative error 2^-4) the
+    worst case is on the largest representable scaled value, i.e.
+    ``scale * 448 * 2^-4``. The tolerance tests pin against exactly
+    this bound."""
+    if codec == "none" or qscales is None:
+        return 0.0
+    s = float(np.max(qscales))
+    return s * (0.5 if codec == "int8" else _FP8_MAX * 2.0 ** -4)
 
 
 @dataclasses.dataclass
 class HostKVEntry:
     """One demoted sequence's KV state: the gathered page tiles
-    ``[L, 2, H_kv, n_blocks, block_size, D]`` (host ndarray, page dtype
-    preserved — fp8 pages stay fp8 with their per-(head, page) scales) and
-    the bookkeeping needed to re-reserve on promotion."""
+    ``[L, 2, H_kv, n_blocks, block_size, D]`` (host ndarray; full width,
+    or codec-quantized with per-page ``qscales``) and the bookkeeping
+    needed to re-reserve on promotion. fp8 DEVICE pages keep their
+    per-(head, page) ``scales`` alongside either way."""
 
     blocks: int                          # device blocks held at demotion
     data: Optional[np.ndarray]           # None when blocks == 0
-    scales: Optional[np.ndarray]         # fp8 page scales (else None)
+    scales: Optional[np.ndarray]         # fp8 device page scales (else None)
     seen_tokens: int                     # KV coverage at demotion
+    codec: str = "none"                  # host-tier page codec
+    qscales: Optional[np.ndarray] = None  # codec scales (per page, fp32)
+    raw_nbytes: int = 0                  # pre-codec bytes (set by put/engine)
 
     @property
     def nbytes(self) -> int:
@@ -40,21 +123,29 @@ class HostKVEntry:
             total += int(self.data.nbytes)
         if self.scales is not None:
             total += int(self.scales.nbytes)
+        if self.qscales is not None:
+            total += int(self.qscales.nbytes)
         return total
 
 
 class HostKVStore:
     """uid -> ``HostKVEntry`` with running byte/lifetime accounting — the
-    "host" column of the serving layer's two-tier KV ledger."""
+    "host" column of the serving layer's two-tier KV ledger. Tracks both
+    STORED bytes (post-codec, what counts against the host budget) and
+    RAW bytes (what the pages would cost at device width) so the
+    host-tier compression ratio is a first-class deterministic counter."""
 
     def __init__(self):
         self._entries: Dict[int, HostKVEntry] = {}
         self.total_bytes = 0
+        self.raw_bytes = 0
         # lifetime counters (monotone; the deterministic proof surface)
         self.demotions = 0
         self.promotions = 0
         self.demoted_bytes = 0
         self.promoted_bytes = 0
+        self.demoted_raw_bytes = 0
+        self.quantized_entries = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -72,10 +163,16 @@ class HostKVStore:
     def put(self, uid: int, entry: HostKVEntry) -> int:
         if uid in self._entries:
             raise ValueError(f"uid {uid} already demoted")
+        if entry.raw_nbytes == 0:
+            entry.raw_nbytes = entry.nbytes
         self._entries[uid] = entry
         self.total_bytes += entry.nbytes
+        self.raw_bytes += entry.raw_nbytes
         self.demotions += 1
         self.demoted_bytes += entry.nbytes
+        self.demoted_raw_bytes += entry.raw_nbytes
+        if entry.codec != "none":
+            self.quantized_entries += 1
         return entry.nbytes
 
     def pop(self, uid: int, promoted: bool = False) -> Optional[HostKVEntry]:
@@ -85,7 +182,14 @@ class HostKVStore:
         if entry is None:
             return None
         self.total_bytes -= entry.nbytes
+        self.raw_bytes -= entry.raw_nbytes
         if promoted:
             self.promotions += 1
             self.promoted_bytes += entry.nbytes
         return entry
+
+    def compression_ratio(self) -> float:
+        """Lifetime demoted raw/stored ratio (1.0 = no quantization) —
+        the 'host-tier compression' row on env_report and /metrics."""
+        return (self.demoted_raw_bytes / self.demoted_bytes
+                if self.demoted_bytes > 0 else 1.0)

@@ -14,7 +14,14 @@ report
   tiers, sheds and ladder transitions, recomputed tokens from fault
   evictions, quarantines, drift recalibrations, and — the availability
   headline — ``degraded_latches`` (sticky 503s), which a healthy siege
-  run must keep at ZERO.
+  run must keep at ZERO;
+* the prefix proof set (``report["prefix"]``): cache-hit ratio and the
+  prefill-work conservation identity ``saved + computed == total``,
+  asserted against the workload's ground-truth shareable-token
+  denominator (multi-turn conversation continuations are TRUE prefix
+  extensions; ``shared_prefix_frac`` cuts every prompt's head from one
+  seeded pool), plus host-tier compression and bytes-per-resident-token
+  from the quantized offload tier.
 
 Closed-loop mode models N concurrent users each waiting for their reply
 (lane i issues its requests sequentially); open-loop mode submits on a
@@ -55,6 +62,12 @@ class ServeScenario:
     slow_client_every: int = 0           # every Kth request streams slowly
     slow_client_token_s: float = 0.005
     low_priority_every: int = 0          # every Kth request priority=-1
+    # fraction of each prompt drawn from ONE seeded shared pool (the
+    # "shared system prompt" of real traffic): request i's prompt starts
+    # with pool[:round(frac * len_i)] — deterministic per index, so the
+    # shareable-token sum is a ground-truth denominator the report can
+    # assert the prefix cache's savings against
+    shared_prefix_frac: float = 0.0
     timeout_s: Optional[float] = None
     submit_retry_limit: int = 200        # closed-loop 429 retries/request
     result_timeout_s: float = 300.0
@@ -68,7 +81,8 @@ SCENARIOS: Dict[str, ServeScenario] = {
     "micro": ServeScenario(name="micro", num_requests=100, concurrency=8),
     "burst": ServeScenario(name="burst", mode="open", num_requests=64,
                            burst=32, arrival_interval_s=0.005,
-                           max_new_tokens=(2, 6)),
+                           max_new_tokens=(2, 6),
+                           prompt_len=(24, 48), shared_prefix_frac=0.5),
     "multi_turn": ServeScenario(name="multi_turn", num_requests=48,
                                 concurrency=6, turns=4,
                                 prompt_len=(4, 10)),
@@ -78,7 +92,8 @@ SCENARIOS: Dict[str, ServeScenario] = {
     "overload": ServeScenario(name="overload", mode="open",
                               num_requests=200, arrival_interval_s=0.001,
                               max_new_tokens=(4, 10),
-                              low_priority_every=3),
+                              low_priority_every=3,
+                              prompt_len=(24, 48), shared_prefix_frac=0.5),
 }
 
 
@@ -92,19 +107,37 @@ def _stats(vals: List[float]) -> Dict[str, float]:
             "max_s": s[-1] if n else 0.0}
 
 
+def _shared_pool(scenario: ServeScenario) -> List[int]:
+    """The one shared token pool every request's shared prefix is cut
+    from — seeded by the scenario seed ONLY (identical across indices,
+    the definition of 'shared')."""
+    rng = np.random.default_rng(scenario.seed * 7_919 + 1)
+    return [int(t) for t in rng.integers(1, scenario.vocab, 256)]
+
+
 def _request_shape(scenario: ServeScenario, index: int
-                   ) -> Tuple[List[int], int, int]:
-    """Deterministic (prompt, max_new, priority) for request ``index`` —
-    a pure function of (seed, index), independent of thread timing."""
+                   ) -> Tuple[List[int], int, int, int]:
+    """Deterministic (prompt, max_new, priority, shared_len) for request
+    ``index`` — a pure function of (seed, index), independent of thread
+    timing. ``shared_len`` is the prompt's leading run drawn from the
+    shared pool (0 when ``shared_prefix_frac`` is off): summed over the
+    run it is the ground-truth shareable-token denominator the prefix
+    counters are asserted against."""
     rng = np.random.default_rng(scenario.seed * 100_003 + index)
     lo, hi = scenario.prompt_len
     n = int(rng.integers(lo, max(hi, lo + 1)))
     prompt = [int(t) for t in rng.integers(1, scenario.vocab, n)]
+    shared_len = 0
+    if scenario.shared_prefix_frac > 0.0:
+        pool = _shared_pool(scenario)
+        shared_len = min(int(round(n * scenario.shared_prefix_frac)),
+                         len(pool))
+        prompt = pool[:shared_len] + prompt[shared_len:]
     mlo, mhi = scenario.max_new_tokens
     max_new = int(rng.integers(mlo, max(mhi, mlo + 1)))
     priority = (-1 if scenario.low_priority_every
                 and index % scenario.low_priority_every == 0 else 0)
-    return prompt, max_new, priority
+    return prompt, max_new, priority, shared_len
 
 
 def _span_latencies(events) -> Tuple[List[float], List[float]]:
@@ -149,18 +182,28 @@ class _Lane:
         max_ctx = self.server.engine.state.max_context_length
         for turn in range(max(sc.turns, 1)):
             for index in self.indices:
-                prompt, max_new, priority = _request_shape(
+                prompt, max_new, priority, shared_len = _request_shape(
                     sc, index + turn * sc.num_requests)
+                reusable = 0
                 if sc.turns > 1:
-                    # multi-turn: prepend the conversation so far (the
-                    # prefix the future radix cache will reuse), capped to
-                    # keep prompt + budget inside the model context
-                    room = max_ctx - max_new - len(prompt) - 1
-                    if room > 0 and self.history:
-                        prompt = self.history[-room:] + prompt
+                    # TRUE conversation continuation: the next turn's
+                    # prompt starts with EXACTLY the previous turn's
+                    # prompt + reply (the root prefix the radix cache
+                    # reuses). Never slice a suffix of the history —
+                    # that would break the prefix property and make the
+                    # hit counters unaccountable; when the conversation
+                    # outgrows the context, start a fresh one instead
+                    if self.history and (len(self.history) + len(prompt)
+                                         + max_new + 1 <= max_ctx):
+                        prompt = self.history + prompt
+                        reusable = len(self.history)
                     else:
                         self.history = []
+                        reusable = shared_len
+                else:
+                    reusable = shared_len
                 record = self._one(index, turn, prompt, max_new, priority)
+                record["reusable_tokens"] = reusable
                 if sc.turns > 1 and record.get("tokens") is not None:
                     self.history = (prompt + record["tokens"])
                 with self.lock:
@@ -225,22 +268,24 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario) -> dict:
     elif scenario.mode == "open":
         pending = []
         for index in range(scenario.num_requests):
-            prompt, max_new, priority = _request_shape(scenario, index)
+            prompt, max_new, priority, shared_len = _request_shape(
+                scenario, index)
             if index >= scenario.burst and scenario.arrival_interval_s > 0:
                 time.sleep(scenario.arrival_interval_s)
             try:
-                pending.append((index, server.submit(
+                pending.append((index, shared_len, server.submit(
                     prompt, max_new_tokens=max_new,
                     timeout_s=scenario.timeout_s, priority=priority)))
             except BackpressureError:
                 results[(0, index)] = {"state": "rejected"}
             except ServerClosedError:
                 results[(0, index)] = {"state": "refused"}
-        for index, req in pending:
+        for index, shared_len, req in pending:
             req.wait(timeout=scenario.result_timeout_s)
             results[(0, index)] = {"state": req.state.value, "uid": req.uid,
                                    "tokens": list(req.tokens),
-                                   "finish_reason": req.finish_reason}
+                                   "finish_reason": req.finish_reason,
+                                   "reusable_tokens": shared_len}
     else:
         raise ValueError(f"unknown scenario mode {scenario.mode!r}")
     drained = server.drain(timeout=scenario.result_timeout_s)
@@ -255,6 +300,24 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario) -> dict:
         client_tokens += len(rec.get("tokens") or ())
     ledger = (server.engine.kv_ledger()
               if hasattr(server.engine, "kv_ledger") else {})
+    # engine-truth prefix/prefill counters (the metrics mirror can lag
+    # one tick; after the drain these are final and exact)
+    prefix = (server.engine.prefix_stats()
+              if hasattr(server.engine, "prefix_stats") else {})
+    if prefix:
+        # ground-truth denominator: tokens the workload genuinely made
+        # shareable (conversation histories + shared-pool prefixes); the
+        # cache can never legitimately save more than this
+        prefix["expected_reusable_tokens"] = sum(
+            rec.get("reusable_tokens", 0) for rec in results.values())
+        prefix["conservation_ok"] = (
+            prefix.get("prefill_tokens_saved", 0)
+            + prefix.get("prefill_tokens_computed", 0)
+            == prefix.get("prefill_tokens_total", 0))
+        prefix["bytes_per_resident_token"] = \
+            snap["bytes_per_resident_token"]
+        prefix["host_compression_ratio"] = \
+            snap["host_kv_compression_ratio"]
     return {
         "scenario": dataclasses.asdict(scenario),
         "wall_s": round(wall_s, 3),
@@ -279,7 +342,13 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario) -> dict:
             "kv_drift_events": snap["kv_drift_events"],
             "kv_recalibrations": snap["kv_recalibrations"],
             "sticky_503": snap["degraded_latches"],
+            "prefix_evictions": snap["prefix_evictions"],
+            "prefill_tokens_total": prefix.get("prefill_tokens_total", 0),
+            "prefill_tokens_saved": prefix.get("prefill_tokens_saved", 0),
+            "prefill_tokens_computed":
+                prefix.get("prefill_tokens_computed", 0),
         },
+        "prefix": prefix,
         "kv_ledger": ledger,
         "ladder": {"level": server.ladder.level.name.lower(),
                    "transitions": dict(server.ladder.transitions),
@@ -298,6 +367,8 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario) -> dict:
 # ---------------------------------------------------------------------------
 def build_tiny_server(kv_num_blocks: int = 64, kv_block_size: int = 16,
                       kv_offload: bool = True,
+                      prefix_cache: bool = True,
+                      host_kv_quantize: str = "int8",
                       serving_overrides: Optional[dict] = None
                       ) -> InferenceServer:
     """The hermetic benchmark target: tiny random-init fp32 llama +
@@ -324,6 +395,9 @@ def build_tiny_server(kv_num_blocks: int = 64, kv_block_size: int = 16,
     overrides = {"max_queue_depth": 32, "kv_offload_enabled": kv_offload,
                  "kv_demote_watermark": 0.5,
                  "kv_demote_watermark_brownout": 0.3,
+                 "prefix_cache_enabled": prefix_cache,
+                 "host_kv_quantize": (host_kv_quantize if kv_offload
+                                      else "none"),
                  "idle_poll_s": 0.001}
     overrides.update(serving_overrides or {})
     return InferenceServer(engine, ServingConfig(**overrides))
@@ -344,6 +418,15 @@ def main(argv=None) -> int:
     p.add_argument("--no-kv-offload", action="store_true",
                    help="run with the offload tier disabled (pre-tier "
                         "admission semantics)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="run with the radix prefix cache disabled "
+                        "(every prompt prefills from scratch)")
+    p.add_argument("--host-kv-quantize", default="int8",
+                   choices=("none", "int8", "fp8"),
+                   help="host-tier page codec for demoted KV")
+    p.add_argument("--shared-prefix-frac", type=float, default=None,
+                   help="override the scenario's shared-prefix fraction "
+                        "(0.0 disables; seeded, deterministic per index)")
     p.add_argument("--json", default=None,
                    help="write the full report JSON here (stdout always "
                         "gets it too)")
@@ -357,12 +440,17 @@ def main(argv=None) -> int:
         patch["concurrency"] = args.concurrency
     if args.seed is not None:
         patch["seed"] = args.seed
+    if args.shared_prefix_frac is not None:
+        patch["shared_prefix_frac"] = args.shared_prefix_frac
     if patch:
         scenario = dataclasses.replace(scenario, **patch)
 
     server = build_tiny_server(kv_num_blocks=args.kv_num_blocks,
                                kv_block_size=args.kv_block_size,
-                               kv_offload=not args.no_kv_offload).start()
+                               kv_offload=not args.no_kv_offload,
+                               prefix_cache=not args.no_prefix_cache,
+                               host_kv_quantize=args.host_kv_quantize
+                               ).start()
     try:
         report = run_scenario(server, scenario)
     finally:

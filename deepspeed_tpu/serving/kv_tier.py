@@ -11,6 +11,15 @@ functions.
 
 Policy (documented in docs/serving.md):
 
+* **Prefix-cache eviction precedes sequence demotion** — under pressure
+  the tick first reclaims UNPINNED cached prefix blocks (capacity nobody
+  is reading; freeing them costs no copies and pauses no request), and
+  only then demotes live sequences. Pinned shared-prefix pages are never
+  discarded: they outlive every unshared page, and when their last
+  reader demotes they travel to the host tier inside that reader's
+  entry (``demote_kv``) instead of being dropped — the demotion-ordering
+  contract: unpinned cache -> unshared sequences (LIFO) -> shared
+  prefixes last, via the host tier.
 * **Demotion is LIFO over the admit order** — the most recently admitted
   active request spills first, so the oldest requests keep running to
   completion (FIFO fairness preserved; same victim order as vLLM's
@@ -36,6 +45,22 @@ def effective_usable_blocks(usable: int, stolen_frac: float) -> int:
         return max(usable, 1)
     kept = int(usable * (1.0 - stolen_frac))
     return max(kept, 1)
+
+
+def plan_prefix_evictions(evictable_blocks: int, over_cap_blocks: int,
+                          reserved_blocks: int,
+                          demote_line_blocks: float) -> int:
+    """How many unpinned cached prefix blocks to reclaim THIS tick,
+    before any sequence is considered for demotion: enough to bring
+    observed reservation back under the demote line (pressure relief
+    that costs no copies and pauses nobody), plus any cache overhang
+    past the configured cap — bounded by what is actually evictable.
+    Pure host-int arithmetic (DS002 hot path); the engine executes the
+    plan via ``evict_prefix_blocks``."""
+    want = over_cap_blocks
+    if reserved_blocks > demote_line_blocks:
+        want = max(want, reserved_blocks - int(demote_line_blocks))
+    return min(max(want, 0), max(evictable_blocks, 0))
 
 
 def plan_demotions(worst_blocks: Sequence[int], held_blocks: Sequence[int],

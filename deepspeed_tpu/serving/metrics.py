@@ -75,6 +75,21 @@ class ServingMetrics:
         self.kv_demoted_bytes = 0
         self.kv_promoted_bytes = 0
         self.host_kv_bytes = 0           # gauge
+        # radix prefix cache (counters mirrored from the engine's
+        # prefix_stats each tick — the engine owns the source of truth,
+        # these are the thread-safe read surface for /metrics)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_evictions = 0        # blocks reclaimed by the tick
+        self.prefill_tokens_total = 0
+        self.prefill_tokens_saved = 0
+        self.prefill_tokens_computed = 0
+        self.prefix_cache_hit_ratio = 0.0   # gauge (hit/lookup tokens)
+        self.prefix_cached_blocks = 0       # gauge
+        self.prefix_pinned_blocks = 0       # gauge
+        # quantized host tier / capacity efficiency
+        self.host_kv_compression_ratio = 1.0   # gauge (raw/stored)
+        self.bytes_per_resident_token = 0.0    # gauge (both tiers)
         # degradation ladder
         self.ladder_level = 0            # gauge (ServeLevel int)
         self.ladder_transitions = 0
@@ -185,6 +200,34 @@ class ServingMetrics:
         with self._lock:
             self.degraded_latches += 1
 
+    def on_prefix_evict(self, blocks: int):
+        with self._lock:
+            self.prefix_evictions += blocks
+
+    def set_prefix_gauges(self, stats: dict, resident_tokens: int,
+                          resident_bytes: int, host_compression: float):
+        """Mirror the engine's prefix/prefill counters (one tick's
+        consistent view) and derive bytes-per-resident-token — the
+        capacity-efficiency headline the quantized host tier moves."""
+        with self._lock:
+            self.prefill_tokens_total = int(
+                stats.get("prefill_tokens_total", 0))
+            self.prefill_tokens_saved = int(
+                stats.get("prefill_tokens_saved", 0))
+            self.prefill_tokens_computed = int(
+                stats.get("prefill_tokens_computed", 0))
+            self.prefix_hits = int(stats.get("prefix_hits", 0))
+            self.prefix_misses = int(stats.get("prefix_misses", 0))
+            self.prefix_cache_hit_ratio = float(
+                stats.get("prefix_hit_ratio", 0.0))
+            self.prefix_cached_blocks = int(
+                stats.get("prefix_cached_blocks", 0))
+            self.prefix_pinned_blocks = int(
+                stats.get("prefix_pinned_blocks", 0))
+            self.host_kv_compression_ratio = float(host_compression)
+            self.bytes_per_resident_token = (
+                resident_bytes / resident_tokens if resident_tokens else 0.0)
+
     def on_demote(self, nbytes: int):
         with self._lock:
             self.kv_demotions += 1
@@ -232,6 +275,17 @@ class ServingMetrics:
                 "kv_demoted_bytes": self.kv_demoted_bytes,
                 "kv_promoted_bytes": self.kv_promoted_bytes,
                 "host_kv_bytes": self.host_kv_bytes,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_evictions": self.prefix_evictions,
+                "prefill_tokens_total": self.prefill_tokens_total,
+                "prefill_tokens_saved": self.prefill_tokens_saved,
+                "prefill_tokens_computed": self.prefill_tokens_computed,
+                "prefix_cache_hit_ratio": self.prefix_cache_hit_ratio,
+                "prefix_cached_blocks": self.prefix_cached_blocks,
+                "prefix_pinned_blocks": self.prefix_pinned_blocks,
+                "host_kv_compression_ratio": self.host_kv_compression_ratio,
+                "bytes_per_resident_token": self.bytes_per_resident_token,
                 "ladder_level": self.ladder_level,
                 "ladder_transitions": self.ladder_transitions,
                 "brownout_entries": self.brownout_entries,
@@ -279,7 +333,9 @@ class ServingMetrics:
                     "kv_demotions", "kv_promotions", "kv_demoted_bytes",
                     "kv_promoted_bytes", "ladder_transitions",
                     "brownout_entries", "shed_entries",
-                    "kv_recalibrations"}
+                    "kv_recalibrations", "prefix_hits", "prefix_misses",
+                    "prefix_evictions", "prefill_tokens_total",
+                    "prefill_tokens_saved", "prefill_tokens_computed"}
         lines = []
         with self._lock:
             summaries = [

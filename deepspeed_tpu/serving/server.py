@@ -51,12 +51,18 @@ from deepspeed_tpu.resilience.chaos import monkey_from_env
 from deepspeed_tpu.serving.degradation import (DegradationLadder,
                                                LadderConfig, ServeLevel)
 from deepspeed_tpu.serving.kv_tier import (effective_usable_blocks,
-                                           plan_demotions, plan_promotions,
-                                           tier_pressure)
+                                           plan_demotions,
+                                           plan_prefix_evictions,
+                                           plan_promotions, tier_pressure)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState
 from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
+
+
+#: an un-trippable demote line for cache trims outside the offload tier
+#: (module-level so the hot tick never calls float() itself)
+_NO_DEMOTE_LINE = float("inf")
 
 
 class BackpressureError(RuntimeError):
@@ -104,6 +110,20 @@ class ServingConfig:
     kv_demote_watermark: float = 0.90       # demote above this device frac
     kv_demote_watermark_brownout: float = 0.60   # aggressive in brownout
     min_active_requests: int = 1            # never demote below this
+    # host-tier page codec ("none" | "int8" | "fp8"): demoted pages are
+    # stored narrow with per-page fp32 scales — ~2x (bf16->fp8) to ~4x
+    # (fp32->int8) more effective blocks under the same host budget;
+    # promotion dequantizes back to device width (tolerance-bounded).
+    # Device-fp8 pages are never re-quantized (bit-identical round-trip
+    # preserved)
+    host_kv_quantize: str = "none"
+
+    # --- radix prefix cache over KV pages (inference/v2/prefix_cache.py;
+    # default OFF = every prompt prefills from scratch) ---
+    prefix_cache_enabled: bool = False
+    # soft cap on UNPINNED cached blocks trimmed every tick (0 = only
+    # pressure evicts); pinned shared pages are never evicted
+    prefix_cache_max_blocks: int = 0
 
     # --- request-level fault isolation ---
     poison_retry_budget: int = 1         # evict+retry this many times,
@@ -178,6 +198,20 @@ class InferenceServer:
         # them; minimal doubles in tests may not)
         self._tier_capable = (self.config.kv_offload_enabled
                               and hasattr(engine, "demote_kv"))
+        from deepspeed_tpu.inference.v2.kv_offload import KV_CODECS
+        if self.config.host_kv_quantize not in KV_CODECS:
+            raise ValueError(
+                f"host_kv_quantize must be one of {KV_CODECS}, got "
+                f"{self.config.host_kv_quantize!r}")
+        # radix prefix cache: the serving knob flips it on at the engine
+        # (where admission lives); minimal test doubles without the hook
+        # simply run uncached
+        if self.config.prefix_cache_enabled and \
+                hasattr(engine, "enable_prefix_cache"):
+            engine.enable_prefix_cache(self.config.prefix_cache_max_blocks)
+        self._prefix_capable = (self.config.prefix_cache_enabled
+                                and getattr(engine, "prefix_cache", None)
+                                is not None)
         self._block_bytes_cache: Optional[int] = None
         # fault-isolation state (serve-loop-private except the flag)
         self._tick = 0
@@ -450,6 +484,11 @@ class InferenceServer:
                        if self.chaos is not None else 0.0)
         if self._tier_capable:
             self._rebalance_kv_tiers(stolen_frac)
+        elif self._prefix_capable:
+            # no offload tier: the cache still honors its soft cap (the
+            # demote line doesn't exist, so pass an un-trippable one)
+            self._trim_prefix_cache(self.engine.kv_reserved_blocks(),
+                                    _NO_DEMOTE_LINE)
         self._admit_from_queue(stolen_frac)
         worked = False
         if self.engine.has_work():
@@ -485,6 +524,7 @@ class InferenceServer:
                                 + sum(self._blocks_for(r)
                                       for r in self._inflight.values()))
         self._reconcile_kv(projected_blocks)
+        self._prefix_gauges()
         self._observe_ladder(queued, stolen_frac)
         self.metrics.set_gauges(queue_depth=queued, inflight=inflight,
                                 kv_occupancy=self.engine.kv_occupancy())
@@ -536,6 +576,14 @@ class InferenceServer:
         worst = [self._blocks_for(r) for r in active]
         held = [self.engine.kv_held_blocks(r.uid) for r in active]
         reserved = self.engine.kv_reserved_blocks()
+        # ---- prefix-cache eviction FIRST (the demotion-ordering
+        # contract): unpinned cached blocks are capacity nobody reads —
+        # reclaiming them costs no copies and pauses no request, so they
+        # go before any sequence is demoted. Pinned shared prefixes are
+        # untouchable here and therefore outlive every unshared page
+        if self._prefix_capable and \
+                self._trim_prefix_cache(reserved, demote_wm * effective):
+            reserved = self.engine.kv_reserved_blocks()
         # ---- demotion (most recently admitted first), bounded by the
         # host budget: once the host tier is full, demotion stops and the
         # pressure has to SURFACE (ladder -> brownout/shed) instead of
@@ -552,7 +600,8 @@ class InferenceServer:
                     + self.engine.kv_held_blocks(victim.uid) * bb
                     > cfg.host_kv_budget_bytes):
                 break
-            freed = self.engine.demote_kv(victim.uid)
+            freed = self.engine.demote_kv(
+                victim.uid, quantize=cfg.host_kv_quantize)
             with self._lock:
                 self._demoted.append(victim.uid)
             executed.add(i)
@@ -607,6 +656,69 @@ class InferenceServer:
                     demoted_requests=len(self._demoted))
 
     # ------------------------------------------------------------------
+    # radix prefix cache (trie in inference/v2/prefix_cache.py; policy
+    # planner in kv_tier.plan_prefix_evictions)
+    # ------------------------------------------------------------------
+    def _trim_prefix_cache(self, reserved: int, demote_line: float) -> int:
+        """Reclaim unpinned cached prefix blocks per the pure planner:
+        down to the demote line under pressure, down to the soft cap
+        always. Returns blocks freed. The planner is host-int
+        arithmetic; the engine call it decides to issue releases blocks
+        (a deliberate off-path device op, same contract as demote)."""
+        if not self._prefix_capable:
+            return 0
+        cache = self.engine.prefix_cache
+        want = plan_prefix_evictions(cache.evictable_blocks(),
+                                     cache.over_cap_blocks(),
+                                     reserved, demote_line)
+        if want <= 0:
+            return 0
+        freed = self.engine.evict_prefix_blocks(want)
+        if freed:
+            self.metrics.on_prefix_evict(freed)
+            get_tracer().instant("serve/prefix_evict", cat="serve",
+                                 blocks=freed)
+        return freed
+
+    def _cache_evictable_blocks(self) -> int:
+        """Unpinned cached blocks (reclaimable on demand) — subtracted
+        from observed reservation wherever occupancy means 'blocks live
+        requests are using': a warm-but-idle cache is capacity, and
+        counting it as pressure would brownout an idle server, while
+        counting it as observed sequence occupancy would fire spurious
+        kv_drift edges and recalibrate admission down on every warm
+        cache (pinned pages DO count — live readers are using them)."""
+        if not self._prefix_capable:
+            return 0
+        return self.engine.prefix_cache.evictable_blocks()
+
+    def _prefix_gauges(self) -> None:
+        """Fold the engine's prefix/prefill counters into the serving
+        metrics each tick (pure host reads — the counters are plain
+        ints the engine already maintains) and emit the dsmem-idiom
+        counter track so cache occupancy lines up with the serve spans
+        on the trace timeline."""
+        stats_fn = getattr(self.engine, "prefix_stats", None)
+        if stats_fn is None:
+            return
+        stats = stats_fn()
+        resident = self.engine.resident_tokens()
+        resident_bytes = self.engine.kv_resident_bytes()
+        host = getattr(self.engine, "host_kv", None)
+        self.metrics.set_prefix_gauges(
+            stats, resident_tokens=resident, resident_bytes=resident_bytes,
+            host_compression=(host.compression_ratio()
+                              if host is not None else 1.0))
+        if self._prefix_capable:
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.counter(
+                    "serve/prefix_cache", cat="mem",
+                    cached_blocks=int(stats.get("prefix_cached_blocks", 0)),
+                    pinned_blocks=int(stats.get("prefix_pinned_blocks", 0)),
+                    hit_tokens=int(stats.get("prefix_hit_tokens", 0)))
+
+    # ------------------------------------------------------------------
     # degradation ladder
     # ------------------------------------------------------------------
     def _observe_ladder(self, queued: int, stolen_frac: float) -> None:
@@ -619,6 +731,10 @@ class InferenceServer:
             reserved = reserved_fn()
         else:
             reserved = int(self.engine.kv_occupancy() * usable)
+        # a warm cache is reclaimable capacity, not pressure: without
+        # this an idle server with an absorbed-history cache would sit
+        # in brownout forever (evictable blocks free on demand)
+        reserved = max(reserved - self._cache_evictable_blocks(), 0)
         host_bytes = (self.engine.host_kv_bytes()
                       if self._tier_capable else 0)
         pressure, reason = tier_pressure(
@@ -804,7 +920,13 @@ class InferenceServer:
             return
         bb = block_bytes()
         projected = projected_blocks * bb
-        observed = self.engine.kv_reserved_blocks() * bb
+        # evictable cache blocks are attributable to NO live request:
+        # counting them as observed occupancy would fire a kv_drift edge
+        # (and recalibrate admission down) on every warm cache, masking
+        # the real leaks this detector exists for. Pinned pages stay in:
+        # live readers hold them and the projection covers those readers
+        observed = (self.engine.kv_reserved_blocks()
+                    - self._cache_evictable_blocks()) * bb
         self.metrics.set_kv_bytes(projected, observed)
         tracer = get_tracer()
         if tracer.enabled:

@@ -104,7 +104,8 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
                        "_reap", "_settle_reaped", "_rebalance_kv_tiers",
                        "_observe_ladder", "_reconcile_kv",
                        "_active_worstcase", "_active_uids",
-                       "_note_clean_step"),
+                       "_note_clean_step", "_trim_prefix_cache",
+                       "_prefix_gauges", "_cache_evictable_blocks"),
         forbidden=ENGINE_FORBIDDEN,
     ),
     # the degradation ladder's per-tick observation + edge transition:
@@ -121,7 +122,33 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         path="deepspeed_tpu/serving/kv_tier.py",
         cls=None,
         hot_functions=("effective_usable_blocks", "plan_demotions",
-                       "plan_promotions", "tier_pressure"),
+                       "plan_prefix_evictions", "plan_promotions",
+                       "tier_pressure"),
+    ),
+    # the radix prefix cache: the serve tick walks/pins/plans against the
+    # trie on EVERY admission and rebalance — registering the whole
+    # bookkeeping surface PROVES the trie never host-syncs the tick (the
+    # only device op a cache decision triggers is the engine-side block
+    # release an eviction plan commits, off these functions)
+    HotPathSpec(
+        path="deepspeed_tpu/inference/v2/prefix_cache.py",
+        cls="PrefixCache",
+        hot_functions=("lookup", "admit_match", "_pin", "_keys",
+                       "insert_from_seq", "release_seq", "plan_evictions",
+                       "evict_blocks", "evictable_blocks", "over_cap_blocks",
+                       "cached_blocks", "pinned_blocks", "pinned_block_ids",
+                       "owns", "snapshot"),
+    ),
+    # the host-tier page codec: pure numpy over ALREADY-GATHERED host
+    # arrays (the device->host copy happened in gather_blocks, off-tick);
+    # registering it proves quantization never grows a device touch or a
+    # float() coercion of its own
+    HotPathSpec(
+        path="deepspeed_tpu/inference/v2/kv_offload.py",
+        cls=None,
+        hot_functions=("quantize_pages", "dequantize_pages",
+                       "_page_absmax"),
+        forbidden=ENGINE_FORBIDDEN,
     ),
     # the prefetch worker exists to overlap H2D with compute; a host sync in
     # the worker body (outside stage_fn, which the engine owns) re-serializes
