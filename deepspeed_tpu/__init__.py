@@ -53,8 +53,11 @@ def initialize(args=None,
         config = config_params
     if config is None and args is not None:
         config = getattr(args, "deepspeed_config", None)
+    # initialize() is THE training entry point: an elastic-agent relaunch's
+    # escalated-ladder overrides (DSTPU_ELASTIC_CONFIG_OVERRIDES) apply
+    # here and only here
     ds_config = config if isinstance(config, DeepSpeedTPUConfig) \
-        else DeepSpeedTPUConfig(config)
+        else DeepSpeedTPUConfig(config, apply_elastic_overrides=True)
 
     if dist_init_required is None:
         # auto (reference: deepspeed.initialize always ensures the process

@@ -22,6 +22,7 @@ import zlib
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -30,11 +31,38 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 LATEST_FILE = "latest"
 MANIFEST_FILE = "ds_manifest.json"
 
+#: ds_meta.json provenance block schema version
+PROVENANCE_VERSION = 1
+
+#: The data-sampler determinism contract recorded in every checkpoint and
+#: honored on resume at ANY world size: the stream position is
+#: ``consumed_samples`` (== engine.global_samples), so the resumed run's
+#: next global batch must start at that sample index — no sample dropped,
+#: none double-trained. ``epoch = consumed_samples // dataset_size`` for
+#: sized datasets. ``train_batch_size`` must be unchanged across resume
+#: (the elastic invariant): it keeps ``step k <-> samples k*batch``
+#: bijective, so step-keyed deterministic data (batch_fn(step)) and
+#: sample-keyed loaders resume to the same position regardless of how the
+#: batch is re-factored into (micro_batch, gas, dp_world) at the new mesh.
+SAMPLER_CONTRACT = ("next_sample_index == consumed_samples; "
+                    "epoch == consumed_samples // dataset_size; "
+                    "train_batch_size invariant across resume")
+
 
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint failed its integrity-manifest verification: a listed
     file is missing or its checksum no longer matches — the checkpoint is
     torn and must never be restored."""
+
+
+class CheckpointProvenanceError(RuntimeError):
+    """The checkpoint's recorded provenance (``ds_meta.json``) is
+    incompatible with the engine trying to restore it: a different model
+    (parameter tree mismatch) or a broken sampler contract (changed
+    ``train_batch_size``). A *mesh/world/zero-tier* change is NOT an error
+    — that is the mesh-portable-resume capability; this error exists so
+    the genuinely-incompatible cases are classified up front instead of
+    surfacing as an orbax shape crash mid-restore."""
 
 
 def _ckpt_dir(save_dir: str, tag: str) -> str:
@@ -260,6 +288,304 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     return path
 
 
+def _param_fingerprint(engine) -> Dict[str, Any]:
+    """Name/shape inventory of the parameter tree (dtype-free: offload
+    checkpoints are fp32 masters while live params may be compute-dtype).
+    The sha256 over the ordered ``name:shape`` lines is the compatibility
+    key a resume checks BEFORE touching orbax."""
+    import hashlib
+    if getattr(engine, "_param_offload", None) is not None:
+        tree = engine._param_offload.masters_tree()
+    else:
+        tree = engine.state.params
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    lines = [f"{jax.tree_util.keystr(path)}:{tuple(np.shape(leaf))}"
+             for path, leaf in flat]
+    return {
+        "count": int(sum(int(np.prod(np.shape(leaf) or (1,)))
+                         for _, leaf in flat)),
+        "leaves": len(lines),
+        "tree": lines,
+        "tree_sha256": hashlib.sha256("\n".join(lines).encode()).hexdigest(),
+    }
+
+
+def _rng_record(engine) -> Dict[str, Any]:
+    """The engine's live PRNG key, host-serialized — restored on resume so
+    the per-step rng stream (dropout etc.) continues exactly where the
+    save left it, at any world size (the key is replicated host state)."""
+    key = engine._rng
+    try:
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            data = np.asarray(jax.random.key_data(key))
+            impl = jax.random.key_impl(key)
+            return {"impl": getattr(impl, "name", None) or str(impl),
+                    "typed": True,
+                    "dtype": str(data.dtype), "shape": list(data.shape),
+                    "data": data.tolist()}
+    except (TypeError, AttributeError):
+        pass
+    arr = np.asarray(jax.device_get(key))
+    return {"typed": False, "dtype": str(arr.dtype),
+            "shape": list(arr.shape), "data": arr.tolist()}
+
+
+def _restore_rng(engine, rec: Dict[str, Any]) -> None:
+    data = np.asarray(rec["data"], dtype=rec.get("dtype", "uint32"))
+    if rec.get("typed"):
+        # the impl rides in provenance for a reason: wrapping rbg-shaped
+        # key data under a process whose default impl is threefry (or vice
+        # versa) would mis-wrap or raise — the saved impl wins
+        impl = rec.get("impl")
+        engine._rng = jax.random.wrap_key_data(
+            data, impl=impl) if impl else jax.random.wrap_key_data(data)
+    else:
+        engine._rng = jnp.asarray(data)
+
+
+def _ledger_provenance(engine) -> Dict[str, Any]:
+    """Analytic per-device memory plan + the observed HBM limit at save
+    time — what a shrink-aware relauncher preflights a smaller world
+    against without touching devices (the saved config rides alongside in
+    ``provenance.config``, so ``MemoryLedger.from_config`` can re-plan any
+    candidate world offline)."""
+    out: Dict[str, Any] = {}
+    try:
+        ledger = engine.memory_ledger()
+        phases = ledger.phase_bytes()
+        out["phase_hbm_bytes"] = {
+            ph: int(v.get("hbm_bytes", 0)) for ph, v in phases.items()}
+        out["max_hbm_bytes"] = int(ledger.max_hbm_bytes())
+        out["zero_world"] = int(ledger.zero_world)
+    except Exception:
+        logger.exception("provenance: memory ledger unavailable")
+    limit = 0
+    try:
+        for s in engine.accelerator.memory_stats().values():
+            limit = max(limit, int(s.get("bytes_limit", 0)))
+    except Exception:
+        pass
+    out["bytes_limit"] = limit
+    return out
+
+
+def checkpoint_provenance(engine) -> Dict[str, Any]:
+    """The ``ds_meta.json`` provenance block: everything a resume at a
+    DIFFERENT world/mesh/zero-tier needs to classify compatibility and
+    re-plan placement before any array byte is read."""
+    from deepspeed_tpu.runtime.zero.partition import zero_placement
+    mesh_shape = {str(k): int(v) for k, v in engine.mesh.shape.items()}
+    zc = engine.config.zero_config
+    return {
+        "version": PROVENANCE_VERSION,
+        "world": {
+            "process_count": int(jax.process_count()),
+            "device_count": int(np.prod(list(mesh_shape.values()))),
+        },
+        "mesh": mesh_shape,
+        "zero": zero_placement(mesh_shape, engine.zero_stage,
+                               offload_optimizer=zc.offload_optimizer.device,
+                               offload_param=zc.offload_param.device),
+        "batch": {
+            "train_batch_size": int(engine.train_batch_size),
+            "micro_batch": int(engine.micro_batch_size),
+            "gradient_accumulation_steps":
+                int(engine.gradient_accumulation_steps),
+            "dp_world": int(engine.dp_world_size),
+        },
+        "sampler": {
+            "consumed_samples": int(engine.global_samples),
+            "contract": SAMPLER_CONTRACT,
+        },
+        "rng": _rng_record(engine),
+        "params": _param_fingerprint(engine),
+        "ledger": _ledger_provenance(engine),
+        "config": engine.config.raw(),
+    }
+
+
+def check_provenance(engine, meta: Dict[str, Any], path: str,
+                     strict: bool = True) -> Optional[Dict[str, Any]]:
+    """Classify checkpoint-vs-engine compatibility from ``ds_meta.json``
+    BEFORE the orbax restore. Returns the provenance block (None for
+    legacy checkpoints). Raises ``CheckpointProvenanceError`` on a model
+    mismatch or a broken sampler/batch contract; a mesh/world/zero change
+    only logs + stamps an ``elastic/reshard`` instant."""
+    prov = meta.get("provenance")
+    if not prov:
+        return None
+
+    saved_fp = prov.get("params") or {}
+    if saved_fp.get("tree_sha256"):
+        cur = _param_fingerprint(engine)
+        if cur["tree_sha256"] != saved_fp["tree_sha256"]:
+            saved_tree = saved_fp.get("tree") or []
+            diff = [f"  saved: {s!r}  !=  engine: {c!r}"
+                    for s, c in zip(saved_tree, cur["tree"]) if s != c]
+            if len(saved_tree) != len(cur["tree"]):
+                diff.append(f"  leaf count: saved {len(saved_tree)} != "
+                            f"engine {len(cur['tree'])}")
+            raise CheckpointProvenanceError(
+                f"checkpoint {path} was saved from a different model: "
+                f"parameter tree mismatch ({saved_fp.get('count')} vs "
+                f"{cur['count']} params). First differences:\n"
+                + "\n".join(diff[:5] or ["  (tree hash differs)"]))
+
+    saved_tb = (prov.get("batch") or {}).get("train_batch_size")
+    if saved_tb and int(saved_tb) != int(engine.train_batch_size):
+        msg = (f"checkpoint {path} breaks the sampler contract: saved "
+               f"train_batch_size {saved_tb} != engine "
+               f"{engine.train_batch_size}. The global batch is the elastic "
+               f"invariant — resume must re-factor (micro_batch, gas, "
+               f"dp_world) at the new mesh, not change the global batch "
+               f"(else 'step k <-> samples k*batch' breaks and samples are "
+               f"dropped/double-trained). Pass strict_provenance=False to "
+               f"override deliberately.")
+        if strict:
+            raise CheckpointProvenanceError(msg)
+        logger.warning(msg + " (override active: consumed_samples stays "
+                       "authoritative for the data position)")
+
+    saved_mesh = prov.get("mesh") or {}
+    cur_mesh = {str(k): int(v) for k, v in engine.mesh.shape.items()}
+    saved_zero = prov.get("zero") or {}
+    if saved_mesh and saved_mesh != cur_mesh:
+        saved_world = (prov.get("world") or {}).get("device_count", "?")
+        cur_world = int(np.prod(list(cur_mesh.values())))
+        log_dist(
+            f"mesh-portable resume: checkpoint saved at world {saved_world} "
+            f"mesh {saved_mesh} (zero stage {saved_zero.get('stage', '?')}), "
+            f"restoring onto world {cur_world} mesh {cur_mesh} (zero stage "
+            f"{engine.zero_stage}) — re-sharding from the parameter-atomic "
+            f"store", ranks=[0])
+        engine.tracer.instant(
+            "elastic/reshard", cat="elastic",
+            saved_world=saved_world, new_world=cur_world,
+            saved_zero_stage=saved_zero.get("stage"),
+            new_zero_stage=engine.zero_stage,
+            consumed_samples=(prov.get("sampler")
+                              or {}).get("consumed_samples"))
+    return prov
+
+
+def _extract_named_subtrees(tree, name: str, out: list) -> None:
+    """Depth-first collect every subtree stored under dict key ``name``
+    (orbax renders optax NamedTuples as dicts keyed by field name)."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            if k == name:
+                out.append(tree[k])
+            else:
+                _extract_named_subtrees(tree[k], name, out)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            _extract_named_subtrees(v, name, out)
+
+
+def _extract_moments(opt_tree, shapes, n_states: int):
+    """Mine per-parameter optimizer moments out of a host-restored optax
+    state tree: the ``mu``/``nu`` (adam) or ``trace`` (momentum) subtrees
+    whose flattened leaves match the parameter shapes in order. Returns
+    ``(states, step_count)`` for ``HostOffloadOptimizer.load_state_dict``,
+    or ``(None, 0)`` when the structure is unrecognized (caller resets
+    moments with a warning — never a crash)."""
+    names = ("mu", "nu") if n_states == 2 else ("trace", "mu")
+    per_state = []
+    for nm in names:
+        found: list = []
+        _extract_named_subtrees(opt_tree, nm, found)
+        match = None
+        for cand in found:
+            leaves = [np.asarray(jax.device_get(l))
+                      for l in jax.tree_util.tree_leaves(cand)]
+            if len(leaves) == len(shapes) and all(
+                    l.shape == tuple(s) for l, s in zip(leaves, shapes)):
+                match = leaves
+                break
+        if match is None:
+            continue
+        per_state.append(match)
+        if len(per_state) == n_states:
+            break
+    if len(per_state) != n_states:
+        return None, 0
+    counts: list = []
+    _extract_named_subtrees(opt_tree, "count", counts)
+    step_count = 0
+    for c in counts:
+        try:
+            step_count = max(step_count,
+                             int(np.asarray(jax.device_get(c))))
+        except (TypeError, ValueError):
+            pass
+    return [[per_state[s][i] for s in range(n_states)]
+            for i in range(len(shapes))], step_count
+
+
+def _inject_moments_into_optax(opt_state, params_treedef, states,
+                               step_count: int):
+    """The reverse adaptation (offload-tier checkpoint -> optax engine,
+    the ladder DE-escalation when capacity regrows): graft host moment
+    arrays into a live optax state's ``mu``/``nu``/``trace`` fields and
+    stamp ``count``. Returns the new state, or None when the optimizer
+    structure is unrecognized."""
+    n_states = len(states[0]) if states else 0
+    field_order = ("mu", "nu") if n_states == 2 else ("trace",)
+    trees = [jax.tree_util.tree_unflatten(
+        params_treedef, [np.asarray(s[i], np.float32) for s in states])
+        for i in range(n_states)]
+    hit = {"n": 0}
+
+    def rebuild(node):
+        if hasattr(node, "_fields"):
+            upd = {}
+            for i, f in enumerate(field_order):
+                if f in node._fields:
+                    cur_leaves = jax.tree_util.tree_leaves(getattr(node, f))
+                    if len(cur_leaves) == len(states) and all(
+                            np.shape(a) == np.shape(b) for a, b in
+                            zip(cur_leaves,
+                                jax.tree_util.tree_leaves(trees[i]))):
+                        upd[f] = trees[i]
+            if "count" in node._fields and upd:
+                upd["count"] = jnp.asarray(step_count,
+                                           np.asarray(node.count).dtype)
+            if upd:
+                hit["n"] += 1
+                return node._replace(**upd)
+            return node._replace(**{
+                f: rebuild(getattr(node, f)) for f in node._fields
+                if isinstance(getattr(node, f), tuple)})
+        if isinstance(node, tuple):
+            return type(node)(rebuild(v) for v in node)
+        if isinstance(node, list):
+            return [rebuild(v) for v in node]
+        return node
+
+    out = rebuild(opt_state)
+    return out if hit["n"] else None
+
+
+def _offload_sidecar_path(path: str) -> Optional[str]:
+    """This process's offload moment sidecar, falling back to proc0's when
+    the checkpoint was saved at a SMALLER world (grown-world resume: a rank
+    beyond the saving world has no file of its own; the moment arrays are
+    full-shape, so every rank grafting proc0's beats some ranks silently
+    resetting to zero — divergent optimizer state across ranks)."""
+    own = os.path.join(path, f"offload_state_proc{jax.process_index()}.npz")
+    if os.path.exists(own):
+        return own
+    if jax.process_index() != 0:
+        p0 = os.path.join(path, "offload_state_proc0.npz")
+        if os.path.exists(p0):
+            logger.warning(
+                f"checkpoint has no offload sidecar for process "
+                f"{jax.process_index()} (saved at a smaller world); using "
+                f"proc0's moments")
+            return p0
+    return None
+
+
 def _snapshot_sidecars(engine, client_state):
     """Capture everything outside the orbax composite at save time."""
     offload = getattr(engine, "_offload", None)
@@ -287,6 +613,13 @@ def _snapshot_sidecars(engine, client_state):
         "mesh_shape": dict(engine.mesh.shape),
         "client_state": client_state or {},
     }
+    try:
+        meta["provenance"] = checkpoint_provenance(engine)
+    except Exception:
+        # a provenance failure must never lose the checkpoint itself;
+        # the resulting tag simply resumes like a legacy (pre-provenance)
+        # checkpoint
+        logger.exception("checkpoint: provenance capture failed")
     return {"offload": offload_sd, "compression": comp_sd, "meta": meta}
 
 
@@ -349,7 +682,8 @@ def _write_sidecars_and_commit(save_dir, tag, path, sidecars):
 
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            load_optimizer_states: bool = True,
-                           verify_integrity: bool = True):
+                           verify_integrity: bool = True,
+                           strict_provenance: bool = True):
     wait_pending_checkpoint(engine)      # an in-flight async save must commit
     load_dir = os.path.abspath(load_dir)
     if tag is None:
@@ -366,6 +700,17 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         # falls back to the newest clean tag); manifest-less (legacy)
         # checkpoints load unverified
         log_dist(f"checkpoint integrity verified: {path}", ranks=[0])
+
+    # provenance gate BEFORE any array read: model/sampler incompatibility
+    # is a classified error here; a mesh/world/zero-tier change is logged
+    # as a mesh-portable resume (and stamped on the dstrace timeline)
+    meta_path = os.path.join(path, "ds_meta.json")
+    meta: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    provenance = check_provenance(engine, meta, path,
+                                  strict=strict_provenance)
 
     state = engine.state
     offload = getattr(engine, "_offload", None)
@@ -408,26 +753,59 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             }),
     }
     ckptr = ocp.StandardCheckpointer()
+    adopted_opt = None       # cross-tier optax state, mined for moments below
+    opt_fallback = False     # opt_state came from the metadata fallback
     try:
-        restored = ckptr.restore(path, target)
-    except ValueError:
-        if load_optimizer_states:
-            ckptr.close()
-            raise
-        # cross-topology/tier load without optimizer state: the saved
-        # opt_state tree (e.g. a zero-3 optax state vs a param-offload
-        # engine's empty tuple) need not match this engine — rebuild that
-        # part of the target from the checkpoint's own metadata and discard
-        # it after restore
-        meta = ckptr.metadata(path)
-        opt_meta = meta["opt_state"] if isinstance(meta, dict) else \
-            getattr(meta, "item_metadata", meta)["opt_state"]
-        target["opt_state"] = jax.tree.map(
-            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
-            opt_meta)
-        restored = ckptr.restore(path, target)
-        restored["opt_state"] = state.opt_state
-    ckptr.close()
+        try:
+            restored = ckptr.restore(path, target)
+        except (ValueError, KeyError):
+            # ValueError: saved opt_state tree shape mismatches the target;
+            # KeyError: the target asks for opt_state keys the checkpoint
+            # never stored (e.g. an offload checkpoint's empty tuple vs a
+            # live optax tree) — both mean "cross-tier/topology opt_state",
+            # same fallback
+            opt_fallback = True
+            # cross-topology/tier load: the saved opt_state tree (e.g. an
+            # optax state vs an offload engine's empty tuple, or vice versa
+            # after the ladder escalated on a shrink) need not match this
+            # engine — rebuild that part of the target host-side from the
+            # checkpoint's own metadata; what to do with the restored tree
+            # is decided below
+            ckpt_meta = ckptr.metadata(path)
+            opt_meta = ckpt_meta["opt_state"] if isinstance(ckpt_meta, dict) \
+                else getattr(ckpt_meta, "item_metadata",
+                             ckpt_meta)["opt_state"]
+            target["opt_state"] = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
+                opt_meta)
+            restored = ckptr.restore(path, target)
+            if load_optimizer_states and offload is not None:
+                # tier escalation (optax -> host offload): the checkpoint's
+                # optax moments become the host kernel's moment buffers
+                adopted_opt = restored["opt_state"]
+            elif load_optimizer_states:
+                # tier de-escalation (offload -> optax): the per-process npz
+                # sidecar carries the moments; grafted into the fresh optax
+                # state after the params land (below). If the checkpoint HAD
+                # a real optax state but it still mismatched this engine's
+                # (different optimizer), classify instead of shape-crashing.
+                if jax.tree_util.tree_leaves(restored["opt_state"]):
+                    mined, mined_count = _extract_moments(
+                        restored["opt_state"],
+                        [tuple(x.shape)
+                         for x in jax.tree_util.tree_leaves(params_target)],
+                        n_states=2)
+                    if mined is None:
+                        raise CheckpointProvenanceError(
+                            f"checkpoint {path}: saved optimizer state does "
+                            f"not match this engine's optimizer structure "
+                            f"and its moments are unrecognizable; resume "
+                            f"with load_optimizer_states=False to restore "
+                            f"weights only") from None
+                    adopted_opt = ("mined", mined, mined_count)
+            restored["opt_state"] = state.opt_state
+    finally:
+        ckptr.close()
 
     from deepspeed_tpu.runtime.engine import EngineState
     from deepspeed_tpu.runtime.precision import LossScaleState
@@ -441,15 +819,33 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         # without this resync the next step would revert to stale masters.
         masters = [np.asarray(jax.device_get(p), np.float32)
                    for p in jax.tree.leaves(restored_params)]
-        npz_path = os.path.join(
-            path, f"offload_state_proc{jax.process_index()}.npz")
-        if load_optimizer_states and os.path.exists(npz_path):
+        npz_path = _offload_sidecar_path(path) if load_optimizer_states \
+            else None
+        if npz_path is not None:
             data = np.load(npz_path)
             n_states = offload.n_states
             states = [[data[f"s_{i}_{j}"] for j in range(n_states)]
                       for i in range(len(masters))]
             offload.load_state_dict({"step_count": int(data["step_count"]),
                                      "masters": masters, "states": states})
+        elif load_optimizer_states and adopted_opt is not None:
+            # tier escalation resume (the shrink ladder moved the optimizer
+            # to host): adopt the checkpoint's optax moments as the host
+            # kernel's moment buffers — optimizer state survives the tier
+            # change instead of resetting
+            states, step_count = _extract_moments(
+                adopted_opt, [m.shape for m in masters], offload.n_states)
+            if states is not None:
+                offload.load_state_dict({"step_count": step_count,
+                                         "masters": masters,
+                                         "states": states})
+                log_dist(f"offload: adopted optimizer moments from the "
+                         f"checkpoint's optax state (tier escalation, "
+                         f"step_count={step_count})", ranks=[0])
+            else:
+                log_dist("offload: checkpoint's optax state structure "
+                         "unrecognized; moments reset to zero", ranks=[0])
+                offload.set_masters(masters, reset_moments=True)
         else:
             if load_optimizer_states:
                 log_dist("offload: checkpoint has no host optimizer state; "
@@ -465,6 +861,42 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             restored_params = jax.device_put(
                 jax.tree_util.tree_unflatten(engine._params_treedef, shadow),
                 engine.param_shardings)
+
+    if load_optimizer_states and offload is None:
+        # tier de-escalation resume (host-offload checkpoint onto an optax
+        # engine, e.g. the ladder relaxing after a regrow): graft the
+        # per-process moment sidecar — or moments mined from a mismatched
+        # optax state — into this engine's live optimizer structure
+        mined = None
+        if isinstance(adopted_opt, tuple) and adopted_opt[0] == "mined":
+            mined = (adopted_opt[1], adopted_opt[2])
+        elif opt_fallback:
+            npz_path = _offload_sidecar_path(path)
+            if npz_path is not None:
+                data = np.load(npz_path)
+                n_leaves = len(jax.tree_util.tree_leaves(restored_params))
+                n_states = len([k for k in data.files
+                                if k.startswith("s_0_")])
+                if n_states:
+                    mined = ([[data[f"s_{i}_{j}"] for j in range(n_states)]
+                              for i in range(n_leaves)],
+                             int(data["step_count"]))
+        if mined is not None:
+            states, step_count = mined
+            grafted = _inject_moments_into_optax(
+                engine.state.opt_state,
+                jax.tree_util.tree_structure(restored_params),
+                states, step_count)
+            if grafted is not None:
+                restored["opt_state"] = jax.device_put(
+                    grafted, engine.opt_state_shardings)
+                log_dist(f"optimizer moments grafted from the checkpoint's "
+                         f"host-offload tier (step_count={step_count})",
+                         ranks=[0])
+            else:
+                log_dist("WARNING: checkpoint optimizer moments do not fit "
+                         "this engine's optimizer structure; optimizer "
+                         "state starts fresh", ranks=[0])
 
     engine.state = EngineState(
         step=sc["step"],
@@ -492,14 +924,20 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             "masks": masks,
         })
 
-    meta_path = os.path.join(path, "ds_meta.json")
     client_state: Dict[str, Any] = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    if meta:
         engine.global_steps = meta.get("global_steps", 0)
         engine.global_samples = meta.get("global_samples", 0)
         engine.micro_steps = meta.get("micro_steps", 0)
         client_state = meta.get("client_state", {})
+    if provenance and provenance.get("rng"):
+        # resume the rng stream exactly where the save left it (replicated
+        # host state — world-size independent), so dropout-style rngs are
+        # deterministic across preempt/shrink/regrow boundaries
+        try:
+            _restore_rng(engine, provenance["rng"])
+        except Exception:
+            logger.exception("checkpoint: rng restore failed; the engine "
+                             "keeps its init-seeded key")
     log_dist(f"loaded checkpoint {path}", ranks=[0])
     return path, client_state

@@ -115,8 +115,117 @@ def inspect_checkpoint(path: str, tag: Optional[str] = None) -> Dict[str, Any]:
         "checkpoint": ckpt_dir,
         "meta": meta,
         "num_params": total,
+        "provenance": provenance_summary(meta),
         "parameters": params_meta,
     }
+
+
+def provenance_summary(meta: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The rendered provenance block: saved world / mesh axes (non-trivial
+    only) / zero placement / step / sampler position / rng key shape. None
+    for legacy (pre-provenance) checkpoints."""
+    prov = (meta or {}).get("provenance")
+    if not prov:
+        return None
+    mesh = prov.get("mesh") or {}
+    rng = prov.get("rng") or {}
+    return {
+        "saved_world": prov.get("world"),
+        "mesh_axes": {a: s for a, s in mesh.items() if int(s or 1) != 1}
+        or {"(all axes 1)": 1},
+        "zero": prov.get("zero"),
+        "step": (meta or {}).get("global_steps"),
+        "sampler": prov.get("sampler"),
+        "rng_key": {"shape": rng.get("shape"), "dtype": rng.get("dtype"),
+                    "typed": rng.get("typed")},
+        "batch": prov.get("batch"),
+        "ledger": {k: v for k, v in (prov.get("ledger") or {}).items()
+                   if k != "phase_hbm_bytes"},
+    }
+
+
+def compat_check(path: str, world: int, tag: Optional[str] = None
+                 ) -> Dict[str, Any]:
+    """Resharding-feasibility report for resuming this checkpoint at
+    ``world`` workers (for a single-process checkpoint, ``world`` chips) —
+    metadata only, no device or array-byte access.
+
+    Checks: (1) the sampler contract's batch divisibility (the saved
+    global batch must factor into (micro, gas, dp) at the new world — via
+    the saved elasticity block when present, plain divisibility
+    otherwise); (2) the analytic ledger preflight at the new per-chip
+    footprint (``plan_world_config`` over the provenance's recorded
+    config/param-count/HBM-limit), reporting the offload-ladder rungs a
+    shrink would need."""
+    ckpt_dir = resolve_checkpoint_dir(path, tag)
+    with open(os.path.join(ckpt_dir, "ds_meta.json")) as f:
+        meta = json.load(f)
+    prov = meta.get("provenance") or {}
+    out: Dict[str, Any] = {"checkpoint": ckpt_dir, "world": int(world),
+                           "checks": {}, "feasible": True}
+    if not prov:
+        out["feasible"] = False
+        out["checks"]["provenance"] = {
+            "ok": False, "detail": "legacy checkpoint: no provenance block "
+            "(saved before PROVENANCE_VERSION 1)"}
+        return out
+
+    batch = prov.get("batch") or {}
+    tb = int(batch.get("train_batch_size", 0) or 0)
+    raw = prov.get("config") or {}
+    # the dp world is denominated in CHIPS, not workers — convert with the
+    # SAME rule the ledger check (plan_from_provenance) uses, or the two
+    # halves of this verdict would use different world units: multi-process
+    # saves count device_count/process_count chips per worker; for a
+    # single-process save ``world`` reads directly as a chip count
+    from deepspeed_tpu.telemetry.memory import provenance_chips_per_worker
+    chips_per_worker = provenance_chips_per_worker(prov)
+    chips = int(world) * chips_per_worker
+    # the batch divides over the DATA-PARALLEL extent only: model-parallel
+    # axes (pipe/tensor/expert/sequence) are divided out of the chip count,
+    # mirroring plan_world_config's mesh derivation
+    model_world = 1
+    for a in ("pipe", "tensor", "expert", "sequence"):
+        model_world *= max(1, int((raw.get("mesh", {}) or {}).get(a, 1) or 1))
+    dp_chips = max(1, chips // model_world)
+    batch_ok, detail = True, (f"train_batch_size {tb} divides over "
+                              f"dp world {dp_chips} ({chips} chips / "
+                              f"model-parallel {model_world})")
+    if (raw.get("elasticity") or {}).get("enabled"):
+        from deepspeed_tpu.elasticity.elasticity import (
+            ElasticityError, compute_elastic_config)
+        try:
+            compute_elastic_config(raw, world_size=int(world))
+            detail = (f"world {world} is in the elastic config's "
+                      f"compatible set (global batch {tb} invariant)")
+        except ElasticityError as e:
+            batch_ok, detail = False, str(e)
+    elif tb and tb % dp_chips != 0:
+        batch_ok = False
+        detail = (f"train_batch_size {tb} not divisible by the dp world "
+                  f"{dp_chips} ({world} workers x {chips_per_worker} chips "
+                  f"/ model-parallel {model_world}): the sampler contract "
+                  f"(global batch invariant) cannot hold")
+    out["checks"]["batch"] = {"ok": batch_ok, "detail": detail}
+
+    from deepspeed_tpu.telemetry.memory import plan_from_provenance
+    plan = plan_from_provenance(prov, int(world))
+    if plan is not None:
+        bytes_limit = plan["verdict"]["bytes_limit"]
+        out["checks"]["ledger"] = {
+            "ok": plan["verdict"]["fits"] or not bytes_limit,
+            "required_bytes_per_chip": plan["verdict"]["required_bytes"],
+            "bytes_limit": bytes_limit,
+            "escalations": plan["escalations"],
+            "detail": ("fits" if plan["verdict"]["fits"] else
+                       "does not fit even at the last offload rung")
+            if bytes_limit else "no HBM limit recorded at save; plan only",
+        }
+    else:
+        out["checks"]["ledger"] = {"ok": True,
+                                   "detail": "no param count recorded"}
+    out["feasible"] = all(c.get("ok") for c in out["checks"].values())
+    return out
 
 
 def _flatten_meta(tree: Any, prefix: str = "") -> Dict[str, Dict[str, Any]]:
@@ -186,9 +295,15 @@ def main(argv=None):
         prog="dstpu_ckpt",
         description="Universal checkpoint tools (inspect / consolidate to fp32)")
     sub = p.add_subparsers(dest="cmd", required=True)
-    pi = sub.add_parser("inspect", help="list parameters + metadata")
+    pi = sub.add_parser("inspect", help="list parameters + metadata + "
+                                        "provenance")
     pi.add_argument("path")
     pi.add_argument("--tag", default=None)
+    pi.add_argument("--compat", type=int, metavar="WORLD", default=None,
+                    help="additionally report resharding feasibility at "
+                         "WORLD workers (chips, for a single-process "
+                         "checkpoint); metadata only, no devices; exit 1 "
+                         "when infeasible")
     pc = sub.add_parser("consolidate",
                         help="write a single fp32 .npz (zero_to_fp32 analog)")
     pc.add_argument("path")
@@ -198,12 +313,19 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.cmd == "inspect":
         info = inspect_checkpoint(args.path, tag=args.tag)
+        if args.compat is not None:
+            info["compat"] = compat_check(args.path, args.compat,
+                                          tag=args.tag)
         print(json.dumps(info, indent=2))
+        if args.compat is not None and not info["compat"]["feasible"]:
+            return 1
     else:
         out = consolidate_to_fp32(args.path, args.output, tag=args.tag,
                                   include_optimizer=args.include_optimizer)
         print(out)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    sys.exit(main())

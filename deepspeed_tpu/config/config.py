@@ -254,7 +254,11 @@ class DataEfficiencyConfig(DeepSpeedTPUConfigModel):
 
 
 class ElasticityConfig(DeepSpeedTPUConfigModel):
-    """reference: deepspeed/elasticity/config.py."""
+    """reference: deepspeed/elasticity/config.py. The shrink-to-survive
+    keys (TPU-native, no reference analog) let the elastic agent re-plan a
+    generation at the SURVIVING world when membership proves a rank
+    permanently lost, instead of relaunch-looping at a world that can
+    never assemble again."""
     enabled: bool = False
     max_train_batch_size: int = 2000
     micro_batch_sizes: list = Field(default_factory=lambda: [2, 4, 6])
@@ -264,6 +268,15 @@ class ElasticityConfig(DeepSpeedTPUConfigModel):
     version: float = 0.2
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+    # shrink-to-survive (elasticity/agent.py): relaunch a comm-fault /
+    # preemption generation at world - |lost ranks| when membership shows
+    # a peer permanently gone ...
+    shrink_on_peer_loss: bool = False
+    # ... never below this floor (the agent refuses and exits instead) ...
+    min_world_size: int = 1
+    # ... after giving the lost rank this long to heartbeat again (0 =
+    # shrink at the first stale-membership verdict)
+    rejoin_grace_s: float = 0.0
 
 
 class PLDConfig(DeepSpeedTPUConfigModel):
@@ -343,7 +356,9 @@ class DeepSpeedTPUConfig:
     runtime/config.py). Performs the batch-size triple reconciliation with
     ``dp_world_size`` = size of (data x fsdp) mesh axes."""
 
-    def __init__(self, config: Union[str, Dict[str, Any], None], dp_world_size: Optional[int] = None):
+    def __init__(self, config: Union[str, Dict[str, Any], None],
+                 dp_world_size: Optional[int] = None,
+                 apply_elastic_overrides: bool = False):
         if config is None:
             config = {}
         if isinstance(config, str):
@@ -354,6 +369,33 @@ class DeepSpeedTPUConfig:
         if not isinstance(config, dict):
             raise TypeError(f"config must be dict or path, got {type(config)}")
         self._raw = dict(config)
+
+        # elastic relaunch overrides: when the agent's shrink preflight
+        # escalated the offload ladder it exports the merged override dict
+        # as env. Applied ONLY for the training entry point
+        # (deepspeed_tpu.initialize passes apply_elastic_overrides=True) —
+        # other configs parsed in the same process (autotuning candidates,
+        # serving groups, eval engines) must see exactly what they were
+        # given, not a silently escalated variant.
+        if apply_elastic_overrides:
+            from deepspeed_tpu.launcher.constants import ENV_CONFIG_OVERRIDES
+            _ov_raw = os.environ.get(ENV_CONFIG_OVERRIDES)
+            if _ov_raw:
+                try:
+                    overrides = json.loads(_ov_raw)
+                except ValueError:
+                    overrides = None
+                if not isinstance(overrides, dict):
+                    logger.warning(f"{ENV_CONFIG_OVERRIDES} is not a JSON "
+                                   f"object; ignored")
+                    overrides = None
+                if overrides:
+                    from deepspeed_tpu.telemetry.memory import deep_merge
+                    import copy
+                    self._raw = deep_merge(copy.deepcopy(self._raw),
+                                           overrides)
+                    logger.info(f"elastic config overrides applied from "
+                                f"{ENV_CONFIG_OVERRIDES}: {overrides}")
 
         for key in list(self._raw):
             if key in C.IGNORED_CUDA_ONLY_KEYS:

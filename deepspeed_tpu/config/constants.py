@@ -56,6 +56,14 @@ SERVING = "serving"                 # serving group (admission, degradation
 #                                     isolation; serving/server.py
 #                                     ServingConfig.from_ds_config)
 
+# elasticity group keys for shrink-to-survive (elasticity/agent.py): the
+# agent may re-plan a generation below the launch world when membership
+# proves a rank permanently lost, floored at MIN_WORLD_SIZE; REJOIN_GRACE_S
+# is how long a lost rank gets to heartbeat again before the shrink commits
+ELASTICITY_MIN_WORLD_SIZE = "min_world_size"
+ELASTICITY_SHRINK_ON_PEER_LOSS = "shrink_on_peer_loss"
+ELASTICITY_REJOIN_GRACE_S = "rejoin_grace_s"
+
 # Defaults (mirroring reference semantics)
 STEPS_PER_PRINT_DEFAULT = 10
 GRADIENT_CLIPPING_DEFAULT = 0.0

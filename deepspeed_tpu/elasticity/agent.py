@@ -8,8 +8,24 @@ callback), recomputes a *compatible* world size from the elastic batch config,
 and relaunches workers with fresh DSTPU_* rendezvous env. Checkpoint/resume is
 the state-transfer mechanism (workers are expected to resume from the latest
 checkpoint tag, as with preempted TPU slices).
+
+**Shrink-to-survive** (the ``elasticity`` config keys ``shrink_on_peer_loss``
+/ ``min_world_size`` / ``rejoin_grace_s``): a permanently dead chip used to
+wedge the job in a relaunch loop forever — every generation re-assembled the
+SAME world and re-faulted on the same missing rank. With shrink enabled the
+agent consults the filesystem membership store on every free-relaunch
+generation: ranks whose heartbeat stays stale past ``rejoin_grace_s`` are
+excluded, the next generation is planned at the surviving world (floored at
+``min_world_size``), a jax-free ``MemoryLedger`` preflight re-plans the
+per-chip footprint (auto-escalating the offload ladder and exporting the
+escalated config to workers via ``DSTPU_ELASTIC_CONFIG_OVERRIDES``), and the
+workers resume from the mesh-portable checkpoint. When an excluded rank's
+heartbeat returns, the agent re-grows back toward the target world. Every
+transition stamps an ``elastic/`` dstrace instant and updates the
+``elastic_status.json`` artifact ``env_report`` renders.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -17,11 +33,22 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from deepspeed_tpu.config.constants import (COMM_GUARD, ELASTICITY,
+                                            ELASTICITY_MIN_WORLD_SIZE,
+                                            ELASTICITY_REJOIN_GRACE_S,
+                                            ELASTICITY_SHRINK_ON_PEER_LOSS,
+                                            MEMORY)
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityIncompatibleWorldSize, compute_elastic_config)
-from deepspeed_tpu.launcher.constants import (ENV_COORDINATOR, ENV_NUM_PROCESSES,
+from deepspeed_tpu.launcher.constants import (ENV_CONFIG_OVERRIDES,
+                                              ENV_COORDINATOR,
+                                              ENV_NUM_PROCESSES,
                                               ENV_PROCESS_ID)
 from deepspeed_tpu.utils.logging import logger
+
+#: env var naming the agent's status artifact (read back by env_report)
+STATUS_ENV = "DSTPU_ELASTIC_STATUS"
+DEFAULT_STATUS_PATH = "elastic_status.json"
 
 
 @dataclass
@@ -57,6 +84,23 @@ class WorkerSpec:
     # relaunches get DSTPU_RESUME=latest so workers resume from the newest
     # committed checkpoint (resilience.resume_from_latest) instead of step 0
     resume_env: bool = True
+    # membership store the agent consults for the shrink verdict (exported
+    # to workers as DSTPU_MEMBERSHIP_DIR so every generation's heartbeats
+    # land in the same place); None = resilience.default_membership_dir()
+    membership_dir: Optional[str] = None
+    # the workers' checkpoint save_dir: the agent reads the latest tag's
+    # ds_meta.json provenance (num_params, observed HBM limit, saved config)
+    # to run the ledger preflight for a shrunk world — no devices touched
+    ckpt_dir: Optional[str] = None
+    # where the per-generation status artifact lands (env_report's elastic
+    # rows); None = $DSTPU_ELASTIC_STATUS when set, else no artifact is
+    # written (a supervisor must opt in — tests and ad-hoc agents must not
+    # litter the cwd). Operators conventionally point it at
+    # ./elastic_status.json, which env_report discovers unprompted.
+    status_path: Optional[str] = None
+    # heartbeat staleness horizon for the agent's own membership view
+    # (mirrors comm_guard.lost_after_s; the config group wins when present)
+    lost_after_s: float = 10.0
 
 
 class ElasticAgent:
@@ -78,6 +122,258 @@ class ElasticAgent:
         self.procs: List[subprocess.Popen] = []
         self._launch_time = 0.0
 
+        # --- shrink-to-survive state (the "elasticity" group's new keys) --
+        ecfg = self.ds_config.get(ELASTICITY) or {}
+        self.shrink_on_peer_loss = bool(
+            ecfg.get(ELASTICITY_SHRINK_ON_PEER_LOSS, False))
+        self.min_world_size = int(ecfg.get(ELASTICITY_MIN_WORLD_SIZE, 1))
+        self.rejoin_grace_s = float(ecfg.get(ELASTICITY_REJOIN_GRACE_S, 0.0))
+        self.target_world: Optional[int] = None    # world of gen 0
+        self.current_world: Optional[int] = None   # world of the live gen
+        self.shrink_events: List[Dict] = []        # shrink/regrow history
+        self.last_exit: Dict = {}                  # last gen's classification
+        self.last_preflight: Optional[Dict] = None
+        self._config_overrides: Dict = {}          # ladder escalation result
+        self._membership = None
+        self._next_regrow_probe = 0.0
+
+    # ------------------------------------------------------------------
+    # shrink-to-survive: membership verdict + ledger preflight + status
+    # ------------------------------------------------------------------
+    def _membership_view(self, world: Optional[int] = None):
+        """The agent's read-side view of the workers' heartbeat store. A
+        fresh view is anchored at every generation launch with
+        ``expected_ranks = range(world)`` — a rank that NEVER publishes
+        (booted dead, or chaos-silenced from the start) classifies lost
+        once the generation is older than the staleness horizon, exactly
+        like one that published and went quiet."""
+        if not self.shrink_on_peer_loss:
+            return None
+        if world is not None or self._membership is None:
+            from deepspeed_tpu.resilience.membership import (
+                MembershipView, default_membership_dir)
+            cg = self.ds_config.get(COMM_GUARD) or {}
+            self._membership = MembershipView(
+                self.spec.membership_dir or default_membership_dir(),
+                lost_after_s=float(cg.get("lost_after_s",
+                                          self.spec.lost_after_s)),
+                expected_ranks=range(world) if world else None)
+        return self._membership
+
+    def _tracer(self):
+        from deepspeed_tpu.telemetry.tracer import get_tracer
+        return get_tracer()
+
+    def _await_membership_verdict(self) -> List[int]:
+        """Ranks of the just-ended generation whose heartbeat is stale AND
+        stays stale through the ``rejoin_grace_s`` window — the
+        permanently-lost set the shrink is planned around. A rank that
+        heartbeats again inside the window drops out (transient blip:
+        relaunch at the same world, no shrink). Only ranks stale at FIRST
+        observation are eligible — survivors whose files age out while the
+        agent waits (they exited cleanly and stopped beating) are never
+        shrunk away."""
+        view = self._membership_view()
+        if view is None or self.current_world is None:
+            return []
+        # membership staleness is the verdict, but only CAPACITY-SHAPED
+        # exits are eligible: a vanished node's local process dies by
+        # signal (negative Popen code / 137) or never exits (None), and a
+        # dead remote host's ssh wrapper returns 255 — while a software
+        # crash exits with a positive status and a deliberate exit (0,
+        # comm-fault 75, preemption 143/130) chose its code. Without this
+        # filter a deterministic exit-1 bug would "mature" into the lost
+        # set as its heartbeat aged and walk the job down the shrink
+        # ladder with the crash budget never charged. Survivors are
+        # additionally protected by freshness: they beat until they exited
+        # ~now, while the rank that CAUSED the failure stopped beating at
+        # least one staleness horizon earlier. Operating envelope:
+        # lost_after_s must exceed the agent's detection latency
+        # (monitor_interval_s).
+        # capacity-shaped = externally killed or vanished: SIGKILL (-9 /
+        # 137 — the OOM killer and the platform reclaiming a node), a dead
+        # remote host's ssh 255, or never-exiting (None). Other signal
+        # deaths are NOT eligible — SIGSEGV/SIGABRT/SIGFPE are how native
+        # code crashes deterministically (XLA CHECK failures), and
+        # reclassifying those as capacity loss would walk the job down the
+        # shrink ladder with the crash budget never charged.
+        codes = getattr(self, "_last_codes", [])
+        eligible = {i for i, c in enumerate(codes)
+                    if c is None or c in (-9, 137, 255)}
+        if not eligible:
+            # every worker chose its exit code (clean/crash/preemption/
+            # comm-fault): nothing can mature into the lost set — don't
+            # burn a staleness horizon on a verdict that cannot change
+            return []
+
+        def lost_now():
+            return {r for r in view.lost_peers()
+                    if r < self.current_world and r in eligible}
+        # a rank that died WITH this generation's failure only turns stale
+        # after the staleness horizon — wait it out before concluding
+        # nobody was lost (the first to mature is the one that died first)
+        initial = lost_now()
+        mature = time.monotonic() + view.lost_after_s + 1.0
+        while not initial and time.monotonic() < mature:
+            time.sleep(0.1)
+            initial = lost_now()
+        if not initial:
+            return []
+        self._tracer().instant("elastic/peer_lost", cat="elastic",
+                               ranks=sorted(initial),
+                               generation=self.restart_count,
+                               world=self.current_world)
+        logger.warning(f"elastic agent: rank(s) {sorted(initial)} lost "
+                       f"(stale heartbeat); waiting "
+                       f"{self.rejoin_grace_s:.1f}s for rejoin before "
+                       f"shrinking")
+        lost = initial
+        deadline = time.monotonic() + self.rejoin_grace_s
+        while lost and time.monotonic() < deadline:
+            time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+            lost = initial & lost_now()
+        return sorted(lost)
+
+    def _read_ckpt_provenance(self) -> Dict:
+        """The latest checkpoint tag's ds_meta provenance (stdlib reads
+        only — the supervisor never touches orbax/devices). Empty dict when
+        no checkpoint or no provenance exists yet. Memoized per tag: the
+        block carries the full config + param-tree lines, and this runs on
+        every status write inside the supervisor loop."""
+        d = self.spec.ckpt_dir
+        if not d:
+            return {}
+        try:
+            with open(os.path.join(d, "latest")) as f:
+                tag = f.read().strip()
+        except OSError:
+            return {}
+        cached = getattr(self, "_prov_cache", None)
+        if cached is not None and cached[0] == tag:
+            return cached[1]
+        try:
+            with open(os.path.join(d, tag, "ds_meta.json")) as f:
+                prov = json.load(f).get("provenance") or {}
+        except (OSError, ValueError):
+            return {}
+        self._prov_cache = (tag, prov)
+        return prov
+
+    def _preflight_world(self, world: int) -> Optional[Dict]:
+        """Ledger preflight for the shrunk world: fewer chips means more
+        bytes per chip, so re-plan analytically (MemoryLedger over the
+        checkpoint's recorded config/param-count/HBM-limit) and escalate
+        the offload ladder until the plan fits. The escalated overrides are
+        exported to workers via DSTPU_ELASTIC_CONFIG_OVERRIDES. Returns the
+        plan (None when no provenance exists to plan from); raises
+        ``ElasticityIncompatibleWorldSize`` when the plan cannot fit and
+        the memory group's policy is "refuse"."""
+        from deepspeed_tpu.telemetry.memory import plan_from_provenance
+        prov = self._read_ckpt_provenance()
+        plan = plan_from_provenance(prov, world,
+                                    default_config=dict(self.ds_config))
+        if plan is None:
+            logger.info("elastic agent: no checkpoint provenance to "
+                        "preflight the shrunk world against; skipping")
+            return None
+        self.last_preflight = {
+            "world": world, "chips": plan["world_chips"],
+            "fits": plan["verdict"]["fits"],
+            "required_bytes": plan["verdict"]["required_bytes"],
+            "bytes_limit": plan["verdict"]["bytes_limit"],
+            "escalations": plan["escalations"],
+        }
+        policy = (self.ds_config.get(MEMORY) or {}).get("preflight", "warn")
+        if plan["escalations"]:
+            logger.warning(
+                f"elastic agent: shrink to {world} workers needs the "
+                f"offload ladder: {plan['escalations']} (exported to "
+                f"workers via {ENV_CONFIG_OVERRIDES})")
+            self._config_overrides = plan["overrides"]
+        if not plan["verdict"]["fits"]:
+            msg = (f"shrunk world {world} cannot fit: plan needs "
+                   f"{plan['verdict']['required_bytes'] / 1e9:.2f}GB/chip vs "
+                   f"limit {plan['verdict']['bytes_limit'] / 1e9:.2f}GB even "
+                   f"at the last offload rung")
+            if policy == "refuse":
+                raise ElasticityIncompatibleWorldSize(
+                    f"elastic agent (preflight: refuse): {msg}")
+            logger.warning(f"elastic agent: {msg}; launching anyway "
+                           f"(memory.preflight={policy})")
+        return plan
+
+    def _clean_excluded_heartbeats(self, world: int) -> None:
+        """Remove heartbeat files of every rank outside the new world so
+        the shrunk generation's membership view (and a single-process
+        worker's ad-hoc view, which counts every published rank) never
+        wedges on pre-shrink leftovers. Unconditional on freshness: a
+        just-terminated healthy survivor's file is still fresh here but
+        will go stale in seconds, and that rank is not a member of the new
+        generation either way."""
+        view = self._membership_view()
+        if view is None:
+            return
+        for rank in view.snapshot():
+            if rank >= world:
+                try:
+                    os.remove(os.path.join(
+                        view.directory, f"rank_{rank}.json"))
+                except OSError:
+                    pass
+
+    def _regrow_candidates(self) -> List[int]:
+        """Excluded ranks whose heartbeat came back (capacity returned)."""
+        view = self._membership_view()
+        if view is None or self.current_world is None or \
+                self.target_world is None or \
+                self.current_world >= self.target_world:
+            return []
+        snap = view.snapshot()
+        return [r for r, h in snap.items()
+                if r >= self.current_world and h.alive]
+
+    def _status_path(self) -> Optional[str]:
+        """Where the status artifact lands — spec wins, then env; None
+        disables the artifact (the in-memory state still accumulates).
+        ``env_report`` looks at $DSTPU_ELASTIC_STATUS then
+        ``./DEFAULT_STATUS_PATH`` (the conventional operator choice for
+        ``status_path``)."""
+        return self.spec.status_path or os.environ.get(STATUS_ENV) or None
+
+    def _write_status(self, event: Optional[Dict] = None) -> None:
+        """Persist the supervisor's view for operators/env_report: worlds,
+        budget, last exit classification, last shrink/regrow event, last
+        preflight. Atomic write; a status failure never kills the agent."""
+        if event is not None:
+            self.shrink_events.append(event)
+        if self._status_path() is None:
+            return
+        status = {
+            "target_world": self.target_world,
+            "current_world": self.current_world,
+            "checkpoint_world": (self._read_ckpt_provenance().get("world")
+                                 or {}).get("process_count"),
+            "generation": self.restart_count,
+            "crash_restarts": self.crash_restarts,
+            "max_restarts": self.spec.max_restarts,
+            "total_restarts": self.restart_count,
+            "max_total_restarts": self.spec.max_total_restarts,
+            "last_exit": self.last_exit or None,
+            "last_event": self.shrink_events[-1] if self.shrink_events
+            else None,
+            "preflight": self.last_preflight,
+            "config_overrides": self._config_overrides or None,
+            "updated_at": time.time(),
+        }
+        path = self._status_path()
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(status, f, indent=2)
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception("elastic agent: status artifact write failed")
+
     def _validate_world(self, world_size: int) -> int:
         """Check the world size against the elastic config; returns the global
         batch that training must use at this scale."""
@@ -85,8 +381,11 @@ class ElasticAgent:
             self.ds_config, world_size=world_size)
         return final_batch
 
-    def _launch(self, hosts: List[str]) -> None:
-        world = len(hosts)
+    def _launch(self, hosts: List[str], world: Optional[int] = None) -> None:
+        """Spawn one worker per world slot (default: one per host; a shrink
+        passes an explicit smaller ``world`` and slots cycle over the
+        surviving hosts)."""
+        world = len(hosts) if world is None else world
         final_batch = self._validate_world(world)
         coordinator = f"{hosts[0]}:{self.spec.coordinator_port}"
         logger.info(f"elastic launch: world={world} batch={final_batch} "
@@ -98,14 +397,13 @@ class ElasticAgent:
         from deepspeed_tpu.comm.guard import (INIT_BACKOFF_ENV,
                                               INIT_DEADLINE_ENV,
                                               INIT_RETRIES_ENV)
-        from deepspeed_tpu.config.constants import COMM_GUARD
         cg = self.ds_config.get(COMM_GUARD) or {}
         init_env = {var: str(cg[key]) for key, var in
                     (("init_deadline_s", INIT_DEADLINE_ENV),
                      ("init_retries", INIT_RETRIES_ENV),
                      ("init_backoff_s", INIT_BACKOFF_ENV)) if key in cg}
         self.procs = []
-        for pid, host in enumerate(hosts):
+        for pid in range(world):
             env = dict(os.environ)
             env.update(self.spec.env)
             for var, val in init_env.items():
@@ -115,13 +413,32 @@ class ElasticAgent:
             env[ENV_PROCESS_ID] = str(pid)
             env["DSTPU_ELASTIC_RESTART"] = str(self.restart_count)
             env["DSTPU_ELASTIC_BATCH"] = str(final_batch)
+            if self.spec.membership_dir:
+                # one shared heartbeat store across generations: the agent's
+                # shrink verdict and the workers' peer-loss detection read
+                # the same files
+                env.setdefault("DSTPU_MEMBERSHIP_DIR",
+                               self.spec.membership_dir)
+            if self._config_overrides:
+                # the shrink preflight escalated the offload ladder: workers
+                # deep-merge this over their raw config at parse time
+                env[ENV_CONFIG_OVERRIDES] = json.dumps(self._config_overrides)
             if self.restart_count > 0 and self.spec.resume_env:
                 # relaunch marker: workers call FaultTolerantRunner
                 # .maybe_resume() at startup, which resumes from the newest
                 # committed checkpoint iff this var is set
                 env["DSTPU_RESUME"] = "latest"
             self.procs.append(self.popen(self.spec.cmd, env=env))
+        self.current_world = world
+        if self.target_world is None:
+            self.target_world = world
+        if self.shrink_on_peer_loss:
+            # fresh view anchored at this generation: never-published
+            # members classify lost once the generation outlives the
+            # staleness horizon
+            self._membership_view(world=world)
         self._launch_time = time.monotonic()
+        self._write_status()
 
     def _poll(self) -> Optional[int]:
         """None while all healthy; first non-zero exit code on failure; 0
@@ -194,9 +511,19 @@ class ElasticAgent:
 
     def run(self) -> int:
         """Supervise until success or the crash-restart budget is exhausted.
-        Preemption exits and membership changes relaunch for free (the
-        platform's churn is not the workload's fault); crashes consume the
-        budget and back off exponentially while the streak lasts."""
+        Preemption/comm-fault exits and membership changes relaunch for free
+        (the platform's churn is not the workload's fault); crashes consume
+        the budget and back off exponentially while the streak lasts.
+
+        With ``shrink_on_peer_loss``: a free-relaunch generation whose
+        membership shows ranks permanently lost (stale past
+        ``rejoin_grace_s``) relaunches at the SURVIVING world — ledger
+        preflight first, offload-ladder escalation exported to workers —
+        and re-grows toward the target world when the lost capacity's
+        heartbeat returns. Shrink generations never consume the crash
+        budget: capacity loss is the platform's fault, even when the dead
+        rank's own exit status looks crash-shaped (a killed node cannot
+        exit cleanly)."""
         hosts = self.host_provider()
         self._launch(hosts)
         while True:
@@ -204,21 +531,56 @@ class ElasticAgent:
             status = self._poll()
             current_hosts = self.host_provider()
             scale_change = set(current_hosts) != set(hosts)
-            if status is None and not scale_change:
+            regrow = self._poll_regrow(status)
+            if status is None and not scale_change and not regrow:
                 continue
             if status == 0 and not scale_change:
                 logger.info("elastic agent: all workers finished")
+                self.last_exit = {"codes": list(self._last_codes),
+                                  "classification": "completed"}
+                self._write_status()
                 return 0
             comm_fault = self._is_comm_fault(status)
             crash = (status is not None and status != 0
                      and not self._is_preemption(status) and not comm_fault)
+            # membership verdict (shrink enabled, any failed generation):
+            # which ranks are REALLY gone, after the rejoin grace window
+            lost: List[int] = []
+            if status is not None and status != 0 and self.shrink_on_peer_loss:
+                lost = self._await_membership_verdict()
+            if crash and lost:
+                free = tuple(self.spec.preemption_exit_codes) + \
+                    tuple(self.spec.comm_fault_exit_codes)
+                bad_idx = [i for i, c in enumerate(self._last_codes)
+                           if c not in (None, 0) and c not in free]
+                if bad_idx and set(bad_idx) <= set(lost):
+                    # every crash-shaped exit belongs to a membership-lost
+                    # rank: that IS the capacity loss (a reclaimed node's
+                    # process never exits preemption-shaped) — the
+                    # generation is free, the budget untouched
+                    crash = False
+                    logger.info(f"elastic agent: crash-shaped exits "
+                                f"{bad_idx} all belong to lost rank(s) "
+                                f"{lost}; classified as capacity loss")
             uptime = time.monotonic() - self._launch_time
             # failure or membership change → restart the group at new scale
             self._terminate_all()
             self.restart_count += 1
+            self.last_exit = {
+                "codes": [c for c in getattr(self, "_last_codes", [])],
+                "classification": (
+                    # status None (all running) or 0 (all finished) can only
+                    # reach here via a host-set/regrow change
+                    "scale_change" if status in (None, 0) else
+                    "capacity_loss" if lost and not crash else
+                    "crash" if crash else
+                    "comm_fault" if comm_fault else "preemption"),
+                "lost_ranks": lost or None,
+            }
             if self.restart_count > self.spec.max_total_restarts:
                 logger.error("elastic agent: total restart backstop "
                              f"exhausted ({self.spec.max_total_restarts})")
+                self._write_status()
                 return status or 1
             if crash:
                 if uptime >= self.spec.healthy_uptime_s:
@@ -228,6 +590,7 @@ class ElasticAgent:
                 if self.crash_restarts > self.spec.max_restarts:
                     logger.error("elastic agent: crash-restart budget "
                                  f"exhausted ({self.spec.max_restarts})")
+                    self._write_status()
                     return status or 1
                 backoff = self._crash_backoff_s()
                 if backoff:
@@ -238,15 +601,143 @@ class ElasticAgent:
                     time.sleep(backoff)
             else:
                 self.consecutive_crashes = 0
-                why = ("scale change" if scale_change else
+                why = ("scale change" if scale_change or regrow else
+                       f"capacity loss (lost ranks {lost})" if lost else
                        f"comm fault (exit {status})" if comm_fault else
                        f"preemption (exit {status})")
                 logger.info(f"elastic agent: {why}; relaunching immediately "
                             "(budget untouched)")
             hosts = current_hosts
             try:
-                self._launch(hosts)
+                world = self._plan_next_world(hosts, lost, regrow)
+                if world is None:            # below min_world_size
+                    self._write_status()
+                    return status or 1
+                self._launch(hosts, world=world)
             except ElasticityIncompatibleWorldSize as e:
-                logger.error(f"elastic agent: no compatible config at "
-                             f"world={len(hosts)}: {e}")
+                logger.error(f"elastic agent: no compatible config at the "
+                             f"planned world: {e}")
+                self._write_status()
                 return 1
+
+    def _poll_regrow(self, status) -> int:
+        """Throttled probe for returned capacity while the group is healthy
+        and shrunk below target: a fresh heartbeat from an excluded rank
+        triggers a regrow relaunch (same restart-the-group mechanics as a
+        host-set scale change)."""
+        if status is not None or not self.shrink_on_peer_loss or \
+                self.current_world is None or self.target_world is None or \
+                self.current_world >= self.target_world:
+            return 0
+        now = time.monotonic()
+        if now < self._next_regrow_probe:
+            return 0
+        self._next_regrow_probe = now + max(
+            self.spec.monitor_interval_s, 1.0)
+        back = len(self._regrow_candidates())
+        if not back:
+            return 0
+        # only restart the group when the returned capacity actually buys a
+        # LARGER compatible world (one chip back under a {2,4}-only batch
+        # config buys nothing at world 2 — don't churn a healthy job)
+        grown = self._compatible_world_at_most(
+            min(self.target_world, self.current_world + back))
+        return back if grown is not None and grown > self.current_world else 0
+
+    def _compatible_world_at_most(self, world: int) -> Optional[int]:
+        """The largest elastic-config-compatible world <= ``world`` (the
+        global batch is invariant, so not every integer world factors);
+        None when nothing <= ``world`` is compatible. In the v0.2
+        model-parallel path ``compute_elastic_config`` reports DATA-PARALLEL
+        worlds — convert to total worker counts (dp * mp) before comparing,
+        or the planner would pick an mp-indivisible world."""
+        ecfg = self.ds_config.get(ELASTICITY) or {}
+        mp = int(ecfg.get("model_parallel_size", 1) or 1) \
+            if float(ecfg.get("version", 0.2) or 0.2) >= 0.2 else 1
+        try:
+            _, valid = compute_elastic_config(self.ds_config)
+        except Exception:
+            return world if world >= 1 else None
+        if mp > 1:
+            valid = [w * mp for w in valid]
+        fits = [w for w in valid if w <= world]
+        return max(fits) if fits else None
+
+    def _plan_next_world(self, hosts: List[str], lost: List[int],
+                         regrow: int) -> Optional[int]:
+        """The next generation's world: host-provider count, minus
+        membership-lost ranks (shrink, rounded DOWN to the nearest
+        batch-compatible world), plus returned capacity (regrow, capped at
+        the target world). Returns None when the surviving world would
+        fall below ``min_world_size`` (the agent refuses and exits — a
+        1-chip remnant grinding a 256-chip job is not survival)."""
+        base = self.current_world if self.current_world is not None \
+            else len(hosts)
+        if not self.shrink_on_peer_loss:
+            return len(hosts)
+        if self.target_world is not None and \
+                len(hosts) != self.target_world and not lost and not regrow:
+            # the host provider re-scoped the cluster: it wins, and the
+            # shrink baseline re-anchors on the new target
+            self.target_world = len(hosts)
+            return len(hosts)
+        world = base
+        if lost:
+            surviving = base - len(lost)
+            # the elastic invariant bounds the shrink too: relaunch at the
+            # LARGEST batch-compatible world <= the surviving capacity
+            # (idle spare chips beat an impossible batch factorization)
+            world = self._compatible_world_at_most(surviving)
+            if world is None or world < self.min_world_size:
+                logger.error(
+                    f"elastic agent: surviving world {surviving} has no "
+                    f"compatible world >= min_world_size="
+                    f"{self.min_world_size}; refusing to shrink further")
+                self._tracer().instant("elastic/shrink_refused",
+                                       cat="elastic", surviving=surviving,
+                                       min_world_size=self.min_world_size)
+                self.shrink_events.append(
+                    {"type": "shrink_refused", "generation":
+                     self.restart_count, "from_world": base,
+                     "to_world": surviving, "at": time.time()})
+                return None
+            plan = self._preflight_world(world)
+            self._tracer().instant(
+                "elastic/shrink_planned", cat="elastic",
+                from_world=base, to_world=world, lost_ranks=list(lost),
+                generation=self.restart_count,
+                preflight_fits=None if plan is None
+                else plan["verdict"]["fits"],
+                escalations=len(plan["escalations"]) if plan else 0)
+            self._write_status(event={
+                "type": "shrink", "generation": self.restart_count,
+                "from_world": base, "to_world": world,
+                "lost_ranks": list(lost), "at": time.time()})
+            self._clean_excluded_heartbeats(world)
+        elif regrow or (self.target_world is not None
+                        and base < self.target_world):
+            back = regrow or len(self._regrow_candidates())
+            if not back:
+                return world
+            # regrow rounds DOWN to a batch-compatible world too — planning
+            # an incompatible one would kill a healthy shrunk job at launch
+            world = self._compatible_world_at_most(
+                min(self.target_world, base + back)) or base
+            if world > base:
+                # capacity is back. Any previously-escalated ladder
+                # overrides stay STICKY: the checkpoints saved since the
+                # shrink record the escalated config in their provenance,
+                # so that is the config the preflight plans from — and the
+                # config the regrown workers must actually launch with for
+                # the verdict to mean anything. Relaxing the ladder after
+                # a regrow is an operator decision (relaunch fresh), not
+                # something the agent guesses at.
+                self._preflight_world(world)
+                self._tracer().instant("elastic/regrow", cat="elastic",
+                                       from_world=base, to_world=world,
+                                       generation=self.restart_count)
+                self._write_status(event={
+                    "type": "regrow", "generation": self.restart_count,
+                    "from_world": base, "to_world": world,
+                    "at": time.time()})
+        return world

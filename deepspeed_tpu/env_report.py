@@ -71,8 +71,66 @@ def debug_report():
     rows.extend(plan_report())
     rows.extend(memory_report())
     rows.extend(serving_report())
+    rows.extend(elastic_report())
     rows.extend(comms_report())
     return rows
+
+
+def elastic_report():
+    """Elastic supervisor status from the agent's ``elastic_status.json``
+    artifact ($DSTPU_ELASTIC_STATUS or ./elastic_status.json): current vs
+    target vs checkpoint world, restart budget consumed, the last
+    generation's exit classification, and the last shrink/regrow event."""
+    import json
+    import os
+    import time
+    try:
+        from deepspeed_tpu.elasticity.agent import (DEFAULT_STATUS_PATH,
+                                                    STATUS_ENV)
+        artifact = os.environ.get(STATUS_ENV) or (
+            DEFAULT_STATUS_PATH if os.path.exists(DEFAULT_STATUS_PATH)
+            else None)
+        hint = ("no artifact (run ElasticAgent with WorkerSpec.status_path "
+                f"or set ${STATUS_ENV})")
+        if not artifact or not os.path.exists(artifact):
+            return [("elastic", hint)]
+        with open(artifact) as f:
+            st = json.load(f)
+        rows = [("elastic world",
+                 f"current {st.get('current_world')} / target "
+                 f"{st.get('target_world')} / checkpoint "
+                 f"{st.get('checkpoint_world') or '?'}")]
+        rows.append(("elastic budget",
+                     f"crashes {st.get('crash_restarts', 0)}/"
+                     f"{st.get('max_restarts', '?')}, total relaunches "
+                     f"{st.get('total_restarts', 0)}/"
+                     f"{st.get('max_total_restarts', '?')}"))
+        last = st.get("last_exit") or {}
+        if last:
+            rows.append(("elastic last exit",
+                         f"{last.get('classification')} (codes "
+                         f"{last.get('codes')}"
+                         + (f", lost ranks {last['lost_ranks']}"
+                            if last.get("lost_ranks") else "") + ")"))
+        ev = st.get("last_event") or {}
+        if ev:
+            ago = ""
+            if ev.get("at"):
+                ago = f", {time.time() - ev['at']:.0f}s ago"
+            rows.append(("elastic last event",
+                         f"{ev.get('type')} world {ev.get('from_world')} -> "
+                         f"{ev.get('to_world')} at gen "
+                         f"{ev.get('generation')}{ago}"))
+        pf = st.get("preflight") or {}
+        if pf:
+            rows.append(("elastic preflight",
+                         f"world {pf.get('world')}: "
+                         f"{'fits' if pf.get('fits') else 'DOES NOT FIT'}"
+                         + (f", ladder: {pf['escalations']}"
+                            if pf.get("escalations") else "")))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("elastic", f"unavailable ({e})")]
 
 
 def memory_report():

@@ -16,6 +16,12 @@ ENV_NUM_PROCESSES = "DSTPU_NUM_PROCESSES"
 ENV_PROCESS_ID = "DSTPU_PROCESS_ID"
 ENV_LOCAL_RANK = "DSTPU_LOCAL_RANK"
 ENV_HOSTNAME = "DSTPU_HOSTNAME"
+# JSON config-override dict the elastic agent exports when a shrink's
+# ledger preflight escalated the offload ladder (fewer chips => more bytes
+# per chip); DeepSpeedTPUConfig deep-merges it over the worker's raw config
+# at parse time, so relaunched workers train at the escalated tier with no
+# config-file edit
+ENV_CONFIG_OVERRIDES = "DSTPU_ELASTIC_CONFIG_OVERRIDES"
 
 DEFAULT_COORDINATOR_PORT = 8476
 

@@ -15,7 +15,9 @@ Faults covered (the failure modes the resilience subsystem exists for):
   - ``comm``  : delay or wedge a guarded collective (``comm/guard.py``
                 deadline + CommWedgeError + coordinated-abort path), or
                 silence a rank's heartbeat (``peer_dead`` — membership
-                marks it lost)
+                marks it lost; the PERMANENT variant survives
+                DSTPU_RESUME relaunches, so the elastic shrink drill is
+                deterministic)
   - ``serve`` : serving-tick faults (``serving/server.py``): stall the
                 serve tick (``DSTPU_CHAOS_SERVE_SLOW_TICK``), steal a
                 fraction of usable KV blocks over a tick window so the
@@ -110,8 +112,16 @@ class ChaosConfig:
     comm_delay_calls: FrozenSet[int] = frozenset()
     comm_delay_prob: float = 0.0
     comm_delay_s: float = 0.0
-    # ranks whose heartbeat is silenced (membership marks them lost)
+    # ranks whose heartbeat is silenced (membership marks them lost).
+    # Default contract matches die_once/comm_wedge_once: a DSTPU_RESUME
+    # relaunch of a silenced rank heartbeats again (the fault was
+    # transient — capacity "came back"). The PERMANENT set survives
+    # relaunches: that rank never heartbeats again in any generation,
+    # which is what makes the elastic shrink drill deterministic (the
+    # agent's same-world retry provably re-faults, so the membership
+    # verdict "lost for good" is forced, never raced)
     peer_dead_ranks: FrozenSet[int] = frozenset()
+    peer_dead_permanent_ranks: FrozenSet[int] = frozenset()
     # serving-tick faults (consumed by serving/server.py). slow_tick
     # stalls the serve tick (every Nth tick, or per-tick probability via
     # the sha roll); kv_pressure steals a fraction of usable KV blocks
@@ -136,6 +146,7 @@ class ChaosConfig:
                     or (self.comm_delay_s > 0
                         and (self.comm_delay_calls or self.comm_delay_prob))
                     or self.peer_dead_ranks
+                    or self.peer_dead_permanent_ranks
                     or (self.serve_slow_tick_s > 0
                         and (self.serve_slow_tick_every
                              or self.serve_slow_tick_prob))
@@ -168,6 +179,8 @@ class ChaosConfig:
             comm_delay_prob=float(g("DSTPU_CHAOS_COMM_DELAY_PROB", "0")),
             comm_delay_s=float(g("DSTPU_CHAOS_COMM_DELAY_S", "0")),
             peer_dead_ranks=_parse_steps(g("DSTPU_CHAOS_PEER_DEAD_RANKS", "")),
+            peer_dead_permanent_ranks=_parse_steps(
+                g("DSTPU_CHAOS_PEER_DEAD_PERMANENT_RANKS", "")),
             **dict(zip(("serve_slow_tick_every", "serve_slow_tick_prob",
                         "serve_slow_tick_s"),
                        _parse_slow_tick(g("DSTPU_CHAOS_SERVE_SLOW_TICK",
@@ -329,8 +342,17 @@ class ChaosMonkey:
     def peer_dead(self, rank: int) -> bool:
         """True when this rank's heartbeat is chaos-silenced (the
         membership view will see its file go stale — a simulated dead
-        peer with no unpublish protocol to cheat through)."""
-        return rank in self.config.peer_dead_ranks
+        peer with no unpublish protocol to cheat through).
+
+        Ranks in ``peer_dead_ranks`` are spared on a DSTPU_RESUME relaunch
+        (the once-contract the die/wedge knobs already follow — the
+        transient-loss drill); ``peer_dead_permanent_ranks`` never come
+        back, across any number of relaunches — the permanent-capacity-loss
+        drill the elastic shrink path is accepted against."""
+        if rank in self.config.peer_dead_permanent_ranks:
+            return True
+        return (rank in self.config.peer_dead_ranks
+                and not os.environ.get("DSTPU_RESUME"))
 
     # ------------------------------------------------------------------
     # device OOM (catchable RESOURCE_EXHAUSTED)

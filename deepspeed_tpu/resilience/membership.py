@@ -194,7 +194,13 @@ class MembershipView:
         return out
 
     def _lost(self, snap: Dict[int, PeerHealth]) -> List[int]:
-        lost = [r for r, h in snap.items() if not h.alive]
+        # with an explicit membership (expected_ranks), only members can be
+        # lost: a stale file from a rank OUTSIDE the set is a corpse from a
+        # previous (pre-shrink) generation, not a dead peer — an elastic
+        # relaunch at world M must not wedge on world-N leftovers. Without
+        # an expected set every published rank counts (ad-hoc membership).
+        lost = [r for r, h in snap.items() if not h.alive
+                and (self.expected_ranks is None or r in self.expected_ranks)]
         if self.expected_ranks is not None and \
                 time.monotonic() - self._created > self.lost_after_s:
             lost.extend(r for r in self.expected_ranks if r not in snap)

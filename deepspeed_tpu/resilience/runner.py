@@ -364,6 +364,14 @@ class FaultTolerantRunner:
             return
         self._judge_stragglers()
         if lost:
+            # elastic/ family instant: the worker-side start of the
+            # loss -> autosave -> shrink -> resume episode (the agent stamps
+            # shrink_planned/regrow; ckpt load stamps reshard) — the whole
+            # sequence reconstructs from one timeline
+            get_tracer().instant("elastic/peer_lost", cat="elastic",
+                                 ranks=list(lost),
+                                 step=self.engine.global_steps,
+                                 lost_after_s=self.membership.lost_after_s)
             raise CommPeerLostError(
                 f"peer rank(s) {lost} lost (heartbeat stale past "
                 f"{self.membership.lost_after_s:.1f}s)", ranks=lost)
@@ -513,24 +521,7 @@ class FaultTolerantRunner:
                 result.stop_reason = self._stop_reason()
                 break
             except CommFaultError as e:
-                # coordinated recovery (the comm guard detected a wedge or
-                # peer loss): the communicator is suspect but this host is
-                # healthy, so drain the async ring WITHOUT letting a guard
-                # verdict mask the primary fault, bundle the evidence,
-                # commit an autosave, and stop with a classified reason —
-                # the worker exits COMM_FAULT_EXIT_CODE and the elastic
-                # agent relaunches it for free (preemption-style accounting)
-                self._comm_fault = e
-                logger.error(f"resilience: comm fault at step "
-                             f"{self.engine.global_steps}: {e}")
-                get_tracer().instant("resilience/comm_fault",
-                                     cat="resilience",
-                                     step=self.engine.global_steps,
-                                     op=e.op, outcome=e.outcome.value)
-                self.write_diagnostic_bundle("comm_fault", error=e)
-                self.flush(raise_guard=False)
-                self.save(reason="comm_fault")
-                result.stop_reason = "comm_fault"
+                self._handle_comm_fault(e, result)
                 break
             except Exception as e:
                 # OOM forensics (dsmem): a RESOURCE_EXHAUSTED means the
@@ -539,13 +530,26 @@ class FaultTolerantRunner:
                 # and re-raise; unlike a preemption there is nothing to
                 # resume into, the config itself must change (the bundle's
                 # ledger says which component to offload/shard)
-                if not is_oom_error(e):
+                if is_oom_error(e):
+                    logger.error(f"resilience: OOM at step "
+                                 f"{self.engine.global_steps}: "
+                                 f"{str(e).splitlines()[0]}")
+                    self.write_diagnostic_bundle("oom", error=e)
                     raise
-                logger.error(f"resilience: OOM at step "
-                             f"{self.engine.global_steps}: "
-                             f"{str(e).splitlines()[0]}")
-                self.write_diagnostic_bundle("oom", error=e)
-                raise
+                # a raw collective failure (the fabric noticed the dead
+                # peer before the membership poll did — gloo/ICI surfaces
+                # connection errors mid-step): consult membership; a
+                # confirmed lost peer reclassifies this as a comm fault so
+                # the worker exits 75 (free relaunch, shrinkable) instead
+                # of charging the crash budget for the platform's fault
+                lost = self._peer_loss_after_error(e)
+                if lost is None:
+                    raise
+                self._handle_comm_fault(CommPeerLostError(
+                    f"peer rank(s) {lost} lost (collective failed with "
+                    f"{type(e).__name__}: {str(e).splitlines()[0][:200]}; "
+                    f"heartbeat confirms)", ranks=lost), result)
+                break
             result.steps_completed += 1
             if "loss" in self._last_host:
                 result.last_loss = float(self._last_host["loss"])
@@ -564,6 +568,57 @@ class FaultTolerantRunner:
         result.preempt_signal = self._preempt_signal
         result.saved_tags = list(self.saved_tags)
         return result
+
+    def _handle_comm_fault(self, e: CommFaultError, result: RunResult):
+        """Coordinated recovery (the comm guard detected a wedge or peer
+        loss): the communicator is suspect but this host is healthy, so
+        drain the async ring WITHOUT letting a guard verdict mask the
+        primary fault, bundle the evidence, commit an autosave where one
+        is still possible, and stop with a classified reason — the worker
+        exits COMM_FAULT_EXIT_CODE and the elastic agent relaunches it
+        for free (preemption-style accounting, shrinkable on permanent
+        loss)."""
+        self._comm_fault = e
+        logger.error(f"resilience: comm fault at step "
+                     f"{self.engine.global_steps}: {e}")
+        get_tracer().instant("resilience/comm_fault", cat="resilience",
+                             step=self.engine.global_steps,
+                             op=e.op, outcome=e.outcome.value)
+        self.write_diagnostic_bundle("comm_fault", error=e)
+        self.flush(raise_guard=False)
+        if isinstance(e, CommPeerLostError) and jax.process_count() > 1:
+            # a multi-process checkpoint save is a collective — it cannot
+            # commit without the dead rank's participation and would wedge
+            # this (healthy) survivor. The last committed periodic
+            # autosave is the resume point; the shrunk relaunch restores
+            # it mesh-portably at the surviving world.
+            logger.warning(
+                "resilience: peer lost at world > 1 — skipping the "
+                "comm-fault autosave (a collective save cannot commit "
+                "without the dead rank); the last committed autosave is "
+                "the resume point")
+        else:
+            self.save(reason="comm_fault")
+        result.stop_reason = "comm_fault"
+
+    def _peer_loss_after_error(self, e: BaseException):
+        """After a raw step/collective failure: is a peer actually gone?
+        Only consulted for comm-shaped (TRANSIENT-classified) errors with
+        real multi-process membership; polls the store up to the staleness
+        horizon (the dead rank's file needs that long to age) and returns
+        the lost ranks, or None (the error was not peer loss — re-raise)."""
+        if self.membership is None or jax.process_count() <= 1:
+            return None
+        from deepspeed_tpu.comm.guard import CommOutcome, classify_exception
+        if classify_exception(e) is CommOutcome.FATAL:
+            return None
+        deadline = time.monotonic() + self.membership.lost_after_s + 1.0
+        while time.monotonic() < deadline:
+            lost = self.membership.lost_peers()
+            if lost:
+                return lost
+            time.sleep(0.1)
+        return None
 
     def _on_watchdog_flag(self, event):
         # only an interrupt-policy flag stops the run; a warn-policy flag

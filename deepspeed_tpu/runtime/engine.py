@@ -1828,14 +1828,24 @@ class DeepSpeedTPUEngine:
                 sampler.phase = prev_phase
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
-                        load_optimizer_states: bool = True):
+                        load_optimizer_states: bool = True,
+                        strict_provenance: bool = True):
         """reference: engine.load_checkpoint:2763 (+_get_all_zero_checkpoints
-        world-size-change handling — free here: the checkpoint is topology-free)."""
+        world-size-change handling — free here: the checkpoint is topology-free).
+
+        Mesh-portable by construction: a checkpoint saved at world N restores
+        onto this engine's mesh at world M (different dp/fsdp factorization,
+        different zero stage/offload tier), re-sharding host-side from the
+        parameter-atomic store. ``ds_meta.json`` provenance is checked first:
+        a different *model* or a changed global batch (the sampler contract)
+        raises ``CheckpointProvenanceError`` — ``strict_provenance=False``
+        downgrades the batch-contract check to a warning."""
         from deepspeed_tpu.checkpoint.engine import load_engine_checkpoint
         with self.tracer.span("ckpt/load", cat="ckpt", tag=tag or "latest"):
             out = load_engine_checkpoint(
                 self, load_dir, tag=tag,
-                load_optimizer_states=load_optimizer_states)
+                load_optimizer_states=load_optimizer_states,
+                strict_provenance=strict_provenance)
         # resync data-efficiency schedules to the restored global step; replay the
         # random-LTD token accounting so consumed_layer_tokens survives resume
         if self.random_ltd_scheduler is not None:

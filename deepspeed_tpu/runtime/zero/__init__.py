@@ -1,0 +1,9 @@
+"""ZeRO stages as SPMD sharding policies (see ``partition.py``)."""
+
+from deepspeed_tpu.runtime.zero.partition import (build_opt_state_shardings,
+                                                  build_param_shardings,
+                                                  zero_fsdp_axes,
+                                                  zero_placement)
+
+__all__ = ["build_opt_state_shardings", "build_param_shardings",
+           "zero_fsdp_axes", "zero_placement"]

@@ -133,6 +133,25 @@ def zero_fsdp_axes(mesh: Mesh, mics: bool = False) -> Tuple[Sequence[str], int]:
     return axes, world
 
 
+def zero_placement(mesh_shape: dict, stage: int,
+                   offload_optimizer: str = "none",
+                   offload_param: str = "none") -> dict:
+    """The ZeRO placement signature derived from mesh + stage (automatic
+    weight-update sharding: placement is a pure function of the mesh and
+    the memory plan, never a hand-set table). Recorded in checkpoint
+    provenance (``ds_meta.json``) and compared on mesh-portable resume so a
+    changed tier/world is an explicit, logged transition — and an
+    *incompatible* one a classified error instead of a shape crash."""
+    sizes = {a: int(mesh_shape.get(a, 1) or 1) for a in FSDP_AXES}
+    return {
+        "stage": int(stage),
+        "zero_world": int(np.prod(list(sizes.values()))),
+        "sharded_axes": [a for a in FSDP_AXES if sizes[a] > 1],
+        "offload_optimizer": str(offload_optimizer),
+        "offload_param": str(offload_param),
+    }
+
+
 def build_param_shardings(params: Any, mesh: Mesh, stage: int,
                           tensor_rules: Optional[Callable] = None,
                           min_shard_size: int = DEFAULT_MIN_SHARD_SIZE,

@@ -768,6 +768,113 @@ def next_offload_tier(ledger: MemoryLedger) -> Optional[Dict[str, Any]]:
     return None
 
 
+def deep_merge(dst: Dict[str, Any], src: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive dict merge (``src`` wins) — the shape config overrides
+    ride in (``next_offload_tier``'s nested ``overrides`` dicts)."""
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def plan_world_config(raw: Dict[str, Any], num_params: int, world_chips: int,
+                      bytes_limit: int, max_rungs: int = 8) -> Dict[str, Any]:
+    """Re-plan a training config for a DIFFERENT chip count — the
+    shrink-aware relauncher's preflight (all stdlib + analytic, no devices
+    touched). Builds the per-chip ledger at ``world_chips``, and while the
+    plan does not fit ``bytes_limit``, escalates the offload ladder
+    (``next_offload_tier``: stage 1 -> 3 -> optimizer offload -> param
+    offload -> nvme) by merging each rung's overrides into a config copy.
+
+    The candidate mesh is the data/fsdp world scaled to ``world_chips``
+    (explicit tensor/expert/sequence/pipe extents in ``raw["mesh"]`` are
+    preserved and divided out of the dp/fsdp span) — placement derives
+    from mesh + memory plan, not a hand-edited table.
+
+    Returns ``{"config", "overrides", "escalations", "verdict", "ledger"}``:
+    ``overrides`` is the single merged dict a relauncher exports to
+    workers; ``verdict`` is the final ``preflight`` result (``fits`` False
+    means even the full ladder cannot fit — the caller's refuse/warn
+    policy decides what happens next)."""
+    import copy
+    cfg = copy.deepcopy(raw or {})
+    model_axes = {a: int((raw.get("mesh", {}) or {}).get(a, 1) or 1)
+                  for a in ("pipe", "tensor", "expert", "sequence")}
+    model_world = 1
+    for v in model_axes.values():
+        model_world *= max(v, 1)
+    zero_world = max(1, int(world_chips) // model_world)
+    effective_chips = zero_world * model_world
+    notes = []
+    if effective_chips != int(world_chips):
+        # a chip count that does not divide the model-parallel extent
+        # cannot build the mesh at all — plan the (conservative: fewer
+        # chips = more bytes/chip) divisible floor, and SAY so rather than
+        # silently pricing a world that will not launch
+        notes.append(
+            f"world_chips {world_chips} not divisible by the model-parallel "
+            f"extent {model_world} ({model_axes}); planned for "
+            f"{effective_chips} chips — launching {world_chips} will fail "
+            f"mesh construction")
+    mesh_shape = dict(model_axes)
+    mesh_shape.update({"data": 1, "fsdp_out": 1, "fsdp": zero_world})
+
+    overrides: Dict[str, Any] = {}
+    escalations = []
+    ledger = MemoryLedger.from_config(cfg, num_params=num_params,
+                                      mesh_shape=mesh_shape)
+    verdict = preflight(ledger, bytes_limit)
+    while bytes_limit and not verdict["fits"] and len(escalations) < max_rungs:
+        rung = verdict.get("suggestion") or next_offload_tier(ledger)
+        if rung is None:
+            break
+        deep_merge(cfg, rung["overrides"])
+        deep_merge(overrides, rung["overrides"])
+        escalations.append(rung["suggestion"])
+        ledger = MemoryLedger.from_config(cfg, num_params=num_params,
+                                          mesh_shape=mesh_shape)
+        verdict = preflight(ledger, bytes_limit)
+    return {"config": cfg, "overrides": overrides,
+            "escalations": escalations, "verdict": verdict,
+            "ledger": ledger.to_dict(), "mesh_shape": mesh_shape,
+            "world_chips": int(world_chips),
+            "world_chips_effective": effective_chips, "notes": notes}
+
+
+def plan_from_provenance(prov: Dict[str, Any], world_workers: int,
+                         default_config: Optional[Dict[str, Any]] = None
+                         ) -> Optional[Dict[str, Any]]:
+    """``plan_world_config`` driven by a checkpoint's ``ds_meta.json``
+    provenance block — the ONE derivation (num_params, recorded HBM limit,
+    chips-per-worker from the saved world) shared by the elastic agent's
+    shrink preflight and ``dstpu_ckpt inspect --compat``, so the CLI's
+    verdict can never diverge from what the agent actually launches.
+    Returns None when the provenance carries no param count to plan from."""
+    num_params = ((prov or {}).get("params") or {}).get("count", 0)
+    if not num_params:
+        return None
+    bytes_limit = (prov.get("ledger") or {}).get("bytes_limit", 0)
+    raw = prov.get("config") or default_config or {}
+    return plan_world_config(
+        raw, num_params=num_params,
+        world_chips=int(world_workers) * provenance_chips_per_worker(prov),
+        bytes_limit=bytes_limit)
+
+
+def provenance_chips_per_worker(prov: Dict[str, Any]) -> int:
+    """Chips one worker of this checkpoint's topology drives. For a
+    multi-process save it is device_count / process_count; for a
+    single-process save (no worker concept) it is 1 — a target ``world``
+    then reads naturally as a CHIP count."""
+    saved = (prov or {}).get("world") or {}
+    pc = max(1, int(saved.get("process_count", 1)))
+    if pc <= 1:
+        return 1
+    return max(1, int(saved.get("device_count", 1)) // pc)
+
+
 def preflight(ledger: MemoryLedger, bytes_limit: int,
               headroom_frac: float = 0.05) -> Dict[str, Any]:
     """Plan vs device limit, before any allocation: ``fits`` is the hard

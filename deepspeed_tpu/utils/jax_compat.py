@@ -16,16 +16,39 @@ def ensure_compat():
         import inspect
 
         from jax.experimental.shard_map import shard_map
-        accepts_vma = "check_vma" in inspect.signature(shard_map).parameters
+        params = inspect.signature(shard_map).parameters
+        accepts_vma = "check_vma" in params
+        accepts_axis_names = "axis_names" in params
+        accepts_auto = "auto" in params
 
         @functools.wraps(shard_map)
         def _shard_map(*args, **kwargs):
             if not accepts_vma and "check_vma" in kwargs:
                 # the kwarg was renamed check_rep -> check_vma upstream
                 kwargs["check_rep"] = kwargs.pop("check_vma")
+            if not accepts_axis_names and "axis_names" in kwargs:
+                # newer jax: axis_names picks the manual subset of mesh
+                # axes. Old shard_map is all-manual; axes left out of the
+                # in/out specs are simply replicated per shard, which is
+                # equivalent for the collectives the body actually names
+                # (translating to the old `auto=` complement instead
+                # aborts XLA compilation on jaxlib 0.4.37 CPU)
+                kwargs.pop("axis_names")
             return shard_map(*args, **kwargs)
 
         jax.shard_map = _shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        # promoted in later releases; older jax exposes the bound size
+        # through the axis env (core.axis_frame(name) IS the size there)
+        def _axis_size(axis_name):
+            import jax.core as core
+            names = axis_name if isinstance(axis_name, (tuple, list)) \
+                else (axis_name,)
+            size = 1
+            for n in names:
+                size *= int(core.axis_frame(n))
+            return size
+        jax.lax.axis_size = _axis_size
     if not hasattr(jax.distributed, "is_initialized"):
         def _is_initialized():
             try:

@@ -1,0 +1,133 @@
+"""Elastic shrink-drill worker: FaultTolerantRunner + heartbeat membership.
+
+Spawned by ``ElasticAgent`` in the shrink acceptance drill
+(test_elastic_shrink.py::test_shrink_drill_end_to_end). Every generation
+trains the SAME deterministic step-keyed global batches under a comm_guard
+membership view; chaos (``DSTPU_CHAOS_PEER_DEAD_PERMANENT_RANKS``) silences
+one rank's heartbeat forever, so the survivors classify it lost, autosave,
+and exit 75 — the agent then shrinks the next generation. Per-step losses
+land in ``losses_gen{G}_rank{R}.jsonl``; the dstrace timeline (with the
+``elastic/`` instants) is dumped per generation/rank.
+
+Env contract: the agent's rendezvous vars plus ``DSTPU_SW_DIR`` (workdir:
+ckpt/ + members/ + loss logs), ``DSTPU_SW_TOTAL_STEPS``,
+``DSTPU_SW_LOST_AFTER_S`` (membership staleness horizon, default 0.6), and
+the generation-0 capacity-loss injection ``DSTPU_SW_KILL_RANK`` /
+``DSTPU_SW_KILL_STEP`` (SIGKILL that rank right after that step's autosave
+commits — permanent: a relaunch of the same rank dies again, forcing the
+shrink instead of a same-world retry loop). A standalone baseline run (no
+agent) passes ``DSTPU_SW_BASELINE=1`` with ``DSTPU_RESUME=latest`` to
+replay the post-shrink trajectory directly.
+"""
+
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# device count must be pinned BEFORE jax import (the agent's env inherits the
+# test harness's 8-device XLA_FLAGS; this worker wants its own small world)
+_n_dev = int(os.environ.get("DSTPU_SW_LOCAL_DEVICES", "1"))
+os.environ["XLA_FLAGS"] = " ".join(
+    [f for f in os.environ.get("XLA_FLAGS", "").split()
+     if "xla_force_host_platform_device_count" not in f]
+    + [f"--xla_force_host_platform_device_count={_n_dev}"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+nproc = int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+rank = int(os.environ.get("DSTPU_PROCESS_ID", "0"))
+if nproc > 1:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+    from deepspeed_tpu.resilience import FaultTolerantRunner, ResilienceConfig
+    from deepspeed_tpu.telemetry import get_tracer
+
+    workdir = os.environ["DSTPU_SW_DIR"]
+    total_steps = int(os.environ["DSTPU_SW_TOTAL_STEPS"])
+    gen = int(os.environ.get("DSTPU_ELASTIC_RESTART", "0"))
+    batch = int(os.environ.get("DSTPU_ELASTIC_BATCH", "8"))
+    lost_after_s = float(os.environ.get("DSTPU_SW_LOST_AFTER_S", "0.6"))
+    baseline = os.environ.get("DSTPU_SW_BASELINE")
+    label = "base" if baseline else f"gen{gen}"
+
+    tracer = get_tracer()
+    tracer.configure(enabled=True)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32),
+        config={"train_batch_size": batch,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "comm_guard": {
+                    "heartbeat_interval_s": 0.05,
+                    "lost_after_s": lost_after_s,
+                    "membership_dir": os.path.join(workdir, "members"),
+                }},
+        example_batch=random_batch(2))
+
+    runner = FaultTolerantRunner(
+        engine, save_dir=os.path.join(workdir, "ckpt"),
+        config=ResilienceConfig(
+            diagnostics_dir=os.path.join(workdir, "diag"),
+            # every-step autosave: at world > 1 a post-peer-loss save is a
+            # collective that can never commit, so the periodic cadence IS
+            # the resume point the shrunk generation restores
+            autosave={"every_steps": 1, "io_backoff_s": 0.01}))
+    runner.maybe_resume()
+    start = engine.global_steps
+
+    local = batch // nproc
+    kill_rank = int(os.environ.get("DSTPU_SW_KILL_RANK", "-1"))
+    kill_step = int(os.environ.get("DSTPU_SW_KILL_STEP", "-1"))
+    log = os.path.join(workdir, f"losses_{label}_rank{rank}.jsonl")
+    logged = set()
+
+    def flush_losses():
+        # incremental: a survivor wedged in a dead-peer collective gets
+        # SIGKILLed by the agent and never returns from run() — every
+        # completed step's loss must already be on disk by then
+        with open(log, "a") as f:
+            for h in runner.history:
+                if h.get("loss") is not None and h["step"] not in logged:
+                    logged.add(h["step"])
+                    f.write(json.dumps({"step": h["step"], "loss": h["loss"],
+                                        "world": nproc}) + "\n")
+
+    def batch_fn(step):
+        flush_losses()
+        # permanent capacity loss: SIGKILL fires at the top of step K+1,
+        # i.e. right after step K's autosave committed — and fires AGAIN
+        # on any same-world relaunch (step >= kill_step after resume), so
+        # only a shrink makes progress
+        if rank == kill_rank and 0 <= kill_step <= step and not baseline:
+            os.kill(os.getpid(), signal.SIGKILL)
+        # deterministic per-step GLOBAL batch sliced to this process's
+        # shard: the assembled batch is identical at every world size, so
+        # loss trajectories are comparable (and, from the same checkpoint,
+        # bit-identical) across generations
+        full = random_batch(batch, seed=step)
+        return {k: v[rank * local:(rank + 1) * local]
+                for k, v in full.items()}
+
+    result = runner.run(num_steps=total_steps - start, batch_fn=batch_fn)
+    runner.close()
+    flush_losses()
+    try:
+        tracer.export_chrome(
+            os.path.join(workdir, f"trace_{label}_rank{rank}.json"))
+    except Exception:
+        pass
+    sys.exit(result.exit_code)
+
+
+if __name__ == "__main__":
+    main()

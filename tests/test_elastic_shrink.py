@@ -1,0 +1,610 @@
+"""Elastic shrink-to-survive: mesh-portable checkpoint resume +
+world-size-aware relaunch.
+
+Covers the PR-12 tentpole end to end:
+
+  - resharding round-trip parity: save@N -> load@M -> save@M -> load@N is
+    bit-identical for params AND optimizer state (the parameter-atomic
+    store is the reshard substrate)
+  - ds_meta.json provenance: recorded on save, rendered by
+    ``dstpu_ckpt inspect``, checked on load — a different model or a
+    broken sampler contract is a CLASSIFIED error, never a shape crash
+  - optimizer state survives offload-ladder tier changes in both
+    directions (optax -> host moments on escalation; host npz -> optax
+    graft on de-escalation)
+  - the rng stream resumes exactly (recorded key, world-independent)
+  - agent shrink accounting: membership-verdict shrink at world-1, budget
+    untouched, min_world floor refusal, regrow when capacity returns,
+    ledger-preflight ladder escalation exported to workers
+  - chaos: the permanent peer-dead variant survives DSTPU_RESUME
+  - the acceptance drill (real subprocesses): permanent kill -> membership
+    lost -> autosave/exit 75 -> shrink relaunch at world-1 -> losses
+    bit-identical to a from-checkpoint baseline at the smaller world, the
+    whole episode reconstructable from elastic/ trace instants
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.engine import CheckpointProvenanceError
+from deepspeed_tpu.checkpoint.universal import compat_check, inspect_checkpoint
+from deepspeed_tpu.comm.mesh import create_mesh
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.elasticity import ElasticAgent, WorkerSpec
+from deepspeed_tpu.models.simple import SimpleModel, random_batch
+from deepspeed_tpu.resilience import ChaosConfig, ChaosMonkey
+from deepspeed_tpu.telemetry import get_tracer
+
+pytestmark = pytest.mark.chaos
+
+CFG = {"train_batch_size": 8,
+       "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+
+
+@pytest.fixture
+def tracing():
+    t = get_tracer()
+    t.clear()
+    t.detach_sink()
+    t.configure(enabled=True)
+    try:
+        yield t
+    finally:
+        t.configure(enabled=False)
+        t.detach_sink()
+        t.clear()
+
+
+def _engine(config=None, mesh=None, seed=1, hidden=64):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden), config=dict(config or CFG),
+        mesh=mesh, example_batch=random_batch(4), seed=seed)
+    return engine
+
+
+def _host_tree(tree):
+    return [np.asarray(jax.device_get(x))
+            for x in jax.tree_util.tree_leaves(tree)]
+
+
+# ---------------------------------------------------------------------------
+# mesh-portable resume
+# ---------------------------------------------------------------------------
+def test_reshard_roundtrip_parity(tmp_path):
+    """save@8 (zero-3, data=2 x fsdp=4) -> load@4 (zero-1, data=2 x fsdp=2)
+    -> save@4 -> load@8: params AND optimizer state bit-identical after the
+    full round trip."""
+    cfg_a = dict(CFG); cfg_a["zero_optimization"] = {"stage": 3}
+    e1 = _engine(cfg_a, create_mesh(MeshConfig(data=2, fsdp=4)), seed=1)
+    for i in range(3):
+        e1.train_batch(batch=random_batch(8, seed=i))
+    d1 = str(tmp_path / "w8")
+    e1.save_checkpoint(d1)
+    want_params = _host_tree(e1.state.params)
+    want_opt = _host_tree(e1.state.opt_state)
+
+    cfg_b = dict(CFG); cfg_b["zero_optimization"] = {"stage": 1}
+    mesh4 = create_mesh(MeshConfig(data=2, fsdp=2), devices=jax.devices()[:4])
+    e2 = _engine(cfg_b, mesh4, seed=77)
+    e2.load_checkpoint(d1)
+    for a, b in zip(want_params, _host_tree(e2.state.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(want_opt, _host_tree(e2.state.opt_state)):
+        np.testing.assert_array_equal(a, b)
+    assert e2.global_steps == 3
+
+    d2 = str(tmp_path / "w4")
+    e2.save_checkpoint(d2)
+    e3 = _engine(cfg_a, create_mesh(MeshConfig(data=2, fsdp=4)), seed=99)
+    e3.load_checkpoint(d2)
+    for a, b in zip(want_params, _host_tree(e3.state.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(want_opt, _host_tree(e3.state.opt_state)):
+        np.testing.assert_array_equal(a, b)
+
+    # training continues bit-identically at the original world
+    l1 = float(e1.train_batch(batch=random_batch(8, seed=50)))
+    l3 = float(e3.train_batch(batch=random_batch(8, seed=50)))
+    assert abs(l1 - l3) < 1e-6
+
+
+def test_rng_stream_restored_on_resume(tmp_path):
+    e1 = _engine(seed=1)
+    e1.train_batch(batch=random_batch(8, seed=0))
+    e1.save_checkpoint(str(tmp_path))
+    want = np.asarray(jax.device_get(e1._rng))
+    e2 = _engine(seed=12345)   # different init seed -> different live key
+    assert not np.array_equal(want, np.asarray(jax.device_get(e2._rng)))
+    e2.load_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(want, np.asarray(jax.device_get(e2._rng)))
+
+
+def test_provenance_recorded_and_inspected(tmp_path):
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    cfg = dict(CFG); cfg["zero_optimization"] = {"stage": 3}
+    e = _engine(cfg, mesh, seed=1)
+    e.train_batch(batch=random_batch(8, seed=0))
+    e.save_checkpoint(str(tmp_path))
+
+    with open(tmp_path / "global_step1" / "ds_meta.json") as f:
+        prov = json.load(f)["provenance"]
+    assert prov["version"] == 1
+    assert prov["world"]["device_count"] == 8
+    assert prov["mesh"]["fsdp"] == 4
+    assert prov["zero"]["stage"] == 3 and prov["zero"]["zero_world"] == 4
+    assert prov["batch"]["train_batch_size"] == 8
+    assert prov["sampler"]["consumed_samples"] == 8
+    assert "train_batch_size invariant" in prov["sampler"]["contract"]
+    assert prov["params"]["count"] == e._param_count()
+    assert prov["rng"]["shape"] and prov["rng"]["data"]
+    assert prov["config"]["zero_optimization"] == {"stage": 3}
+
+    info = inspect_checkpoint(str(tmp_path))
+    summary = info["provenance"]
+    assert summary["saved_world"]["device_count"] == 8
+    assert summary["mesh_axes"] == {"data": 2, "fsdp": 4}
+    assert summary["zero"]["stage"] == 3
+    assert summary["step"] == 1
+    assert summary["sampler"]["consumed_samples"] == 8
+    assert summary["rng_key"]["shape"] == prov["rng"]["shape"]
+
+
+def test_compat_check_reports_feasibility(tmp_path):
+    e = _engine(seed=1)
+    e.train_batch(batch=random_batch(8, seed=0))
+    e.save_checkpoint(str(tmp_path))
+    ok = compat_check(str(tmp_path), world=4)
+    assert ok["feasible"] and ok["checks"]["batch"]["ok"]
+    assert ok["checks"]["ledger"]["ok"]
+    bad = compat_check(str(tmp_path), world=3)   # 8 % 3 != 0
+    assert not bad["feasible"] and not bad["checks"]["batch"]["ok"]
+    # the CLI form: exit 0 feasible / 1 infeasible, with --compat in JSON
+    from deepspeed_tpu.checkpoint.universal import main as ckpt_main
+    assert ckpt_main(["inspect", str(tmp_path), "--compat", "4"]) == 0
+    assert ckpt_main(["inspect", str(tmp_path), "--compat", "3"]) == 1
+
+
+def test_provenance_mismatch_is_classified_error(tmp_path):
+    e = _engine(seed=1, hidden=64)
+    e.train_batch(batch=random_batch(8, seed=0))
+    e.save_checkpoint(str(tmp_path))
+    # different model -> classified, names the differing leaves, never an
+    # orbax shape crash
+    other = _engine(seed=2, hidden=32)
+    with pytest.raises(CheckpointProvenanceError, match="different model"):
+        other.load_checkpoint(str(tmp_path))
+    # changed global batch breaks the sampler contract...
+    cfg = dict(CFG); cfg["train_batch_size"] = 16
+    bigger = _engine(cfg, seed=3)
+    with pytest.raises(CheckpointProvenanceError, match="sampler contract"):
+        bigger.load_checkpoint(str(tmp_path))
+    # ...unless deliberately overridden
+    path, _ = bigger.load_checkpoint(str(tmp_path), strict_provenance=False)
+    assert path is not None and bigger.global_steps == 1
+
+
+def test_offload_escalation_preserves_optimizer_state(tmp_path):
+    """The ladder escalates on shrink (optax -> host-offload): moments are
+    adopted bit-identically; de-escalation (offload ckpt -> optax engine)
+    grafts them back."""
+    e1 = _engine(seed=1, mesh=create_mesh(MeshConfig(data=8)))
+    for i in range(3):
+        e1.train_batch(batch=random_batch(8, seed=i))
+    d1 = str(tmp_path / "optax")
+    e1.save_checkpoint(d1)
+    mu = _host_tree(e1.state.opt_state[0].mu)
+    nu = _host_tree(e1.state.opt_state[0].nu)
+
+    cfg = dict(CFG)
+    cfg["zero_optimization"] = {"stage": 1,
+                                "offload_optimizer": {"device": "cpu"}}
+    mesh4 = create_mesh(MeshConfig(data=4), devices=jax.devices()[:4])
+    e2 = _engine(cfg, mesh4, seed=9)
+    e2.load_checkpoint(d1)
+    got = [e2._offload._materialized_states(l) for l in e2._offload.leaves]
+    for (m, n), wm, wn in zip(got, mu, nu):
+        np.testing.assert_array_equal(m, wm)
+        np.testing.assert_array_equal(n, wn)
+    assert e2._offload.kernel.step_count == 3
+    e2.train_batch(batch=random_batch(8, seed=50))   # trains at the new tier
+
+    d2 = str(tmp_path / "offload")
+    e2.save_checkpoint(d2)
+    e3 = _engine(seed=4, mesh=create_mesh(MeshConfig(data=8)))
+    e3.load_checkpoint(d2)
+    got_mu = _host_tree(e3.state.opt_state[0].mu)
+    want_mu = [e2._offload._materialized_states(l)[0]
+               for l in e2._offload.leaves]
+    for a, b in zip(got_mu, want_mu):
+        np.testing.assert_array_equal(a, b)
+    assert int(jax.device_get(e3.state.opt_state[0].count)) == 4
+    e3.train_batch(batch=random_batch(8, seed=60))
+
+
+# ---------------------------------------------------------------------------
+# chaos: permanent peer death
+# ---------------------------------------------------------------------------
+def test_chaos_peer_dead_permanent_survives_resume(monkeypatch):
+    cfg = ChaosConfig.from_env({"DSTPU_CHAOS_PEER_DEAD_RANKS": "1",
+                                "DSTPU_CHAOS_PEER_DEAD_PERMANENT_RANKS": "2"})
+    assert cfg.active
+    monkey = ChaosMonkey(cfg)
+    monkeypatch.delenv("DSTPU_RESUME", raising=False)
+    assert monkey.peer_dead(1) and monkey.peer_dead(2)
+    assert not monkey.peer_dead(0)
+    # a DSTPU_RESUME relaunch spares the once-set (transient loss drill)
+    # but the permanent set stays dead — the shrink drill's determinism
+    monkeypatch.setenv("DSTPU_RESUME", "latest")
+    assert not monkey.peer_dead(1)
+    assert monkey.peer_dead(2)
+
+
+def test_chaos_permanent_silence_keeps_membership_stale(tmp_path,
+                                                        monkeypatch):
+    from deepspeed_tpu.resilience import Heartbeat, MembershipView
+    monkeypatch.setenv("DSTPU_RESUME", "latest")    # relaunched worker
+    monkey = ChaosMonkey(ChaosConfig(peer_dead_permanent_ranks=frozenset({3})))
+    hb = Heartbeat(3, str(tmp_path), interval_s=0.02, chaos=monkey,
+                   listen_comm_ops=False).start()
+    time.sleep(0.1)
+    hb.stop()
+    view = MembershipView(str(tmp_path), lost_after_s=0.2,
+                          expected_ranks=[3])
+    time.sleep(0.25)
+    assert view.lost_peers() == [3]      # never published, even on resume
+
+
+# ---------------------------------------------------------------------------
+# agent shrink accounting (scripted processes, real membership files)
+# ---------------------------------------------------------------------------
+class _Proc:
+    def __init__(self, codes):
+        self.codes = list(codes)
+        self.last = None
+
+    def poll(self):
+        if self.codes:
+            self.last = self.codes.pop(0)
+        return self.last
+
+    def terminate(self):
+        pass
+
+    def wait(self, timeout=None):
+        return self.last
+
+    def kill(self):
+        pass
+
+
+def _write_peer(members, rank, age=0.0):
+    p = os.path.join(members, f"rank_{rank}.json")
+    with open(p, "w") as f:
+        json.dump({"rank": rank, "pid": 1, "ts": time.time() - age,
+                   "beat": 3}, f)
+    if age:
+        t = time.time() - age
+        os.utime(p, (t, t))
+
+
+def _shrink_cfg(**over):
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 8, "version": 0.1,
+                          "shrink_on_peer_loss": True, "min_world_size": 1,
+                          "rejoin_grace_s": 0.2}}
+    cfg["elasticity"].update(over.pop("elasticity", {}))
+    cfg.update(over)
+    return cfg
+
+
+def _spec(tmp_path, members, **kw):
+    kw.setdefault("max_restarts", 0)
+    kw.setdefault("monitor_interval_s", 0.01)
+    kw.setdefault("term_grace_s", 0.05)
+    kw.setdefault("restart_backoff_s", 0.0)
+    kw.setdefault("membership_dir", str(members))
+    kw.setdefault("lost_after_s", 5.0)
+    kw.setdefault("status_path", str(tmp_path / "elastic_status.json"))
+    return WorkerSpec(cmd=["x"], **kw)
+
+
+def test_agent_shrinks_on_permanent_peer_loss(tmp_path, tracing):
+    members = tmp_path / "members"
+    members.mkdir()
+    launches = []
+
+    def popen(cmd, env=None):
+        launches.append(env)
+        if int(env["DSTPU_ELASTIC_RESTART"]) == 0:
+            # rank 0 survives (exits 75, classified); rank 1 is the dead
+            # chip (SIGKILL-shaped exit + stale heartbeat)
+            _write_peer(str(members), 0, age=0.0)
+            _write_peer(str(members), 1, age=60.0)
+            return _Proc([None, 75]) if env["DSTPU_PROCESS_ID"] == "0" \
+                else _Proc([None, -9])
+        return _Proc([0])
+
+    agent = ElasticAgent(_spec(tmp_path, members), _shrink_cfg(),
+                         host_provider=lambda: ["h0", "h1"], popen=popen)
+    assert agent.run() == 0
+    # shrunk generation: world 1, resume env set, budget untouched
+    assert launches[-1]["DSTPU_NUM_PROCESSES"] == "1"
+    assert launches[-1]["DSTPU_RESUME"] == "latest"
+    assert agent.crash_restarts == 0
+    assert [(e["type"], e["from_world"], e["to_world"])
+            for e in agent.shrink_events] == [("shrink", 2, 1)]
+    # corpse heartbeat cleaned so the shrunk generation can't wedge on it
+    assert not (members / "rank_1.json").exists()
+    # status artifact carries the episode
+    with open(tmp_path / "elastic_status.json") as f:
+        st = json.load(f)
+    assert st["current_world"] == 1 and st["target_world"] == 2
+    assert st["last_event"]["type"] == "shrink"
+    # timeline: peer_lost then shrink_planned, in order
+    names = [e[1] for e in tracing.events_snapshot()]
+    assert "elastic/peer_lost" in names and "elastic/shrink_planned" in names
+    assert names.index("elastic/peer_lost") < \
+        names.index("elastic/shrink_planned")
+
+
+def test_agent_refuses_shrink_below_min_world(tmp_path):
+    members = tmp_path / "members"
+    members.mkdir()
+
+    def popen(cmd, env=None):
+        _write_peer(str(members), 0, age=0.0)
+        _write_peer(str(members), 1, age=60.0)
+        return _Proc([None, 75]) if env["DSTPU_PROCESS_ID"] == "0" \
+            else _Proc([None, -9])
+
+    agent = ElasticAgent(
+        _spec(tmp_path, members),
+        _shrink_cfg(elasticity={"min_world_size": 2}),
+        host_provider=lambda: ["h0", "h1"], popen=popen)
+    rc = agent.run()
+    assert rc == 75                      # classified, not a success
+    assert agent.crash_restarts == 0     # still not charged as a crash
+    assert agent.shrink_events[-1]["type"] == "shrink_refused"
+
+
+def test_agent_regrows_when_capacity_returns(tmp_path):
+    members = tmp_path / "members"
+    members.mkdir()
+    launches = []
+
+    def popen(cmd, env=None):
+        launches.append(env)
+        gen = int(env["DSTPU_ELASTIC_RESTART"])
+        if gen == 0:
+            _write_peer(str(members), 0, age=0.0)
+            _write_peer(str(members), 1, age=60.0)
+            return _Proc([None, 75]) if env["DSTPU_PROCESS_ID"] == "0" \
+                else _Proc([None, -9])
+        if gen == 1:
+            # shrunk world-1 generation runs healthy; meanwhile the lost
+            # rank's heartbeat comes back (node rebooted into the pool)
+            _write_peer(str(members), 0, age=0.0)
+            _write_peer(str(members), 1, age=0.0)
+            return _Proc([None] * 400)
+        return _Proc([0])
+
+    agent = ElasticAgent(_spec(tmp_path, members), _shrink_cfg(),
+                         host_provider=lambda: ["h0", "h1"], popen=popen)
+    assert agent.run() == 0
+    worlds = [env["DSTPU_NUM_PROCESSES"] for env in launches]
+    # gen0: 2 workers; gen1: 1 (shrunk); gen2: 2 again (regrown)
+    assert worlds == ["2", "2", "1", "2", "2"]
+    types = [e["type"] for e in agent.shrink_events]
+    assert types == ["shrink", "regrow"]
+    assert agent.crash_restarts == 0
+
+
+def test_agent_preflight_escalates_ladder_and_exports_overrides(tmp_path):
+    members = tmp_path / "members"
+    members.mkdir()
+    ck = tmp_path / "ckpt"
+    (ck / "tag7").mkdir(parents=True)
+    (ck / "latest").write_text("tag7")
+    # 7B fp32 adam at 16GB chips: world 4 needs the full ladder
+    raw = {"train_batch_size": 64,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+    with open(ck / "tag7" / "ds_meta.json", "w") as f:
+        json.dump({"provenance": {
+            "params": {"count": 7_000_000_000},
+            "ledger": {"bytes_limit": 16 << 30},
+            "world": {"process_count": 8, "device_count": 8},
+            "config": raw}}, f)
+    launches = []
+
+    def popen(cmd, env=None):
+        launches.append(env)
+        if int(env["DSTPU_ELASTIC_RESTART"]) == 0:
+            for r in range(5):
+                _write_peer(str(members), r, age=0.0)
+            for r in range(5, 8):
+                _write_peer(str(members), r, age=60.0)
+            # ranks 0-4 survive and classify (75); 5-7 are the lost chips
+            return _Proc([None, 75]) if int(env["DSTPU_PROCESS_ID"]) < 5 \
+                else _Proc([None, -9])
+        return _Proc([0])
+
+    agent = ElasticAgent(
+        _spec(tmp_path, members, ckpt_dir=str(ck)),
+        _shrink_cfg(), host_provider=lambda: ["h"] * 8, popen=popen)
+    assert agent.run() == 0
+    # 5 chips survive but the elastic batch only factors at 4 — the agent
+    # shrinks to the largest COMPATIBLE world
+    assert launches[-1]["DSTPU_NUM_PROCESSES"] == "4"
+    # preflight recorded the ladder and exported the escalated overrides
+    assert agent.last_preflight["world"] == 4
+    assert agent.last_preflight["escalations"]
+    overrides = json.loads(launches[-1]["DSTPU_ELASTIC_CONFIG_OVERRIDES"])
+    assert overrides["zero_optimization"]
+    with open(tmp_path / "elastic_status.json") as f:
+        assert json.load(f)["preflight"]["escalations"]
+
+
+def test_elastic_overrides_env_merges_into_config(monkeypatch):
+    from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+    monkeypatch.setenv(
+        "DSTPU_ELASTIC_CONFIG_OVERRIDES",
+        json.dumps({"zero_optimization": {
+            "stage": 3, "offload_optimizer": {"device": "cpu"}}}))
+    # the training entry point (initialize) opts in ...
+    cfg = DeepSpeedTPUConfig({"train_batch_size": 8,
+                              "zero_optimization": {"stage": 1}},
+                             dp_world_size=1, apply_elastic_overrides=True)
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    # ... but any OTHER config parsed in the worker process (autotuning
+    # candidates, serving groups) sees exactly what it was given
+    plain = DeepSpeedTPUConfig({"train_batch_size": 8,
+                                "zero_optimization": {"stage": 1}},
+                               dp_world_size=1)
+    assert plain.zero_config.stage == 1
+    assert plain.zero_config.offload_optimizer.device == "none"
+
+
+def test_env_report_elastic_rows(tmp_path, monkeypatch):
+    status = tmp_path / "st.json"
+    with open(status, "w") as f:
+        json.dump({"target_world": 8, "current_world": 7,
+                   "checkpoint_world": 8, "crash_restarts": 1,
+                   "max_restarts": 100, "total_restarts": 3,
+                   "max_total_restarts": 1000,
+                   "last_exit": {"classification": "capacity_loss",
+                                 "codes": [75, -9], "lost_ranks": [5]},
+                   "last_event": {"type": "shrink", "from_world": 8,
+                                  "to_world": 7, "generation": 3,
+                                  "at": time.time()},
+                   "preflight": {"world": 7, "fits": True,
+                                 "escalations": []}}, f)
+    monkeypatch.setenv("DSTPU_ELASTIC_STATUS", str(status))
+    from deepspeed_tpu.env_report import elastic_report
+    rows = dict(elastic_report())
+    assert rows["elastic world"] == "current 7 / target 8 / checkpoint 8"
+    assert "crashes 1/100" in rows["elastic budget"]
+    assert "capacity_loss" in rows["elastic last exit"]
+    assert "lost ranks [5]" in rows["elastic last exit"]
+    assert "shrink world 8 -> 7" in rows["elastic last event"]
+    assert "fits" in rows["elastic preflight"]
+
+
+def test_plan_world_config_ladder_escalation():
+    """Shrink preflight unit: fewer chips escalates the ladder rung by
+    rung; the merged overrides are exactly what workers receive."""
+    from deepspeed_tpu.telemetry.memory import plan_world_config
+    raw = {"train_batch_size": 64,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+    at8 = plan_world_config(raw, num_params=1_000_000_000, world_chips=8,
+                            bytes_limit=16 << 30)
+    at2 = plan_world_config(raw, num_params=7_000_000_000, world_chips=2,
+                            bytes_limit=16 << 30)
+    assert len(at2["escalations"]) > len(at8["escalations"])
+    assert at2["verdict"]["fits"]
+    zo = at2["overrides"]["zero_optimization"]
+    assert zo.get("offload_optimizer", {}).get("device") == "cpu" or \
+        zo.get("stage") == 3
+    # no limit recorded -> plan only, never escalates
+    free = plan_world_config(raw, num_params=7_000_000_000, world_chips=1,
+                             bytes_limit=0)
+    assert free["escalations"] == [] and free["verdict"]["fits"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: real subprocesses, end to end
+# ---------------------------------------------------------------------------
+def test_shrink_drill_end_to_end(tmp_path, tracing):
+    """Chaos kills rank 1 permanently right after step KILL's autosave
+    commits -> membership classifies it lost -> the agent relaunches at
+    world 1 (free, preflight recorded) -> the shrunk run's per-step losses
+    are bit-identical to a from-checkpoint baseline started directly at
+    world 1 -> the episode reconstructs from elastic/ instants."""
+    import subprocess
+    from deepspeed_tpu.testing import free_port
+
+    workdir = str(tmp_path)
+    members = os.path.join(workdir, "members")
+    total, kill_step = 14, 3
+    spec = WorkerSpec(
+        cmd=[sys.executable,
+             os.path.join(os.path.dirname(__file__), "shrink_worker.py")],
+        max_restarts=0,                      # ANY budgeted crash fails it
+        monitor_interval_s=0.3, term_grace_s=5.0,
+        coordinator_port=free_port(),
+        membership_dir=members, lost_after_s=1.0,
+        ckpt_dir=os.path.join(workdir, "ckpt"),
+        status_path=os.path.join(workdir, "elastic_status.json"),
+        env={"DSTPU_SW_DIR": workdir,
+             "DSTPU_SW_TOTAL_STEPS": str(total),
+             "DSTPU_SW_LOST_AFTER_S": "1.0",
+             "DSTPU_SW_KILL_RANK": "1",
+             "DSTPU_SW_KILL_STEP": str(kill_step)})
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 8,
+                          "micro_batch_sizes": [1, 2, 4], "min_gpus": 1,
+                          "max_gpus": 4, "version": 0.1,
+                          "shrink_on_peer_loss": True, "min_world_size": 1,
+                          "rejoin_grace_s": 0.2},
+           "comm_guard": {"lost_after_s": 1.0}}
+    agent = ElasticAgent(spec, cfg,
+                         host_provider=lambda: ["localhost", "localhost"])
+    assert agent.run() == 0
+    assert agent.crash_restarts == 0                 # the loss was free
+    assert [(e["type"], e["from_world"], e["to_world"])
+            for e in agent.shrink_events] == [("shrink", 2, 1)]
+    assert agent.last_preflight is not None          # verdict recorded
+    with open(os.path.join(workdir, "elastic_status.json")) as f:
+        st = json.load(f)
+    assert st["current_world"] == 1 and st["target_world"] == 2
+
+    def read(label, rank=0, root=None):
+        path = os.path.join(root or workdir,
+                            f"losses_{label}_rank{rank}.jsonl")
+        with open(path) as f:
+            return {r["step"]: (r["loss"], r["world"])
+                    for r in map(json.loads, f)}
+
+    g0, g1 = read("gen0"), read("gen1")
+    assert all(w == 2 for _, w in g0.values())
+    assert all(w == 1 for _, w in g1.values())
+    resume_step = min(g1)
+    assert kill_step <= resume_step <= min(g0) + len(g0)  # resumed, not 0
+    assert max(g1) == total - 1                           # finished
+
+    # baseline: fresh world-1 run resumed DIRECTLY from the same tag the
+    # shrunk generation restored (copy the ckpt dir, pin `latest` there)
+    basedir = os.path.join(workdir, "baseline")
+    os.makedirs(os.path.join(basedir, "members"))
+    shutil.copytree(os.path.join(workdir, "ckpt"),
+                    os.path.join(basedir, "ckpt"))
+    with open(os.path.join(basedir, "ckpt", "latest"), "w") as f:
+        f.write(f"global_step{resume_step}")
+    env = dict(os.environ)
+    env.update(spec.env)
+    env.update({"DSTPU_SW_DIR": basedir, "DSTPU_SW_BASELINE": "1",
+                "DSTPU_RESUME": "latest", "DSTPU_NUM_PROCESSES": "1",
+                "DSTPU_PROCESS_ID": "0", "DSTPU_ELASTIC_BATCH": "8"})
+    subprocess.run(spec.cmd, env=env, check=True, timeout=300)
+    base = read("base", root=basedir)
+    assert min(base) == resume_step
+    # bit-identical per-step losses: shrunk resume == direct small-world run
+    for step in sorted(g1):
+        assert base[step][0] == g1[step][0], (step, base[step], g1[step])
+
+    # the episode reconstructs from the elastic/ timeline: the agent's
+    # instants in THIS process, the worker-side reshard in gen1's trace
+    names = [e[1] for e in tracing.events_snapshot()]
+    assert "elastic/peer_lost" in names and "elastic/shrink_planned" in names
+    with open(os.path.join(workdir, "trace_gen1_rank0.json")) as f:
+        worker_events = [ev.get("name") for ev in
+                         json.load(f)["traceEvents"]]
+    assert "elastic/reshard" in worker_events

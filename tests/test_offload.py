@@ -256,7 +256,11 @@ def test_offload_bf16_shadows_on_device(mesh_dp8):
         "zero_optimization": {"stage": 1,
                               "offload_optimizer": {"device": "cpu"}},
     }
-    engine, losses = _train(cfg, steps=3, mesh=mesh_dp8)
+    # 6 steps, not 3: the convergence assertion compares losses on
+    # DIFFERENT batches (seed=i%3), and under bf16 shadows the first
+    # couple of steps are noisy enough on the CPU backend that a 3-step
+    # horizon flips sign; by step 6 the drop is decisive
+    engine, losses = _train(cfg, steps=6, mesh=mesh_dp8)
     for p in jax.tree.leaves(engine.state.params):
         assert p.dtype == jnp.bfloat16
     assert losses[-1] < losses[0]
@@ -317,7 +321,9 @@ def test_nvme_swap_masters_false_keeps_masters_in_ram(tmp_path, mesh_dp8):
             "device": "nvme", "nvme_path": str(tmp_path),
             "swap_masters": False}},
     }
-    e, losses = _train(cfg, steps=3, mesh=mesh_dp8)
+    # 6 steps for the same different-batch-comparison reason as the bf16
+    # shadow test above: 3 steps is not a decisive convergence horizon
+    e, losses = _train(cfg, steps=6, mesh=mesh_dp8)
     assert losses[-1] < losses[0]
     assert all(l.master is not None for l in e._offload.leaves)
     import glob as _glob

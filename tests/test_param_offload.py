@@ -231,8 +231,12 @@ def test_param_offload_tp_sharded_streaming():
         [po._leaf_sharding[i].spec]) or
         any("tensor" in str(e) for e in po._leaf_sharding[i].spec)
         for i in wq), [po._leaf_sharding[i].spec for i in wq]
+    # train on ONE fixed batch: random-token batches carry no shared
+    # signal, so a fresh batch per step leaves the loss hovering near
+    # ln(VOCAB) and the convergence sign flips on short horizons;
+    # memorizing a fixed batch drops decisively within 3 steps
     losses = [float(jax.device_get(engine.train_batch(
-        batch=random_tokens(4, 32, vocab_size=VOCAB, seed=i, gas=1),
+        batch=random_tokens(4, 32, vocab_size=VOCAB, seed=0, gas=1),
         stacked=True))) for i in range(3)]
     assert losses[-1] < losses[0], losses
     # numerically identical to the REPLICATED stream on the same mesh/batch
@@ -242,7 +246,7 @@ def test_param_offload_tp_sharded_streaming():
     assert all(s == e2._param_offload._replicated
                for s in e2._param_offload._leaf_sharding)
     l2 = [float(jax.device_get(e2.train_batch(
-        batch=random_tokens(4, 32, vocab_size=VOCAB, seed=i, gas=1),
+        batch=random_tokens(4, 32, vocab_size=VOCAB, seed=0, gas=1),
         stacked=True))) for i in range(3)]
     np.testing.assert_allclose(losses, l2, rtol=1e-4)
 
