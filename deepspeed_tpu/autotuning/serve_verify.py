@@ -1,0 +1,213 @@
+"""The verify half of the serving proposal loop (``dstpu plan --serve``).
+
+The training planner's closed loop (PR 7: plan -> Autotuner executes ->
+exact span-count verdict) applied to serving: each serve-plan proposal
+carries ONE executable serving-config override and an exact counter
+prediction ``{counter, op, value}`` over bench_serve's deterministic proof
+set (sheds, demotion bytes, prefix evictions, brownout entries, ...).
+``verify_serve_plan`` re-executes the SAME seeded bench_serve preset the
+plan was attributed from — provenance records preset, seed, the full
+scenario and the server-builder args — once per proposal with its override
+applied, and judges the prediction EXACTLY against the re-run's counters
+(no wall-clock, no tolerance: the comparison either holds or it doesn't).
+
+Verdicts — ``verified`` / ``refuted`` / ``unverified`` (the re-run died or
+the counter is missing) — persist under ``plan.serve_verifications`` in
+``autotuning_results.json``, next to the training loop's
+``plan.verifications``, and are written back into the plan artifact when
+one is given so ``env_report`` can tally them.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+RESULTS_NAME = "autotuning_results.json"
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    "<=": lambda observed, value: observed <= value,
+    ">=": lambda observed, value: observed >= value,
+    "<": lambda observed, value: observed < value,
+    ">": lambda observed, value: observed > value,
+    "==": lambda observed, value: observed == value,
+}
+
+
+def _load_plan(plan: Any) -> Tuple[dict, Optional[str]]:
+    """Accept a serve-plan report dict or an artifact path (returns the
+    path too, so verdicts can be written back into the artifact)."""
+    if isinstance(plan, dict):
+        return plan, None
+    if isinstance(plan, str):
+        with open(plan) as f:
+            return json.load(f), plan
+    raise ValueError(f"plan must be a serve-plan report dict or artifact "
+                     f"path, got {type(plan).__name__}")
+
+
+def _lookup_counter(report: dict, name: str) -> Optional[float]:
+    """Find a predicted counter in a bench_serve report: the deterministic
+    proof set first, then the prefix section, then the raw metrics."""
+    for section in ("counters", "prefix", "metrics"):
+        vals = report.get(section) or {}
+        if name in vals:
+            try:
+                return float(vals[name])
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+def verify_serve_plan(plan: Any, results_dir: Optional[str] = None,
+                      requests: Optional[int] = None,
+                      build_server: Optional[Callable] = None,
+                      max_proposals: Optional[int] = None
+                      ) -> List[Dict[str, Any]]:
+    """Re-execute the plan's seeded preset once per executable proposal
+    with the proposed serving override applied; judge each counter
+    prediction exactly. Returns the verdict list (and persists it — see
+    module docstring). ``requests`` overrides the preset's request count
+    (scaled drills make the same predictions: they were computed from the
+    baseline run's own counters). ``build_server`` replaces the tiny-llama
+    builder (tests inject engine doubles). ``max_proposals`` bounds the
+    re-run count (proposals are verified in plan order: dominant signal
+    first)."""
+    from deepspeed_tpu.serving import bench_serve
+    from deepspeed_tpu.telemetry.tracer import get_tracer
+
+    plan, artifact_path = _load_plan(plan)
+    prov = plan.get("provenance") or {}
+    proposals = plan.get("proposals") or []
+    if max_proposals is not None:
+        proposals = proposals[:max_proposals]
+    verifications: List[Dict[str, Any]] = []
+    scenario = None
+    sc_dict = prov.get("scenario")
+    if sc_dict:
+        known = {f.name for f in dataclasses.fields(bench_serve.ServeScenario)}
+        kwargs = {k: (tuple(v) if isinstance(v, list) else v)
+                  for k, v in sc_dict.items() if k in known}
+        scenario = bench_serve.ServeScenario(**kwargs)
+    elif prov.get("preset") in bench_serve.SCENARIOS:
+        scenario = bench_serve.SCENARIOS[prov["preset"]]
+        if prov.get("seed") is not None:
+            scenario = dataclasses.replace(scenario, seed=prov["seed"])
+    if scenario is not None and requests is not None:
+        scenario = dataclasses.replace(scenario, num_requests=requests)
+    builder = dict(prov.get("builder") or {})
+    base_overrides = dict(builder.pop("serving_overrides", {}) or {})
+
+    # run_scenario force-enables the process tracer (its span-derived
+    # latency section needs it) and each verification clears the ring to
+    # judge its own counters — restore the caller's enabled state after,
+    # so a long-lived process doesn't keep paying emit cost forever
+    tracer_was_enabled = get_tracer().enabled
+    try:
+        _verify_all(proposals, scenario, builder, base_overrides,
+                    build_server, requests, verifications)
+    finally:
+        get_tracer().configure(enabled=tracer_was_enabled)
+
+    persist_serve_verifications(results_dir, plan, verifications)
+    if artifact_path is not None:
+        try:   # write the verdicts back into the artifact for env_report
+            plan["verifications"] = verifications
+            with open(artifact_path, "w") as f:
+                json.dump(plan, f, indent=2)
+                f.write("\n")
+        except OSError:
+            logger.exception("serve_verify: cannot update artifact %s",
+                             artifact_path)
+    return verifications
+
+
+def _verify_all(proposals, scenario, builder, base_overrides, build_server,
+                requests, verifications) -> None:
+    from deepspeed_tpu.serving import bench_serve
+    from deepspeed_tpu.telemetry.tracer import get_tracer
+
+    for p in proposals:
+        overrides = (p.get("overrides") or {}).get("serving")
+        pred = dict(p.get("predicted") or {})
+        row: Dict[str, Any] = {"proposal": p.get("id"),
+                               "overrides": p.get("overrides"),
+                               "predicted": pred}
+        if not overrides or scenario is None:
+            row["verdict"] = "unverified"
+            row["detail"] = ("no executable serving override" if not
+                            overrides else "plan has no bench_serve "
+                            "provenance (re-run bench_serve --json to "
+                            "attach the preset/seed)")
+            verifications.append(row)
+            continue
+        merged = {**base_overrides, **overrides}
+        try:
+            factory = build_server or bench_serve.build_tiny_server
+            server = factory(serving_overrides=merged, **builder).start()
+            try:
+                # each verification run judges ITS OWN spans/counters: the
+                # bounded ring must not leak the baseline run's (or the
+                # previous proposal's) request spans into this report
+                get_tracer().clear()
+                rerun = bench_serve.run_scenario(server, scenario)
+            finally:
+                server.stop(drain_timeout=30.0)
+        except Exception as e:
+            logger.exception("serve_verify: re-run for %s failed",
+                             p.get("id"))
+            row["verdict"] = "unverified"
+            row["detail"] = f"re-run failed: {e!r}"
+            verifications.append(row)
+            continue
+        counter = pred.get("counter")
+        op = _OPS.get(pred.get("op", ""))
+        observed = (_lookup_counter(rerun, counter)
+                    if counter is not None else None)
+        if op is None or observed is None:
+            row["verdict"] = "unverified"
+            row["detail"] = (f"counter {counter!r} not in the re-run "
+                             "report" if op is not None else
+                             f"unknown predicate op {pred.get('op')!r}")
+            verifications.append(row)
+            continue
+        value = float(pred.get("value", 0))
+        ok = op(observed, value)
+        row["observed"] = {counter: observed}
+        row["verdict"] = "verified" if ok else "refuted"
+        row["detail"] = (f"{counter} {observed:g} {pred['op']} {value:g} "
+                         f"{'holds' if ok else 'FAILS'} (baseline "
+                         f"{pred.get('baseline')})")
+        if not ok:
+            logger.warning("serve_verify: prediction REFUTED for %s: %s",
+                           p.get("id"), row["detail"])
+        verifications.append(row)
+
+
+def persist_serve_verifications(results_dir: Optional[str], plan: dict,
+                                verifications: List[Dict[str, Any]]) -> None:
+    """Merge the verdicts under ``plan.serve_verifications`` in
+    ``autotuning_results.json`` — never clobbering an existing training
+    tune's experiments/verifications in the same results dir."""
+    if not results_dir:
+        return
+    os.makedirs(results_dir, exist_ok=True)
+    path = os.path.join(results_dir, RESULTS_NAME)
+    data: Dict[str, Any] = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            logger.warning("serve_verify: existing %s unreadable — "
+                           "rewriting", path)
+            data = {}
+    section = data.setdefault("plan", {})
+    section["serve_source"] = plan.get("source")
+    section["serve_verifications"] = verifications
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    logger.info(f"serve plan verdicts written to {path}")

@@ -69,6 +69,7 @@ def debug_report():
     rows.extend(dslint_report())
     rows.extend(trace_report())
     rows.extend(plan_report())
+    rows.extend(serve_plan_report())
     rows.extend(memory_report())
     rows.extend(serving_report())
     rows.extend(elastic_report())
@@ -236,6 +237,68 @@ def plan_report():
         return rows
     except Exception as e:   # the report must never die on tooling drift
         return [("dstpu plan", f"unavailable ({e})")]
+
+
+def serve_plan_report():
+    """Serving-tick planning status: the last ``dstpu plan --serve``
+    artifact (dominant stage + p50 tick ms + proposal count + the
+    proposal->verify verdict tally) and the serve-plan baseline's ratchet
+    size — the serving counterpart of the plan rows."""
+    import json
+    import os
+    rows = []
+    try:
+        from deepspeed_tpu.telemetry.serve_attribution import (
+            DEFAULT_SERVE_PLAN_ARTIFACT, SERVE_PLAN_ARTIFACT_ENV,
+            SERVE_PLAN_BASELINE_NAME, STAGES, find_serve_plan_baseline,
+            load_serve_plan_baseline)
+        artifact = os.environ.get(SERVE_PLAN_ARTIFACT_ENV) or (
+            DEFAULT_SERVE_PLAN_ARTIFACT
+            if os.path.exists(DEFAULT_SERVE_PLAN_ARTIFACT) else None)
+        if artifact and os.path.exists(artifact):
+            with open(artifact) as f:
+                rep = json.load(f)
+            agg = rep.get("aggregate", {})
+            if agg:
+                dominant = max(
+                    (s for s in STAGES if s in agg),
+                    key=lambda s: agg[s].get("share", 0.0))
+                tally = ""
+                verdicts = rep.get("verifications") or []
+                if verdicts:
+                    counts = {}
+                    for v in verdicts:
+                        key = v.get("verdict", "?")
+                        counts[key] = counts.get(key, 0) + 1
+                    tally = (", verdicts "
+                             f"{counts.get('verified', 0)} verified/"
+                             f"{counts.get('refuted', 0)} refuted/"
+                             f"{counts.get('unverified', 0)} unverified")
+                rows.append(("serve plan", f"{artifact} ({dominant} "
+                             f"{agg[dominant]['share'] * 100:.0f}% of tick "
+                             f"time, p50 tick {rep.get('tick_ms_p50')}ms, "
+                             f"{len(rep.get('proposals', []))} proposals"
+                             f"{tally})"))
+            else:
+                rows.append(("serve plan", f"{artifact} (no aggregate)"))
+        else:
+            rows.append(("serve plan",
+                         f"no artifact (bin/dstpu plan --serve report.json "
+                         f"--out {DEFAULT_SERVE_PLAN_ARTIFACT}, or set "
+                         f"${SERVE_PLAN_ARTIFACT_ENV})"))
+        bl = find_serve_plan_baseline(os.path.dirname(
+            os.path.abspath(__file__)))
+        if bl is None:
+            rows.append(("serve plan baseline",
+                         f"not found ({SERVE_PLAN_BASELINE_NAME})"))
+        else:
+            n = len(load_serve_plan_baseline(bl).get("entries", {}))
+            rows.append(("serve plan baseline",
+                         f"{n} stage{'s' if n != 1 else ''} ratcheted "
+                         f"({bl})"))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("serve plan", f"unavailable ({e})")]
 
 
 def serving_report():

@@ -12,6 +12,7 @@ serving runs entirely from compiled programs.
 """
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -37,6 +38,7 @@ from deepspeed_tpu.inference.v2.scheduler import (
     snap_bucket,
 )
 from deepspeed_tpu.models.llama import LlamaConfig
+from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -150,6 +152,8 @@ class InferenceEngineV2:
         self._prefill_total = 0
         self._prefill_saved = 0
         self._prefill_computed = 0
+        # last step's host-timed prefill/decode split (serve-tick clocks)
+        self.last_step_timing = {"prefill_s": 0.0, "decode_s": 0.0}
         # speculative-decoding counters (speculative_stats)
         self._spec_steps = 0
         self._spec_proposed = 0
@@ -281,6 +285,9 @@ class InferenceEngineV2:
         # jitted steps as a (pages, scales) tuple
         cache = self.kv.data if self.kv.scales is None else \
             (self.kv.data, self.kv.scales)
+        tracer = get_tracer()
+        t_prefill = t_decode = 0.0
+        t0 = time.monotonic()
 
         # --- prefill chunks (SplitFuse) ---
         for chunk in plan.prefill_chunks:
@@ -312,8 +319,13 @@ class InferenceEngineV2:
                 tok = int(self._sample_batch(logits[None])[0])
                 seq.generated.append(tok)
                 out[seq.uid] = tok
+        if plan.prefill_chunks:
+            t_prefill = time.monotonic() - t0
+            tracer.complete("serve/step_prefill", t_prefill, cat="serve",
+                            chunks=len(plan.prefill_chunks))
 
         # --- decode batch ---
+        t0 = time.monotonic()
         if plan.decode_seqs:
             seqs = plan.decode_seqs
             b = snap_bucket(len(seqs), self.config.decode_batch_buckets)
@@ -355,11 +367,18 @@ class InferenceEngineV2:
                 if self.config.eos_token_id is not None and \
                         tok == self.config.eos_token_id:
                     seq.done = True
+            t_decode = time.monotonic() - t0
+            tracer.complete("serve/step_decode", t_decode, cat="serve",
+                            batch=len(plan.decode_seqs))
 
         if self.kv.scales is None:
             self.kv.data = cache
         else:
             self.kv.data, self.kv.scales = cache
+        # the serve tick's stage clocks read these (serve/tick_stage_share
+        # gauges + `dstpu plan --serve` prefill/decode attribution)
+        self.last_step_timing = {"prefill_s": t_prefill,
+                                 "decode_s": t_decode}
         return out
 
     def _sample_batch(self, logits) -> np.ndarray:

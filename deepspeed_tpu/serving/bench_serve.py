@@ -36,6 +36,7 @@ asserts the counter invariants.
 
 import dataclasses
 import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -241,11 +242,21 @@ class _Lane:
                 "finish_reason": req.finish_reason}
 
 
-def run_scenario(server: InferenceServer, scenario: ServeScenario) -> dict:
+def run_scenario(server: InferenceServer, scenario: ServeScenario,
+                 provenance: Optional[dict] = None) -> dict:
     """Drive ``server`` (already started) with the scenario; drains it at
     the end and returns the report dict. The process-global tracer is
     enabled for the run if it wasn't (the span-derived latency section
-    depends on it)."""
+    depends on it).
+
+    The report carries a ``provenance`` section — preset name, seed, the
+    full scenario and resolved serving config, and the DSTPU_TRACE dump
+    path — so ``dstpu plan --serve`` can locate the trace, enforce
+    workload-scoped baselines, and the verify runner
+    (``autotuning.serve_verify``) can re-execute the SAME seeded preset
+    with a proposed override applied. Caller-supplied ``provenance`` keys
+    (e.g. an explicit ``trace_path``, the builder args) merge over the
+    auto-filled ones."""
     tracer = get_tracer()
     if not tracer.enabled:
         tracer.configure(enabled=True)
@@ -318,8 +329,28 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario) -> dict:
             snap["bytes_per_resident_token"]
         prefix["host_compression_ratio"] = \
             snap["host_kv_compression_ratio"]
+    # the atexit dump lands relative to THIS process's cwd — record it
+    # absolute, or `dstpu plan --serve` would resolve a relative
+    # DSTPU_TRACE against the report's directory instead
+    env_trace = os.environ.get("DSTPU_TRACE")
+    prov = {
+        "preset": scenario.name,
+        "seed": scenario.seed,
+        "mode": scenario.mode,
+        "num_requests": scenario.num_requests,
+        "scenario": dataclasses.asdict(scenario),
+        "serving_config": dataclasses.asdict(server.config),
+        "trace_path": (os.path.abspath(env_trace) if env_trace else None),
+    }
+    kv_cfg = getattr(getattr(server.engine, "kv", None), "cfg", None)
+    if kv_cfg is not None:
+        prov["kv_num_blocks"] = kv_cfg.num_blocks
+        prov["kv_block_size"] = kv_cfg.block_size
+    if provenance:
+        prov.update(provenance)
     return {
         "scenario": dataclasses.asdict(scenario),
+        "provenance": prov,
         "wall_s": round(wall_s, 3),
         "drained": drained,
         "requests": {"issued": len(results), "states": states,
@@ -430,7 +461,33 @@ def main(argv=None) -> int:
     p.add_argument("--json", default=None,
                    help="write the full report JSON here (stdout always "
                         "gets it too)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="dump the dstrace ring here after the run and "
+                        "record it in the report's provenance (feeds "
+                        "`dstpu plan --serve`)")
+    p.add_argument("--serving-overrides", default=None, metavar="JSON",
+                   help="serving-config override dict applied to the "
+                        "tiny server (e.g. '{\"kv_demote_watermark\": "
+                        "0.5}') — recorded in provenance so plan "
+                        "proposals compose over it")
+    p.add_argument("--verify-plan", default=None, metavar="PLAN",
+                   help="instead of a fresh run: load a `dstpu plan "
+                        "--serve` artifact and re-execute its seeded "
+                        "preset once per proposal with the override "
+                        "applied, judging each counter prediction "
+                        "exactly (verdicts -> autotuning_results.json)")
+    p.add_argument("--results-dir", default=None,
+                   help="with --verify-plan: where "
+                        "autotuning_results.json persists the verdicts")
     args = p.parse_args(argv)
+
+    if args.verify_plan:
+        from deepspeed_tpu.autotuning.serve_verify import verify_serve_plan
+        verifications = verify_serve_plan(
+            args.verify_plan, results_dir=args.results_dir,
+            requests=args.requests)
+        print(json.dumps(verifications, indent=2, default=str))
+        return 0
 
     scenario = SCENARIOS[args.scenario]
     patch = {}
@@ -445,16 +502,24 @@ def main(argv=None) -> int:
     if patch:
         scenario = dataclasses.replace(scenario, **patch)
 
-    server = build_tiny_server(kv_num_blocks=args.kv_num_blocks,
-                               kv_block_size=args.kv_block_size,
-                               kv_offload=not args.no_kv_offload,
-                               prefix_cache=not args.no_prefix_cache,
-                               host_kv_quantize=args.host_kv_quantize
-                               ).start()
+    serving_overrides = (json.loads(args.serving_overrides)
+                         if args.serving_overrides else {})
+    builder = {"kv_num_blocks": args.kv_num_blocks,
+               "kv_block_size": args.kv_block_size,
+               "kv_offload": not args.no_kv_offload,
+               "prefix_cache": not args.no_prefix_cache,
+               "host_kv_quantize": args.host_kv_quantize,
+               "serving_overrides": serving_overrides}
+    server = build_tiny_server(**builder).start()
+    provenance = {"builder": builder}
+    if args.trace:
+        provenance["trace_path"] = os.path.abspath(args.trace)
     try:
-        report = run_scenario(server, scenario)
+        report = run_scenario(server, scenario, provenance=provenance)
     finally:
         server.stop(drain_timeout=30.0)
+    if args.trace:
+        get_tracer().export_chrome(args.trace)
     text = json.dumps(report, indent=2, default=str)
     print(text)
     if args.json:

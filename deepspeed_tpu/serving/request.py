@@ -58,6 +58,10 @@ class Request:
         # engine-step faults attributed to this request (fault isolation:
         # past the retry budget the request is quarantined, not retried)
         self.fault_count = 0
+        # degradation-ladder level the server accepted this request under
+        # (stamped by submit(); rides on the lifecycle retro-spans so the
+        # serve plan reports latency tails per ladder level)
+        self.ladder_level = "healthy"
 
         # lifecycle timestamps (monotonic clock; durations only)
         self.arrival_ts = time.monotonic()
@@ -140,21 +144,23 @@ class Request:
         derivable from the trace alone: TTFT = queued.dur + prefill.dur,
         TPOT = decode.dur / (tokens - 1)."""
         tid = request_tid(self.uid)
+        level = self.ladder_level
         if self.admit_ts is not None:
             tracer.complete("serve/queued", self.admit_ts - self.arrival_ts,
                             cat="serve", end_ts=self.admit_ts, tid=tid,
-                            uid=self.uid)
+                            uid=self.uid, level=level)
             if self.first_token_ts is not None:
                 tracer.complete("serve/prefill",
                                 self.first_token_ts - self.admit_ts,
                                 cat="serve", end_ts=self.first_token_ts,
-                                tid=tid, uid=self.uid,
+                                tid=tid, uid=self.uid, level=level,
                                 prompt_tokens=len(self.prompt_tokens))
         if self.first_token_ts is not None and self.finish_ts is not None:
             tracer.complete("serve/decode",
                             self.finish_ts - self.first_token_ts,
                             cat="serve", end_ts=self.finish_ts, tid=tid,
-                            uid=self.uid, tokens=len(self.tokens))
+                            uid=self.uid, level=level,
+                            tokens=len(self.tokens))
         tracer.instant(f"serve/{self.state.value}", cat="serve", tid=tid,
                        uid=self.uid, reason=self.finish_reason)
 

@@ -64,6 +64,18 @@ from deepspeed_tpu.utils.logging import logger
 #: (module-level so the hot tick never calls float() itself)
 _NO_DEMOTE_LINE = float("inf")
 
+#: the serving-tick stage clocks `dstpu plan --serve` attributes: the
+#: server times admission/demote/promote/drain segments itself, the engine
+#: reports prefill/decode from inside step() (``last_step_timing``), and
+#: the remainder of the tick is residual
+_TICK_STAGES = ("admission", "prefill", "decode", "demote", "promote",
+                "drain")
+
+#: stage -> retro-span name for the server-timed segments (prefill/decode
+#: spans are emitted by the engine inside serve/engine_step)
+_TICK_SPAN_NAMES = {"admission": "serve/admit", "demote": "serve/demote",
+                    "promote": "serve/promote", "drain": "serve/drain"}
+
 
 class BackpressureError(RuntimeError):
     """Admission rejected: queue full, projected KV occupancy over the
@@ -213,6 +225,11 @@ class InferenceServer:
                                 and getattr(engine, "prefix_cache", None)
                                 is not None)
         self._block_bytes_cache: Optional[int] = None
+        # serving-tick stage clocks (serve-loop-private): cumulative busy
+        # seconds per stage + cumulative tick seconds, feeding the
+        # serve/tick_stage_share counter track (/metrics + dstrace)
+        self._tick_stage_cum = {s: 0.0 for s in _TICK_STAGES}
+        self._tick_cum_s = 0.0
         # fault-isolation state (serve-loop-private except the flag)
         self._tick = 0
         self._consecutive_faults = 0
@@ -373,6 +390,10 @@ class InferenceServer:
                       timeout_s=(timeout_s if timeout_s is not None
                                  else cfg.default_timeout_s),
                       priority=priority)
+        # the ladder level this request was accepted under rides on its
+        # lifecycle retro-spans, so `dstpu plan --serve` can report
+        # TTFT/TPOT per ladder level (healthy vs brownout tails)
+        req.ladder_level = level.name.lower()
         if not req.prompt_tokens:
             raise ValueError("empty prompt")
         max_ctx = self.engine.state.max_context_length
@@ -473,36 +494,46 @@ class InferenceServer:
                 self._wake.clear()
 
     def _serve_once(self) -> bool:
+        t_tick0 = time.monotonic()
         self._tick += 1
+        marks: List[tuple] = []     # the tick's stage timeline (see _mark)
         if self.chaos is not None:
             self.chaos.serve_slow_tick(self._tick)
         if self.membership is not None and self._degraded is None:
             if not self._check_membership():
                 return False
+        t0 = time.monotonic()
         self._expire_and_cancel()
+        self._mark(marks, "drain", t0)
         stolen_frac = (self.chaos.serve_kv_pressure(self._tick)
                        if self.chaos is not None else 0.0)
+        moved = 0
         if self._tier_capable:
-            self._rebalance_kv_tiers(stolen_frac)
+            moved += self._rebalance_kv_tiers(stolen_frac, marks)
         elif self._prefix_capable:
             # no offload tier: the cache still honors its soft cap (the
             # demote line doesn't exist, so pass an un-trippable one)
             self._trim_prefix_cache(self.engine.kv_reserved_blocks(),
                                     _NO_DEMOTE_LINE)
-        self._admit_from_queue(stolen_frac)
+        t0 = time.monotonic()
+        moved += self._admit_from_queue(stolen_frac)
+        self._mark(marks, "admission", t0)
         worked = False
         if self.engine.has_work():
             try:
                 if self.chaos is not None:
                     self.chaos.maybe_poison_serve(self._active_uids())
-                with get_tracer().span("serve/engine_step", cat="serve"):
+                with get_tracer().span("serve/engine_step", cat="serve",
+                                       tick=self._tick):
                     out = self.engine.step()
             except Exception as e:
                 raise _EngineStepError(str(e)) from e
             self.metrics.on_step()
             self._note_clean_step()
             worked = True
+            t0 = time.monotonic()
             self._fan_out(out)
+            self._mark(marks, "drain", t0)
         elif self._fault_episode:
             # an idle server is trivially clean: age the fault episode out
             # on empty ticks too, or a drained replica would advertise
@@ -514,7 +545,9 @@ class InferenceServer:
             if idle:
                 self._clean_steps += 1
                 self._maybe_recover()
+        t0 = time.monotonic()
         self._reap()
+        self._mark(marks, "drain", t0)
         with self._lock:
             queued, inflight = len(self._queue), len(self._inflight)
             # the admission model's worst-case projection, re-derived at
@@ -534,7 +567,65 @@ class InferenceServer:
                 self.metrics.export(self.monitor, self.metrics.engine_steps)
             except Exception:
                 logger.exception("serve loop: monitor export failed")
+        if worked or moved:
+            # only ticks that did something land in the ring: an idle
+            # server polling its queue must not flood the bounded trace
+            self._emit_tick_spans(marks, t_tick0, worked, queued, inflight)
         return worked
+
+    def _mark(self, marks: list, stage: str, t0: float, **args) -> None:
+        """Record one tick-timeline segment ``(stage, t0, now, args)`` —
+        pure host bookkeeping; the retro-spans are emitted in one batch by
+        ``_emit_tick_spans`` at tick end (and only for working ticks)."""
+        marks.append((stage, t0, time.monotonic(), args or None))
+
+    def _emit_tick_spans(self, marks: list, t_tick0: float, worked: bool,
+                         queued: int, inflight: int) -> None:
+        """Emit the tick's stage timeline as dstrace retro-spans plus the
+        ``serve/tick`` window span (the unit ``dstpu plan --serve``
+        attributes: the stage ledger provably sums to this window), then
+        fold the durations into the cumulative stage clocks."""
+        stage_s = {s: 0.0 for s in _TICK_STAGES}
+        timing = getattr(self.engine, "last_step_timing", None)
+        if worked and timing:
+            # the engine timed (and trace-spanned) its own step interior
+            stage_s["prefill"] = timing.get("prefill_s", 0.0)
+            stage_s["decode"] = timing.get("decode_s", 0.0)
+        for stage, t0, t1, _args in marks:
+            stage_s[stage] += t1 - t0
+        t_end = time.monotonic()
+        tracer = get_tracer()
+        if tracer.enabled:
+            for stage, t0, t1, args in marks:
+                tracer.complete(_TICK_SPAN_NAMES[stage], t1 - t0,
+                                cat="serve", end_ts=t1, tick=self._tick,
+                                **(args or {}))
+            tracer.complete("serve/tick", t_end - t_tick0, cat="serve",
+                            end_ts=t_end, tick=self._tick, worked=worked,
+                            queued=queued, inflight=inflight)
+        self._tick_stage_gauges(stage_s, t_end - t_tick0, tracer)
+
+    def _tick_stage_gauges(self, stage_s: dict, tick_s: float,
+                           tracer) -> None:
+        """Fold one tick's stage durations into the cumulative clocks and
+        publish the tick-stage share gauges as ONE counter track
+        (``serve/tick_stage_share``) — /metrics exposes it under the
+        single ``dstpu_trace_counter`` TYPE block, Perfetto renders it as
+        a stacked share series alongside the serve spans."""
+        cum = self._tick_stage_cum
+        for stage, dt in stage_s.items():
+            cum[stage] += dt
+        self._tick_cum_s += tick_s
+        total = self._tick_cum_s
+        if not tracer.enabled or total <= 0:
+            return
+        shares = {}
+        attributed = 0.0
+        for stage in _TICK_STAGES:
+            attributed += cum[stage]
+            shares[stage] = round(cum[stage] / total, 4)
+        shares["residual"] = round(max(1.0 - attributed / total, 0.0), 4)
+        tracer.counter("serve/tick_stage_share", cat="serve", **shares)
 
     def _active_uids(self) -> List[int]:
         """Engine-resident uids the next step will actually plan (demoted
@@ -546,11 +637,15 @@ class InferenceServer:
     # ------------------------------------------------------------------
     # host KV offload tier (policy in kv_tier.py; movement in the engine)
     # ------------------------------------------------------------------
-    def _rebalance_kv_tiers(self, stolen_frac: float) -> None:
+    def _rebalance_kv_tiers(self, stolen_frac: float,
+                            marks: Optional[list] = None) -> int:
         """Watermark-driven demotion (LIFO over admit order) and
         promotion-on-schedule (FIFO over demotion order). Bookkeeping is
         pure host arithmetic (DS002-registered); the page copies happen
-        inside the engine demote/promote calls this decides to issue."""
+        inside the engine demote/promote calls this decides to issue —
+        each timed onto the tick timeline (``marks``) so the serve plan
+        can attribute demote/promote churn. Returns pages moved (demotions
+        + promotions)."""
         cfg = self.config
         usable = max(self.engine.kv_usable_blocks(), 1)
         effective = effective_usable_blocks(usable, stolen_frac)
@@ -593,6 +688,7 @@ class InferenceServer:
                               cfg.min_active_requests)
         bb = self._block_bytes()
         demoted_now = 0
+        promoted_now = 0
         executed = set()
         for i in plan:
             victim = active[i]
@@ -600,8 +696,11 @@ class InferenceServer:
                     + self.engine.kv_held_blocks(victim.uid) * bb
                     > cfg.host_kv_budget_bytes):
                 break
+            t0 = time.monotonic()
             freed = self.engine.demote_kv(
                 victim.uid, quantize=cfg.host_kv_quantize)
+            if marks is not None:
+                self._mark(marks, "demote", t0, uid=victim.uid, bytes=freed)
             with self._lock:
                 self._demoted.append(victim.uid)
             executed.add(i)
@@ -635,9 +734,14 @@ class InferenceServer:
                                         self.engine.kv_reserved_blocks(),
                                         demote_wm * effective)
             for r in demoted_reqs[:n_promote]:
+                t0 = time.monotonic()
                 restored = self.engine.promote_kv(r.uid)
                 if restored is None:
                     break
+                if marks is not None:
+                    self._mark(marks, "promote", t0, uid=r.uid,
+                               bytes=restored)
+                promoted_now += 1
                 with self._lock:
                     if r.uid in self._demoted:
                         self._demoted.remove(r.uid)
@@ -654,6 +758,7 @@ class InferenceServer:
                     device_reserved_blocks=self.engine.kv_reserved_blocks(),
                     host_bytes=self.engine.host_kv_bytes(),
                     demoted_requests=len(self._demoted))
+        return demoted_now + promoted_now
 
     # ------------------------------------------------------------------
     # radix prefix cache (trie in inference/v2/prefix_cache.py; policy
@@ -982,14 +1087,15 @@ class InferenceServer:
         self._fail_all(reason)
         return False
 
-    def _admit_from_queue(self, stolen_frac: float = 0.0):
+    def _admit_from_queue(self, stolen_frac: float = 0.0) -> int:
         """FIFO admission while the engine has room for the request's FULL
         worst case (prompt + max_new_tokens) AND the active worst-case sum
         stays under the (possibly pressure-shrunk) capacity line — the
         dynamic form of the no-mid-decode-exhaustion invariant once the
         offload tier lets accepted work exceed device capacity. Brownout
         pauses low-priority admits (they wait in the queue, never silently
-        dropped)."""
+        dropped). Returns the number of requests admitted this tick."""
+        admitted = 0
         brownout = self.ladder.level >= ServeLevel.BROWNOUT
         if self._tier_capable:
             # computed once, incremented per admission (the sum changes by
@@ -1020,13 +1126,13 @@ class InferenceServer:
                     req = cand
                     break
                 if req is None:
-                    return
+                    return admitted
             need_blocks = self._blocks_for(req)
             if self._tier_capable and active_worst + need_blocks > capacity:
-                return
+                return admitted
             need = len(req.prompt_tokens) + req.max_new_tokens
             if not self.engine.can_schedule([req.uid], [need]):
-                return
+                return admitted
             with self._lock:
                 self._queue.remove(req)
                 self._inflight[req.uid] = req
@@ -1048,6 +1154,7 @@ class InferenceServer:
                 # the original queue-wait/TTFT edges
                 req.admit_ts = time.monotonic()
             req.state = RequestState.PREFILL
+            admitted += 1
             if self._tier_capable:
                 active_worst += need_blocks
 

@@ -17,6 +17,7 @@ from deepspeed_tpu.telemetry.tracer import (DEFAULT_CAPACITY,
 __all__ = ["Tracer", "get_tracer", "configure_tracing", "TRACE_ENV",
            "DEFAULT_CAPACITY", "REQUEST_TID_BASE", "request_tid",
            "analyze_path", "attribute", "events_from_tracer", "load_events",
+           "analyze_serve_path", "attribute_serve", "propose_serve",
            "MemoryLedger", "MemorySampler", "is_oom_error",
            "estimate_zero2_model_states_mem_needs",
            "estimate_zero3_model_states_mem_needs"]
@@ -28,6 +29,12 @@ __all__ = ["Tracer", "get_tracer", "configure_tracing", "TRACE_ENV",
 #: only when someone actually asks for the replay API.
 _ATTRIBUTION_EXPORTS = ("analyze_path", "attribute", "events_from_tracer",
                         "load_events")
+
+#: serving-tick replay (``dstpu plan --serve``) — same lazy contract as
+#: attribution: serve_attribution is OFFLINE_ONLY, so the hot-path import
+#: chain must never load it transitively
+_SERVE_PLAN_EXPORTS = ("analyze_serve_path", "attribute_serve",
+                       "propose_serve")
 
 #: dsmem (memory ledger + sampler + OOM classification) — also lazy: the
 #: module is stdlib-only but pulling it into every ``get_tracer`` importer
@@ -41,6 +48,9 @@ def __getattr__(name):
     if name in _ATTRIBUTION_EXPORTS:
         from deepspeed_tpu.telemetry import attribution
         return getattr(attribution, name)
+    if name in _SERVE_PLAN_EXPORTS:
+        from deepspeed_tpu.telemetry import serve_attribution
+        return getattr(serve_attribution, name)
     if name in _MEMORY_EXPORTS:
         from deepspeed_tpu.telemetry import memory
         return getattr(memory, name)

@@ -82,6 +82,61 @@ def step_time_bounds(events: List[dict],
     return lo, hi
 
 
+def filter_request(events: List[dict], uid: int) -> List[dict]:
+    """``--request UID`` — one serving request's story: its queued/
+    prefill/decode retro-spans (the synthetic ``request-UID`` track plus
+    any span/instant carrying its ``uid`` arg) AND every serve-category
+    event intersecting the request's wall-time window, so the serve ticks,
+    demote/promote copies, and ladder edges that shaped its latency ride
+    along. The slice stays plan-loadable Chrome JSON (feeds ``dstpu plan
+    --serve`` / bug reports)."""
+    uid = int(uid)
+    names = track_names(events)
+    req_tids = {tid for tid, label in names.items()
+                if label == f"request-{uid}"}
+    other_req_tids = {tid for tid, label in names.items()
+                      if label.startswith("request-")
+                      and tid not in req_tids}
+
+    def _is_request(e):
+        args = e.get("args") or {}
+        return args.get("uid") == uid or e.get("tid") in req_tids
+
+    req_events = [e for e in events
+                  if e.get("ph") != "M" and _is_request(e)]
+    if not req_events:
+        known = sorted({(e.get("args") or {}).get("uid")
+                        for e in events
+                        if e.get("ph") != "M"
+                        and (e.get("args") or {}).get("uid") is not None})
+        raise ValueError(f"no events for request uid {uid} in trace "
+                         f"(uids present: {known[:20]}"
+                         f"{'...' if len(known) > 20 else ''})")
+    lo = min(float(e.get("ts", 0)) for e in req_events)
+    hi = max(float(e.get("ts", 0)) + float(e.get("dur", 0))
+             for e in req_events)
+    out = []
+    for e in events:
+        if e.get("ph") == "M":
+            out.append(e)
+            continue
+        if _is_request(e):
+            out.append(e)
+            continue
+        name = e.get("name", "")
+        if not (e.get("cat") == "serve" or name.startswith("serve/")):
+            continue
+        # serve-LOOP context only: another request's synthetic track is
+        # that request's story, not this one's — the loop-track ticks and
+        # demote/promote copies (whichever uid they moved) ride along
+        if e.get("tid") in other_req_tids:
+            continue
+        ts = float(e.get("ts", 0))
+        if ts + float(e.get("dur", 0)) >= lo and ts <= hi:
+            out.append(e)
+    return out
+
+
 def filter_step_range(events: List[dict], spec: str) -> List[dict]:
     """``--step-range A:B`` — keep every event intersecting the wall-time
     window those steps occupied (NOT just events carrying a step arg: the
@@ -187,6 +242,10 @@ def main(argv=None) -> int:
     parser.add_argument("--track", default=None, metavar="NAME",
                         help="slice to one Perfetto track by thread label "
                              "(e.g. MainThread, request-7) or raw tid")
+    parser.add_argument("--request", default=None, metavar="UID", type=int,
+                        help="slice to one serving request: its retro-"
+                             "spans plus intersecting serve ticks / "
+                             "demote / promote spans")
     parser.add_argument("--out", default=None, metavar="PATH",
                         help="write the sliced events as Chrome-trace JSON "
                              "(feeds `dstpu plan` / bug reports)")
@@ -199,6 +258,8 @@ def main(argv=None) -> int:
     try:
         if args.step_range:
             events = filter_step_range(events, args.step_range)
+        if args.request is not None:
+            events = filter_request(events, args.request)
         if args.track:
             events = filter_track(events, args.track)
     except ValueError as e:

@@ -370,28 +370,36 @@ class Tracer:
     def counter_series(self, prefix: Optional[str] = None
                        ) -> Dict[str, Dict[str, Dict[str, float]]]:
         """Per-counter per-series aggregate over the ring's "C" events:
-        ``{counter: {series: {"last", "max", "count"}}}`` — the read side
-        of the dsmem HBM/RSS/KV tracks (events are id-ordered, so "last"
-        is the newest sample)."""
-        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        ``{counter: {series: {"last", "max", "p95", "p99", "count"}}}`` —
+        the read side of the dsmem HBM/RSS/KV tracks (events are
+        id-ordered, so "last" is the newest sample; p95/p99 follow the
+        shared exact-quantile rule ``_quantile``, same as the serve-plan
+        replay's standalone copy, so KV/prefix counter tracks report tails
+        rather than just last/max)."""
+        values: Dict[str, Dict[str, List[float]]] = {}
         for e in sorted(self.events_snapshot(), key=lambda e: e[_EID]):
             if e[_PH] != "C" or not e[_ARGS]:
                 continue
             name = e[_NAME]
             if prefix and not name.startswith(prefix):
                 continue
-            bucket = out.setdefault(name, {})
+            bucket = values.setdefault(name, {})
             for series, value in e[_ARGS].items():
                 try:
                     v = float(value)
                 except (TypeError, ValueError):
                     continue
-                s = bucket.setdefault(series,
-                                      {"last": 0.0, "max": 0.0, "count": 0})
-                s["last"] = v
-                if v > s["max"]:
-                    s["max"] = v
-                s["count"] += 1
+                bucket.setdefault(series, []).append(v)
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for name, bucket in values.items():
+            rows = out.setdefault(name, {})
+            for series, vals in bucket.items():
+                last = vals[-1]
+                vals.sort()
+                rows[series] = {"last": last, "max": vals[-1],
+                                "p95": _quantile(vals, 0.95),
+                                "p99": _quantile(vals, 0.99),
+                                "count": len(vals)}
         return out
 
     def prometheus_lines(self, prefix: Optional[str] = None) -> List[str]:
@@ -422,12 +430,11 @@ class Tracer:
             for name in sorted(counters):
                 for series in sorted(counters[name]):
                     s = counters[name][series]
-                    lines.append(f'dstpu_trace_counter{{counter="{name}",'
-                                 f'series="{series}",stat="last"}} '
-                                 f'{s["last"]:.9g}')
-                    lines.append(f'dstpu_trace_counter{{counter="{name}",'
-                                 f'series="{series}",stat="max"}} '
-                                 f'{s["max"]:.9g}')
+                    for stat in ("last", "max", "p95", "p99"):
+                        lines.append(
+                            f'dstpu_trace_counter{{counter="{name}",'
+                            f'series="{series}",stat="{stat}"}} '
+                            f'{s[stat]:.9g}')
         return lines
 
 

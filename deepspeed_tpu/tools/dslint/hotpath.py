@@ -105,7 +105,13 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
                        "_observe_ladder", "_reconcile_kv",
                        "_active_worstcase", "_active_uids",
                        "_note_clean_step", "_trim_prefix_cache",
-                       "_prefix_gauges", "_cache_evictable_blocks"),
+                       "_prefix_gauges", "_cache_evictable_blocks",
+                       # the serve-plan tick clocks: per-tick stage marks,
+                       # the batched retro-span emission, and the
+                       # tick-stage share gauges all run every working
+                       # tick — registering them PROVES the serving-tick
+                       # attribution substrate never host-syncs the tick
+                       "_mark", "_emit_tick_spans", "_tick_stage_gauges"),
         forbidden=ENGINE_FORBIDDEN,
     ),
     # the degradation ladder's per-tick observation + edge transition:
@@ -210,4 +216,7 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
 #: analyzer has no business touching the device runtime at all).
 OFFLINE_ONLY_MODULES: Tuple[str, ...] = (
     "deepspeed_tpu/telemetry/attribution.py",
+    # the serving-tick replay (`dstpu plan --serve`) — same contract:
+    # stdlib-only, file-loadable on jax-less hosts, never on a hot path
+    "deepspeed_tpu/telemetry/serve_attribution.py",
 )

@@ -1,0 +1,106 @@
+"""Regenerate the checked-in serve-plan fixtures for tests/test_serve_plan.py.
+
+Run from the repo root (CPU is fine — the fixtures are frozen so the
+golden attribution assertions stay deterministic across hosts):
+
+    JAX_PLATFORMS=cpu python tests/serve_plan_fixtures/make_fixtures.py
+
+One pinned artifact set, regenerated together (the golden test pins their
+agreement):
+
+  micro_serve_trace.json    dstrace dump of a small seeded siege run on
+                            the tiny CPU llama: kv offload with a LOW
+                            demote watermark (demote churn shows at micro
+                            request counts), prefix cache with a small
+                            soft cap (eviction pressure shows), open-loop
+                            arrivals (backpressure shows) — so the tick
+                            ledger carries every stage
+  micro_serve_report.json   the bench_serve report for the same run, with
+                            provenance (preset/seed/scenario/serving
+                            config/builder + relative trace_path) — the
+                            preferred `dstpu plan --serve` input
+  ../../serve_plan_baseline.json   the regression ratchet anchored to the
+                            trace's attribution (workload-scoped by trace
+                            basename, dslint/plan idiom)
+
+The run is warmed once untraced first so XLA compiles don't dominate the
+frozen tick quantiles. Regression-variant traces for the exit-code matrix
+are derived in-test (demote spans grown into their windows) — never
+checked in.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: the fixture workload: a scaled seeded overload mix (open-loop arrivals,
+#: shared prefixes, low-priority lanes) small enough to trace in seconds
+BUILDER = {"kv_num_blocks": 48, "kv_block_size": 16, "kv_offload": True,
+           "prefix_cache": True, "host_kv_quantize": "int8",
+           "serving_overrides": {"kv_demote_watermark": 0.45,
+                                 "kv_demote_watermark_brownout": 0.3,
+                                 "prefix_cache_max_blocks": 6,
+                                 "max_queue_depth": 16}}
+
+
+def _scenario():
+    from deepspeed_tpu.serving.bench_serve import SCENARIOS
+    return dataclasses.replace(SCENARIOS["overload"], num_requests=24)
+
+
+def main():
+    from deepspeed_tpu.serving.bench_serve import (build_tiny_server,
+                                                   run_scenario)
+    from deepspeed_tpu.telemetry import get_tracer
+
+    tracer = get_tracer()
+    scenario = _scenario()
+
+    # --- warmup (compile the siege shapes outside the trace) ---------------
+    server = build_tiny_server(**BUILDER).start()
+    try:
+        run_scenario(server, dataclasses.replace(scenario, num_requests=6))
+    finally:
+        server.stop(drain_timeout=30.0)
+    tracer.clear()
+
+    # --- the traced fixture run --------------------------------------------
+    tracer.configure(enabled=True)
+    server = build_tiny_server(**BUILDER).start()
+    try:
+        report = run_scenario(server, scenario, provenance={
+            "builder": BUILDER, "trace_path": "micro_serve_trace.json"})
+    finally:
+        server.stop(drain_timeout=30.0)
+    tracer.configure(enabled=False)
+
+    trace_path = os.path.join(HERE, "micro_serve_trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(tracer.to_chrome(), f, default=str)
+    print(f"wrote {trace_path} ({len(tracer.events_snapshot())} events)")
+    tracer.clear()
+
+    report_path = os.path.join(HERE, "micro_serve_report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+        f.write("\n")
+    print(f"wrote {report_path}")
+
+    # --- regression baseline (ratchet anchor, one artifact set) ------------
+    from deepspeed_tpu.telemetry import serve_attribution
+    rep = serve_attribution.analyze_serve_path(report_path)
+    bl = os.path.join(REPO, serve_attribution.SERVE_PLAN_BASELINE_NAME)
+    serve_attribution.write_serve_plan_baseline(bl, rep)
+    print(f"wrote {bl}")
+    print(f"ticks={rep['ticks_total']} proposals="
+          f"{[p['id'] for p in rep['proposals']]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -175,9 +175,9 @@ def test_counter_roundtrip_chrome_and_aggregates():
     assert tr.summary() == {}
     agg = tr.counter_series()
     assert agg["mem/hbm_bytes_in_use"]["TPU_0"] == {
-        "last": 300.0, "max": 300.0, "count": 2}
+        "last": 300.0, "max": 300.0, "p95": 300.0, "p99": 300.0, "count": 2}
     assert agg["mem/hbm_bytes_in_use"]["TPU_1"] == {
-        "last": 50.0, "max": 150.0, "count": 2}
+        "last": 50.0, "max": 150.0, "p95": 150.0, "p99": 150.0, "count": 2}
     lines = tr.prometheus_lines(prefix="mem/")
     assert any('counter="mem/hbm_bytes_in_use",series="TPU_0",stat="max"'
                in ln and ln.endswith(" 300") for ln in lines)

@@ -349,6 +349,27 @@ def test_prometheus_prefix_rows_one_type_block_each():
     snap = m.snapshot()
     assert snap["bytes_per_resident_token"] == 10.0
     assert snap["host_kv_compression_ratio"] == 2.0
+    # the serve-tick stage-share gauges ride the SAME single
+    # dstpu_trace_counter TYPE block as every other counter family (a
+    # second metadata block would fail the whole scrape)
+    from deepspeed_tpu.telemetry import get_tracer
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.configure(enabled=True)
+    try:
+        tracer.counter("serve/tick_stage_share", cat="serve",
+                       admission=0.01, prefill=0.4, decode=0.3,
+                       demote=0.05, promote=0.02, drain=0.02,
+                       residual=0.2)
+        tracer.counter("serve/kv_bytes", cat="mem",
+                       projected=1024, observed=512)
+        text = m.prometheus_text()
+        assert text.count("# TYPE dstpu_trace_counter gauge\n") == 1
+        assert 'counter="serve/tick_stage_share",series="decode"' in text
+        assert 'stat="p99"' in text        # counter tracks report tails
+    finally:
+        tracer.configure(enabled=was_enabled)
+        tracer.clear()
 
 
 def test_env_report_serving_rows(tmp_path, monkeypatch):
