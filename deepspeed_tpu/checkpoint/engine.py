@@ -566,6 +566,114 @@ def _inject_moments_into_optax(opt_state, params_treedef, states,
     return out if hit["n"] else None
 
 
+def _adopt_error_feedback(opt_state, fallback_tree):
+    """Mesh-portable comm_compression residual adoption (cross-topology
+    resume): mine the checkpoint's ``error_feedback`` subtree out of the
+    metadata-shaped fallback restore (orbax renders the
+    ``CommCompressState`` NamedTuple as a dict) and fit each bucket's
+    residual to the live engine's layout — bit-exact when the replica
+    world matches, mean-preserving worker reshard
+    (``compress.reshard_error_feedback``) when it changed. The bucket
+    MEMBERSHIP is a pure function of model + config, while the payload
+    padding moves with the world — a width mismatch is fitted losslessly
+    (the pad tail carries exactly-zero residual); only a structurally
+    unrecognizable tree (bucket count / rank mismatch — a different model
+    or config) leaves the fresh zero residuals in place, logged by the
+    caller — never a crash. Returns the updated opt_state, or None when
+    there is nothing to adopt."""
+    try:
+        from deepspeed_tpu.comm.compress import (CommCompressState, TensorEF,
+                                                 reshard_error_feedback)
+    except Exception:           # jax-less / partial install: nothing to do
+        return None
+    if not isinstance(opt_state, CommCompressState) \
+            or not opt_state.error_feedback:
+        return None
+    found: list = []
+    _extract_named_subtrees(fallback_tree, "error_feedback", found)
+    for cand in found:
+        buckets = list(cand) if isinstance(cand, (list, tuple)) else None
+        if buckets is None or len(buckets) != len(opt_state.error_feedback):
+            continue
+        new_ef = []
+        for saved, cur in zip(buckets, opt_state.error_feedback):
+            if isinstance(saved, dict):
+                worker, server = saved.get("worker"), saved.get("server")
+            else:
+                worker = getattr(saved, "worker", None)
+                server = getattr(saved, "server", None)
+            w_cur, n_pad = (int(cur.worker.shape[0]),
+                            int(cur.worker.shape[1]))
+            if worker is None or np.ndim(worker) != 2:
+                new_ef = None   # different bucket plan: keep fresh zeros
+                break
+            # stay on HOST (the moment-mining idiom): the caller's single
+            # sharded device_put distributes the result — materializing
+            # [W, n_pad] fp32 per bucket on one device first would spike
+            # HBM by the full replica-world multiple during load
+            worker = np.asarray(jax.device_get(worker), np.float32)
+            if worker.shape[1] != n_pad:
+                # n_pad is padded to world*chunk, so a world change can
+                # move it even for the SAME bucket (same leaves, same n).
+                # The payload occupies [:n] in both layouts and the pad
+                # tail carries an exactly-zero residual (quantizing zeros
+                # is exact), so pad/truncate is lossless
+                fit = np.zeros((worker.shape[0], n_pad), np.float32)
+                m = min(int(worker.shape[1]), n_pad)
+                fit[:, :m] = worker[:, :m]
+                worker = fit
+            if int(worker.shape[0]) == w_cur and server is not None \
+                    and tuple(np.shape(server)) == tuple(cur.server.shape):
+                # same replica world: residuals restore bit-identically
+                new_ef.append(TensorEF(
+                    worker=worker,
+                    server=np.asarray(jax.device_get(server), np.float32)))
+            else:
+                # changed world: THE shared mean-preserving rule, on host
+                # (xp=np) so nothing materializes on one device
+                new_ef.append(reshard_error_feedback(
+                    TensorEF(worker=worker, server=None), w_cur, xp=np))
+        if new_ef is not None:
+            return opt_state._replace(error_feedback=tuple(new_ef))
+    return None
+
+
+def _respread_error_feedback(engine, opt_state, provenance):
+    """comm_compression residuals across a replica-world change on the
+    DIRECT restore path: orbax fits the checkpoint's [W_old, n_pad] state
+    to the new leading dim by row-prefix (zero-pad on grow, truncate on
+    shrink — verified behavior), which under-weights the surviving
+    residual mass. Re-spread the surviving rows' mean to every new
+    participant — the mean over the new group equals the mean over the
+    survivors, i.e. the correction mass the next reduction repays — and
+    restart the server residuals at zero (their chunking changed with the
+    world). The saved replica world comes from checkpoint provenance;
+    returns the fixed opt_state or None when nothing needs doing."""
+    try:
+        from deepspeed_tpu.comm.compress import (CommCompressState,
+                                                 reshard_error_feedback)
+    except Exception:
+        return None
+    comp = getattr(engine, "_comm_compress", None)
+    if comp is None or not isinstance(opt_state, CommCompressState) \
+            or not opt_state.error_feedback:
+        return None
+    saved_mesh = (provenance or {}).get("mesh") or {}
+    if not saved_mesh:
+        return None
+    w_old = 1
+    for ax in comp.axes:
+        w_old *= int(saved_mesh.get(ax, 1) or 1)
+    w_cur = comp.world
+    if w_old == w_cur:
+        return None                # same replica world: rows are exact
+    surviving = max(min(w_old, w_cur), 1)
+    new_ef = tuple(
+        reshard_error_feedback(ef, w_cur, surviving=surviving)
+        for ef in opt_state.error_feedback)
+    return opt_state._replace(error_feedback=new_ef)
+
+
 def _offload_sidecar_path(path: str) -> Optional[str]:
     """This process's offload moment sidecar, falling back to proc0's when
     the checkpoint was saved at a SMALLER world (grown-world resume: a rank
@@ -755,6 +863,7 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ckptr = ocp.StandardCheckpointer()
     adopted_opt = None       # cross-tier optax state, mined for moments below
     opt_fallback = False     # opt_state came from the metadata fallback
+    fallback_opt_tree = None  # the checkpoint's own-shaped opt tree (host)
     try:
         try:
             restored = ckptr.restore(path, target)
@@ -779,6 +888,9 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
                 opt_meta)
             restored = ckptr.restore(path, target)
+            # keep the checkpoint-shaped host tree: the moment mining below
+            # AND the comm_compression error-feedback adoption read it
+            fallback_opt_tree = restored["opt_state"]
             if load_optimizer_states and offload is not None:
                 # tier escalation (optax -> host offload): the checkpoint's
                 # optax moments become the host kernel's moment buffers
@@ -898,10 +1010,39 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                          "this engine's optimizer structure; optimizer "
                          "state starts fresh", ranks=[0])
 
+    final_opt = restored["opt_state"] if load_optimizer_states \
+        else state.opt_state
+    if load_optimizer_states:
+        # comm_compression residuals across a topology change must survive
+        # the elastic reshard instead of silently resetting / zero-padding
+        adopted_ef = None
+        if fallback_opt_tree is not None:
+            # structure changed (cross-tier / toggled group): mine the
+            # error_feedback subtree out of the checkpoint-shaped tree
+            adopted_ef = _adopt_error_feedback(final_opt, fallback_opt_tree)
+        else:
+            # direct restore succeeded: orbax fits the [W, n_pad] state to
+            # a changed replica world by row-prefix (zero-pad on grow,
+            # truncate on shrink) — re-spread the surviving rows' mean
+            adopted_ef = _respread_error_feedback(engine, final_opt,
+                                                  provenance)
+        if adopted_ef is not None:
+            final_opt = jax.device_put(adopted_ef,
+                                       engine.opt_state_shardings)
+            log_dist("comm_compression: error-feedback residuals adopted "
+                     "from the checkpoint (resharded to the current "
+                     "replica world)", ranks=[0])
+        elif fallback_opt_tree is not None \
+                and getattr(engine, "_comm_compress", None) is not None:
+            # never silent: the fallback restore ran but the checkpoint's
+            # EF subtree was absent or its bucket plan unrecognizable
+            log_dist("comm_compression: checkpoint carries no adoptable "
+                     "error-feedback residuals; starting fresh (moments "
+                     "unaffected)", ranks=[0])
     engine.state = EngineState(
         step=sc["step"],
         params=restored_params,
-        opt_state=restored["opt_state"] if load_optimizer_states else state.opt_state,
+        opt_state=final_opt,
         loss_scale=LossScaleState(sc["loss_scale"], sc["good_steps"], sc["hysteresis"]),
         skipped_steps=sc["skipped_steps"],
     )

@@ -48,9 +48,15 @@ def _axis_size(axis_name) -> int:
     return jax.lax.axis_size(axis_name)
 
 
-def _record(op_name: str, x, axis_name, world: Optional[int] = None):
+def _record(op_name: str, x, axis_name, world: Optional[int] = None,
+            nbytes: Optional[int] = None, wire_bytes: Optional[int] = None,
+            kind: Optional[str] = None):
     # membership feed: the active heartbeat carries "last-completed comm op"
-    # per worker (one attribute read when no heartbeat is running)
+    # per worker (one attribute read when no heartbeat is running).
+    # ``nbytes`` overrides the logical payload (default: x's bytes);
+    # ``wire_bytes`` is what actually rides the wire (default: == nbytes —
+    # uncompressed ops are their own wire format); ``kind`` is the canonical
+    # op kind for exact busbw classification (comms_logging.OP_KINDS).
     note_comm_op(op_name)
     logger_ = get_comms_logger()
     tracer = get_tracer()
@@ -60,13 +66,16 @@ def _record(op_name: str, x, axis_name, world: Optional[int] = None):
         world = world or _axis_size(axis_name)
     except Exception:
         world = world or 1
-    nbytes = _nbytes(x)
+    if nbytes is None:
+        nbytes = _nbytes(x)
     if logger_.enabled:
-        logger_.record_traced(op_name, nbytes, world)   # also traces
+        logger_.record_traced(op_name, nbytes, world,
+                              wire_bytes=wire_bytes, kind=kind)  # also traces
     else:
         # tracing without the comms logger: emit the trace-time instant
         # through the shared helper, skip the volume-accounting tables
-        emit_comm_instant(op_name, nbytes, world)
+        emit_comm_instant(op_name, nbytes, world, wire_bytes=wire_bytes,
+                          kind=kind)
 
 
 # --- trace-safe collectives (usable under jit/shard_map with named axes) ----
@@ -124,6 +133,68 @@ def broadcast_one_to_all(x, axis_name, root: int = 0):
 def barrier(axis_name):
     """reference: dist.barrier. Under SPMD a psum of a scalar is a full barrier."""
     return jax.lax.psum(jnp.ones(()), axis_name)
+
+
+# --- quantized collectives (comm/compress.py math, facade-recorded) --------
+
+def _axes_tuple(axis_name) -> tuple:
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def quantized_all_reduce(x, axis_name, op: ReduceOp = ReduceOp.AVG,
+                         wire_dtype: str = "int8", chunk: Optional[int] = None,
+                         error=None):
+    """EQuARX-style all-reduce with int8/fp8 codes + per-chunk fp32 scales
+    on the wire (comm/compress.py — reduce-scatter, server re-quantize,
+    regather). ``axis_name`` may be one mesh axis or a tuple; call inside
+    shard_map manual over those axes. ``x`` is flat [n]; ``error`` an
+    optional ``compress.TensorEF`` (worker [n_pad], server [n_pad/W]) —
+    the error-feedback residuals this call compensates with and refreshes.
+
+    Returns ``(out [n_pad], new_error)`` (slice to n if exact shape
+    matters; ``new_error`` is None when ``error`` is). Recorded through
+    ``_record`` with BOTH ``bytes`` (logical payload) and ``wire_bytes``
+    so commguard, the heartbeat, and dstrace see the compressed op."""
+    from deepspeed_tpu.comm import compress
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise NotImplementedError(f"quantized reduce op {op}")
+    axes = _axes_tuple(axis_name)
+    chunk = compress.DEFAULT_CHUNK if chunk is None else chunk
+    world = compress.axis_world(axes)
+    _record("quantized_all_reduce", x, axes, world=world,
+            wire_bytes=compress.all_reduce_wire_bytes(
+                int(jnp.size(x)), world, wire_dtype, chunk),
+            kind="all_reduce")
+    out, w_err, s_err = compress.all_reduce_impl(
+        x, axes, wire_dtype, chunk,
+        worker_error=None if error is None else error.worker,
+        server_error=None if error is None else error.server,
+        mean=(op == ReduceOp.AVG))
+    new_error = None if error is None else compress.TensorEF(
+        worker=w_err, server=s_err)
+    return out, new_error
+
+
+def quantized_reduce_scatter(x, axis_name, op: ReduceOp = ReduceOp.AVG,
+                             wire_dtype: str = "int8",
+                             chunk: Optional[int] = None, error=None):
+    """Quantized reduce-scatter (the first phase of the all-reduce): flat
+    [n] in, this participant's reduced shard [n_pad / W] out. ``error`` is
+    the worker residual [n_pad] (or None). Returns ``(shard, new_error)``.
+    Facade-recorded with logical + wire bytes like every collective."""
+    from deepspeed_tpu.comm import compress
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise NotImplementedError(f"quantized reduce op {op}")
+    axes = _axes_tuple(axis_name)
+    chunk = compress.DEFAULT_CHUNK if chunk is None else chunk
+    world = compress.axis_world(axes)
+    _record("quantized_reduce_scatter", x, axes, world=world,
+            wire_bytes=compress.reduce_scatter_wire_bytes(
+                int(jnp.size(x)), world, wire_dtype, chunk),
+            kind="reduce_scatter")
+    return compress.reduce_scatter_impl(
+        x, axes, wire_dtype, chunk, worker_error=error,
+        mean=(op == ReduceOp.AVG))
 
 
 # --- eager (outside-jit) helpers -------------------------------------------

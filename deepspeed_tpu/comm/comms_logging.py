@@ -19,11 +19,51 @@ from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 
-def calc_bw(op_name: str, size_bytes: int, duration_s: float, world: int):
+#: canonical op-name -> op-kind registry. Classification is an EXACT lookup
+#: on this table (plus the explicit ``kind`` the facade passes for new ops),
+#: never a substring match — "quantized_all_reduce" must take the allreduce
+#: busbw factor because the table says so, and an op whose NAME merely
+#: contains "all_reduce" must not silently inherit the 2(n-1)/n factor.
+OP_KINDS = {
+    "all_reduce": "all_reduce",
+    "quantized_all_reduce": "all_reduce",
+    "all_gather": "all_gather",
+    "sparse_all_gather": "all_gather",
+    "reduce_scatter": "reduce_scatter",
+    "quantized_reduce_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "quantized_all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+    "broadcast": "broadcast",
+    "device_broadcast": "broadcast",
+    "barrier": "barrier",
+}
+
+#: busbw = algbw * factor(n) per op kind (reference calc_bw_log ring factors)
+_RING_FACTORS = {
+    "all_reduce": lambda n: 2 * (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+}
+
+
+def canonical_op_kind(op_name: str, kind: str = None) -> str:
+    """The op's canonical kind: an explicit ``kind`` wins, else the exact
+    registry entry, else ``"other"`` (busbw == algbw)."""
+    if kind:
+        return kind
+    return OP_KINDS.get(op_name, "other")
+
+
+def calc_bw(op_name: str, size_bytes: int, duration_s: float, world: int,
+            kind: str = None):
     """Algorithm vs bus bandwidth (reference: comms_logging.py:34 calc_bw_log).
 
     busbw scales algbw by the ring-collective traffic factor: allreduce 2(n-1)/n,
-    allgather/reduce_scatter/all_to_all (n-1)/n.
+    allgather/reduce_scatter/all_to_all (n-1)/n — selected by the CANONICAL
+    op kind (``canonical_op_kind``), an exact lookup, so compressed /
+    quantized op names can never misclassify the factor.
 
     Degenerate inputs are guarded, not propagated: a zero/negative duration
     (clock granularity on a fast op) or a negative size yields (0, 0)
@@ -37,23 +77,27 @@ def calc_bw(op_name: str, size_bytes: int, duration_s: float, world: int):
     n = max(world, 1)
     if n == 1:
         return algbw, algbw     # no inter-member traffic to scale by
-    if "all_reduce" in op_name:
-        busbw = algbw * (2 * (n - 1) / n)
-    elif any(k in op_name for k in ("all_gather", "reduce_scatter", "all_to_all")):
-        busbw = algbw * ((n - 1) / n)
-    else:
-        busbw = algbw
+    factor = _RING_FACTORS.get(canonical_op_kind(op_name, kind))
+    busbw = algbw * factor(n) if factor else algbw
     return algbw, busbw
 
 
-def emit_comm_instant(op_name: str, nbytes: int, world: int) -> None:
+def emit_comm_instant(op_name: str, nbytes: int, world: int,
+                      wire_bytes: int = None, kind: str = None) -> None:
     """Trace-time analytic comm record: an instant event (no runtime duration
-    exists under XLA scheduling) carrying op/bytes/world. THE single emission
-    point — both ``CommsLogger.record_traced`` and the collective facade's
-    logger-off path route through here so the trace args can never drift."""
+    exists under XLA scheduling) carrying op/bytes/wire_bytes/world. THE
+    single emission point — both ``CommsLogger.record_traced`` and the
+    collective facade's logger-off path route through here so the trace args
+    can never drift. ``wire_bytes`` defaults to the logical ``bytes`` (an
+    uncompressed op is its own wire format); compressed collectives pass
+    the codes+scales payload so dstrace / ``dstpu plan`` rollups can report
+    the compression ratio deterministically."""
     tracer = get_tracer()
     if tracer.enabled:
         tracer.instant(f"comm/{op_name}", cat="comm", bytes=int(nbytes),
+                       wire_bytes=int(nbytes if wire_bytes is None
+                                      else wire_bytes),
+                       kind=canonical_op_kind(op_name, kind),
                        world=int(world))
 
 
@@ -63,9 +107,10 @@ class CommsLogger:
         self.verbose = False
         self.prof_all = True
         self.prof_ops = []
-        # op -> {count, total_bytes}
-        self.traced: Dict[str, Dict[str, float]] = defaultdict(lambda: {"count": 0, "bytes": 0})
-        # op -> list of (bytes, seconds, world)
+        # op -> {count, total_bytes, wire_bytes}
+        self.traced: Dict[str, Dict[str, float]] = defaultdict(
+            lambda: {"count": 0, "bytes": 0, "wire_bytes": 0})
+        # op -> list of (bytes, seconds, world, wire_bytes)
         self.timed_records: Dict[str, list] = defaultdict(list)
 
     def configure(self, enabled: bool = True, verbose: bool = False,
@@ -75,31 +120,38 @@ class CommsLogger:
         self.prof_all = prof_all
         self.prof_ops = prof_ops or []
 
-    def record_traced(self, op_name: str, nbytes: int, world: int):
+    def record_traced(self, op_name: str, nbytes: int, world: int,
+                      wire_bytes: int = None, kind: str = None):
         rec = self.traced[op_name]
         rec["count"] += 1
         rec["bytes"] += nbytes
-        emit_comm_instant(op_name, nbytes, world)
+        rec["wire_bytes"] += nbytes if wire_bytes is None else wire_bytes
+        emit_comm_instant(op_name, nbytes, world, wire_bytes=wire_bytes,
+                          kind=kind)
         if self.verbose:
             logger.info(f"[comms][trace] {op_name}: {nbytes / 1e6:.2f} MB over {world} members")
 
     @contextmanager
-    def timed(self, op_name: str, nbytes: int, world: int):
+    def timed(self, op_name: str, nbytes: int, world: int,
+              wire_bytes: int = None, kind: str = None):
         tracer = get_tracer()
         if not (self.enabled or tracer.enabled):
             yield
             return
+        wire = nbytes if wire_bytes is None else wire_bytes
         start = time.time()
         yield
         dur = time.time() - start
-        algbw, busbw = calc_bw(op_name, nbytes, dur, world)
+        algbw, busbw = calc_bw(op_name, nbytes, dur, world, kind=kind)
         if tracer.enabled:
             tracer.complete(f"comm/{op_name}", dur, cat="comm",
-                            bytes=int(nbytes), world=int(world),
+                            bytes=int(nbytes), wire_bytes=int(wire),
+                            kind=canonical_op_kind(op_name, kind),
+                            world=int(world),
                             algbw_gbps=algbw / 1e9, busbw_gbps=busbw / 1e9)
         if not self.enabled:
             return
-        self.timed_records[op_name].append((nbytes, dur, world))
+        self.timed_records[op_name].append((nbytes, dur, world, wire))
         if self.verbose:
             logger.info(f"[comms] {op_name}: {nbytes / 1e6:.2f} MB in {dur * 1e3:.2f} ms | "
                         f"algbw {algbw / 1e9:.2f} GB/s busbw {busbw / 1e9:.2f} GB/s")
@@ -122,33 +174,62 @@ class CommsLogger:
     def per_op_totals(self) -> Dict[str, Dict[str, float]]:
         """Merged per-op volume/time totals across both recording modes —
         the summary ``env_report`` and tests consume without parsing log
-        lines: ``{op: {count, bytes, seconds}}`` (seconds only for eager
-        timed ops; traced ops are scheduled by XLA)."""
+        lines: ``{op: {count, bytes, wire_bytes, seconds}}`` (seconds only
+        for eager timed ops; traced ops are scheduled by XLA). The
+        compression ratio of an op is ``bytes / wire_bytes`` — equal when
+        nothing on that op compresses."""
         out: Dict[str, Dict[str, float]] = {}
         for op, rec in self.traced.items():
             out[op] = {"count": int(rec["count"]),
-                       "bytes": float(rec["bytes"]), "seconds": 0.0}
+                       "bytes": float(rec["bytes"]),
+                       "wire_bytes": float(rec["wire_bytes"]),
+                       "seconds": 0.0}
         for op, recs in self.timed_records.items():
-            e = out.setdefault(op, {"count": 0, "bytes": 0.0, "seconds": 0.0})
+            e = out.setdefault(op, {"count": 0, "bytes": 0.0,
+                                    "wire_bytes": 0.0, "seconds": 0.0})
             e["count"] += len(recs)
             e["bytes"] += float(sum(r[0] for r in recs))
+            e["wire_bytes"] += float(sum(
+                r[3] if len(r) > 3 else r[0] for r in recs))
             e["seconds"] += float(sum(r[1] for r in recs))
         return out
 
     def env_report_rows(self) -> List[Tuple[str, str]]:
-        """(key, value) rows for the ``dstpu_report`` environment report."""
+        """(key, value) rows for the ``dstpu_report`` environment report —
+        per-op volume with the wire column, plus ONE comm-compression
+        status row summarizing whether any op this process recorded moved
+        fewer wire than logical bytes."""
         totals = self.per_op_totals()
         if not totals:
-            return [("comms ops", "none recorded in this process")]
+            return [("comms ops", "none recorded in this process"),
+                    ("comm compression",
+                     "no compressed ops recorded (enable the "
+                     "comm_compression config group)")]
         rows = []
+        logical_total = wire_total = 0.0
         for op, t in sorted(totals.items()):
             val = f"{int(t['count'])} calls, {t['bytes'] / 1e6:.2f} MB"
+            if t["wire_bytes"] < t["bytes"]:
+                ratio = t["bytes"] / max(t["wire_bytes"], 1.0)
+                val += (f" -> {t['wire_bytes'] / 1e6:.2f} MB wire "
+                        f"({ratio:.2f}x)")
             if t["seconds"] > 0:
                 # volume/duration only: bus bandwidth needs the per-op world
                 # size, which totals deliberately do not aggregate over
                 val += (f", {t['seconds'] * 1e3:.1f} ms, "
                         f"{t['bytes'] / t['seconds'] / 1e9:.2f} GB/s")
             rows.append((f"comms[{op}]", val))
+            logical_total += t["bytes"]
+            wire_total += t["wire_bytes"]
+        if wire_total < logical_total:
+            rows.append(("comm compression",
+                         f"active: {logical_total / 1e6:.2f} MB logical -> "
+                         f"{wire_total / 1e6:.2f} MB wire "
+                         f"({logical_total / max(wire_total, 1.0):.2f}x)"))
+        else:
+            rows.append(("comm compression",
+                         "no compressed ops recorded (enable the "
+                         "comm_compression config group)"))
         return rows
 
     def reset(self):

@@ -450,6 +450,11 @@ class DeepSpeedTPUConfig:
         _cg = self._raw.get(C.COMM_GUARD, {})
         self.comm_guard = CommGuardConfig(**{"enabled": C.COMM_GUARD
                                              in self._raw, **_cg})
+        # quantized error-feedback collectives + bucketed backward overlap
+        # (comm/compress.py); default OFF = today's exact wire + semantics
+        from deepspeed_tpu.comm.compress import CommCompressionConfig
+        self.comm_compression = CommCompressionConfig(
+            **self._raw.get(C.COMM_COMPRESSION, {}))
 
         self.gradient_clipping: float = float(
             self._raw.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -48,6 +48,9 @@ ASYNC_PIPELINE = "async_pipeline"   # latency-hiding step pipeline group
 RESILIENCE = "resilience"           # fault-tolerance group (guards/autosave)
 COMM_GUARD = "comm_guard"           # comm fault-tolerance group (deadlines/
 #                                     heartbeat/membership; comm/guard.py)
+COMM_COMPRESSION = "comm_compression"  # quantized error-feedback collectives
+#                                     + bucketed backward/reduce-scatter
+#                                     overlap (comm/compress.py)
 DEBUG_NANS = "debug_nans"           # jax_debug_nans for the compiled step
 MEMORY = "memory"                   # dsmem group (ledger preflight + live
 #                                     HBM/RSS sampling; telemetry/memory.py)

@@ -488,6 +488,90 @@ class DeepSpeedTPUEngine:
                        "no replica batch axis or no embedding-like leaf — ")
                     + "gradients reduce densely", ranks=[0])
 
+        # --- comm compression (comm/compress.py) ------------------------------
+        # Quantized error-feedback collectives + bucketed backward/
+        # reduce-scatter overlap over the replica axes. Default OFF =
+        # today's exact semantics. When active it OWNS the wire: qgZ
+        # (zero_quantized_gradients) defers to it — one compression layer,
+        # one error-feedback state, one set of wire-byte counters.
+        ccfg = config.comm_compression
+        self._comm_compress = None
+        self._overlap_meta: List[Dict[str, Any]] = []
+        self._overlap_wire_total = 0
+        if ccfg.enabled:
+            if self._param_offload is not None or self._offload is not None:
+                log_dist("comm_compression: disabled — offload tiers run a "
+                         "host-synchronous optimizer step whose reductions "
+                         "keep today's wire format", ranks=[0])
+            elif not self._replica_axes:
+                import warnings
+                msg = ("comm_compression enabled but the mesh has NO "
+                       "replica batch axis (pure-fsdp ZeRO-3): there is no "
+                       "pure-DP all-reduce hop to compress, so NO bytes "
+                       "are saved on the wire — the group is ignored. Add "
+                       "a replica axis (a 'data' mesh axis, or split fsdp "
+                       "via mics_shard_size < world so 'fsdp_out' "
+                       "replicates). See docs/performance.md#wire-"
+                       "compression--overlap.")
+                warnings.warn(msg, UserWarning, stacklevel=3)
+                logger.warning(msg)
+            else:
+                from deepspeed_tpu.comm.compress import (CommCompressState,
+                                                         GradCompressor,
+                                                         with_error_feedback)
+                comp = GradCompressor(ccfg, self._replica_axes, self.mesh)
+                comp.build(self.state.params,
+                           itemsize=jnp.dtype(config.grad_accum_dtype)
+                           .itemsize,
+                           exclude_paths=self._sparse_grad_paths)
+                if not comp.buckets:
+                    log_dist("comm_compression: no leaf meets min_size "
+                             f"({ccfg.min_size}) — nothing to compress",
+                             ranks=[0])
+                else:
+                    self._comm_compress = comp
+                    # overlap spans describe the per-bucket schedule; a
+                    # fused single bucket (overlap=False) has no schedule
+                    # to claim, so nothing rides the comm-overlap track
+                    self._overlap_meta = comp.bucket_summaries() \
+                        if ccfg.overlap else []
+                    self._overlap_wire_total = max(
+                        sum(b["wire_bytes"] for b in self._overlap_meta), 1)
+                    if self._quantized_gradients:
+                        log_dist("comm_compression supersedes "
+                                 "zero_quantized_gradients on the replica "
+                                 "axes (one compression layer owns the "
+                                 "wire)", ranks=[0])
+                        self._qgz_axes = ()
+                        # clearing the axes alone would re-arm the
+                        # per-microbatch int8 round-trip fallback in
+                        # _grads_one_micro — the wire is quantized ONCE,
+                        # by the bucketed reduction
+                        self._quantized_gradients = False
+                    # error-feedback residuals ride the optimizer state so
+                    # they checkpoint and survive the mesh-portable resume
+                    ef_shardings = comp.error_feedback_shardings(self.mesh)
+                    ef = jax.jit(comp.zero_error_feedback,
+                                 out_shardings=ef_shardings)() \
+                        if comp.ef_enabled() else ()
+                    self.tx = with_error_feedback(self.tx,
+                                                  comp.zero_error_feedback)
+                    self.state = self.state._replace(
+                        opt_state=CommCompressState(
+                            inner=self.state.opt_state, error_feedback=ef))
+                    self.opt_state_shardings = CommCompressState(
+                        inner=self.opt_state_shardings,
+                        error_feedback=ef_shardings)
+                    self.state_shardings = self.state_shardings._replace(
+                        opt_state=self.opt_state_shardings)
+                    log_dist(
+                        f"comm_compression: {len(comp.buckets)} bucket(s) "
+                        f"over {self._replica_axes} "
+                        f"(wire={ccfg.wire_dtype}, chunk={ccfg.chunk}, "
+                        f"error_feedback={'on' if comp.ef_enabled() else 'off'}, "
+                        f"overlap={'per-bucket' if ccfg.overlap else 'fused'})",
+                        ranks=[0])
+
         # --- async step pipeline (deferred metric readback + prefetch) --------
         # config.async_pipeline; disabled -> per-step readback semantics are
         # bit-for-bit today's (no ring, no extra sync, device-array metrics)
@@ -756,49 +840,112 @@ class DeepSpeedTPUEngine:
             return loss_sum / gas, grads
 
         from deepspeed_tpu.runtime.zero.qgz import wrap_grads_phase
+        if self._comm_compress is not None:
+            # comm_compression owns the manual-region reduction: per-bucket
+            # facade-recorded quantized all-reduce with the error-feedback
+            # residuals threaded through the shard_map (sparse embedding
+            # leaves keep their sparse wire format via the fallback)
+            comp = self._comm_compress
+            axes = self._replica_axes
+            sync = comp.make_sync_fn(
+                fallback_leaf_sync=self._compress_fallback_sync(axes))
+            if comp.ef_enabled():
+                return wrap_grads_phase(grads_phase, self.mesh, axes,
+                                        self.batch_spec, stacked=True,
+                                        sync_fn=sync,
+                                        ef_specs=comp.ef_partition_specs())
+
+            def sync_no_ef(grads, batch):
+                reduced, _ = sync(grads, batch, ())
+                return reduced
+
+            return wrap_grads_phase(grads_phase, self.mesh, axes,
+                                    self.batch_spec, stacked=True,
+                                    sync_fn=sync_no_ef)
         axes = self._qgz_axes or self._sparse_grad_axes
         return wrap_grads_phase(grads_phase, self.mesh, axes,
                                 self.batch_spec, stacked=True,
                                 sync_fn=self._make_grad_sync(axes))
 
-    def _make_grad_sync(self, axes):
-        """Per-leaf wire policy for the manual-region gradient reduction:
-        embedding leaves (sparse_gradients) use the sparse (indices, values)
-        format, everything else int8 (qgZ) or plain fp pmean. Returns None
-        (the default quantized sync) when no sparse leaves are selected."""
+    @staticmethod
+    def _batch_token_count(batch) -> int:
+        """k = batch tokens on this device: a pure-lookup embedding grad
+        touches at most one row per token, so top-k at this k keeps every
+        touched row and the sparse reduction is EXACT. Max over integer
+        leaves — small int side fields (bucket ids, lengths) must not
+        shrink k below the token count."""
+        return max((int(leaf.size) for leaf in jax.tree.leaves(batch)
+                    if jnp.issubdtype(leaf.dtype, jnp.integer)),
+                   default=0)
+
+    def _sparse_wire_policy(self, axes):
+        """THE sparse-embedding wire rule, shared by the composite grad
+        sync and the comm_compression fallback so the win heuristic can
+        never drift between them: returns ``fn(path_str, g, k_tokens) ->
+        reduced | None`` (None = not a sparse-profitable leaf — caller
+        falls through to its dense policy), or None when no sparse leaves
+        are configured."""
         if not self._sparse_grad_paths or not axes:
             return None
         from deepspeed_tpu.runtime.sparse_tensor import sparse_grad_sync
-        from deepspeed_tpu.runtime.zero.qgz import quantized_grad_sync
-        from deepspeed_tpu.utils.tree import tree_path_str
         sparse_paths = set(self._sparse_grad_paths)
-        qgz_on = bool(self._qgz_axes)
-
         world = 1
         for ax in axes:
             world *= self.mesh.shape[ax]
 
+        def leaf_rule(p, g, k_tokens):
+            if p not in sparse_paths or not k_tokens:
+                return None
+            v, d = g.shape
+            k = min(v, k_tokens)
+            # wire win vs dense: the gathered sparse representation is
+            # O(k·(d+1)·world) rows across the replica group, a dense
+            # all-reduce O(v·d) — sparse only pays when the batch's token
+            # set is small relative to V/world
+            if k * (d + 1) * world < v * d:
+                return sparse_grad_sync(g, axes, k)
+            return None
+
+        return leaf_rule
+
+    def _compress_fallback_sync(self, axes):
+        """Leaf sync for leaves OUTSIDE every compression bucket
+        (sub-min_size, non-float, or sparse-selected): sparse embedding
+        leaves keep the sparse (indices, values) wire format, everything
+        else a full-precision pmean. None when no sparse leaves are
+        configured (the compressor's default pmean fallback applies)."""
+        sparse_rule = self._sparse_wire_policy(axes)
+        if sparse_rule is None:
+            return None
+        from deepspeed_tpu.utils.tree import tree_path_str
+
+        def fallback(path, g, batch):
+            out = sparse_rule(tree_path_str(path), g,
+                              self._batch_token_count(batch))
+            return jax.lax.pmean(g, axes) if out is None else out
+
+        return fallback
+
+    def _make_grad_sync(self, axes):
+        """Per-leaf wire policy for the manual-region gradient reduction:
+        embedding leaves (sparse_gradients) use the sparse (indices, values)
+        format via the shared ``_sparse_wire_policy`` rule, everything else
+        int8 (qgZ) or plain fp pmean. Returns None (the default quantized
+        sync) when no sparse leaves are selected."""
+        sparse_rule = self._sparse_wire_policy(axes)
+        if sparse_rule is None:
+            return None
+        from deepspeed_tpu.runtime.zero.qgz import quantized_grad_sync
+        from deepspeed_tpu.utils.tree import tree_path_str
+        qgz_on = bool(self._qgz_axes)
+
         def sync_fn(grads, batch):
-            # k = batch tokens on this device: a pure-lookup embedding grad
-            # touches at most one row per token, so top-k keeps every
-            # touched row and the reduction is EXACT. Max over integer
-            # leaves — small int side fields (bucket ids, lengths) must not
-            # shrink k below the token count.
-            k_tokens = max((int(leaf.size) for leaf in jax.tree.leaves(batch)
-                            if jnp.issubdtype(leaf.dtype, jnp.integer)),
-                           default=0)
+            k_tokens = self._batch_token_count(batch)
 
             def leaf_sync(path, g):
-                p = tree_path_str(path)
-                if p in sparse_paths and k_tokens:
-                    v, d = g.shape
-                    k = min(v, k_tokens)
-                    # wire win vs dense: the gathered sparse representation
-                    # is O(k·(d+1)·world) rows across the replica group,
-                    # a dense all-reduce O(v·d) — sparse only pays when the
-                    # batch's token set is small relative to V/world
-                    if k * (d + 1) * world < v * d:
-                        return sparse_grad_sync(g, axes, k)
+                out = sparse_rule(tree_path_str(path), g, k_tokens)
+                if out is not None:
+                    return out
                 if qgz_on:
                     return quantized_grad_sync(g, axes)
                 return jax.lax.pmean(g, axes)
@@ -816,10 +963,23 @@ class DeepSpeedTPUEngine:
         lr_schedule = self.lr_schedule
         grads_phase = self._make_grads_phase()
 
+        ef_active = (self._comm_compress is not None
+                     and self._comm_compress.ef_enabled())
+
         def train_batch_step(state: EngineState, stacked_batch, rng) -> Tuple[EngineState, StepOutput]:
             scale = state.loss_scale.scale
             rngs = jax.random.split(rng, gas)
-            loss, grads = grads_phase(state.params, stacked_batch, rngs, scale)
+            if ef_active:
+                # comm_compression error feedback: residuals ride the
+                # optimizer-state wrapper into the manual region and come
+                # back refreshed by the bucketed quantized reduction
+                ef = state.opt_state.error_feedback
+                loss, grads, new_ef = grads_phase(state.params,
+                                                  stacked_batch, rngs,
+                                                  scale, ef)
+            else:
+                loss, grads = grads_phase(state.params, stacked_batch,
+                                          rngs, scale)
             # unscale + average over gas in fp32 (reference scales loss by 1/gas
             # pre-bwd; accumulation dtype may be lower via data_types config).
             # No per-microbatch overflow check is needed (the reference checks
@@ -831,6 +991,15 @@ class DeepSpeedTPUEngine:
             grads = jax.tree.map(
                 lambda g: g.astype(jnp.float32) / (scale * gas), grads)
             new_state, out = self._update(state, grads, tx, lr_schedule, clip, fp16)
+            if ef_active:
+                # a residual refreshed from non-finite grads would poison
+                # every later step: on overflow the old residuals survive
+                # with the params (exactly the keep_old contract)
+                kept = jax.tree.map(
+                    lambda n, o: jnp.where(out.overflow, o, n), new_ef, ef)
+                new_state = new_state._replace(
+                    opt_state=new_state.opt_state._replace(
+                        error_feedback=kept))
             return new_state, out._replace(loss=loss)
 
         donate = (0,)
@@ -982,6 +1151,9 @@ class DeepSpeedTPUEngine:
                     or self.global_steps >= 2 * max(self._sync_every or 1,
                                                     1)):
                 sampler.phase = "steady"
+        overlap_trace = (self._comm_compress is not None
+                         and self.tracer.enabled)
+        t_dispatch0 = time.monotonic() if overlap_trace else 0.0
         try:
             with self.tracer.span(
                     "engine/dispatch", cat="train", step=self.global_steps,
@@ -994,6 +1166,8 @@ class DeepSpeedTPUEngine:
             # and stash forensics before the error unwinds (no-op otherwise)
             self._note_oom(e)
             raise
+        if overlap_trace:
+            self._emit_overlap_spans(t_dispatch0, time.monotonic())
         step_timer.stop()
         self.tput_timer.stop(global_step=True)
 
@@ -1134,6 +1308,28 @@ class DeepSpeedTPUEngine:
             self._reset_compiled_fns()
             log_dist(f"non-finite step guard {'armed' if enabled else 'off'}",
                      ranks=[0])
+
+    def _emit_overlap_spans(self, t0: float, t1: float) -> None:
+        """Per-bucket ``comm/overlap`` retro-spans on the dedicated
+        synthetic track (tracer.COMM_OVERLAP_TID): the analytic schedule of
+        the bucketed quantized reductions inside the dispatched step — the
+        window [t0, t1] split proportionally by each bucket's wire bytes.
+        Off the main track by construction, so ``dstpu plan`` attributes
+        the time as overlapped comm (overlap_fraction) rather than step
+        cost, exactly the treatment the prefetch worker's staging gets.
+        Hot-path registered: appends only, no device touch."""
+        from deepspeed_tpu.telemetry.tracer import COMM_OVERLAP_TID
+        comp = self._comm_compress
+        window = max(t1 - t0, 0.0)
+        end = t0
+        for b in self._overlap_meta:
+            dur = window * (b["wire_bytes"] / self._overlap_wire_total)
+            end += dur
+            self.tracer.complete(
+                "comm/overlap", dur, cat="comm", end_ts=end,
+                tid=COMM_OVERLAP_TID, bucket=b["index"], bytes=b["bytes"],
+                wire_bytes=b["wire_bytes"], world=comp.world,
+                op="quantized_all_reduce", step=self.global_steps)
 
     def dump_trace(self, path: Optional[str] = None,
                    tail_s: Optional[float] = None) -> Dict[str, Any]:
@@ -1620,10 +1816,25 @@ class DeepSpeedTPUEngine:
         # backward when not accumulating); with replica axes the reduce is
         # the int8/sparse-wire collective, one sync per forward/backward pair
         from deepspeed_tpu.runtime.zero.qgz import wrap_grads_phase
-        wire_axes = self._qgz_axes or self._sparse_grad_axes
+        if self._comm_compress is not None:
+            # compression without error feedback on the per-microbatch
+            # shim: residuals are defined at the accumulation boundary (one
+            # reduction per optimizer step), which forward/backward/step
+            # does not expose — train_batch() is the EF-carrying path
+            wire_axes = self._replica_axes
+            _csync = self._comm_compress.make_sync_fn(
+                fallback_leaf_sync=self._compress_fallback_sync(wire_axes))
+
+            def sync_fn(grads, batch):
+                reduced, _ = _csync(grads, batch, ())
+                return reduced
+        else:
+            wire_axes = self._qgz_axes or self._sparse_grad_axes
+            sync_fn = self._make_grad_sync(wire_axes)
+
         fwd_bwd = wrap_grads_phase(fwd_bwd_local, self.mesh, wire_axes,
                                    self.batch_spec, stacked=False,
-                                   sync_fn=self._make_grad_sync(wire_axes))
+                                   sync_fn=sync_fn)
 
         self._micro_fwd_bwd_fn = jax.jit(
             fwd_bwd, out_shardings=(None, grad_shardings))

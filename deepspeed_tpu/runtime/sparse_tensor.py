@@ -62,10 +62,28 @@ class SparseTensor:
                 self.dense_rows * self.values.shape[-1])
 
 
-def sparse_all_gather(st: SparseTensor, axis_name: str) -> SparseTensor:
+def _nbytes(x) -> int:
+    return int(jnp.size(x)) * jnp.dtype(x.dtype).itemsize
+
+
+def sparse_all_gather(st: SparseTensor, axis_name: str,
+                      logical_bytes: int = None) -> SparseTensor:
     """The reference's sparse allreduce: gather all ranks' (indices, values);
     duplicates stay un-summed until ``to_dense`` scatter-adds them. Usable
-    inside shard_map."""
+    inside shard_map.
+
+    Facade-recorded like every collective (comm guard ``_record`` sees the
+    op; dstrace gets a comm instant): ``bytes`` is the logical payload —
+    the dense tensor a full-precision reduction would have moved, passed by
+    the caller (defaults to the sparse representation itself when gathering
+    genuinely sparse data) — and ``wire_bytes`` the (indices, values) pair
+    actually on the wire, so the sparse path's compression ratio shows up
+    in the same counters as the quantized collectives'."""
+    wire = _nbytes(st.indices) + _nbytes(st.values)
+    from deepspeed_tpu.comm.comm import _record
+    _record("sparse_all_gather", st.values, axis_name,
+            nbytes=wire if logical_bytes is None else int(logical_bytes),
+            wire_bytes=wire, kind="all_gather")
     idx = jax.lax.all_gather(st.indices, axis_name, axis=0, tiled=True)
     vals = jax.lax.all_gather(st.values, axis_name, axis=0, tiled=True)
     return SparseTensor(idx, vals, st.dense_rows)
@@ -81,8 +99,11 @@ def sparse_grad_sync(g, axes, k: int):
     bytes: O(k·D·world) vs O(N·D) dense. Must run inside a shard_map whose
     manual axes include ``axes``."""
     st = SparseTensor.from_dense(g, k)
+    dense_bytes = _nbytes(g)
     w = 1
     for ax in axes:
         w *= jax.lax.axis_size(ax)
-        st = sparse_all_gather(st, ax)
+        # logical payload per hop = the dense gradient a full-precision
+        # reduction over this axis would move; wire = (indices, values)
+        st = sparse_all_gather(st, ax, logical_bytes=dense_bytes)
     return (st.to_dense() / w).astype(g.dtype)

@@ -84,37 +84,36 @@ def quantized_grad_sync(grads, axes: Tuple[str, ...]):
     """Mean-reduce a gradient pytree over the manual ``axes`` with int8 on
     the wire. Must run inside a shard_map whose manual axes include ``axes``.
 
-    Per leaf: hierarchical int8 reduce-scatter + int8 regather
-    (``ops.pallas.quant.quantized_psum`` — innermost/fast axis scattered
-    first, the reference's intra-node then inter-node structure) so the
-    result is replicated across ``axes`` for the auto-mode optimizer. Tiny
-    leaves take a full-precision pmean.
-    """
-    from deepspeed_tpu.ops.pallas.quant import quantized_psum
-
-    w_total = 1
-    for ax in axes:
-        w_total *= jax.lax.axis_size(ax)
+    A thin adapter over the comm compression layer: each large leaf rides
+    ONE ``comm.quantized_all_reduce`` (int8 exchange + regather with
+    per-chunk fp32 scales — ``comm/compress.py``, the single
+    quantize/dequantize implementation), routed through the facade so
+    commguard ``_record``, the heartbeat, and dstrace see the op with exact
+    logical + wire byte counts. 1-D and tiny leaves take a full-precision
+    pmean (norm scales and biases are bandwidth-irrelevant and the most
+    quantization-sensitive — this adapter carries no error feedback), and
+    so does any leaf whose padded wire payload would not actually beat the
+    dense reduction (the old rows<world pad-blowup guard, generalized to
+    the chunked codec)."""
+    from deepspeed_tpu.comm.comm import quantized_all_reduce
+    from deepspeed_tpu.comm.compress import (DEFAULT_CHUNK, axis_world,
+                                             padded_elems, wire_payload_bytes)
 
     def sync(g):
-        # 1-D leaves (biases, norm scales) get one scale for the whole
-        # vector and a pad-to-w row blowup if quantized — pmean them in fp
-        # along with anything under the size threshold or with fewer rows
-        # than devices (padding would outweigh the wire saving)
         if g.ndim < 2 or g.size < MIN_QUANT_SIZE:
             return jax.lax.pmean(g, axes)
-        shape, dt = g.shape, g.dtype
-        g2 = g.reshape(-1, shape[-1])
-        if g2.shape[0] < w_total:
+        wire = wire_payload_bytes(
+            padded_elems(g.size, axis_world(axes), DEFAULT_CHUNK))
+        if wire >= g.size * jnp.dtype(g.dtype).itemsize:
             return jax.lax.pmean(g, axes)
-        g2 = quantized_psum(g2, axes, mean=True)
-        return g2.reshape(shape).astype(dt)
+        out, _ = quantized_all_reduce(g.reshape(-1), axes)
+        return out[:g.size].reshape(g.shape).astype(g.dtype)
 
     return jax.tree.map(sync, grads)
 
 
 def wrap_grads_phase(grads_phase, mesh: Mesh, axes: Tuple[str, ...],
-                     batch_spec, stacked: bool, sync_fn=None):
+                     batch_spec, stacked: bool, sync_fn=None, ef_specs=None):
     """Wrap ``grads_phase(params, batch, rngs, scale) -> (loss, grads)`` in a
     partial-manual shard_map over the replica ``axes``: inside, gradients are
     per-device partials (no XLA psum over the manual axes), the loss is
@@ -127,13 +126,20 @@ def wrap_grads_phase(grads_phase, mesh: Mesh, axes: Tuple[str, ...],
     gas dimension. Returns a drop-in replacement for ``grads_phase`` whose
     outputs are replicated over ``axes`` (identical to the SPMD result,
     modulo the wire compression in use).
+
+    ``ef_specs`` threads persistent error-feedback state (comm_compression)
+    through the manual region: a pytree of PartitionSpecs matching the EF
+    tree (each leaf manual over ``axes`` on its participant dim). When
+    given, the wrapped fn is ``(params, batch, rngs, scale, ef) ->
+    (loss, grads, new_ef)`` and ``sync_fn(grads, batch, ef)`` must return
+    ``(grads, new_ef)``.
     """
     if not axes:
         return grads_phase
     if sync_fn is None:
         sync_fn = lambda grads, batch: quantized_grad_sync(grads, axes)  # noqa: E731
 
-    def local_phase(params, batch, rngs, scale):
+    def local_phase(params, batch, rngs, scale, *ef):
         # decorrelate dropout/noise across replicas: in auto-SPMD the random
         # bits are drawn per global batch position, but in here every replica
         # traces with the same key — fold the replica index in so masks
@@ -147,15 +153,23 @@ def wrap_grads_phase(grads_phase, mesh: Mesh, axes: Tuple[str, ...],
             rngs = jax.random.fold_in(rngs, idx)
         loss, grads = grads_phase(params, batch, rngs, scale)
         loss = jax.lax.pmean(loss, axes)
-        grads = sync_fn(grads, batch)
-        return loss, grads
+        if ef_specs is None:
+            grads = sync_fn(grads, batch)
+            return loss, grads
+        grads, new_ef = sync_fn(grads, batch, ef[0])
+        return loss, grads, new_ef
 
     bspec = manual_part(batch_spec, axes)
     if stacked:
         bspec = P(None, *bspec)
+    in_specs = (P(), bspec, P(), P())
+    out_specs = (P(), P())
+    if ef_specs is not None:
+        in_specs = in_specs + (ef_specs,)
+        out_specs = out_specs + (ef_specs,)
     return jax.shard_map(
         local_phase, mesh=mesh,
-        in_specs=(P(), bspec, P(), P()),
-        out_specs=(P(), P()),
+        in_specs=in_specs,
+        out_specs=out_specs,
         axis_names=frozenset(axes),
         check_vma=False)

@@ -50,6 +50,12 @@ DEFAULT_CAPACITY = 65536
 REQUEST_TID_BASE = 1_000_000
 REQUEST_TID_SPAN = 10_000_000
 
+#: synthetic track for the comm-compression ``comm/overlap`` bucket spans
+#: (below the request window; same no-collision argument). Its own track is
+#: the contract ``dstpu plan`` relies on: off-main-track spans attribute as
+#: overlapped work — the prefetch-worker treatment — never as step cost.
+COMM_OVERLAP_TID = 900_000
+
 
 def request_tid(uid: int) -> int:
     """Synthetic per-request track id (stable for a given uid)."""
@@ -274,6 +280,8 @@ class Tracer:
             if tid not in seen_tids:
                 if tid in thread_names:
                     seen_tids[tid] = thread_names[tid]
+                elif tid == COMM_OVERLAP_TID:
+                    seen_tids[tid] = "comm-overlap"
                 elif REQUEST_TID_BASE <= tid < REQUEST_TID_BASE + \
                         REQUEST_TID_SPAN:
                     seen_tids[tid] = f"request-{tid - REQUEST_TID_BASE}"

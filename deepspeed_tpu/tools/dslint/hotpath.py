@@ -71,6 +71,9 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
             "_shard_batch",
             "_advance_data_schedules",
             "_ensure_prefetcher",
+            # per-step comm/overlap retro-span emission (comm_compression):
+            # append-only analytic schedule spans, never a device touch
+            "_emit_overlap_spans",
         ),
         # the async push branch of _record_metrics queues device arrays
         # verbatim — any transfer there re-serializes every step; the
@@ -178,6 +181,25 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         path="deepspeed_tpu/telemetry/tracer.py",
         cls="_Span",
         hot_functions=("__enter__", "__exit__"),
+    ),
+    # the comm compression layer: the codec + error-feedback step and the
+    # in-shard_map collective impls run at TRACE time inside the compiled
+    # step (a host sync there wedges compilation of every traced program),
+    # and the bucket scheduler's sync closure runs per traced reduction —
+    # registering the whole surface PROVES the per-bucket path never
+    # host-syncs (the satellite contract: DS002 green, baseline empty)
+    HotPathSpec(
+        path="deepspeed_tpu/comm/compress.py",
+        cls=None,
+        hot_functions=("quantize_wire", "dequantize_wire", "ef_step",
+                       "reduce_scatter_impl", "all_reduce_impl",
+                       "_exchange", "_regather", "axis_world",
+                       "plan_buckets"),
+    ),
+    HotPathSpec(
+        path="deepspeed_tpu/comm/compress.py",
+        cls="GradCompressor",
+        hot_functions=("make_sync_fn", "bucket_summaries"),
     ),
     # the comm-op listener runs inside the collective facade's _record —
     # trace time for jit collectives, per call when eager. Registering it

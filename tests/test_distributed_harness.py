@@ -201,3 +201,53 @@ def test_multichip_with_commguard_active(tmp_path):
         _commguard_body, world_size=2, devices_per_process=2,
         env={"DSTPU_TEST_MEMBERS_DIR": str(tmp_path / "members")})
     assert all("guarded ok" in o for o in outs)
+
+
+def _comm_compress_body():
+    """MULTICHIP-with-compression body: every gradient reduction over the
+    replica axis moves int8 codes + per-chunk scales across REAL process
+    boundaries, error-feedback state threaded through the optimizer state,
+    wire-byte counters recorded on every rank."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.comms_logging import get_comms_logger
+    from deepspeed_tpu.comm.mesh import create_mesh
+    from deepspeed_tpu.config.config import MeshConfig
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+
+    cl = get_comms_logger()
+    cl.configure(enabled=True)
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=64),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3},
+                "comm_compression": {"enabled": True}},
+        mesh=mesh, example_batch=random_batch(4))
+    assert engine._comm_compress is not None
+    for _ in range(2):
+        loss = engine.train_batch(batch=random_batch(8, seed=0))
+    assert np.isfinite(float(loss))
+    totals = cl.per_op_totals()["quantized_all_reduce"]
+    assert totals["bytes"] / totals["wire_bytes"] >= 3.5, totals
+    # EF state is sharded over the replica axis (rows span processes):
+    # inspect this rank's addressable shards
+    ef_leaves = jax.tree_util.tree_leaves(
+        engine.state.opt_state.error_feedback)
+    assert any(np.abs(np.asarray(s.data)).max() > 0
+               for leaf in ef_leaves for s in leaf.addressable_shards)
+    print(f"rank {jax.process_index()} compressed ok "
+          f"({totals['bytes'] / totals['wire_bytes']:.2f}x)")
+
+
+def test_multichip_with_comm_compression_enabled():
+    """Acceptance (ISSUE 14): the MULTICHIP harness exits rc=0 with
+    ``comm_compression`` enabled — quantized error-feedback collectives
+    over a real multi-process replica axis, counters proving the wire
+    reduction on every rank."""
+    outs = run_distributed(_comm_compress_body, world_size=2,
+                           devices_per_process=2)
+    assert all("compressed ok" in o for o in outs)

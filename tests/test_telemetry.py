@@ -215,7 +215,7 @@ def test_comms_per_op_totals_and_env_rows(tracing):
         time.sleep(0.001)
     totals = cl.per_op_totals()
     assert totals["all_reduce"] == {"count": 2, "bytes": 1500.0,
-                                    "seconds": 0.0}
+                                    "wire_bytes": 1500.0, "seconds": 0.0}
     assert totals["broadcast"]["count"] == 1
     assert totals["broadcast"]["seconds"] > 0
     rows = dict(cl.env_report_rows())
