@@ -161,13 +161,26 @@ def main():
     loss = engine.train_batch(batch=make_batch(0), stacked=True)  # compile
     float(jax.device_get(loss))
 
+    from deepspeed_tpu.telemetry.compiles import compiles_total
+
     steps = 10
     engine.timers(TRAIN_BATCH_TIMER).reset()   # drop the compile-step record
+    compile_mark = compiles_total()            # warmup done: ledger marked
     t0 = time.time()
     for i in range(1, steps + 1):
         loss = engine.train_batch(batch=make_batch(i), stacked=True)
     float(jax.device_get(loss))
     dt = time.time() - t0
+    # the compile-event ledger proof: the warm step compiled the exact
+    # shapes, so the timed window must be compile-free — a nonzero count
+    # means the headline timed XLA compilation, not training. An explicit
+    # check (not assert: python -O must not strip the proof)
+    compiles_during_measurement = compiles_total() - compile_mark
+    if compiles_during_measurement != 0:
+        raise SystemExit(
+            f"bench: {compiles_during_measurement} XLA compile(s) inside "
+            "the timed window — warm the exact shapes first (see "
+            "xla/compile instants in the trace)")
     steps_per_sec = steps / dt
     # host time per step spent *launching* — only meaningful on the fused
     # path (async dispatch leaves completion on-device, so its timer records
@@ -230,11 +243,18 @@ def main():
             engine.flush_metrics()                # completion barrier
             engine.timers(TRAIN_BATCH_TIMER).reset()
             engine.timers(TRAIN_BATCH_DISPATCH_TIMER).reset()
+            arm_mark = compiles_total()           # arm warmed: ledger marked
             a0 = time.time()
             for _ in range(sweep_steps):
                 engine.train_batch(data_iter=it)
             engine.flush_metrics()                # completion barrier
             adt = time.time() - a0
+            arm_compiles = compiles_total() - arm_mark
+            if arm_compiles != 0:
+                raise SystemExit(
+                    f"bench: sync_every={se}: {arm_compiles} XLA "
+                    "compile(s) inside the timed sweep arm — the arm "
+                    "warm step missed a shape")
             async_sweep[f"sync_every={se}"] = {
                 "steps_per_sec": round(sweep_steps / adt, 3),
                 "dispatch_gap_ms": round(
@@ -242,6 +262,7 @@ def main():
                 "step_ms_reconciled": round(
                     engine.timers(TRAIN_BATCH_TIMER).mean() * 1000.0, 3),
                 "prefetch": arm_prefetch,
+                "compiles_during_measurement": arm_compiles,
             }
         engine.configure_async_pipeline(enabled=False, prefetch=False)
 
@@ -269,6 +290,9 @@ def main():
             "mfu": round(mfu, 3),
             "peak_tflops": peak,
             "steps_per_sec": round(steps_per_sec, 3),
+            # the compile-ledger proof: 0 == the timed window never paid
+            # an XLA compile (asserted above; reported for the record)
+            "compiles_during_measurement": compiles_during_measurement,
         },
     }
     if dispatch_gap_ms is not None:

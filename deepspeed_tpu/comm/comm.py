@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.comm.comms_logging import emit_comm_instant, get_comms_logger
-from deepspeed_tpu.comm.guard import guarded, note_comm_op
+from deepspeed_tpu.comm.guard import guarded, next_op_seq, note_comm_op
 from deepspeed_tpu.telemetry.tracer import get_tracer
 
 
@@ -62,6 +62,11 @@ def _record(op_name: str, x, axis_name, world: Optional[int] = None,
     tracer = get_tracer()
     if not (logger_.enabled or tracer.enabled):
         return
+    # op_seq: the cross-rank join key — SPMD records collectives in the
+    # same order on every rank, so the k-th recorded op matches across
+    # ranks (allocated only when someone will actually record it, keeping
+    # the sequence aligned with what the trace carries)
+    op_seq = next_op_seq()
     try:
         world = world or _axis_size(axis_name)
     except Exception:
@@ -70,12 +75,13 @@ def _record(op_name: str, x, axis_name, world: Optional[int] = None,
         nbytes = _nbytes(x)
     if logger_.enabled:
         logger_.record_traced(op_name, nbytes, world,
-                              wire_bytes=wire_bytes, kind=kind)  # also traces
+                              wire_bytes=wire_bytes, kind=kind,
+                              op_seq=op_seq)  # also traces
     else:
         # tracing without the comms logger: emit the trace-time instant
         # through the shared helper, skip the volume-accounting tables
         emit_comm_instant(op_name, nbytes, world, wire_bytes=wire_bytes,
-                          kind=kind)
+                          kind=kind, op_seq=op_seq)
 
 
 # --- trace-safe collectives (usable under jit/shard_map with named axes) ----

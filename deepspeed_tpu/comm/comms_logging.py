@@ -83,7 +83,8 @@ def calc_bw(op_name: str, size_bytes: int, duration_s: float, world: int,
 
 
 def emit_comm_instant(op_name: str, nbytes: int, world: int,
-                      wire_bytes: int = None, kind: str = None) -> None:
+                      wire_bytes: int = None, kind: str = None,
+                      op_seq: int = None) -> None:
     """Trace-time analytic comm record: an instant event (no runtime duration
     exists under XLA scheduling) carrying op/bytes/wire_bytes/world. THE
     single emission point — both ``CommsLogger.record_traced`` and the
@@ -91,14 +92,19 @@ def emit_comm_instant(op_name: str, nbytes: int, world: int,
     can never drift. ``wire_bytes`` defaults to the logical ``bytes`` (an
     uncompressed op is its own wire format); compressed collectives pass
     the codes+scales payload so dstrace / ``dstpu plan`` rollups can report
-    the compression ratio deterministically."""
+    the compression ratio deterministically. ``op_seq`` is the commguard
+    sequence number — the cross-rank join key ``dstpu trace merge`` matches
+    the k-th collective on rank 0 to the k-th on rank 3 by."""
     tracer = get_tracer()
     if tracer.enabled:
-        tracer.instant(f"comm/{op_name}", cat="comm", bytes=int(nbytes),
-                       wire_bytes=int(nbytes if wire_bytes is None
-                                      else wire_bytes),
-                       kind=canonical_op_kind(op_name, kind),
-                       world=int(world))
+        args = {"bytes": int(nbytes),
+                "wire_bytes": int(nbytes if wire_bytes is None
+                                  else wire_bytes),
+                "kind": canonical_op_kind(op_name, kind),
+                "world": int(world)}
+        if op_seq is not None:
+            args["op_seq"] = int(op_seq)
+        tracer.instant(f"comm/{op_name}", cat="comm", **args)
 
 
 class CommsLogger:
@@ -121,19 +127,20 @@ class CommsLogger:
         self.prof_ops = prof_ops or []
 
     def record_traced(self, op_name: str, nbytes: int, world: int,
-                      wire_bytes: int = None, kind: str = None):
+                      wire_bytes: int = None, kind: str = None,
+                      op_seq: int = None):
         rec = self.traced[op_name]
         rec["count"] += 1
         rec["bytes"] += nbytes
         rec["wire_bytes"] += nbytes if wire_bytes is None else wire_bytes
         emit_comm_instant(op_name, nbytes, world, wire_bytes=wire_bytes,
-                          kind=kind)
+                          kind=kind, op_seq=op_seq)
         if self.verbose:
             logger.info(f"[comms][trace] {op_name}: {nbytes / 1e6:.2f} MB over {world} members")
 
     @contextmanager
     def timed(self, op_name: str, nbytes: int, world: int,
-              wire_bytes: int = None, kind: str = None):
+              wire_bytes: int = None, kind: str = None, op_seq: int = None):
         tracer = get_tracer()
         if not (self.enabled or tracer.enabled):
             yield
@@ -144,11 +151,13 @@ class CommsLogger:
         dur = time.time() - start
         algbw, busbw = calc_bw(op_name, nbytes, dur, world, kind=kind)
         if tracer.enabled:
+            extra = {} if op_seq is None else {"op_seq": int(op_seq)}
             tracer.complete(f"comm/{op_name}", dur, cat="comm",
                             bytes=int(nbytes), wire_bytes=int(wire),
                             kind=canonical_op_kind(op_name, kind),
                             world=int(world),
-                            algbw_gbps=algbw / 1e9, busbw_gbps=busbw / 1e9)
+                            algbw_gbps=algbw / 1e9, busbw_gbps=busbw / 1e9,
+                            **extra)
         if not self.enabled:
             return
         self.timed_records[op_name].append((nbytes, dur, world, wire))

@@ -41,6 +41,7 @@ detect → classify → abort → autosave → resume loop is drillable on CPU.
 """
 
 import enum
+import itertools
 import os
 import threading
 import time
@@ -210,6 +211,24 @@ def comm_trace_tail(tail_s: float = 30.0) -> List[dict]:
         out.append({"name": name, "ph": ph, "ts": ts, "dur_s": dur,
                     "args": dict(args) if args else {}})
     return out
+
+
+# ---------------------------------------------------------------------------
+# comm-op sequence numbers (the cross-rank join key)
+# ---------------------------------------------------------------------------
+#: process-wide monotonic comm-op counter. SPMD programs record collectives
+#: in the SAME order on every rank (trace-time for jit ops, call order for
+#: eager guarded ops), so the k-th recorded op on rank 0 IS the k-th on
+#: rank 3 — ``op_seq`` stamped into every comm span/instant is what
+#: ``dstpu trace merge`` joins per-rank timelines on. itertools.count is
+#: GIL-atomic: allocation never locks the hot path.
+_op_seq = itertools.count(1)
+
+
+def next_op_seq() -> int:
+    """Allocate the next comm-op sequence number (registered DS002 hot
+    path: one C-level counter increment, never a host sync)."""
+    return next(_op_seq)
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +430,9 @@ class CommGuard:
         call_idx = self._calls
         self._calls += 1
         tracer = get_tracer()
+        # allocated at ENTRY so the k-th guarded op carries the same seq on
+        # every rank even when one of them wedges mid-op
+        op_seq = next_op_seq()
         fault = self.chaos.comm_fault(op, call_idx) \
             if self.chaos is not None else None
         run_fn = fn
@@ -422,7 +444,7 @@ class CommGuard:
             run_fn = self._delayed(op, fn)
         t0 = time.monotonic()
         with tracer.span(f"comm/guarded/{op}", cat="comm", call=call_idx,
-                         deadline_s=deadline):
+                         op_seq=op_seq, deadline_s=deadline):
             box = _run_with_deadline(run_fn, deadline, op)
         elapsed = time.monotonic() - t0
         if not box["done"]:

@@ -308,4 +308,10 @@ def init_distributed(coordinator_address: Optional[str] = None,
     bounded_init(lambda: jax.distributed.initialize(**kwargs),
                  name="jax_distributed", deadline_s=deadline_s,
                  retries=retries, backoff_s=backoff_s)
+    # stamp the dstrace process-identity header at rendezvous: every trace
+    # this worker dumps from here on carries rank/world, the join key
+    # ``dstpu trace merge`` aligns per-rank timelines by
+    from deepspeed_tpu.telemetry.tracer import get_tracer
+    get_tracer().set_process_identity(jax.process_index(),
+                                      jax.process_count())
     log_dist(f"jax.distributed initialized: {jax.process_count()} processes", ranks=[0])

@@ -70,6 +70,7 @@ def debug_report():
     rows.extend(trace_report())
     rows.extend(plan_report())
     rows.extend(serve_plan_report())
+    rows.extend(crossrank_report())
     rows.extend(memory_report())
     rows.extend(serving_report())
     rows.extend(elastic_report())
@@ -299,6 +300,57 @@ def serve_plan_report():
         return rows
     except Exception as e:   # the report must never die on tooling drift
         return [("serve plan", f"unavailable ({e})")]
+
+
+def crossrank_report():
+    """Cross-rank merged-trace status: the last ``dstpu plan --cross-rank``
+    artifact ($DSTPU_CROSSRANK_ARTIFACT or ./crossrank.json — ranks
+    joined, max residual clock skew, dominant straggler) and the crossrank
+    baseline's ratchet size — the multi-process counterpart of the
+    plan/serve-plan rows."""
+    import json
+    import os
+    rows = []
+    try:
+        from deepspeed_tpu.telemetry.crossrank import (
+            CROSSRANK_ARTIFACT_ENV, CROSSRANK_BASELINE_NAME,
+            DEFAULT_CROSSRANK_ARTIFACT, find_crossrank_baseline,
+            load_crossrank_baseline)
+        artifact = os.environ.get(CROSSRANK_ARTIFACT_ENV) or (
+            DEFAULT_CROSSRANK_ARTIFACT
+            if os.path.exists(DEFAULT_CROSSRANK_ARTIFACT) else None)
+        if artifact and os.path.exists(artifact):
+            with open(artifact) as f:
+                rep = json.load(f)
+            dom = rep.get("dominant_straggler")
+            rows.append(("cross-rank",
+                         f"{artifact} (ranks {rep.get('ranks')}, "
+                         f"{rep.get('matched', 0)} matched collectives, "
+                         f"max residual skew "
+                         f"{rep.get('max_residual_skew_us', 0.0):.0f}us, "
+                         f"dominant straggler "
+                         f"{'rank ' + str(dom) if dom is not None else 'none'})"
+                         ))
+        else:
+            rows.append(("cross-rank",
+                         "no artifact (bin/dstpu trace merge r0.json "
+                         "r1.json, then bin/dstpu plan --cross-rank "
+                         f"merged_trace.json --out "
+                         f"{DEFAULT_CROSSRANK_ARTIFACT}, or set "
+                         f"${CROSSRANK_ARTIFACT_ENV})"))
+        bl = find_crossrank_baseline(os.path.dirname(
+            os.path.abspath(__file__)))
+        if bl is None:
+            rows.append(("cross-rank baseline",
+                         f"not found ({CROSSRANK_BASELINE_NAME})"))
+        else:
+            n = len(load_crossrank_baseline(bl).get("entries", {}))
+            rows.append(("cross-rank baseline",
+                         f"{n} rank{'s' if n != 1 else ''} ratcheted "
+                         f"({bl})"))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("cross-rank", f"unavailable ({e})")]
 
 
 def serving_report():

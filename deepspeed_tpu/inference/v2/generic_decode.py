@@ -157,3 +157,14 @@ def decode_step_g(params, cache_data, tokens, positions, block_tables, valid,
 
     logits = policy.unembed(params, x, cfg)
     return logits, cache
+
+
+# compile-event ledger: every XLA compile of the serving step fns emits an
+# ``xla/compile`` instant (fn + shape signature + wall ms) and bumps the
+# process compile counter — bench_serve asserts ZERO compiles inside the
+# measured window after warmup (telemetry/compiles.py)
+from deepspeed_tpu.telemetry.compiles import watch_jit  # noqa: E402
+
+prefill_chunk_g = watch_jit(prefill_chunk_g, "generic_decode.prefill_chunk_g")
+verify_chunk_g = watch_jit(verify_chunk_g, "generic_decode.verify_chunk_g")
+decode_step_g = watch_jit(decode_step_g, "generic_decode.decode_step_g")

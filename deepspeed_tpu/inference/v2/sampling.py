@@ -47,3 +47,10 @@ def sample_tokens(logits, key, cfg: SamplingConfig):
                          axis=-1, keepdims=True)
         scaled = jnp.where(scaled < cutoff, NEG_INF, scaled)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+# compile-event ledger: sampler recompiles (a new [B, V] bucket or a new
+# SamplingConfig) are real serve-tick stalls too — watched like the step fns
+from deepspeed_tpu.telemetry.compiles import watch_jit  # noqa: E402
+
+sample_tokens = watch_jit(sample_tokens, "sampling.sample_tokens")

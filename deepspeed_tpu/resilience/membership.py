@@ -266,7 +266,11 @@ class StragglerDetector:
         if len(durations_by_rank) < 2:
             return []
         durs = sorted(durations_by_rank.values())
-        median = durs[len(durs) // 2]
+        # LOWER median: identical to durs[n//2] for odd n, but at n=2 it
+        # compares against the FASTER rank — the upper median would pick
+        # the slower rank itself and make a 2-process straggler (the
+        # MULTICHIP crossrank drill) mathematically unflaggable
+        median = durs[(len(durs) - 1) // 2]
         if median <= 0:
             return []
         outliers = []

@@ -43,6 +43,7 @@ from deepspeed_tpu.runtime.zero.partition import (
     build_param_shardings,
     build_secondary_shardings,
 )
+from deepspeed_tpu.telemetry.compiles import watch_jit
 from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.runtime.dataloader import PrefetchLoader, StagedBatch
@@ -1003,11 +1004,15 @@ class DeepSpeedTPUEngine:
             return new_state, out._replace(loss=loss)
 
         donate = (0,)
-        self._train_batch_fn = jax.jit(
+        # watch_jit: every XLA compile of the step fn emits an xla/compile
+        # instant (qualname + shape signature + wall ms) and bumps the
+        # process compile counter — benches assert ZERO compiles inside
+        # their timed window after warmup (telemetry/compiles.py)
+        self._train_batch_fn = watch_jit(jax.jit(
             train_batch_step,
             donate_argnums=donate,
             out_shardings=(self.state_shardings, None),
-        )
+        ), "engine.train_batch_step")
 
     def _update(self, state: EngineState, grads, tx, lr_schedule, clip,
                 fp16) -> Tuple[EngineState, StepOutput]:
@@ -1250,7 +1255,8 @@ class DeepSpeedTPUEngine:
                     norm = precision.global_grad_norm(grads)
                 return loss, grads, norm, overflow
 
-            self._offload_grad_fn = jax.jit(grad_step)
+            self._offload_grad_fn = watch_jit(jax.jit(grad_step),
+                                              "engine.offload_grad_step")
 
         device_batch = self._shard_batch(batch, stacked=True)
         self._rng, r = jax.random.split(self._rng)
@@ -1836,14 +1842,16 @@ class DeepSpeedTPUEngine:
                                    self.batch_spec, stacked=False,
                                    sync_fn=sync_fn)
 
-        self._micro_fwd_bwd_fn = jax.jit(
-            fwd_bwd, out_shardings=(None, grad_shardings))
+        self._micro_fwd_bwd_fn = watch_jit(jax.jit(
+            fwd_bwd, out_shardings=(None, grad_shardings)),
+            "engine.micro_fwd_bwd")
 
         def accum(buf, grads):
             return jax.tree.map(jnp.add, buf, grads)
 
-        self._accum_fn = jax.jit(accum, donate_argnums=(0,),
-                                 out_shardings=grad_shardings)
+        self._accum_fn = watch_jit(jax.jit(accum, donate_argnums=(0,),
+                                           out_shardings=grad_shardings),
+                                   "engine.accum")
 
         def apply_update(state, grad_sum):
             gas = self.gradient_accumulation_steps
@@ -1852,9 +1860,10 @@ class DeepSpeedTPUEngine:
                 lambda g: g.astype(jnp.float32) / (scale * gas), grad_sum)
             return self._update(state, grads, tx, lr_schedule, clip, fp16)
 
-        self._apply_update_fn = jax.jit(
+        self._apply_update_fn = watch_jit(jax.jit(
             apply_update, donate_argnums=(0, 1),
-            out_shardings=(self.state_shardings, None))
+            out_shardings=(self.state_shardings, None)),
+            "engine.apply_update")
 
     def _reject_param_offload(self, api: str):
         if self._param_offload is not None:

@@ -20,7 +20,9 @@ __all__ = ["Tracer", "get_tracer", "configure_tracing", "TRACE_ENV",
            "analyze_serve_path", "attribute_serve", "propose_serve",
            "MemoryLedger", "MemorySampler", "is_oom_error",
            "estimate_zero2_model_states_mem_needs",
-           "estimate_zero3_model_states_mem_needs"]
+           "estimate_zero3_model_states_mem_needs",
+           "merge_traces", "attribute_crossrank", "analyze_crossrank_path",
+           "matched_collectives"]
 
 #: offline trace replay (``dstpu plan``) — re-exported LAZILY (PEP 562):
 #: every hot-path file imports this package for ``get_tracer``, and the
@@ -43,6 +45,12 @@ _MEMORY_EXPORTS = ("MemoryLedger", "MemorySampler", "is_oom_error",
                    "estimate_zero2_model_states_mem_needs",
                    "estimate_zero3_model_states_mem_needs")
 
+#: cross-rank merge + skew ledger (``dstpu trace merge`` / ``dstpu plan
+#: --cross-rank``) — OFFLINE_ONLY like attribution: the hot-path import
+#: chain must never load it transitively
+_CROSSRANK_EXPORTS = ("merge_traces", "attribute_crossrank",
+                      "analyze_crossrank_path", "matched_collectives")
+
 
 def __getattr__(name):
     if name in _ATTRIBUTION_EXPORTS:
@@ -54,5 +62,8 @@ def __getattr__(name):
     if name in _MEMORY_EXPORTS:
         from deepspeed_tpu.telemetry import memory
         return getattr(memory, name)
+    if name in _CROSSRANK_EXPORTS:
+        from deepspeed_tpu.telemetry import crossrank
+        return getattr(crossrank, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

@@ -90,10 +90,12 @@ class PlanError(Exception):
 # event loading / normalization
 # ---------------------------------------------------------------------------
 class Ev:
-    """One normalized trace event (Chrome-trace microsecond clock)."""
-    __slots__ = ("name", "cat", "ph", "ts", "dur", "tid", "args")
+    """One normalized trace event (Chrome-trace microsecond clock).
+    ``pid`` carries the rank of a merged cross-rank dump (``dstpu trace
+    merge`` keys each source dump's events by pid = rank)."""
+    __slots__ = ("name", "cat", "ph", "ts", "dur", "tid", "args", "pid")
 
-    def __init__(self, name, cat, ph, ts, dur, tid, args):
+    def __init__(self, name, cat, ph, ts, dur, tid, args, pid=None):
         self.name = name
         self.cat = cat
         self.ph = ph
@@ -101,6 +103,7 @@ class Ev:
         self.dur = float(dur)
         self.tid = tid
         self.args = args or {}
+        self.pid = pid
 
     @property
     def end(self) -> float:
@@ -125,7 +128,7 @@ def events_from_chrome(obj: Any) -> List[Ev]:
         try:
             out.append(Ev(e.get("name", "?"), e.get("cat", ""), e.get("ph"),
                           float(e.get("ts", 0.0)), float(e.get("dur", 0.0)),
-                          e.get("tid"), e.get("args")))
+                          e.get("tid"), e.get("args"), pid=e.get("pid")))
         except (TypeError, ValueError):
             continue   # malformed row: skip, never die mid-replay
     return out
@@ -271,10 +274,22 @@ def _union(intervals: List[Tuple[float, float]]) -> float:
 # ---------------------------------------------------------------------------
 # attribution
 # ---------------------------------------------------------------------------
-def attribute(events: List[Ev], source: str = "<events>") -> Dict[str, Any]:
+def attribute(events: List[Ev], source: str = "<events>",
+              merged_ranks: Optional[Dict[Any, int]] = None
+              ) -> Dict[str, Any]:
     """Replay a trace into the plan report: per-window exclusive stage
     ledger (ties out to the window within ``TIE_OUT_TOLERANCE``), aggregate
-    per-step quantiles, comm rollups, observed config, and proposals."""
+    per-step quantiles, comm rollups, observed config, and proposals.
+
+    ``merged_ranks`` (pid -> rank, from a ``dstpu trace merge`` dump)
+    switches to the cross-rank form: the top-level ledger attributes the
+    REFERENCE rank's timeline (mixing N ranks' dispatch spans into one
+    window sweep would attribute nothing meaningful) and a ``cross_rank``
+    section carries every rank's per-stage ledger plus the cross-rank
+    variance — which stage's cost diverges across ranks is exactly the
+    load-imbalance signal the skew ledger's waits trace back to."""
+    if merged_ranks and len(set(merged_ranks.values())) > 1:
+        return _attribute_merged(events, source, merged_ranks)
     windows, mode = step_windows(events)
     track = main_track(events)
     spans = [e for e in events if e.ph == "X"]
@@ -351,6 +366,68 @@ def attribute(events: List[Ev], source: str = "<events>") -> Dict[str, Any]:
         "memory": memory_observed(events),
     }
     report["proposals"] = propose(report)
+    return report
+
+
+def _attribute_merged(events: List[Ev], source: str,
+                      merged_ranks: Dict[Any, int]) -> Dict[str, Any]:
+    """The cross-rank form of ``attribute``: reference-rank ledger +
+    per-rank stage ledgers + per-stage cross-rank variance."""
+    by_rank: Dict[int, List[Ev]] = {}
+    for e in events:
+        rank = merged_ranks.get(e.pid)
+        if rank is not None:
+            by_rank.setdefault(rank, []).append(e)
+    # ONE attribution pass per rank; the reference (top-level) ledger is
+    # the lowest rank that actually carries step spans — a serving-only
+    # rank 0 must not kill the whole replay
+    reps: Dict[int, Dict[str, Any]] = {}
+    for rank in sorted(by_rank):
+        try:
+            reps[rank] = attribute(by_rank[rank],
+                                   source=f"{source}#rank{rank}")
+        except PlanError:
+            continue          # a rank with no step spans (serving-only...)
+    if not reps:
+        raise PlanError(f"no rank in {source} carries step spans "
+                        "(engine/steps_reconciled, engine/dispatch, "
+                        "engine/train_step all absent on every rank) — "
+                        "use `dstpu plan --cross-rank` for comm-only "
+                        "merged dumps")
+    ref = min(reps)
+    report = dict(reps[ref])
+    report["source"] = source
+    per_rank: Dict[str, Any] = {}
+    stage_p50s: Dict[str, Dict[int, float]] = {s: {} for s in STAGES}
+    for rank, rep in sorted(reps.items()):
+        per_rank[str(rank)] = {
+            "steps_total": rep["steps_total"],
+            "step_ms_p50": rep["step_ms_p50"],
+            "stages": {s: {"p50_step_ms": rep["aggregate"][s]["p50_step_ms"],
+                           "share": rep["aggregate"][s]["share"]}
+                       for s in STAGES},
+        }
+        for s in STAGES:
+            stage_p50s[s][rank] = rep["aggregate"][s]["p50_step_ms"]
+    variance: Dict[str, Any] = {}
+    for s in STAGES:
+        vals = stage_p50s[s]
+        if len(vals) < 2:
+            continue
+        lo_rank = min(sorted(vals), key=lambda r: vals[r])
+        hi_rank = max(sorted(vals), key=lambda r: vals[r])
+        variance[s] = {
+            "p50_step_ms_min": vals[lo_rank],
+            "p50_step_ms_max": vals[hi_rank],
+            "spread_ms": round(vals[hi_rank] - vals[lo_rank], 4),
+            "slowest_rank": hi_rank,
+        }
+    report["cross_rank"] = {
+        "ranks": sorted(by_rank),
+        "reference_rank": ref,
+        "per_rank": per_rank,
+        "variance": variance,
+    }
     return report
 
 
@@ -899,8 +976,22 @@ def render(report: Dict[str, Any], top_windows: int = 8) -> str:
 
 
 def analyze_path(trace_path: str) -> Dict[str, Any]:
-    """Load + attribute in one call (the API tests and env_report use)."""
-    return attribute(load_events(trace_path), source=trace_path)
+    """Load + attribute in one call (the API tests and env_report use).
+    A merged cross-rank dump (``dstpu trace merge`` output, detected by
+    its ``otherData.crossrank`` block) gets the per-rank ledger form."""
+    try:
+        with open(trace_path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        raise PlanError(f"cannot read trace {trace_path}: {e}") from e
+    merged_ranks = None
+    if isinstance(obj, dict):
+        cr = (obj.get("otherData") or {}).get("crossrank")
+        if cr and cr.get("ranks"):
+            # merge contract: each source dump's events carry pid == rank
+            merged_ranks = {int(r): int(r) for r in cr["ranks"]}
+    return attribute(events_from_chrome(obj), source=trace_path,
+                     merged_ranks=merged_ranks)
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,818 @@
+"""dstrace-mp — cross-rank trace merge and collective-skew attribution.
+
+The multi-process half of the observability story: every layer so far
+(dstrace PR 5, ``dstpu plan`` PR 7, dsmem PR 8, serve-plan PR 13) replays
+ONE process's ring. A MULTICHIP run dumps N isolated rings and nobody can
+see *which rank made the collective slow* — the canonical multi-chip
+diagnostic (DeepSpeed comms logger's straggler view, T3-style per-rank
+barrier-wait decomposition, arxiv 2401.16677). This module closes it:
+
+1. **Merge** (``bin/dstpu trace merge r0.json r1.json ...``) — joins
+   per-rank dstrace dumps into ONE Chrome-trace/Perfetto timeline with
+   per-rank track groups (pid = rank). Clocks are aligned by the dumps'
+   monotonic↔wall anchor pairs (the process-identity header the tracer
+   stamps at dump time) when present, else by **matched-collective offset
+   estimation**: the k-th recorded collective carries the same ``op_seq``
+   on every rank (SPMD records in program order), so the median pairwise
+   completion-time delta over the op_seq join IS the clock offset (under
+   blocking semantics collectives complete together). Either way
+   the post-alignment median delta is reported as the **residual skew**
+   per rank — the error bar on every cross-rank duration read off the
+   merged timeline.
+
+   The matched-collective aligner's documented failure mode: a rank that
+   is *systematically* late at every collective (a persistently slow
+   rank) is indistinguishable from a clock offset — the median absorbs
+   it, and the skew ledger under-reports that rank's lateness. Wall
+   anchors (same host, or NTP-disciplined hosts) do not have this
+   failure, which is why they win when present and why
+   ``residual_skew_us`` is always published: a large residual under
+   wall-anchor alignment is real systematic skew, not clock error.
+
+2. **Namespacing** — event ids and tids are only process-unique, and the
+   tracer's synthetic tracks (``COMM_OVERLAP_TID``, per-uid request
+   tracks) use small fixed integers that WOULD collide across ranks. The
+   merge namespaces both as ``(rank << 40) | (id & (2**40 - 1))`` so no
+   two ranks' events can alias, and prefixes every thread label with
+   ``r<rank>/``.
+
+3. **Skew ledger** (``bin/dstpu plan --cross-rank merged.json``) — for
+   every matched collective op@seq: per-rank **arrival** time (span END =
+   when the rank's own contribution to the op completed — a rank that got
+   to the op late ends late, and a rank whose op itself ran slow ends
+   late; both are the lateness everyone else pays for), ``wait =
+   last_arrival − own_arrival`` (what every earlier rank burned blocking
+   on the last one), per-rank wait totals + p50/p99, and the dominant
+   straggler (the rank that *caused* the most wait) per window and
+   overall — tied out against ``StragglerDetector`` verdicts in the
+   MULTICHIP drill. A checked-in workload-scoped
+   ``crossrank_baseline.json`` ratchets each rank's share of caused wait
+   (dslint/plan idiom: regression exit 1, stale expiry only via
+   ``--write-baseline``).
+
+Offline-only, by contract: stdlib-only at module level, file-loadable by
+``bin/dstpu`` on jax-less hosts, listed in ``OFFLINE_ONLY_MODULES``
+(tools/dslint/hotpath.py) — it replays whole dumps and must never ride a
+hot path.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_UNREADABLE = 2
+
+CROSSRANK_VERSION = 1
+CROSSRANK_BASELINE_VERSION = 1
+CROSSRANK_BASELINE_NAME = "crossrank_baseline.json"
+CROSSRANK_ARTIFACT_ENV = "DSTPU_CROSSRANK_ARTIFACT"
+DEFAULT_CROSSRANK_ARTIFACT = "crossrank.json"
+DEFAULT_MERGED_NAME = "merged_trace.json"
+
+#: id/tid namespacing at merge time: rank in the high bits, the original
+#: (process-local) id masked into the low 40. 2**40 monotonic event ids is
+#: far beyond any ring's lifetime, and masking a pointer-sized thread ident
+#: keeps its distinguishing low bits while the rank field guarantees two
+#: RANKS can never alias (the raw idents themselves routinely coincide
+#: across processes — every glibc MainThread lands at a similar address).
+RANK_SHIFT = 40
+RANK_ID_MASK = (1 << RANK_SHIFT) - 1
+
+#: windowing for "dominant straggler per window": collectives separated by
+#: a gap larger than max(10x the median inter-collective gap, 1ms) belong
+#: to different phases (same split rule as attribution's sync-window
+#: synthesis — pauses between phases must not fuse windows)
+WINDOW_SPLIT_GAP_FACTOR = 10.0
+WINDOW_SPLIT_GAP_MIN_US = 1_000.0
+
+#: per-window tie-out: no rank can wait longer than the window it waited
+#: in — a violation means the clock alignment (or the op_seq join) is
+#: garbage and the ledger row is untrustworthy
+TIE_OUT_TOLERANCE = 0.05
+
+
+class CrossRankError(Exception):
+    """Unreadable/unmergeable input — maps to CLI exit code 2."""
+
+
+def quantile(sorted_vals: List[float], q: float) -> float:
+    """Exact sample quantile, the repo-wide rule (``tracer._quantile`` /
+    ``attribution.quantile``): value at ``min(int(q*n), n-1)``. A local
+    copy by the standalone-load contract (this module imports nothing from
+    the package); tests pin the copies equal."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(q * len(sorted_vals)), len(sorted_vals) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# dump loading + identity
+# ---------------------------------------------------------------------------
+def load_dump(path: str) -> dict:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CrossRankError(f"cannot read trace {path}: {e}") from e
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise CrossRankError(f"{path}: not a Chrome trace (no traceEvents)")
+    return obj
+
+
+def dump_identity(obj: dict, fallback_rank: int) -> Dict[str, Any]:
+    """The process-identity header (``Tracer.process_identity``) of one
+    dump, defaulted for pre-header dumps: rank falls back to the dump's
+    POSITION in the merge argument list (stable, documented), anchors to
+    None (matched-collective alignment takes over)."""
+    proc = (obj.get("otherData") or {}).get("process") or {}
+    return {
+        "rank": int(proc.get("rank", fallback_rank)),
+        "world": int(proc.get("world", 0) or 0),
+        "hostname": proc.get("hostname", "?"),
+        "pid": int(proc.get("pid", 0) or 0),
+        "wall_s": proc.get("wall_s"),
+        "monotonic_s": proc.get("monotonic_s"),
+        "epoch_monotonic_s": proc.get("epoch_monotonic_s"),
+    }
+
+
+def _wall_base_us(ident: Dict[str, Any]) -> Optional[float]:
+    """Wall-clock time (us) at the dump's trace epoch (ts == 0), from the
+    header's monotonic↔wall anchor pair — or None for pre-header dumps."""
+    if ident["wall_s"] is None or ident["monotonic_s"] is None \
+            or ident["epoch_monotonic_s"] is None:
+        return None
+    return (float(ident["wall_s"])
+            - (float(ident["monotonic_s"])
+               - float(ident["epoch_monotonic_s"]))) * 1e6
+
+
+def _is_comm(e: dict) -> bool:
+    return e.get("cat") == "comm" or str(e.get("name", "")).startswith("comm/")
+
+
+def _comm_span_arrivals(events: List[dict]) -> Dict[int, float]:
+    """op_seq -> span END ts (us) over one dump's COMPLETE comm spans —
+    the join the offset estimator and the skew ledger both run on.
+
+    The END is the rank's **arrival** at the collective's sync point: the
+    instant its own contribution finished (a rank that got to the op late
+    ends late; a rank whose fabric/op is slow also ends late — both are
+    the lateness everyone else pays for). Under truly blocking semantics
+    exits align, which is exactly why matched END times are the classic
+    clock-offset estimator. In-jit collectives are trace-time instants
+    (no runtime duration exists under XLA scheduling) and never join."""
+    out: Dict[int, float] = {}
+    for e in events:
+        if e.get("ph") != "X" or not _is_comm(e):
+            continue
+        args = e.get("args") or {}
+        if "op_seq" not in args:
+            continue
+        seq = int(args["op_seq"])
+        if seq not in out:        # first occurrence wins (seq is unique)
+            out[seq] = float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+def merge_traces(paths: List[str]) -> dict:
+    """Merge per-rank dstrace dumps into ONE plan-loadable Chrome trace.
+
+    Per-rank track groups: each source dump becomes its own Chrome
+    ``pid`` (= rank), labeled ``rank N (hostname, pid P)``, with every
+    thread re-labeled ``r<N>/<label>``. Clock alignment: wall anchors
+    when every dump has a header, else matched-collective median offset
+    vs the reference rank; residual per-rank skew is measured after
+    alignment either way and published in ``otherData.crossrank``.
+    """
+    if not paths:
+        raise CrossRankError("nothing to merge (no trace paths)")
+    dumps = []
+    for i, path in enumerate(paths):
+        obj = load_dump(path)
+        events = [e for e in obj["traceEvents"] if isinstance(e, dict)]
+        dumps.append({"path": path, "obj": obj, "events": events,
+                      "ident": dump_identity(obj, fallback_rank=i)})
+    # rank uniqueness: duplicate headers (two dumps from the same rank, or
+    # pre-header dumps defaulting to 0) fall back to argument position
+    ranks = [d["ident"]["rank"] for d in dumps]
+    if len(set(ranks)) != len(ranks):
+        for i, d in enumerate(dumps):
+            d["ident"]["rank"] = i
+        rank_note = "duplicate rank headers: ranks reassigned by position"
+    else:
+        rank_note = None
+    dumps.sort(key=lambda d: d["ident"]["rank"])
+    ref = dumps[0]
+    ref_rank = ref["ident"]["rank"]
+
+    wall_bases = {d["ident"]["rank"]: _wall_base_us(d["ident"])
+                  for d in dumps}
+    use_wall = all(b is not None for b in wall_bases.values())
+    ref_starts = _comm_span_arrivals(ref["events"])
+
+    offsets: Dict[int, float] = {}
+    residual: Dict[int, Optional[float]] = {}
+    joined: Dict[int, int] = {}
+    unaligned: List[int] = []
+    for d in dumps:
+        rank = d["ident"]["rank"]
+        arrivals = _comm_span_arrivals(d["events"]) if rank != ref_rank \
+            else ref_starts
+        join = arrivals.keys() & ref_starts.keys()
+        joined[rank] = len(join)
+        if use_wall:
+            offsets[rank] = wall_bases[rank] - wall_bases[ref_rank]
+        elif rank == ref_rank:
+            offsets[rank] = 0.0
+        elif join:
+            # the median matched-collective completion delta IS the clock
+            # offset (robust to the minority of genuinely-late ops); see
+            # the module docstring for the systematic-skew caveat
+            deltas = sorted(arrivals[s] - ref_starts[s] for s in join)
+            offsets[rank] = -quantile(deltas, 0.5)
+        else:
+            # no anchors AND no matched spans: this rank's timeline is
+            # UNALIGNED — say so loudly instead of presenting an
+            # arbitrary epoch offset as a perfect (residual 0) alignment
+            offsets[rank] = 0.0
+            unaligned.append(rank)
+        # residual skew: the median aligned completion delta that REMAINS
+        # — under wall anchors this is real systematic lateness; under
+        # matched-collective alignment it is ~0 by construction; None for
+        # an unaligned rank (there is no error bar to report)
+        if rank == ref_rank:
+            residual[rank] = 0.0
+        elif rank in unaligned:
+            residual[rank] = None
+        else:
+            aligned = sorted((arrivals[s] + offsets[rank])
+                             - (ref_starts[s] + offsets[ref_rank])
+                             for s in join)
+            residual[rank] = quantile(aligned, 0.5)
+
+    merged_events: List[dict] = []
+    total = 0
+    for d in dumps:
+        rank = d["ident"]["rank"]
+        off = offsets[rank]
+        labels = {e.get("tid"): (e.get("args") or {}).get("name", "")
+                  for e in d["events"]
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        merged_events.append({
+            "name": "process_name", "ph": "M", "pid": rank,
+            "args": {"name": f"rank {rank} ({d['ident']['hostname']}, "
+                             f"pid {d['ident']['pid']})"}})
+        seen_tids: Dict[int, int] = {}
+        for e in d["events"]:
+            if e.get("ph") == "M":
+                continue
+            raw_tid = int(e.get("tid", 0))
+            ns_tid = seen_tids.get(raw_tid)
+            if ns_tid is None:
+                ns_tid = (rank << RANK_SHIFT) | (raw_tid & RANK_ID_MASK)
+                seen_tids[raw_tid] = ns_tid
+                label = labels.get(raw_tid) or f"thread-{raw_tid}"
+                merged_events.append({
+                    "name": "thread_name", "ph": "M", "pid": rank,
+                    "tid": ns_tid, "args": {"name": f"r{rank}/{label}"}})
+            out = dict(e)
+            out["pid"] = rank
+            out["tid"] = ns_tid
+            out["ts"] = round(float(e.get("ts", 0.0)) + off, 3)
+            args = e.get("args")
+            if isinstance(args, dict) and e.get("ph") != "C":
+                args = dict(args)
+                if "id" in args:
+                    try:
+                        args["id"] = (rank << RANK_SHIFT) | \
+                            (int(args["id"]) & RANK_ID_MASK)
+                    except (TypeError, ValueError):
+                        pass
+                if _is_comm(e):
+                    args["rank"] = rank   # StragglerDetector.ingest_spans
+                out["args"] = args        # + the skew ledger key off this
+            merged_events.append(out)
+            total += 1
+
+    max_residual = max((abs(v) for v in residual.values()
+                        if v is not None), default=0.0)
+    if use_wall:
+        alignment = "wall_anchor"
+    elif len(unaligned) == len(dumps) - 1 and len(dumps) > 1:
+        alignment = "none"        # nothing aligned anything
+    else:
+        alignment = "matched_collectives"
+    crossrank = {
+        "ranks": [d["ident"]["rank"] for d in dumps],
+        "reference_rank": ref_rank,
+        "alignment": alignment,
+        "offsets_us": {str(r): round(v, 3) for r, v in offsets.items()},
+        "residual_skew_us": {str(r): (round(v, 3) if v is not None
+                                      else None)
+                             for r, v in residual.items()},
+        "max_residual_skew_us": round(max_residual, 3),
+        "matched_collectives": {str(r): n for r, n in joined.items()},
+        "sources": {str(d["ident"]["rank"]):
+                    {"path": os.path.basename(d["path"]),
+                     "hostname": d["ident"]["hostname"],
+                     "pid": d["ident"]["pid"],
+                     "world": d["ident"]["world"]} for d in dumps},
+    }
+    if unaligned:
+        crossrank["unaligned_ranks"] = unaligned
+    if rank_note:
+        crossrank["note"] = rank_note
+    return {
+        "traceEvents": merged_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "clock": "monotonic",
+            "events": total,
+            "crossrank": crossrank,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# matched collectives + skew ledger
+# ---------------------------------------------------------------------------
+def _matched_with_mismatches(obj: dict
+                             ) -> Tuple[Dict[int, Dict[str, Any]], int]:
+    events = obj.get("traceEvents") or []
+    by_seq: Dict[int, Dict[str, Any]] = {}
+    mismatches = 0
+    for e in events:
+        if not isinstance(e, dict) or e.get("ph") != "X" or not _is_comm(e):
+            continue
+        args = e.get("args") or {}
+        if "op_seq" not in args or "rank" not in args:
+            continue
+        seq, rank = int(args["op_seq"]), int(args["rank"])
+        ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
+        rec = by_seq.setdefault(seq, {"op": e.get("name"), "ranks": {}})
+        if rec["op"] != e.get("name"):
+            rec["mismatch"] = True
+            continue
+        if rank not in rec["ranks"]:      # seq unique per rank: first wins
+            rec["ranks"][rank] = {"start_us": ts, "end_us": ts + dur,
+                                  "dur_us": dur}
+    out = {}
+    for seq, rec in by_seq.items():
+        if rec.pop("mismatch", False):
+            mismatches += 1
+            continue
+        if len(rec["ranks"]) >= 2:
+            out[seq] = rec
+    return dict(sorted(out.items())), mismatches
+
+
+def matched_collectives(obj: dict) -> Dict[int, Dict[str, Any]]:
+    """``{op_seq: {"op": name, "ranks": {rank: {"start_us", "end_us",
+    "dur_us"}}}}`` over a MERGED dump's complete comm spans — the ledger's
+    input, exposed so tests can feed the same durations straight into a
+    ``StragglerDetector``. Seqs whose op NAME disagrees across ranks are
+    dropped (a misaligned join must not fabricate waits)."""
+    return _matched_with_mismatches(obj)[0]
+
+
+def attribute_crossrank(obj: dict, source: str = "<merged>"
+                        ) -> Dict[str, Any]:
+    """Replay a merged dump into the collective-skew ledger.
+
+    Per matched op@seq: per-rank **arrival** (span END — when the rank's
+    contribution to the collective completed), ``wait = last_arrival −
+    own_arrival`` (the time every earlier rank burned blocking on the
+    last one; the last arrival waits 0 and is the collective's
+    **straggler**). Windows split at large inter-collective gaps; each
+    window reports per-rank waited/caused totals, its dominant straggler,
+    and a tie-out check (no rank waits longer than the window —
+    violations mean the alignment or the join is broken, and the row is
+    flagged, not trusted)."""
+    cr = (obj.get("otherData") or {}).get("crossrank") or {}
+    matched, mismatches = _matched_with_mismatches(obj)
+    ranks = sorted({r for rec in matched.values() for r in rec["ranks"]})
+    if not ranks and cr.get("ranks"):
+        ranks = sorted(int(r) for r in cr["ranks"])
+
+    collectives = []
+    for seq, rec in matched.items():
+        arrivals = {r: v["end_us"] for r, v in rec["ranks"].items()}
+        last = max(arrivals.values())
+        straggler = max(sorted(arrivals), key=lambda r: arrivals[r])
+        waits = {r: last - a for r, a in arrivals.items()}
+        collectives.append({
+            "seq": seq,
+            "op": rec["op"],
+            "arrivals_us": {str(r): round(a, 3)
+                            for r, a in sorted(arrivals.items())},
+            "waits_us": {str(r): round(w, 3)
+                         for r, w in sorted(waits.items())},
+            "straggler": straggler,
+            "wait_total_us": round(sum(waits.values()), 3),
+        })
+    collectives.sort(key=lambda c: min(
+        float(v) for v in c["arrivals_us"].values()))
+
+    # windowing on first-arrival times (attribution's gap-split rule)
+    windows: List[Dict[str, Any]] = []
+    if collectives:
+        firsts = [min(float(v) for v in c["arrivals_us"].values())
+                  for c in collectives]
+        gaps = sorted(max(b - a, 0.0) for a, b in zip(firsts, firsts[1:]))
+        med_gap = gaps[len(gaps) // 2] if gaps else 0.0
+        cut = max(med_gap * WINDOW_SPLIT_GAP_FACTOR, WINDOW_SPLIT_GAP_MIN_US)
+        runs: List[List[int]] = [[0]]
+        for i in range(1, len(collectives)):
+            if firsts[i] - firsts[i - 1] > cut:
+                runs.append([])
+            runs[-1].append(i)
+        for run in runs:
+            sub = [collectives[i] for i in run]
+            w0 = min(min(float(v) for v in c["arrivals_us"].values())
+                     for c in sub)
+            w1 = max(max(float(v) for v in c["arrivals_us"].values())
+                     for c in sub)
+            waited = {r: 0.0 for r in ranks}
+            caused = {r: 0.0 for r in ranks}
+            for c in sub:
+                for r_str, w in c["waits_us"].items():
+                    waited[int(r_str)] = waited.get(int(r_str), 0.0) + w
+                caused[c["straggler"]] = caused.get(c["straggler"], 0.0) \
+                    + c["wait_total_us"]
+            dur = w1 - w0
+            worst = max(waited.values(), default=0.0)
+            windows.append({
+                "start_us": round(w0, 3),
+                "dur_us": round(dur, 3),
+                "collectives": len(sub),
+                "waited_us": {str(r): round(v, 3)
+                              for r, v in sorted(waited.items())},
+                "caused_us": {str(r): round(v, 3)
+                              for r, v in sorted(caused.items())},
+                "dominant_straggler": max(
+                    sorted(caused), key=lambda r: caused[r]) if sub else None,
+                # no rank can wait longer than the window it waited in
+                "tie_out_error": round(max(worst - dur, 0.0) / dur, 6)
+                if dur > 0 else 0.0,
+            })
+
+    per_rank: Dict[str, Dict[str, float]] = {}
+    total_caused = sum(c["wait_total_us"] for c in collectives) or 0.0
+    for r in ranks:
+        own_waits = sorted(float(c["waits_us"].get(str(r), 0.0))
+                           for c in collectives)
+        caused_us = sum(c["wait_total_us"] for c in collectives
+                        if c["straggler"] == r)
+        straggled = sum(1 for c in collectives if c["straggler"] == r)
+        per_rank[str(r)] = {
+            "waited_us": round(sum(own_waits), 3),
+            "caused_us": round(caused_us, 3),
+            "wait_share": round(caused_us / total_caused, 4)
+            if total_caused > 0 else 0.0,
+            "straggled": straggled,
+            "wait_p50_us": round(quantile(own_waits, 0.5), 3),
+            "wait_p99_us": round(quantile(own_waits, 0.99), 3),
+        }
+    dominant = None
+    if per_rank and total_caused > 0:
+        dominant = int(max(sorted(per_rank),
+                           key=lambda r: per_rank[r]["caused_us"]))
+    return {
+        "version": CROSSRANK_VERSION,
+        "source": source,
+        "ranks": ranks,
+        "alignment": cr.get("alignment"),
+        "reference_rank": cr.get("reference_rank"),
+        "residual_skew_us": cr.get("residual_skew_us", {}),
+        "max_residual_skew_us": cr.get("max_residual_skew_us", 0.0),
+        "unaligned_ranks": cr.get("unaligned_ranks", []),
+        "matched": len(collectives),
+        "seq_mismatches": mismatches,
+        "collectives": collectives,
+        "windows": windows,
+        "per_rank": per_rank,
+        "wait_total_us": round(total_caused, 3),
+        "dominant_straggler": dominant,
+    }
+
+
+def analyze_crossrank_path(path: str) -> Dict[str, Any]:
+    """Load + attribute a merged dump in one call (env_report / tests)."""
+    return attribute_crossrank(load_dump(path), source=path)
+
+
+# ---------------------------------------------------------------------------
+# regression baseline (dslint/plan ratchet idiom)
+# ---------------------------------------------------------------------------
+def load_crossrank_baseline(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != CROSSRANK_BASELINE_VERSION:
+        raise ValueError(f"unsupported crossrank baseline version "
+                         f"{data.get('version')!r} in {path} "
+                         f"(expected {CROSSRANK_BASELINE_VERSION})")
+    return data
+
+
+def find_crossrank_baseline(start: str) -> Optional[str]:
+    """Walk up from ``start`` for the checked-in baseline (dslint rule)."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, CROSSRANK_BASELINE_NAME)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def write_crossrank_baseline(path: str, report: Dict[str, Any],
+                             tolerance: float = 2.0,
+                             min_abs_share: float = 0.10,
+                             min_abs_ms: float = 0.05) -> dict:
+    """Record each rank's caused-wait share + p99 own-wait as the new
+    baseline, workload-scoped by the merged trace's basename (discovered
+    baselines only judge traces of the same workload)."""
+    data = {
+        "version": CROSSRANK_BASELINE_VERSION,
+        "workload": os.path.basename(str(report.get("source", ""))),
+        "tolerance": float(tolerance),
+        "min_abs_share": float(min_abs_share),
+        "min_abs_ms": float(min_abs_ms),
+        "entries": {
+            r: {"wait_share": rec["wait_share"],
+                "wait_p99_ms": round(rec["wait_p99_us"] / 1e3, 4)}
+            for r, rec in sorted(report.get("per_rank", {}).items())},
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def check_crossrank_baseline(report: Dict[str, Any], baseline: dict,
+                             tolerance: Optional[float] = None
+                             ) -> Tuple[List[dict], List[dict]]:
+    """(regressions, stale). A rank REGRESSES when its caused-wait share
+    (or p99 own-wait) exceeds baseline * tolerance AND by more than the
+    absolute floor; improvements past the same margin are STALE entries
+    that must expire via ``--write-baseline`` (the ratchet)."""
+    tol = float(tolerance if tolerance is not None
+                else baseline.get("tolerance", 2.0))
+    share_floor = float(baseline.get("min_abs_share", 0.10))
+    ms_floor = float(baseline.get("min_abs_ms", 0.05))
+    regressions, stale = [], []
+    for rank, entry in sorted(baseline.get("entries", {}).items()):
+        cur_rec = report.get("per_rank", {}).get(rank)
+        if cur_rec is None:
+            continue
+        for metric, floor, cur in (
+                ("wait_share", share_floor, cur_rec["wait_share"]),
+                ("wait_p99_ms", ms_floor, cur_rec["wait_p99_us"] / 1e3)):
+            base = float(entry.get(metric, 0.0))
+            row = {"rank": rank, "metric": metric,
+                   "baseline": round(base, 4), "current": round(cur, 4),
+                   "ratio": round(cur / base, 3) if base > 0 else None}
+            if cur > base * tol and (cur - base) > floor:
+                regressions.append(row)
+            elif base > cur * tol and (base - cur) > floor:
+                stale.append(row)
+    return regressions, stale
+
+
+# ---------------------------------------------------------------------------
+# rendering + CLIs
+# ---------------------------------------------------------------------------
+def render(report: Dict[str, Any], top: int = 10) -> str:
+    out = []
+    out.append(f"dstpu plan --cross-rank — {report['source']}")
+    res = report.get("residual_skew_us") or {}
+    out.append(f"ranks {report['ranks']} | alignment "
+               f"{report.get('alignment') or 'unknown'} (reference rank "
+               f"{report.get('reference_rank')}), max residual skew "
+               f"{report.get('max_residual_skew_us', 0.0):.1f}us | "
+               f"{report['matched']} matched collectives"
+               + (f", {report['seq_mismatches']} seq mismatches dropped"
+                  if report.get("seq_mismatches") else ""))
+    out.append("")
+    out.append(f"{'rank':>5} {'waited ms':>10} {'caused ms':>10} "
+               f"{'share':>7} {'straggled':>10} {'p50 wait':>10} "
+               f"{'p99 wait':>10} {'resid us':>9}")
+    out.append("-" * 78)
+    for r, rec in sorted(report.get("per_rank", {}).items(),
+                         key=lambda kv: int(kv[0])):
+        out.append(f"{r:>5} {rec['waited_us'] / 1e3:>10.3f} "
+                   f"{rec['caused_us'] / 1e3:>10.3f} "
+                   f"{rec['wait_share'] * 100:>6.1f}% "
+                   f"{rec['straggled']:>10} "
+                   f"{rec['wait_p50_us'] / 1e3:>9.3f}ms "
+                   f"{rec['wait_p99_us'] / 1e3:>9.3f}ms "
+                   f"{float(res.get(r) or 0.0):>9.1f}")
+    if report.get("dominant_straggler") is not None:
+        dom = report["dominant_straggler"]
+        caused_ms = report["per_rank"][str(dom)]["caused_us"] / 1e3
+        out.append("")
+        out.append(f"dominant straggler: rank {dom} (caused "
+                   f"{caused_ms:.3f}ms of "
+                   f"{report['wait_total_us'] / 1e3:.3f}ms total wait)")
+    if report.get("windows"):
+        out.append("")
+        out.append(f"{'window':>7} {'ms':>9} {'collectives':>12} "
+                   f"{'dominant':>9}   tie-out")
+        out.append("-" * 48)
+        for i, w in enumerate(report["windows"][:top]):
+            out.append(f"{i:>7} {w['dur_us'] / 1e3:>9.2f} "
+                       f"{w['collectives']:>12} "
+                       f"{str(w['dominant_straggler']):>9}   "
+                       f"{w['tie_out_error'] * 100:.2f}%")
+        if len(report["windows"]) > top:
+            out.append(f"... {len(report['windows']) - top} more windows")
+    worst = sorted(report.get("collectives", []),
+                   key=lambda c: -c["wait_total_us"])[:top]
+    if worst:
+        out.append("")
+        out.append("worst collectives (op@seq: total wait, straggler)")
+        for c in worst:
+            out.append(f"  {c['op']}@{c['seq']:<6} "
+                       f"{c['wait_total_us'] / 1e3:>9.3f}ms  "
+                       f"rank {c['straggler']}")
+    return "\n".join(out)
+
+
+def merge_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu trace merge",
+        description="merge per-rank dstrace dumps into one Perfetto "
+                    "timeline with per-rank track groups and aligned "
+                    "clocks (feeds `dstpu plan --cross-rank`)")
+    parser.add_argument("traces", nargs="+",
+                        help="per-rank Chrome-trace JSON dumps "
+                             "(DSTPU_TRACE output, one per rank)")
+    parser.add_argument("--out", default=DEFAULT_MERGED_NAME,
+                        help=f"merged trace path "
+                             f"(default ./{DEFAULT_MERGED_NAME})")
+    parser.add_argument("--json", action="store_true",
+                        help="print the crossrank summary as JSON")
+    args = parser.parse_args(argv)
+    try:
+        merged = merge_traces(args.traces)
+    except CrossRankError as e:
+        print(f"dstpu trace merge: {e}", file=sys.stderr)
+        return EXIT_UNREADABLE
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    cr = merged["otherData"]["crossrank"]
+    if cr.get("unaligned_ranks"):
+        print(f"WARNING: ranks {cr['unaligned_ranks']} have no clock "
+              "anchors AND no matched collectives — their timelines are "
+              "UNALIGNED (epoch-relative only); cross-rank deltas "
+              "involving them are meaningless", file=sys.stderr)
+    if args.json:
+        print(json.dumps(cr, indent=2))
+    else:
+        print(f"# merged {len(args.traces)} dumps -> {args.out} "
+              f"(ranks {cr['ranks']}, alignment {cr['alignment']}, "
+              f"max residual skew {cr['max_residual_skew_us']:.1f}us, "
+              f"load in https://ui.perfetto.dev)")
+    return EXIT_OK
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu plan --cross-rank",
+        description="collective-skew attribution over a merged cross-rank "
+                    "dstrace dump (produce one with `dstpu trace merge "
+                    "r0.json r1.json ...`)")
+    parser.add_argument("trace", help="merged Chrome-trace JSON")
+    parser.add_argument("--baseline", default=None,
+                        help=f"crossrank baseline path (default: walk up "
+                             f"from the trace for {CROSSRANK_BASELINE_NAME})")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record this report as the new baseline "
+                             "(ratchet: also how stale entries expire)")
+    parser.add_argument("--tolerance", type=float, default=None,
+                        help="regression factor vs baseline (default: the "
+                             "stored factor, 2.0 when writing fresh)")
+    parser.add_argument("--out", default=None,
+                        help="write the full artifact JSON here "
+                             f"(env_report reads ${CROSSRANK_ARTIFACT_ENV} "
+                             f"or ./{DEFAULT_CROSSRANK_ARTIFACT})")
+    parser.add_argument("--json", action="store_true",
+                        help="print the report as JSON instead of a table")
+    parser.add_argument("--top", type=int, default=10,
+                        help="windows / worst collectives to show")
+    args = parser.parse_args(argv)
+
+    try:
+        report = analyze_crossrank_path(args.trace)
+    except CrossRankError as e:
+        print(f"dstpu plan --cross-rank: {e}", file=sys.stderr)
+        return EXIT_UNREADABLE
+
+    # baseline discovery anchors at the TRACE path (plan/dslint rule): a
+    # merged dump outside the repo is a different workload
+    bl_path = args.baseline or find_crossrank_baseline(args.trace)
+    regressions, stale = [], []
+    effective_tol = args.tolerance if args.tolerance is not None else 2.0
+    if args.write_baseline:
+        trace_dir = os.path.dirname(os.path.abspath(args.trace))
+        target = bl_path or os.path.join(trace_dir, CROSSRANK_BASELINE_NAME)
+        if args.baseline is None and os.path.exists(target):
+            try:    # never clobber a DISCOVERED other-workload baseline
+                existing_wl = load_crossrank_baseline(target).get("workload")
+            except (OSError, ValueError):
+                existing_wl = None
+            if existing_wl and existing_wl != os.path.basename(args.trace):
+                redirected = os.path.join(trace_dir, CROSSRANK_BASELINE_NAME)
+                if os.path.abspath(redirected) == os.path.abspath(target):
+                    print(f"# refusing --write-baseline: {target} ratchets "
+                          f"workload {existing_wl!r} — pass --baseline PATH "
+                          "to overwrite deliberately", file=sys.stderr)
+                    target = None
+                else:
+                    print(f"# note: {target} ratchets workload "
+                          f"{existing_wl!r} — starting this workload's "
+                          f"baseline at {redirected} instead",
+                          file=sys.stderr)
+                    target = redirected
+        if target is not None:
+            if args.tolerance is None and os.path.exists(target):
+                try:    # ratchet rewrite keeps the stored factor
+                    effective_tol = float(load_crossrank_baseline(target)
+                                          .get("tolerance", 2.0))
+                except (OSError, ValueError):
+                    pass
+            write_crossrank_baseline(target, report,
+                                     tolerance=effective_tol)
+            print(f"# crossrank baseline written -> {target}",
+                  file=sys.stderr)
+        bl_path = target
+    elif bl_path:
+        try:
+            baseline = load_crossrank_baseline(bl_path)
+        except (OSError, ValueError) as e:
+            print(f"dstpu plan --cross-rank: bad baseline {bl_path}: {e}",
+                  file=sys.stderr)
+            return EXIT_UNREADABLE
+        bl_workload = baseline.get("workload")
+        trace_workload = os.path.basename(args.trace)
+        if args.baseline is None and bl_workload \
+                and bl_workload != trace_workload:
+            print(f"# note: discovered baseline {bl_path} is for workload "
+                  f"{bl_workload!r}, not {trace_workload!r} — comparison "
+                  "skipped (pass --baseline to compare anyway)",
+                  file=sys.stderr)
+            bl_path = None
+        else:
+            regressions, stale = check_crossrank_baseline(
+                report, baseline, tolerance=args.tolerance)
+            effective_tol = args.tolerance if args.tolerance is not None \
+                else float(baseline.get("tolerance", 2.0))
+    report["baseline"] = {"path": bl_path, "regressions": regressions,
+                          "stale": stale}
+
+    violations = [i for i, w in enumerate(report["windows"])
+                  if w["tie_out_error"] > TIE_OUT_TOLERANCE]
+    report["tie_out_violations"] = violations
+    for idx in violations:
+        w = report["windows"][idx]
+        print(f"WARNING: window {idx} has a rank waiting "
+              f"{w['tie_out_error'] * 100:.1f}% longer than the window "
+              f"(> {TIE_OUT_TOLERANCE * 100:.0f}% tolerance) — broken "
+              "clock alignment or op_seq join; treat its row as suspect",
+              file=sys.stderr)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report, top=args.top))
+        for r in regressions:
+            print(f"REGRESSION: rank {r['rank']} {r['metric']} "
+                  f"{r['baseline']} -> {r['current']} ({r['ratio']}x, "
+                  f"tolerance {effective_tol}x) vs {bl_path}",
+                  file=sys.stderr)
+        for r in stale:
+            print(f"stale baseline entry (improved): rank {r['rank']} "
+                  f"{r['metric']} {r['baseline']} -> {r['current']} — "
+                  "re-run with --write-baseline to ratchet",
+                  file=sys.stderr)
+    return EXIT_REGRESSION if regressions else EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,63 @@ def step_time_bounds(events: List[dict],
     return lo, hi
 
 
+def rank_pids(events: List[dict]) -> Dict[int, int]:
+    """pid -> rank from a MERGED dump's process metadata (``dstpu trace
+    merge`` labels every source dump ``rank N (host, pid P)`` and keys its
+    events by pid = rank)."""
+    out: Dict[int, int] = {}
+    for e in events:
+        if e.get("ph") != "M" or e.get("name") != "process_name":
+            continue
+        label = (e.get("args") or {}).get("name", "")
+        if label.startswith("rank "):
+            try:
+                out[e.get("pid")] = int(label.split()[1])
+            except (ValueError, IndexError):
+                continue
+    return out
+
+
+def filter_rank(events: List[dict], rank: int) -> List[dict]:
+    """``--rank N`` — one rank's story out of a merged cross-rank dump:
+    every event on that rank's tracks PLUS the *matched* collective spans
+    of the other ranks (same ``op_seq``), so the slice still shows who the
+    rank was waiting on. Stays plan-loadable Chrome JSON."""
+    rank = int(rank)
+    pids = {pid for pid, r in rank_pids(events).items() if r == rank}
+    if not pids:
+        known = sorted(set(rank_pids(events).values()))
+        raise ValueError(f"no rank {rank} in trace (merged ranks: {known}; "
+                         "produce a merged dump with `dstpu trace merge`)")
+
+    def _comm_seq(e):
+        if e.get("ph") != "X":
+            return None
+        name = e.get("name", "")
+        if e.get("cat") != "comm" and not name.startswith("comm/"):
+            return None
+        return (e.get("args") or {}).get("op_seq")
+
+    own_seqs = {_comm_seq(e) for e in events
+                if e.get("pid") in pids and _comm_seq(e) is not None}
+    out = []
+    for e in events:
+        if e.get("ph") == "M":
+            # keep every rank's process label (matched spans from other
+            # ranks still group under a named track) but only THIS rank's
+            # thread labels — the other ranks' threads are out of scope
+            if e.get("name") == "process_name" or e.get("pid") in pids:
+                out.append(e)
+            continue
+        if e.get("pid") in pids:
+            out.append(e)
+            continue
+        seq = _comm_seq(e)
+        if seq is not None and seq in own_seqs:
+            out.append(e)      # the matched half of this rank's collectives
+    return out
+
+
 def filter_request(events: List[dict], uid: int) -> List[dict]:
     """``--request UID`` — one serving request's story: its queued/
     prefill/decode retro-spans (the synthetic ``request-UID`` track plus
@@ -242,6 +299,10 @@ def main(argv=None) -> int:
     parser.add_argument("--track", default=None, metavar="NAME",
                         help="slice to one Perfetto track by thread label "
                              "(e.g. MainThread, request-7) or raw tid")
+    parser.add_argument("--rank", default=None, metavar="N", type=int,
+                        help="slice a merged cross-rank dump to one rank's "
+                             "tracks plus its matched collective spans "
+                             "(produce one with `dstpu trace merge`)")
     parser.add_argument("--request", default=None, metavar="UID", type=int,
                         help="slice to one serving request: its retro-"
                              "spans plus intersecting serve ticks / "
@@ -256,6 +317,8 @@ def main(argv=None) -> int:
         print(f"dstpu_trace: cannot read {args.trace}: {e}", file=sys.stderr)
         return 2
     try:
+        if args.rank is not None:
+            events = filter_rank(events, args.rank)
         if args.step_range:
             events = filter_step_range(events, args.step_range)
         if args.request is not None:

@@ -34,6 +34,7 @@ import collections
 import itertools
 import json
 import os
+import socket
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -43,6 +44,13 @@ from deepspeed_tpu.utils.logging import logger
 TRACE_ENV = "DSTPU_TRACE"
 TRACE_CAPACITY_ENV = "DSTPU_TRACE_CAPACITY"
 DEFAULT_CAPACITY = 65536
+
+#: env fallbacks for the process-identity header (``set_process_identity``
+#: is the programmatic form — ``comm.mesh.init_distributed`` stamps it at
+#: rendezvous, which covers every MULTICHIP worker; the env form covers
+#: launchers that know the rank before the process does)
+TRACE_RANK_ENV = "DSTPU_TRACE_RANK"
+TRACE_WORLD_ENV = "DSTPU_TRACE_WORLD"
 
 #: synthetic tid range for per-request serving tracks — renders one Perfetto
 #: track per request uid. Real thread idents are pointer-sized (far above
@@ -127,6 +135,13 @@ class Tracer:
         self._lock = threading.Lock()         # export/config only, never emit
         self._cleared = 0                     # events discarded by clear()
         self._sink: Optional[Callable[[str, int], None]] = None
+        # process identity for cross-rank merge (``dstpu trace merge``):
+        # rank/world default from env, re-stampable at rendezvous time
+        try:
+            self._rank = int(os.environ.get(TRACE_RANK_ENV, 0))
+            self._world = int(os.environ.get(TRACE_WORLD_ENV, 1))
+        except ValueError:
+            self._rank, self._world = 0, 1
 
     # ------------------------------------------------------------------
     # configuration
@@ -151,6 +166,32 @@ class Tracer:
     @property
     def capacity(self) -> int:
         return self._events.maxlen
+
+    def set_process_identity(self, rank: int, world: int) -> None:
+        """Stamp this process's rank/world into every future dump header
+        (``comm.mesh.init_distributed`` calls this at rendezvous — config
+        time, never the hot path). The header is what ``dstpu trace merge``
+        joins per-rank dumps on; without it a dump merges as rank 0 of 1."""
+        self._rank = int(rank)
+        self._world = int(world)
+
+    def process_identity(self) -> Dict[str, Any]:
+        """The dump header: who emitted this trace and a FRESH monotonic↔
+        wall anchor pair (same instant, both clocks) so a merger can place
+        this dump's monotonic timeline on the shared wall clock. Stamped at
+        dump time — anchors age badly; a dump-time pair bounds NTP drift to
+        the run's tail, not its whole life."""
+        return {
+            "rank": self._rank,
+            "world": self._world,
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            # one anchor pair, read back-to-back: wall_s - monotonic_s maps
+            # any event ts (epoch-relative monotonic) onto the wall clock
+            "monotonic_s": time.monotonic(),
+            "wall_s": time.time(),
+            "epoch_monotonic_s": self._epoch,
+        }
 
     def clear(self) -> None:
         with self._lock:
@@ -302,8 +343,11 @@ class Tracer:
             else:
                 ev["args"] = dict(args, id=eid) if args else {"id": eid}
             trace_events.append(ev)
+        identity = self.process_identity()
+        proc_label = "deepspeed_tpu" if identity["world"] <= 1 else \
+            f"deepspeed_tpu rank{identity['rank']}/{identity['world']}"
         meta = [{"name": "process_name", "ph": "M", "pid": pid,
-                 "args": {"name": "deepspeed_tpu"}}]
+                 "args": {"name": proc_label}}]
         meta.extend({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": tid, "args": {"name": label}}
                     for tid, label in sorted(seen_tids.items()))
@@ -315,6 +359,9 @@ class Tracer:
                 "events": len(events),
                 "dropped": self.dropped(),
                 "capacity": self._events.maxlen,
+                # the cross-rank join key: which process this dump is, and
+                # the clock anchor that places it on the shared wall clock
+                "process": identity,
             },
         }
 

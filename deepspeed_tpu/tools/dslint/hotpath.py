@@ -209,7 +209,11 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
     HotPathSpec(
         path="deepspeed_tpu/comm/guard.py",
         cls=None,
-        hot_functions=("note_comm_op",),
+        # next_op_seq allocates the cross-rank comm sequence number inside
+        # the collective facade's _record (trace time under jit, per call
+        # eager) — registering it PROVES op_seq stamping is one C-level
+        # counter increment, never a host sync
+        hot_functions=("note_comm_op", "next_op_seq"),
     ),
     HotPathSpec(
         path="deepspeed_tpu/resilience/membership.py",
@@ -227,6 +231,16 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         cls="MemorySampler",
         hot_functions=("on_drain", "sample", "_collect"),
     ),
+    # the compile-event ledger's dispatch wrapper rides EVERY watched jit
+    # dispatch (train step, serving prefill/decode/sample) — registering
+    # it PROVES compile detection is one C-level cache-size probe per
+    # call, never a readback; the signature builder runs only on the
+    # compile (slow) path and reads .shape/.dtype attributes, never data
+    HotPathSpec(
+        path="deepspeed_tpu/telemetry/compiles.py",
+        cls="CompileWatched",
+        hot_functions=("__call__",),
+    ),
 )
 
 #: the inverse registry: modules that must NEVER run on (or be imported
@@ -241,4 +255,8 @@ OFFLINE_ONLY_MODULES: Tuple[str, ...] = (
     # the serving-tick replay (`dstpu plan --serve`) — same contract:
     # stdlib-only, file-loadable on jax-less hosts, never on a hot path
     "deepspeed_tpu/telemetry/serve_attribution.py",
+    # the cross-rank merge + skew ledger (`dstpu trace merge` / `dstpu
+    # plan --cross-rank`) — replays N whole dumps at once; strictly
+    # offline, stdlib-only, jax-less-host loadable
+    "deepspeed_tpu/telemetry/crossrank.py",
 )

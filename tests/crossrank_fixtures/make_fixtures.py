@@ -1,0 +1,155 @@
+"""Regenerate the checked-in cross-rank fixtures for tests/test_crossrank.py.
+
+Run from the repo root (pure stdlib — the fixtures are synthetic
+exact-microsecond dumps, deterministic by construction on any host):
+
+    python tests/crossrank_fixtures/make_fixtures.py
+
+The artifact set (fixtures + baseline move TOGETHER; the regeneration pin
+test fails if they drift):
+
+  rank0_trace.json    rank 0's dstrace dump: 12 guarded comm spans with
+                      op_seq 1..12, 12 dispatch spans, an in-jit comm
+                      instant, plus the synthetic comm-overlap (tid
+                      900000) and request-7 (tid 1000007) tracks that
+                      exist IDENTICALLY on both ranks — the tid-collision
+                      case the merge must namespace apart
+  rank1_trace.json    rank 1's dump: same program, but ops 7..12 COMPLETE
+                      2000us late (duration stretched — the chaos
+                      comm_delay shape: the delay rides inside the span,
+                      so rank 1 is the straggler on the back half) and
+                      dispatch runs 2ms slower
+  merged_micro.json   `merge_traces([rank0, rank1])` output — wall-anchor
+                      aligned, per-rank pids, namespaced tids/event-ids
+  ../../crossrank_baseline.json
+                      the repo-root ratchet written from the merged
+                      fixture's skew ledger (workload-scoped to
+                      merged_micro.json), checked in exactly clean
+
+Golden numbers the tests assert (derive, don't measure):
+  12 matched collectives; ops 1..6 tie at arrival (wait 0), ops 7..12
+  rank0 waits 2000us each -> rank0 waited 12000us total, rank1 caused
+  12000us, wait_share rank1 = 1.0, dominant straggler = rank 1; one
+  window (20000us spacing << the 200000us split cut), tie-out 0.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_crossrank():
+    """File-load the stdlib-only analyzer (no package import: regeneration
+    works on jax-less hosts, same contract as bin/dstpu)."""
+    import importlib.util
+    path = os.path.join(REPO, "deepspeed_tpu", "telemetry", "crossrank.py")
+    spec = importlib.util.spec_from_file_location("crossrank_fixgen", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: per-op spacing (us) and the rank-1 lateness on the back half
+OP_SPACING_US = 20_000.0
+DELAY_US = 2_000.0
+N_OPS = 12
+
+#: synthetic tracks present on BOTH ranks (tracer.COMM_OVERLAP_TID and a
+#: request uid 7 track) — the collision case
+OVERLAP_TID = 900_000
+REQUEST_TID = 1_000_007
+MAIN_TID = 7_777
+
+
+def rank_dump(rank: int) -> dict:
+    late = lambda k: DELAY_US if (rank == 1 and k >= 6) else 0.0  # noqa: E731
+    evs = [
+        {"name": "process_name", "ph": "M", "pid": 4000 + rank,
+         "args": {"name": f"deepspeed_tpu rank{rank}/2"}},
+        {"name": "thread_name", "ph": "M", "pid": 4000 + rank,
+         "tid": MAIN_TID, "args": {"name": "MainThread"}},
+        {"name": "thread_name", "ph": "M", "pid": 4000 + rank,
+         "tid": OVERLAP_TID, "args": {"name": "comm-overlap"}},
+        {"name": "thread_name", "ph": "M", "pid": 4000 + rank,
+         "tid": REQUEST_TID, "args": {"name": "request-7"}},
+    ]
+    eid = 1
+    for k in range(N_OPS):
+        base = k * OP_SPACING_US
+        # the chaos comm_delay shape: the delay rides INSIDE the span, so
+        # rank 1's op STARTS on time but COMPLETES (arrives) 2000us late
+        dur = 500.0 + late(k)
+        evs.append({"name": "comm/guarded/drill_allreduce", "cat": "comm",
+                    "ph": "X", "ts": base, "dur": dur, "tid": MAIN_TID,
+                    "args": {"op_seq": k + 1, "call": k, "id": eid}})
+        eid += 1
+        # the training step that produced it (attribution's cross_rank
+        # per-rank ledgers read these)
+        evs.append({"name": "engine/dispatch", "cat": "train", "ph": "X",
+                    "ts": base + 4_000.0,
+                    "dur": 15_000.0 + (2_000.0 if rank == 1 else 0.0),
+                    "tid": MAIN_TID,
+                    "args": {"step": k, "mode": "sync", "id": eid}})
+        eid += 1
+    # in-jit analytic comm instant (zero-duration: must NOT join the skew
+    # ledger, which reads complete spans only)
+    evs.append({"name": "comm/all_reduce", "cat": "comm", "ph": "i",
+                "ts": 1_000.0, "tid": MAIN_TID, "s": "t",
+                "args": {"bytes": 4096, "wire_bytes": 4096, "world": 2,
+                         "kind": "all_reduce", "op_seq": 100 + rank,
+                         "id": eid}})
+    eid += 1
+    # synthetic-track spans with IDENTICAL tids/event-ids on both ranks —
+    # the collision the merge namespaces apart
+    evs.append({"name": "comm/overlap", "cat": "comm", "ph": "X",
+                "ts": 5_000.0, "dur": 800.0, "tid": OVERLAP_TID,
+                "args": {"bucket": 0, "bytes": 2048, "id": 999}})
+    evs.append({"name": "serve/decode", "cat": "serve", "ph": "X",
+                "ts": 6_000.0, "dur": 700.0, "tid": REQUEST_TID,
+                "args": {"uid": 7, "tokens": 3, "id": 1000}})
+    return {
+        "traceEvents": evs,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "clock": "monotonic",
+            "events": len(evs),
+            # wall anchors: both ranks' epochs sit at the same wall time
+            # (single-host drill shape) -> wall-anchor offsets are 0 and
+            # every arrival delta in the ledger is REAL skew
+            "process": {"rank": rank, "world": 2, "hostname": "fixture",
+                        "pid": 4000 + rank, "wall_s": 1_000.0,
+                        "monotonic_s": 500.0 + 100.0 * rank,
+                        "epoch_monotonic_s": 400.0 + 100.0 * rank},
+        },
+    }
+
+
+def main():
+    cr = _load_crossrank()
+    paths = []
+    for rank in (0, 1):
+        path = os.path.join(HERE, f"rank{rank}_trace.json")
+        with open(path, "w") as f:
+            json.dump(rank_dump(rank), f, indent=1)
+            f.write("\n")
+        paths.append(path)
+        print(f"wrote {path}")
+    merged = cr.merge_traces(paths)
+    merged_path = os.path.join(HERE, "merged_micro.json")
+    with open(merged_path, "w") as f:
+        json.dump(merged, f, indent=1)
+        f.write("\n")
+    print(f"wrote {merged_path}")
+    report = cr.attribute_crossrank(merged, source=merged_path)
+    bl_path = os.path.join(REPO, cr.CROSSRANK_BASELINE_NAME)
+    cr.write_crossrank_baseline(bl_path, report)
+    print(f"wrote {bl_path} (workload merged_micro.json, "
+          f"dominant straggler rank {report['dominant_straggler']})")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

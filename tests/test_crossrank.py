@@ -1,0 +1,649 @@
+"""dstrace-mp tests — cross-rank trace merge, collective-skew ledger,
+compile-event ledger (ISSUE 15).
+
+Fast tier-1 half: checked-in synthetic fixtures (tests/crossrank_fixtures/
+make_fixtures.py regenerates fixtures + the repo-root crossrank_baseline.json
+as ONE artifact set) drive merge/namespacing/ledger goldens, the CLI exit
+matrix, clock-alignment contracts, the ``--rank`` slice, env_report rows,
+and the compile ledger. The 2-proc gloo MULTICHIP drill (chaos comm_delay
+on rank 1 -> rank 1 dominant in BOTH the ledger and StragglerDetector) is
+marked slow like every harness drill.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.telemetry import crossrank
+
+pytestmark = pytest.mark.crossrank
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "crossrank_fixtures")
+R0 = os.path.join(FIXTURES, "rank0_trace.json")
+R1 = os.path.join(FIXTURES, "rank1_trace.json")
+MERGED = os.path.join(FIXTURES, "merged_micro.json")
+BASELINE = os.path.join(REPO, "crossrank_baseline.json")
+DSTPU = os.path.join(REPO, "bin", "dstpu")
+DSTPU_TRACE = os.path.join(REPO, "bin", "dstpu_trace")
+
+RANK_SHIFT = crossrank.RANK_SHIFT
+
+
+@pytest.fixture
+def tracing():
+    from deepspeed_tpu.telemetry.tracer import get_tracer
+    t = get_tracer()
+    was = t.enabled
+    t.configure(enabled=True)
+    t.clear()
+    yield t
+    t.clear()
+    t.configure(enabled=was)
+
+
+# ---------------------------------------------------------------------------
+# fixtures are one artifact set
+# ---------------------------------------------------------------------------
+def test_fixture_regeneration_pin():
+    """merged_micro.json and crossrank_baseline.json are exactly what
+    make_fixtures.py produces from the rank dumps — fixtures and baseline
+    move together or not at all."""
+    merged = crossrank.merge_traces([R0, R1])
+    with open(MERGED) as f:
+        assert merged == json.load(f)
+    report = crossrank.attribute_crossrank(merged, source=MERGED)
+    import tempfile
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as tmp:
+        crossrank.write_crossrank_baseline(tmp.name, report)
+        regenerated = json.load(open(tmp.name))
+    assert regenerated == json.load(open(BASELINE))
+
+
+def test_baseline_exactly_clean():
+    report = crossrank.analyze_crossrank_path(MERGED)
+    baseline = crossrank.load_crossrank_baseline(BASELINE)
+    assert baseline["workload"] == "merged_micro.json"
+    regressions, stale = crossrank.check_crossrank_baseline(report, baseline)
+    assert regressions == [] and stale == []
+
+
+# ---------------------------------------------------------------------------
+# merge: identity, alignment, namespacing
+# ---------------------------------------------------------------------------
+def test_merge_identity_and_wall_alignment():
+    merged = crossrank.merge_traces([R0, R1])
+    cr = merged["otherData"]["crossrank"]
+    assert cr["ranks"] == [0, 1]
+    assert cr["reference_rank"] == 0
+    assert cr["alignment"] == "wall_anchor"
+    # both fixture epochs sit at the same wall time -> zero offsets, and
+    # the 2000us residual is REAL systematic skew (the back-half delay)
+    assert cr["offsets_us"] == {"0": 0.0, "1": 0.0}
+    assert cr["residual_skew_us"]["1"] == 2000.0
+    assert cr["max_residual_skew_us"] == 2000.0
+    assert cr["matched_collectives"] == {"0": 12, "1": 12}
+    assert cr["sources"]["1"]["hostname"] == "fixture"
+
+
+def test_merge_namespaces_synthetic_tids_no_collision():
+    """The satellite fix: COMM_OVERLAP_TID (900000) and the request-7
+    track exist with IDENTICAL raw tids on both ranks — the merge must
+    namespace them apart as rank<<40 | tid."""
+    merged = crossrank.merge_traces([R0, R1])
+    overlap_tids = {e["tid"] for e in merged["traceEvents"]
+                    if e.get("name") == "comm/overlap"}
+    assert overlap_tids == {900_000, (1 << RANK_SHIFT) | 900_000}
+    req_tids = {e["tid"] for e in merged["traceEvents"]
+                if e.get("name") == "serve/decode"}
+    assert req_tids == {1_000_007, (1 << RANK_SHIFT) | 1_000_007}
+    labels = {(e.get("args") or {}).get("name")
+              for e in merged["traceEvents"]
+              if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert {"r0/comm-overlap", "r1/comm-overlap",
+            "r0/request-7", "r1/request-7"} <= labels
+
+
+def test_merge_namespaces_event_ids_unique():
+    """Event ids are only process-unique; merged args ids must never
+    collide across ranks (rank<<40 | id), including the deliberately
+    identical ids 999/1000 planted on both rank fixtures."""
+    merged = crossrank.merge_traces([R0, R1])
+    ids = [e["args"]["id"] for e in merged["traceEvents"]
+           if e.get("ph") != "M" and isinstance(e.get("args"), dict)
+           and "id" in e["args"]]
+    assert len(ids) == len(set(ids))
+    # per-rank track groups: pid == rank
+    pids = {e.get("pid") for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+
+
+def test_merge_positional_rank_fallback_for_headerless_dumps(tmp_path):
+    """Pre-header dumps (no otherData.process) merge by argument
+    position, never silently as N copies of rank 0."""
+    for i, src in enumerate((R0, R1)):
+        obj = json.load(open(src))
+        del obj["otherData"]["process"]
+        json.dump(obj, open(tmp_path / f"d{i}.json", "w"))
+    merged = crossrank.merge_traces([str(tmp_path / "d0.json"),
+                                     str(tmp_path / "d1.json")])
+    cr = merged["otherData"]["crossrank"]
+    assert cr["ranks"] == [0, 1]
+    assert cr["alignment"] == "matched_collectives"
+
+    # two dumps CLAIMING the same rank (header duplicates) also fall back
+    # to position, with a note — never two track groups labeled rank 0
+    obj = json.load(open(R1))
+    obj["otherData"]["process"]["rank"] = 0
+    json.dump(obj, open(tmp_path / "dup.json", "w"))
+    merged = crossrank.merge_traces([R0, str(tmp_path / "dup.json")])
+    cr = merged["otherData"]["crossrank"]
+    assert cr["ranks"] == [0, 1] and "note" in cr
+
+
+# ---------------------------------------------------------------------------
+# skew ledger goldens
+# ---------------------------------------------------------------------------
+def test_skew_ledger_golden():
+    rep = crossrank.analyze_crossrank_path(MERGED)
+    assert rep["matched"] == 12
+    assert rep["alignment"] == "wall_anchor"
+    assert rep["dominant_straggler"] == 1
+    assert rep["wait_total_us"] == 12_000.0
+    r0, r1 = rep["per_rank"]["0"], rep["per_rank"]["1"]
+    # ops 7..12: rank 1 completes 2000us late -> rank 0 waits 2000us each
+    assert r0["waited_us"] == 12_000.0 and r0["caused_us"] == 0.0
+    assert r1["caused_us"] == 12_000.0 and r1["wait_share"] == 1.0
+    assert r1["straggled"] == 6
+    assert r0["wait_p99_us"] == 2000.0 and r1["wait_p99_us"] == 0.0
+    # one window (20ms spacing << the 200ms split cut), clean tie-out
+    assert len(rep["windows"]) == 1
+    w = rep["windows"][0]
+    assert w["dominant_straggler"] == 1 and w["tie_out_error"] == 0.0
+    assert w["collectives"] == 12
+    # per-collective waits sum consistently with the matched spans
+    assert sum(c["wait_total_us"] for c in rep["collectives"]) \
+        == rep["wait_total_us"]
+
+
+def test_matched_collectives_excludes_injit_instants():
+    """In-jit comm instants (ph 'i') carry op_seq too but have no runtime
+    duration — they must never join the skew ledger."""
+    matched = crossrank.matched_collectives(json.load(open(MERGED)))
+    assert set(matched) == set(range(1, 13))      # spans only, not 100/101
+    assert all(rec["op"] == "comm/guarded/drill_allreduce"
+               for rec in matched.values())
+
+
+def test_window_split_on_large_gaps():
+    """Collectives separated by a phase-sized pause land in separate
+    windows with their own dominant straggler."""
+    merged = copy.deepcopy(json.load(open(MERGED)))
+    for e in merged["traceEvents"]:
+        if e.get("ph") == "M" or "op_seq" not in (e.get("args") or {}):
+            continue
+        if e["args"]["op_seq"] > 6:
+            e["ts"] += 10_000_000.0       # 10s pause before the back half
+    rep = crossrank.attribute_crossrank(merged)
+    assert len(rep["windows"]) == 2
+    assert rep["windows"][0]["dominant_straggler"] == 0   # all ties
+    assert rep["windows"][1]["dominant_straggler"] == 1
+
+
+def test_straggler_detector_ties_out_with_ledger():
+    """The detector's duration-outlier verdict and the ledger's
+    waiter-causer verdict must name the SAME rank on the fixture."""
+    from deepspeed_tpu.resilience.membership import StragglerDetector
+    matched = crossrank.matched_collectives(json.load(open(MERGED)))
+    det = StragglerDetector(factor=3.0)
+    flagged = []
+    for seq, rec in sorted(matched.items()):
+        flagged.extend(det.observe(
+            f"{rec['op']}@{seq}",
+            {r: v["dur_us"] / 1e6 for r, v in rec["ranks"].items()}))
+    assert flagged and set(flagged) == {1}
+    assert crossrank.analyze_crossrank_path(MERGED)["dominant_straggler"] \
+        == 1
+
+
+def test_straggler_detector_flags_two_rank_outlier():
+    """The lower-median fix: with exactly 2 ranks the detector compares
+    against the FASTER rank (the upper median — the slower rank itself —
+    made 2-process stragglers mathematically unflaggable)."""
+    from deepspeed_tpu.resilience.membership import StragglerDetector
+    det = StragglerDetector(factor=3.0)
+    assert det.observe("drill", {0: 0.002, 1: 0.050}) == [1]
+    assert det.observe("drill", {0: 0.002, 1: 0.004}) == []   # under factor
+
+
+def test_matched_collective_alignment_recovers_clock_shift(tmp_path):
+    """An anchor-less dump with a constant clock shift: the median
+    matched-collective delta recovers the offset, and the systematic
+    back-half delay is partially absorbed — the documented failure mode
+    (the ledger under-reports a persistently-late rank without anchors)."""
+    obj = json.load(open(R1))
+    del obj["otherData"]["process"]      # no anchors on rank 1
+    for e in obj["traceEvents"]:
+        if e.get("ph") != "M":
+            e["ts"] += 500_000.0         # +0.5s clock shift
+    shifted = tmp_path / "r1_shifted.json"
+    json.dump(obj, open(shifted, "w"))
+    merged = crossrank.merge_traces([R0, str(shifted)])
+    cr = merged["otherData"]["crossrank"]
+    assert cr["alignment"] == "matched_collectives"
+    # median end-delta over the join: sorted [500000]*6 + [502000]*6 ->
+    # 502000 (the estimator absorbed the 2000us delay into the offset)
+    assert cr["offsets_us"]["1"] == -502_000.0
+    rep = crossrank.attribute_crossrank(merged)
+    # under-attribution, exactly as documented: rank 0 now looks late on
+    # the TIED ops; the ledger still ties out, but the verdict flipped —
+    # the reason wall anchors win when present
+    assert rep["alignment"] == "matched_collectives"
+    assert all(w["tie_out_error"] <= crossrank.TIE_OUT_TOLERANCE
+               for w in rep["windows"])
+
+
+def test_quantile_parity_with_tracer():
+    from deepspeed_tpu.telemetry.tracer import _quantile
+    samples = sorted([0.3, 1.0, 2.5, 2.5, 7.0, 9.9, 11.0])
+    for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+        assert crossrank.quantile(samples, q) == _quantile(samples, q)
+
+
+# ---------------------------------------------------------------------------
+# process-identity header + op_seq stamping
+# ---------------------------------------------------------------------------
+def test_tracer_dump_carries_identity_header(tracing):
+    tracing.set_process_identity(3, 8)
+    try:
+        with tracing.span("x/y"):
+            pass
+        dump = tracing.to_chrome()
+        proc = dump["otherData"]["process"]
+        assert proc["rank"] == 3 and proc["world"] == 8
+        assert proc["pid"] == os.getpid()
+        assert isinstance(proc["hostname"], str) and proc["hostname"]
+        # a monotonic<->wall anchor PAIR stamped at dump time
+        for key in ("monotonic_s", "wall_s", "epoch_monotonic_s"):
+            assert isinstance(proc[key], float)
+        labels = [e["args"]["name"] for e in dump["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert labels == ["deepspeed_tpu rank3/8"]
+    finally:
+        tracing.set_process_identity(0, 1)
+
+
+def test_guarded_ops_carry_monotonic_op_seq(tracing):
+    from deepspeed_tpu.comm.guard import CommGuard, CommGuardConfig
+    guard = CommGuard(CommGuardConfig(enabled=True))
+    guard.run("drill", lambda: 1)
+    guard.run("drill", lambda: 2)
+    seqs = [e[7]["op_seq"] for e in tracing.events_snapshot()
+            if e[1] == "comm/guarded/drill"]
+    assert len(seqs) == 2 and seqs[1] > seqs[0]
+
+
+def test_comm_instant_carries_op_seq(tracing):
+    from deepspeed_tpu.comm.comms_logging import emit_comm_instant
+    emit_comm_instant("all_reduce", 4096, 2, op_seq=41)
+    ev = [e for e in tracing.events_snapshot()
+          if e[1] == "comm/all_reduce"][-1]
+    assert ev[7]["op_seq"] == 41 and ev[7]["bytes"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit matrix, merge subcommand, jax-less load, --rank slice
+# ---------------------------------------------------------------------------
+def _run(args, **kw):
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, cwd=REPO, **kw)
+
+
+def test_cli_clean_exit_zero():
+    proc = _run([DSTPU, "plan", "--cross-rank", MERGED])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dominant straggler: rank 1" in proc.stdout
+    assert "REGRESSION" not in proc.stderr
+
+
+def test_cli_regression_exit_one(tmp_path):
+    """Growing rank 0's waits (rank 0 becomes the late one on the front
+    half) regresses its caused-wait share past tolerance+floor."""
+    merged = copy.deepcopy(json.load(open(MERGED)))
+    for e in merged["traceEvents"]:
+        args = e.get("args") or {}
+        if e.get("ph") == "M" or "op_seq" not in args:
+            continue
+        if args.get("rank") == 0 and args["op_seq"] <= 6:
+            e["dur"] = e.get("dur", 0.0) + 5_000.0    # rank 0 ends late
+    bad = tmp_path / "merged_micro.json"
+    json.dump(merged, open(bad, "w"))
+    proc = _run([DSTPU, "plan", "--cross-rank", str(bad),
+                 "--baseline", BASELINE])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "REGRESSION" in proc.stderr
+    assert "rank 0 wait_share" in proc.stderr
+
+
+def test_cli_unreadable_exit_two(tmp_path):
+    junk = tmp_path / "junk.json"
+    junk.write_text("not json")
+    proc = _run([DSTPU, "plan", "--cross-rank", str(junk)])
+    assert proc.returncode == 2
+
+
+def test_cli_discovered_baseline_skips_other_workload(tmp_path):
+    """A differently-named merged dump must not be judged against the
+    repo's merged_micro baseline via discovery (workload scoping)."""
+    other = tmp_path / "other_workload.json"
+    other.write_text(open(MERGED).read())
+    (tmp_path / crossrank.CROSSRANK_BASELINE_NAME).write_text(
+        open(BASELINE).read())
+    proc = _run([DSTPU, "plan", "--cross-rank", str(other)])
+    assert proc.returncode == 0
+    assert "comparison skipped" in proc.stderr
+
+
+def test_cli_write_baseline_and_stale_expiry(tmp_path):
+    """The ratchet: write a fresh baseline, improve the workload, and the
+    improvement surfaces as a STALE entry (exit 0) until re-written."""
+    merged_path = tmp_path / "drill.json"
+    merged_path.write_text(open(MERGED).read())
+    bl = tmp_path / "bl.json"
+    proc = _run([DSTPU, "plan", "--cross-rank", str(merged_path),
+                 "--write-baseline", "--baseline", str(bl)])
+    assert proc.returncode == 0 and bl.exists()
+    improved = copy.deepcopy(json.load(open(MERGED)))
+    for e in improved["traceEvents"]:
+        args = e.get("args") or {}
+        if e.get("ph") != "M" and "op_seq" in args:
+            e["dur"] = 500.0                      # nobody is late anymore
+    json.dump(improved, open(merged_path, "w"))
+    proc = _run([DSTPU, "plan", "--cross-rank", str(merged_path),
+                 "--baseline", str(bl)])
+    assert proc.returncode == 0
+    assert "stale baseline entry" in proc.stderr
+
+
+def test_trace_merge_cli_roundtrip(tmp_path):
+    out = tmp_path / "merged.json"
+    proc = _run([DSTPU, "trace", "merge", R0, R1, "--out", str(out)])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rep = crossrank.attribute_crossrank(json.load(open(out)))
+    assert rep["dominant_straggler"] == 1
+
+
+def test_crossrank_cli_never_imports_the_package():
+    """`dstpu plan --cross-rank` and `dstpu trace merge` file-load the
+    stdlib-only analyzer — jax-less hosts replay merged dumps."""
+    for args in (["plan", "--cross-rank", MERGED, "--json"],
+                 ["trace", "merge", R0, R1, "--out", os.devnull]):
+        proc = _run(["-X", "importtime", DSTPU] + args)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        imported = [ln for ln in proc.stderr.splitlines()
+                    if "import time:" in ln]
+        assert imported
+        assert not any("deepspeed_tpu" in ln for ln in imported)
+
+
+def test_rank_filter_slices_one_rank_plus_matched_spans(tmp_path):
+    from deepspeed_tpu.telemetry import report as trace_report
+    events = trace_report.load_events(MERGED)
+    sliced = trace_report.filter_rank(events, 1)
+    pids = {e.get("pid") for e in sliced if e.get("ph") != "M"}
+    assert 1 in pids and 0 in pids
+    # rank 0 contributes ONLY its matched collective spans to the slice
+    rank0 = [e for e in sliced if e.get("pid") == 0 and e.get("ph") != "M"]
+    assert rank0 and all("op_seq" in (e.get("args") or {}) for e in rank0)
+    assert not any(e.get("name") == "engine/dispatch" for e in rank0)
+    # the slice stays plan-loadable and the ledger still matches
+    out = tmp_path / "r1_slice.json"
+    trace_report.write_slice(str(out), sliced)
+    proc = _run([DSTPU, "plan", "--cross-rank", str(out), "--json"])
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["dominant_straggler"] == 1
+    with pytest.raises(ValueError, match="merged ranks"):
+        trace_report.filter_rank(events, 9)
+
+
+def test_dstpu_trace_rank_flag(tmp_path):
+    out = tmp_path / "slice.json"
+    proc = _run([DSTPU_TRACE, MERGED, "--rank", "0", "--out", str(out)])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    sliced = json.load(open(out))["traceEvents"]
+    assert any(e.get("pid") == 0 for e in sliced)
+
+
+# ---------------------------------------------------------------------------
+# plan integration + env_report + registries
+# ---------------------------------------------------------------------------
+def test_merged_dump_gets_cross_rank_attribution():
+    """`dstpu plan` (plain) on a merged dump: reference-rank ledger plus
+    per-rank stage ledgers + the cross-rank variance section."""
+    from deepspeed_tpu.telemetry import attribution
+    rep = attribution.analyze_path(MERGED)
+    cr = rep["cross_rank"]
+    assert cr["ranks"] == [0, 1] and cr["reference_rank"] == 0
+    assert cr["per_rank"]["0"]["steps_total"] == 12
+    # rank 1's dispatch runs 2ms slower by construction (the exclusive
+    # sweep carves the tail each dispatch span shares with the NEXT op's
+    # higher-priority comm span, so the per-step p50 sits just under the
+    # raw 15/17ms durations — the spread is what the section is for)
+    var = cr["variance"]["dispatch"]
+    assert var["slowest_rank"] == 1
+    assert 1.0 < var["spread_ms"] <= 2.0
+    assert cr["per_rank"]["0"]["stages"]["dispatch"]["p50_step_ms"] == 15.0
+    assert cr["per_rank"]["1"]["stages"]["dispatch"]["p50_step_ms"] \
+        == pytest.approx(16.29, abs=0.01)
+
+
+def test_env_report_rows(tmp_path, monkeypatch):
+    from deepspeed_tpu.env_report import crossrank_report
+    artifact = tmp_path / "crossrank.json"
+    proc = _run([DSTPU, "plan", "--cross-rank", MERGED,
+                 "--out", str(artifact)])
+    assert proc.returncode == 0
+    monkeypatch.setenv(crossrank.CROSSRANK_ARTIFACT_ENV, str(artifact))
+    rows = dict(crossrank_report())
+    assert str(artifact) in rows["cross-rank"]
+    assert "ranks [0, 1]" in rows["cross-rank"]
+    assert "max residual skew 2000us" in rows["cross-rank"]
+    assert "dominant straggler rank 1" in rows["cross-rank"]
+    assert "2 ranks ratcheted" in rows["cross-rank baseline"]
+
+
+def test_registry_covers_crossrank_and_compiles():
+    from deepspeed_tpu.tools.dslint.hotpath import (HOT_PATHS,
+                                                    OFFLINE_ONLY_MODULES)
+    assert "deepspeed_tpu/telemetry/crossrank.py" in OFFLINE_ONLY_MODULES
+    by_path = {(s.path, s.cls): s for s in HOT_PATHS}
+    spec = by_path[("deepspeed_tpu/telemetry/compiles.py", "CompileWatched")]
+    assert "__call__" in spec.hot_functions
+    guard = by_path[("deepspeed_tpu/comm/guard.py", None)]
+    assert "next_op_seq" in guard.hot_functions
+
+
+def test_telemetry_lazy_crossrank_reexport():
+    code = (
+        "import sys\n"
+        "import deepspeed_tpu.telemetry as T\n"
+        "assert 'deepspeed_tpu.telemetry.crossrank' not in sys.modules\n"
+        "T.merge_traces\n"
+        "assert 'deepspeed_tpu.telemetry.crossrank' in sys.modules\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# compile-event ledger
+# ---------------------------------------------------------------------------
+def test_watch_jit_emits_compile_instants(tracing):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.telemetry import compiles
+    fn = compiles.watch_jit(jax.jit(lambda x: x * 2), "test.double")
+    before = compiles.compiles_total()
+    fn(jnp.ones((3,)))                  # compile 1
+    fn(jnp.ones((3,)))                  # cached
+    fn(jnp.ones((2, 4)))                # compile 2 (new shape)
+    assert compiles.compiles_total() - before == 2
+    instants = [e[7] for e in tracing.events_snapshot()
+                if e[1] == compiles.COMPILE_INSTANT]
+    assert len(instants) == 2
+    assert instants[0]["fn"] == "test.double"
+    assert instants[0]["signature"] == "float32[3]"
+    assert instants[1]["signature"] == "float32[2,4]"
+    assert instants[0]["wall_ms"] > 0
+
+
+def test_engine_step_zero_compiles_after_warmup(tracing):
+    """The acceptance invariant bench.py asserts, proven at engine level:
+    after the warm step compiled the exact shapes, further same-shape
+    steps never compile."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_batch
+    from deepspeed_tpu.telemetry import compiles
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}},
+        example_batch=random_batch(4))
+    engine.train_batch(batch=random_batch(8, seed=0))      # warm/compile
+    assert compiles.compiles_total() > 0
+    warm_instants = len([e for e in tracing.events_snapshot()
+                         if e[1] == compiles.COMPILE_INSTANT])
+    assert warm_instants >= 1
+    mark = compiles.compiles_total()
+    for i in range(1, 3):
+        engine.train_batch(batch=random_batch(8, seed=i))
+    assert compiles.compiles_total() - mark == 0
+
+
+@pytest.mark.serve_load
+def test_bench_serve_warm_reports_zero_compiles(tracing):
+    """bench_serve's proof set: a warmed run reports
+    compiles_during_measurement == 0 — the 'warm the exact shapes first'
+    discipline as a machine-checked counter."""
+    from deepspeed_tpu.serving.bench_serve import (SCENARIOS,
+                                                   build_tiny_server,
+                                                   run_scenario)
+    import dataclasses
+    scenario = dataclasses.replace(SCENARIOS["micro"], num_requests=12,
+                                   concurrency=4)
+    server = build_tiny_server().start()
+    try:
+        report = run_scenario(server, scenario, warmup=True)
+    finally:
+        server.stop(drain_timeout=30.0)
+    assert report["warmed"]["enabled"] and report["warmed"]["requests"] > 0
+    assert report["counters"]["compiles_during_measurement"] == 0
+    # conservation identities survive the warm wave (cumulative counters)
+    assert report["prefix"] == {} or report["prefix"]["conservation_ok"]
+
+
+# ---------------------------------------------------------------------------
+# MULTICHIP drill (slow: real 2-proc gloo processes)
+# ---------------------------------------------------------------------------
+def _crossrank_drill_body():
+    """Per-rank drill: 10 guarded 'collectives' (2ms of work) with a REAL
+    cross-process reduction as the inter-op barrier; chaos comm_delay
+    (50ms, every call) on rank 1 only. Rank 1's guarded spans complete
+    late -> it is the straggler in every layer's verdict."""
+    import os
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.comm.guard import CommGuard, CommGuardConfig
+    from deepspeed_tpu.resilience.chaos import ChaosConfig, ChaosMonkey
+    from deepspeed_tpu.telemetry.tracer import get_tracer
+
+    rank = jax.process_index()
+    tracer = get_tracer()
+    tracer.configure(enabled=True)
+    # identity was stamped by init_distributed in the harness bootstrap
+    assert tracer.process_identity()["rank"] == rank
+    assert tracer.process_identity()["world"] == 2
+
+    chaos = ChaosMonkey(ChaosConfig(comm_delay_s=0.05,
+                                    comm_delay_prob=1.0)) if rank == 1 \
+        else None
+    guard = CommGuard(CommGuardConfig(enabled=True, op_deadline_s=60.0),
+                      chaos=chaos)
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(len(devs)), ("data",))
+    x = jax.device_put(jnp.ones((len(devs),)),
+                       NamedSharding(mesh, P("data")))
+    total = jax.jit(lambda v: v.sum(),
+                    out_shardings=NamedSharding(mesh, P()))
+
+    for _ in range(10):
+        guard.run("drill_allreduce", lambda: time.sleep(0.002))
+        # REAL cross-process barrier between ops: fetching the global sum
+        # blocks until every rank dispatched — per-op lateness shows as a
+        # late span END, and never accumulates past the window (tie-out)
+        assert float(total(x)) == float(len(devs))
+    out = os.path.join(os.environ["DSTPU_CROSSRANK_DIR"],
+                       f"r{rank}.json")
+    tracer.export_chrome(out)
+    print(f"rank {rank} dumped ok")
+
+
+@pytest.mark.slow
+def test_multichip_crossrank_drill(tmp_path):
+    """Acceptance (ISSUE 15): 2-proc gloo drill with chaos comm_delay on
+    rank 1 — per-rank DSTPU-style dumps merge into ONE timeline (rc=0),
+    `dstpu plan --cross-rank` runs rc=0, waits tie out, and rank 1 is the
+    dominant straggler in BOTH the skew ledger and StragglerDetector."""
+    from deepspeed_tpu.resilience.membership import StragglerDetector
+    from deepspeed_tpu.testing import run_distributed
+
+    outs = run_distributed(_crossrank_drill_body, world_size=2,
+                           devices_per_process=1,
+                           env={"DSTPU_CROSSRANK_DIR": str(tmp_path)})
+    assert all("dumped ok" in o for o in outs)
+    r0, r1 = str(tmp_path / "r0.json"), str(tmp_path / "r1.json")
+    merged_path = tmp_path / "merged.json"
+    proc = _run([DSTPU, "trace", "merge", r0, r1,
+                 "--out", str(merged_path)])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    artifact = tmp_path / "crossrank.json"
+    proc = _run([DSTPU, "plan", "--cross-rank", str(merged_path),
+                 "--out", str(artifact), "--json"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    merged = json.load(open(merged_path))
+    cr = merged["otherData"]["crossrank"]
+    assert cr["ranks"] == [0, 1]
+    assert cr["alignment"] == "wall_anchor"       # headers on both dumps
+
+    rep = json.load(open(artifact))
+    assert rep["matched"] == 10
+    assert rep["dominant_straggler"] == 1
+    # rank 0 pays ~50ms per op waiting on the delayed rank
+    assert rep["per_rank"]["0"]["waited_us"] > 10 * 30_000
+    assert rep["per_rank"]["1"]["wait_share"] > 0.9
+    # the ledger's waits sum consistently with the matched spans, and no
+    # rank waits longer than its window (tie-out <= 5%)
+    assert sum(c["wait_total_us"] for c in rep["collectives"]) == \
+        pytest.approx(rep["wait_total_us"])
+    assert rep["tie_out_violations"] == []
+    # StragglerDetector verdict == ledger verdict (per-op durations from
+    # the SAME matched spans; lower-median rule makes 2 ranks judgeable)
+    matched = crossrank.matched_collectives(merged)
+    det = StragglerDetector(factor=3.0, min_s=0.01)
+    flagged = []
+    for seq, rec in sorted(matched.items()):
+        flagged.extend(det.observe(
+            f"drill@{seq}",
+            {r: v["dur_us"] / 1e6 for r, v in rec["ranks"].items()}))
+    assert flagged and set(flagged) == {1}
