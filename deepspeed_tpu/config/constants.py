@@ -58,6 +58,11 @@ SERVING = "serving"                 # serving group (admission, degradation
 #                                     ladder, host KV offload tier, fault
 #                                     isolation; serving/server.py
 #                                     ServingConfig.from_ds_config)
+FLEET = "fleet"                     # fleet router group (replicas, prefix
+#                                     affinity, ladder-aware spill, failover
+#                                     retry budget, scale-out thresholds;
+#                                     serving/fleet.py
+#                                     FleetConfig.from_ds_config)
 
 # elasticity group keys for shrink-to-survive (elasticity/agent.py): the
 # agent may re-plan a generation below the launch world when membership

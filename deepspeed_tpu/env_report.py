@@ -73,6 +73,7 @@ def debug_report():
     rows.extend(crossrank_report())
     rows.extend(memory_report())
     rows.extend(serving_report())
+    rows.extend(fleet_report())
     rows.extend(elastic_report())
     rows.extend(comms_report())
     return rows
@@ -387,6 +388,50 @@ def serving_report():
         return rows
     except Exception as e:   # the report must never die on tooling drift
         return [("prefix cache", f"unavailable ({e})")]
+
+
+def fleet_report():
+    """Fleet-router status from the router's status artifact
+    ($DSTPU_FLEET_STATUS or ./fleet_status.json): replicas in rotation /
+    draining / lost, and the failover-proof counters (reroutes with zero
+    requests_lost is the zero-loss invariant holding in production)."""
+    import json
+    import os
+    try:
+        from deepspeed_tpu.serving.fleet import FLEET_STATUS_ENV
+        artifact = os.environ.get(FLEET_STATUS_ENV) or (
+            "fleet_status.json" if os.path.exists("fleet_status.json")
+            else None)
+        hint = (f"no artifact (bin/dstpu_fleet --status-path "
+                f"fleet_status.json, or set ${FLEET_STATUS_ENV})")
+        if not artifact or not os.path.exists(artifact):
+            return [("fleet", hint)]
+        with open(artifact) as f:
+            st = json.load(f)
+        reps = st.get("replicas") or []
+        c = st.get("counters") or {}
+        rows = [("fleet replicas",
+                 f"{sum(1 for r in reps if r.get('in_rotation'))} in "
+                 f"rotation / {sum(1 for r in reps if r.get('draining'))} "
+                 f"draining / {sum(1 for r in reps if r.get('lost'))} lost "
+                 f"of {len(reps)} ({artifact})")]
+        rows.append(("fleet routing",
+                     f"{c.get('completed', 0)}/{c.get('submitted', 0)} "
+                     f"completed, {c.get('affinity_hits', 0)} affinity "
+                     f"hits, {c.get('spills', 0)} spills "
+                     f"({c.get('client_sheds', 0)} client 429s of "
+                     f"{c.get('first_choice_sheds', 0)} first-choice "
+                     f"sheds)"))
+        rows.append(("fleet failover",
+                     f"{c.get('reroutes', 0)} reroutes "
+                     f"({c.get('recomputed_tokens', 0)} tokens recomputed), "
+                     f"{c.get('requests_lost', 0)} requests lost, "
+                     f"{c.get('replicas_lost', 0)} replicas lost / "
+                     f"{c.get('relaunches', 0)} relaunched, "
+                     f"{c.get('handoffs', 0)} prefix handoffs"))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("fleet", f"unavailable ({e})")]
 
 
 def comms_report():

@@ -506,6 +506,107 @@ class InferenceEngineV2:
         self._table_sig = None
         return entry.nbytes
 
+    # ------------------------------------------------------------------
+    # fleet prefix handoff (drain-time export / adopt-time import)
+    # ------------------------------------------------------------------
+    def export_prefix_handoff(self, path: str,
+                              quantize: str = "none") -> Dict[str, int]:
+        """Serialize every cached prefix chain to ``path`` (npz): for each
+        root-to-leaf trie chain, the token key plus its KV pages gathered
+        from the device and stored through the host-tier codec
+        (``quantize``: "none"/"int8"/"fp8" — the same ``quantize_pages``
+        path demotion uses; device-fp8 pages are never re-quantized).
+        This is a retiring fleet replica's warm-cache handoff: its
+        successor adopts the file and the shared prefixes survive the
+        retirement instead of being recomputed fleet-wide. A deliberate
+        device->host gather — drain-time only, never on the serve tick."""
+        cache = self.prefix_cache
+        out = {"chains": 0, "blocks": 0, "stored_bytes": 0, "raw_bytes": 0}
+        payload: Dict[str, np.ndarray] = {}
+        for tokens, blocks in (cache.chains() if cache is not None else ()):
+            data, scales = self.kv.gather_blocks(list(blocks))
+            raw = int(data.nbytes) + (int(scales.nbytes)
+                                      if scales is not None else 0)
+            codec, qscales = "none", None
+            if quantize != "none" and self.kv.cfg.dtype != jnp.float8_e4m3fn:
+                data, qscales = quantize_pages(data, quantize)
+                codec = quantize
+            entry = HostKVEntry(blocks=len(blocks), data=data, scales=scales,
+                                seen_tokens=len(tokens), codec=codec,
+                                qscales=qscales, raw_nbytes=raw)
+            i = out["chains"]
+            payload[f"tokens_{i}"] = np.asarray(tokens, np.int32)
+            payload[f"data_{i}"] = entry.data
+            payload[f"codec_{i}"] = np.array(entry.codec)
+            if entry.scales is not None:
+                payload[f"scales_{i}"] = entry.scales
+            if entry.qscales is not None:
+                payload[f"qscales_{i}"] = entry.qscales
+            out["chains"] += 1
+            out["blocks"] += entry.blocks
+            out["stored_bytes"] += entry.nbytes
+            out["raw_bytes"] += raw
+        payload["block_size"] = np.asarray(self.config.kv_block_size,
+                                           np.int32)
+        payload["num_chains"] = np.asarray(out["chains"], np.int32)
+        with open(path, "wb") as f:
+            np.savez(f, **payload)
+        return out
+
+    def import_prefix_handoff(self, path: str) -> Dict[str, int]:
+        """Adopt a predecessor's exported prefix chains: reserve device
+        blocks, scatter the dequantized pages, and register each chain in
+        the trie as EVICTABLE nodes (refcount 0 — a warm start, not a
+        pin: pressure can reclaim them like any flush-absorbed prefix).
+        Chains that don't fit the free pool, mismatch the block geometry,
+        or collide with incumbents are skipped/trimmed and counted —
+        adoption is best-effort by design. Deliberate host->device
+        copies — wire it through ``InferenceServer.adopt_prefix_handoff``
+        so the serve-loop thread (the engine's owner) runs it between
+        ticks."""
+        out = {"chains": 0, "blocks": 0, "skipped": 0, "bytes": 0}
+        cache = self.prefix_cache
+        with np.load(path, allow_pickle=False) as z:
+            n = int(z["num_chains"]) if "num_chains" in z else 0
+            bs = int(z["block_size"]) if "block_size" in z else -1
+            for i in range(n):
+                tokens = [int(t) for t in z[f"tokens_{i}"]]
+                stored = z[f"data_{i}"]
+                codec = str(z[f"codec_{i}"])
+                scales = z[f"scales_{i}"] if f"scales_{i}" in z.files else None
+                qscales = (z[f"qscales_{i}"] if f"qscales_{i}" in z.files
+                           else None)
+                nb = int(stored.shape[3])
+                if (cache is None or bs != self.config.kv_block_size
+                        or nb > self.kv.free_blocks):
+                    out["skipped"] += 1
+                    continue
+                blocks = self.kv.reserve(nb)
+                try:
+                    data = dequantize_pages(stored, qscales, codec,
+                                            np.dtype(np.float32)
+                                            if codec != "none"
+                                            else stored.dtype)
+                    self.kv.scatter_blocks(blocks, data, scales)
+                except Exception:
+                    # geometry mismatch (different model/layout): give the
+                    # reservation back and skip — adoption must never
+                    # poison a healthy successor
+                    self.kv.release(blocks)
+                    out["skipped"] += 1
+                    continue
+                added = cache.insert_from_seq(0, tokens, blocks,
+                                              seen_tokens=len(tokens),
+                                              pin=False)
+                # chain prefixes the trie already held keep the incumbent
+                # pages (first writer wins); unclaimed reservations go
+                # straight back to the allocator via the owns() partition
+                self.kv.release([b for b in blocks if not cache.owns(b)])
+                out["chains"] += 1
+                out["blocks"] += added
+                out["bytes"] += int(stored.nbytes)
+        return out
+
     def demoted_uids(self) -> List[int]:
         """Demotion-ordered uids currently in the host tier."""
         return self.host_kv.uids()

@@ -309,6 +309,30 @@ class PrefixCache:
             return 0
         return max(self._unpinned - self.max_cached_blocks, 0)
 
+    # ------------------------------------------------------------------
+    # export (drain-time handoff walk — NOT a hot path)
+    # ------------------------------------------------------------------
+    def chains(self) -> List[Tuple[Tuple[int, ...], Tuple[int, ...]]]:
+        """Every root-to-leaf path as ``(token tuple, block ids)`` — the
+        drain-time export surface for fleet prefix handoff. Leaves only:
+        an interior node's tokens/blocks are a prefix of each descendant
+        leaf's, so leaf chains carry the whole trie without duplication
+        (the importer re-splits them block-by-block). Offline by
+        contract (retirement), deliberately NOT in the DS002 registry."""
+        out: List[Tuple[Tuple[int, ...], Tuple[int, ...]]] = []
+        stack: List[Tuple[_TrieNode, Tuple[int, ...], Tuple[int, ...]]] = [
+            (self._root, (), ())]
+        while stack:
+            node, tokens, blocks = stack.pop()
+            if node is not self._root:
+                tokens = tokens + node.key
+                blocks = blocks + (node.block,)
+                if not node.children:
+                    out.append((tokens, blocks))
+            for child in node.children.values():
+                stack.append((child, tokens, blocks))
+        return out
+
     def snapshot(self) -> Dict[str, int]:
         """Counters + occupancy in one dict (the /metrics surface)."""
         s = self.stats

@@ -25,7 +25,14 @@ Faults covered (the failure modes the resilience subsystem exists for):
                 (``DSTPU_CHAOS_SERVE_KV_PRESSURE``), or make one request
                 uid deterministically fault the engine step so the
                 poison-quarantine path fires
-                (``DSTPU_CHAOS_SERVE_POISON_UID``)
+                (``DSTPU_CHAOS_SERVE_POISON_UID``), or SIGKILL one fleet
+                replica mid-decode (``DSTPU_CHAOS_REPLICA_KILL="RID[:TICK]"``
+                — the replica whose ``DSTPU_REPLICA_ID`` matches dies at
+                the first serve tick >= TICK that has decode work in
+                flight; TICK omitted = sha-rolled from the seed; the
+                die-once contract spares its DSTPU_RESUME relaunch, so
+                the fleet failover drill is kill -> reroute -> rejoin,
+                never a crash loop)
 
 Knobs come from an explicit ``ChaosConfig`` or from the environment
 (``ChaosConfig.from_env``), so a launcher can chaos-test an unmodified
@@ -48,8 +55,22 @@ from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 
+#: set by the fleet launcher on every replica worker it spawns; the
+#: replica-kill knob selects its victim by this id (and the fleet router
+#: reports it back through /healthz for affinity + retirement decisions)
+REPLICA_ID_ENV = "DSTPU_REPLICA_ID"
+
+
 def _parse_steps(raw: str) -> FrozenSet[int]:
     return frozenset(int(s) for s in raw.replace(" ", "").split(",") if s)
+
+
+def _parse_replica_kill(raw: str):
+    """``"RID[:TICK]"`` -> (replica_id, tick); tick 0 = sha-rolled."""
+    if not raw:
+        return -1, 0
+    head, _, tick = raw.partition(":")
+    return int(head), int(tick or 0)
 
 
 def _parse_slow_tick(raw: str):
@@ -134,6 +155,15 @@ class ChaosConfig:
     serve_kv_pressure_from: int = 0
     serve_kv_pressure_until: int = -1
     serve_poison_uid: int = -1
+    # fleet replica death: SIGKILL the worker whose DSTPU_REPLICA_ID
+    # matches, at the first serve tick >= replica_kill_tick that has
+    # decode work in flight (mid-decode by construction — the router must
+    # fail over live streams, not an idle process). tick 0 = sha-rolled
+    # from the seed; replica_kill_once spares DSTPU_RESUME relaunches
+    # (die_once contract), so kill -> reroute -> rejoin drills exactly once
+    replica_kill_id: int = -1
+    replica_kill_tick: int = 0
+    replica_kill_once: bool = True
 
     @property
     def active(self) -> bool:
@@ -151,7 +181,8 @@ class ChaosConfig:
                         and (self.serve_slow_tick_every
                              or self.serve_slow_tick_prob))
                     or self.serve_kv_pressure_frac > 0
-                    or self.serve_poison_uid >= 0)
+                    or self.serve_poison_uid >= 0
+                    or self.replica_kill_id >= 0)
 
     @classmethod
     def from_env(cls, env=os.environ) -> "ChaosConfig":
@@ -190,6 +221,11 @@ class ChaosConfig:
                        _parse_kv_pressure(g("DSTPU_CHAOS_SERVE_KV_PRESSURE",
                                             "")))),
             serve_poison_uid=int(g("DSTPU_CHAOS_SERVE_POISON_UID", "-1")),
+            **dict(zip(("replica_kill_id", "replica_kill_tick"),
+                       _parse_replica_kill(g("DSTPU_CHAOS_REPLICA_KILL",
+                                             "")))),
+            replica_kill_once=g("DSTPU_CHAOS_REPLICA_KILL_ONCE", "1")
+            not in ("0", "false"),
         )
 
 
@@ -221,7 +257,7 @@ class ChaosMonkey:
         self.injected = {"nan": 0, "ckpt": 0, "slow": 0, "oom": 0,
                          "comm_wedge": 0, "comm_delay": 0,
                          "serve_slow_tick": 0, "serve_kv_pressure": 0,
-                         "serve_poison": 0}
+                         "serve_poison": 0, "replica_kill": 0}
         self._serve_kv_pressure_on = False   # edge detector for the instant
 
     # ------------------------------------------------------------------
@@ -433,6 +469,38 @@ class ChaosMonkey:
         logger.warning(f"chaos: poisoning engine step (request uid {uid})")
         raise ChaosInjectedPoisonError(
             f"chaos: poisoned request {uid} aborted the engine step")
+
+    def maybe_kill_replica(self, tick: int, mid_decode: bool) -> None:
+        """SIGKILL this serving replica when it is the configured victim
+        and the due tick has arrived WITH decode work in flight
+        (``mid_decode``) — the drill's contract is death mid-decode, so
+        there are live streams for the router to fail over, never an idle
+        process quietly disappearing. The victim is selected by
+        ``DSTPU_REPLICA_ID`` (set by the fleet launcher); the due tick is
+        sha-rolled from the seed when not pinned; ``replica_kill_once``
+        spares the DSTPU_RESUME relaunch (die-once contract)."""
+        c = self.config
+        if c.replica_kill_id < 0 or not mid_decode:
+            return
+        try:
+            rid = int(os.environ.get(REPLICA_ID_ENV, "-1") or "-1")
+        except ValueError:
+            return
+        if rid != c.replica_kill_id:
+            return
+        due = c.replica_kill_tick or 1 + int(self._roll("replica_kill",
+                                                        rid) * 32)
+        if tick < due:
+            return
+        if c.replica_kill_once and os.environ.get("DSTPU_RESUME"):
+            return
+        self.injected["replica_kill"] += 1
+        logger.warning(f"chaos: SIGKILL replica {rid} at serve tick {tick}")
+        # breadcrumb only: SIGKILL is uncatchable — the router learns of
+        # the death from its broken streams + healthz, which is the drill
+        get_tracer().instant("chaos/replica_kill", cat="resilience",
+                             tick=tick, replica=rid)
+        os.kill(os.getpid(), signal.SIGKILL)
 
     # ------------------------------------------------------------------
     # worker death

@@ -8,6 +8,8 @@ See docs/serving.md.
 
 from deepspeed_tpu.serving.degradation import (DegradationLadder,
                                                LadderConfig, ServeLevel)
+from deepspeed_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                         ReplicaHandle)
 from deepspeed_tpu.serving.frontend import ServingFrontend
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState
@@ -17,8 +19,11 @@ from deepspeed_tpu.serving.server import (BackpressureError, InferenceServer,
 __all__ = [
     "BackpressureError",
     "DegradationLadder",
+    "FleetConfig",
+    "FleetRouter",
     "InferenceServer",
     "LadderConfig",
+    "ReplicaHandle",
     "Request",
     "RequestState",
     "ServeLevel",

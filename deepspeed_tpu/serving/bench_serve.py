@@ -502,6 +502,223 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
 
 
 # ---------------------------------------------------------------------------
+# fleet mode: the same seeded workloads through the multi-replica router
+# ---------------------------------------------------------------------------
+def build_tiny_fleet(replicas: int = 2, kv_num_blocks: int = 64,
+                     kv_block_size: int = 16,
+                     fleet_overrides: Optional[dict] = None,
+                     **builder_kwargs):
+    """N in-process ``build_tiny_server`` replicas behind HTTP frontends,
+    fronted by a ``FleetRouter`` (affinity keyed to the replicas' KV
+    block size). Returns the started router; tear it down with
+    ``stop_tiny_fleet``. In-process replicas share the jit caches, so
+    replica 2..N costs no extra compiles — the fleet drill stays inside
+    the tier-1 budget."""
+    from deepspeed_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                             ReplicaHandle)
+    from deepspeed_tpu.serving.frontend import ServingFrontend
+    handles, members = [], []
+    for rid in range(replicas):
+        server = build_tiny_server(kv_num_blocks=kv_num_blocks,
+                                   kv_block_size=kv_block_size,
+                                   **builder_kwargs)
+        server.replica_id = rid     # in-process: env identity can't differ
+        server.start()
+        fe = ServingFrontend(server).start()
+        handles.append(ReplicaHandle(rid, fe.url))
+        members.append((server, fe))
+    cfg = FleetConfig(replicas=replicas,
+                      affinity_block_tokens=kv_block_size,
+                      **(fleet_overrides or {}))
+    router = FleetRouter(cfg, handles=handles)
+    router._members = members       # teardown + warm need the objects
+    return router.start()
+
+
+def stop_tiny_fleet(router) -> None:
+    router.stop(terminate_replicas=False)
+    for server, fe in getattr(router, "_members", ()):
+        fe.stop()
+        if server.running:
+            server.stop(drain_timeout=30.0)
+
+
+class _FleetLane:
+    """One closed-loop user against the ROUTER's front door: same seeded
+    request shapes as ``_Lane``, but through HTTP streams, retrying fleet
+    429s with the router's Retry-After hint (bounded)."""
+
+    def __init__(self, router_url: str, scenario: ServeScenario,
+                 indices: List[int], results: dict, lock: threading.Lock):
+        self.url = router_url
+        self.scenario = scenario
+        self.indices = indices
+        self.results = results
+        self.lock = lock
+
+    def run(self):
+        from deepspeed_tpu.serving import http_util
+        sc = self.scenario
+        for index in self.indices:
+            prompt, max_new, priority, shared_len = _request_shape(sc, index)
+            record = {"state": "gave_up", "retries": 0}
+            for attempt in range(sc.submit_retry_limit + 1):
+                tokens: List[int] = []
+                final: dict = {}
+                try:
+                    reply = http_util.open_stream(
+                        self.url + "/generate",
+                        {"prompt_tokens": prompt,
+                         "max_new_tokens": max_new, "priority": priority,
+                         "stream": True},
+                        timeout_s=sc.result_timeout_s)
+                    if reply.status == 429:
+                        record = {"state": "rejected", "retries": attempt}
+                        time.sleep(min(reply.retry_after_s() or 0.02, 0.02))
+                        continue
+                    if reply.status != 200:
+                        record = {"state": "refused", "retries": attempt,
+                                  "error": reply.error}
+                        break
+                    for rec in reply.records():
+                        if "token" in rec:
+                            tokens.append(int(rec["token"]))
+                        elif rec.get("done"):
+                            final = rec
+                except Exception as e:
+                    record = {"state": "failed", "retries": attempt,
+                              "error": repr(e)}
+                    break
+                record = {"state": final.get("state", "failed"),
+                          "uid": final.get("uid"), "tokens": tokens,
+                          "finish_reason": final.get("finish_reason"),
+                          "rerouted": final.get("rerouted", 0),
+                          "recomputed_tokens":
+                              final.get("recomputed_tokens", 0),
+                          "retries": attempt}
+                break
+            record["reusable_tokens"] = shared_len
+            with self.lock:
+                self.results[(0, index)] = record
+
+
+def run_fleet_scenario(router, scenario: ServeScenario,
+                       provenance: Optional[dict] = None,
+                       warmup: bool = False) -> dict:
+    """Closed-loop drive of a fleet through the ROUTER. The proof set is
+    the router's exact counters plus the replica-summed prefix section
+    (same conservation identity as the single-replica report: ``saved +
+    computed == total`` holds fleet-wide because every replica holds it),
+    and the routing conservation identity ``completed + client_sheds +
+    requests_lost + client_errors == submitted`` — every HTTP request the
+    router admitted is accounted to exactly one terminal counter."""
+    if scenario.mode != "closed" or scenario.turns > 1:
+        raise ValueError("fleet scenarios are closed-loop single-turn")
+    members = getattr(router, "_members", ())
+    if warmup:
+        for server, _fe in members:
+            warm_scenario(server, scenario)
+    c0 = router.counters_snapshot()
+    pre_prefix: List[dict] = [
+        server.engine.prefix_stats() if hasattr(server.engine,
+                                                "prefix_stats") else {}
+        for server, _fe in members]
+    results: dict = {}
+    lock = threading.Lock()
+    t0 = time.monotonic()
+    lanes = [
+        _FleetLane(router.url, scenario,
+                   list(range(i, scenario.num_requests,
+                              scenario.concurrency)),
+                   results, lock)
+        for i in range(max(scenario.concurrency, 1))]
+    threads = [threading.Thread(target=lane.run, daemon=True,
+                                name=f"fleet-lane-{i}")
+               for i, lane in enumerate(lanes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t0
+    # settle WITHOUT drain(): drain flips the replica to draining
+    # permanently, which would pull every member out of rotation and
+    # leave the fleet unroutable after one scenario (lanes already hold
+    # final records, so quiescence is just the tail of bookkeeping)
+    settle_deadline = time.monotonic() + scenario.result_timeout_s
+    for server, _fe in members:
+        while time.monotonic() < settle_deadline:
+            h = server.health()
+            if h.get("queued", 0) == 0 and h.get("inflight", 0) == 0:
+                break
+            time.sleep(0.01)
+
+    counters = {k: v - c0.get(k, 0)
+                for k, v in router.counters_snapshot().items()}
+    states: Dict[str, int] = {}
+    client_tokens = 0
+    for rec in results.values():
+        states[rec["state"]] = states.get(rec["state"], 0) + 1
+        client_tokens += len(rec.get("tokens") or ())
+    prefix: dict = {}
+    for i, (server, _fe) in enumerate(members):
+        if not hasattr(server.engine, "prefix_stats"):
+            continue
+        stats = server.engine.prefix_stats()
+        for k in ("prefill_tokens_total", "prefill_tokens_saved",
+                  "prefill_tokens_computed", "prefix_lookups",
+                  "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+                  "prefix_lookup_tokens"):
+            if k in stats:
+                prefix[k] = (prefix.get(k, 0) + stats[k]
+                             - (pre_prefix[i].get(k, 0) if warmup else 0))
+    if prefix:
+        prefix["prefix_hit_ratio"] = (
+            prefix.get("prefix_hit_tokens", 0)
+            / max(prefix.get("prefix_lookup_tokens", 0), 1))
+        prefix["expected_reusable_tokens"] = sum(
+            rec.get("reusable_tokens", 0) for rec in results.values())
+        prefix["conservation_ok"] = (
+            prefix.get("prefill_tokens_saved", 0)
+            + prefix.get("prefill_tokens_computed", 0)
+            == prefix.get("prefill_tokens_total", 0))
+    health = router.health()
+    prov = {
+        "preset": scenario.name,
+        "seed": scenario.seed,
+        "mode": "fleet_closed",
+        "num_requests": scenario.num_requests,
+        "scenario": dataclasses.asdict(scenario),
+        # the fleet topology: who routed, with what affinity/spill policy
+        "fleet": {
+            "replicas": [{"id": s["id"], "url": s["url"]}
+                         for s in health["replicas"]],
+            "affinity_enabled": router.config.affinity_enabled,
+            "affinity_block_tokens": router.config.affinity_block_tokens,
+            "spill_enabled": router.config.spill_enabled,
+            "retry_budget": router.config.retry_budget,
+        },
+    }
+    if provenance:
+        prov.update(provenance)
+    return {
+        "scenario": dataclasses.asdict(scenario),
+        "provenance": prov,
+        "wall_s": round(wall_s, 3),
+        "requests": {"issued": len(results), "states": states,
+                     "client_tokens": client_tokens},
+        # the router's exact proof set + the conservation identity over it
+        "counters": counters,
+        "routing_conservation_ok": (
+            counters.get("completed", 0) + counters.get("client_sheds", 0)
+            + counters.get("requests_lost", 0)
+            + counters.get("client_errors", 0)
+            == counters.get("submitted", 0)),
+        "prefix": prefix,
+        "replicas": health["replicas"],
+    }
+
+
+# ---------------------------------------------------------------------------
 # CLI (bin/dstpu_bench_serve) — hermetic tiny-llama CPU run
 # ---------------------------------------------------------------------------
 def build_tiny_server(kv_num_blocks: int = 64, kv_block_size: int = 16,
@@ -554,6 +771,11 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--kv-num-blocks", type=int, default=64)
     p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="run the scenario through a FleetRouter over this "
+                        "many in-process replicas (>1 switches to fleet "
+                        "mode: router counters + replica-summed prefix "
+                        "proof set; topology lands in provenance)")
     p.add_argument("--no-kv-offload", action="store_true",
                    help="run with the offload tier disabled (pre-tier "
                         "admission semantics)")
@@ -623,15 +845,25 @@ def main(argv=None) -> int:
                "prefix_cache": not args.no_prefix_cache,
                "host_kv_quantize": args.host_kv_quantize,
                "serving_overrides": serving_overrides}
-    server = build_tiny_server(**builder).start()
     provenance = {"builder": builder}
     if args.trace:
         provenance["trace_path"] = os.path.abspath(args.trace)
-    try:
-        report = run_scenario(server, scenario, provenance=provenance,
-                              warmup=args.warm)
-    finally:
-        server.stop(drain_timeout=30.0)
+    if args.replicas > 1:
+        provenance["builder"] = dict(builder, replicas=args.replicas)
+        router = build_tiny_fleet(replicas=args.replicas, **builder)
+        try:
+            report = run_fleet_scenario(router, scenario,
+                                        provenance=provenance,
+                                        warmup=args.warm)
+        finally:
+            stop_tiny_fleet(router)
+    else:
+        server = build_tiny_server(**builder).start()
+        try:
+            report = run_scenario(server, scenario, provenance=provenance,
+                                  warmup=args.warm)
+        finally:
+            server.stop(drain_timeout=30.0)
     if args.trace:
         get_tracer().export_chrome(args.trace)
     text = json.dumps(report, indent=2, default=str)
@@ -640,7 +872,7 @@ def main(argv=None) -> int:
         with open(args.json, "w") as f:
             f.write(text + "\n")
     if args.warm:
-        compiles = report["counters"]["compiles_during_measurement"]
+        compiles = report["counters"].get("compiles_during_measurement", 0)
         if compiles != 0:
             # explicit check, not assert: python -O must not strip the
             # proof, and the CLI keeps its exit-code discipline

@@ -19,13 +19,32 @@ Endpoints:
                   "brownout" | "shed", ...} / 503 otherwise ("level" +
                   "level_reason" expose the degradation ladder; brownout
                   and shed still answer 200 — the replica is alive, it is
-                  shedding per-request, so LBs should keep it in rotation)
+                  shedding per-request, so LBs should keep it in rotation).
+                  Carries the fleet router's signals too: ``replica_id``,
+                  ``prefix_cache_blocks`` (affinity), ``draining``
+                  (retirement)
+  POST /admin/drain {"handoff_path": P?, "quantize": C?}
+      -> 202; background: drain, stop the serve loop, export the warm
+         prefix cache to P (fleet retirement — the successor adopts it),
+         then fire ``on_retired`` (the fleet worker exits there)
+  POST /admin/adopt {"handoff_path": P}
+      -> 200; queues P for adoption by the serve loop (the engine-owning
+         thread imports it between ticks)
+
+Slow/malformed-client hardening: a declared Content-Length over
+``max_body_bytes`` is refused with 413 WITHOUT reading the body (the
+connection closes — draining a hostile body is exactly the wedge); a
+body that stalls past ``read_timeout_s`` (socket-level deadline) or
+arrives short gets 408. Either way the handler thread is released —
+the accept loop never inherits a wedged connection.
 """
 
 import json
+import os
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 from deepspeed_tpu.serving.request import RequestState
 from deepspeed_tpu.serving.server import (BackpressureError, InferenceServer,
@@ -38,13 +57,27 @@ class ServingFrontend:
     picks an ephemeral port (tests); read it back from ``.port``."""
 
     def __init__(self, server: InferenceServer, host: str = "127.0.0.1",
-                 port: int = 0, request_timeout_s: float = 120.0):
+                 port: int = 0, request_timeout_s: float = 120.0,
+                 max_body_bytes: int = 1 << 20,
+                 read_timeout_s: float = 30.0,
+                 drain_timeout_s: float = 30.0):
         self.serving = server
         self.request_timeout_s = request_timeout_s
+        self.max_body_bytes = max_body_bytes
+        self.read_timeout_s = read_timeout_s
+        self.drain_timeout_s = drain_timeout_s
+        # fleet hook: called after an admin-initiated drain+retire
+        # completes (the fleet worker exits its process there)
+        self.on_retired: Optional[Callable[[], None]] = None
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # socket-level read deadline: applies to every blocking read
+            # on the connection (request line, headers, body), so a
+            # stalled client times out instead of parking this handler
+            # thread and its keep-alive socket forever
+            timeout = read_timeout_s
 
             def log_message(self, fmt, *args):   # route to our logger
                 logger.debug("frontend: " + fmt % args)
@@ -75,10 +108,41 @@ class ServingFrontend:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                # drain the body FIRST: responding with unread body bytes on
-                # the socket corrupts the next keep-alive request
-                raw = self.rfile.read(int(self.headers.get("Content-Length",
-                                                           0) or 0))
+                try:
+                    clen = int(self.headers.get("Content-Length", 0) or 0)
+                except ValueError:
+                    self.close_connection = True
+                    self._json(400, {"error": "bad Content-Length"})
+                    return
+                if clen > frontend.max_body_bytes:
+                    # refuse WITHOUT reading: draining an oversized body
+                    # is exactly the wedge this cap exists to prevent —
+                    # the connection closes with the 413 instead
+                    self.close_connection = True
+                    self._json(413, {"error": f"body of {clen} bytes over "
+                                              f"cap {frontend.max_body_bytes}"})
+                    return
+                try:
+                    # drain the body FIRST: responding with unread body
+                    # bytes on the socket corrupts the next keep-alive
+                    # request (the socket deadline bounds this read)
+                    raw = self.rfile.read(clen)
+                except (socket.timeout, OSError):
+                    self.close_connection = True
+                    try:
+                        self._json(408, {"error": "request body read "
+                                                  "timed out"})
+                    except OSError:
+                        pass    # client already gone
+                    return
+                if len(raw) < clen:
+                    # client hung up (or stalled to EOF) mid-body
+                    self.close_connection = True
+                    self._json(408, {"error": "short request body"})
+                    return
+                if self.path.startswith("/admin/"):
+                    self._admin(raw)
+                    return
                 if self.path != "/generate":
                     self._json(404, {"error": f"no route {self.path}"})
                     return
@@ -132,6 +196,38 @@ class ServingFrontend:
                             RequestState.FAILED: 500}.get(req.state, 200)
                     self._json(code, req.describe() | {"tokens": req.tokens})
 
+            def _admin(self, raw: bytes):
+                try:
+                    body = json.loads(raw or b"{}")
+                    if not isinstance(body, dict):
+                        raise TypeError("payload must be a JSON object")
+                except (ValueError, TypeError) as e:
+                    self._json(400, {"error": f"bad request: {e!r}"})
+                    return
+                if self.path == "/admin/adopt":
+                    path = body.get("handoff_path")
+                    if not isinstance(path, str) or not path:
+                        self._json(400, {"error": "handoff_path required"})
+                        return
+                    try:
+                        frontend.serving.adopt_prefix_handoff(path)
+                    except (ValueError, AttributeError) as e:
+                        self._json(400, {"error": f"cannot adopt: {e!r}"})
+                        return
+                    self._json(200, {"adopted": True, "handoff_path": path})
+                elif self.path == "/admin/drain":
+                    handoff = body.get("handoff_path")
+                    threading.Thread(
+                        target=frontend._drain_and_retire,
+                        args=(handoff, body.get("quantize")),
+                        name="dstpu-frontend-drain", daemon=True).start()
+                    # 202: retirement runs in the background — watch
+                    # /healthz flip to draining, then stopped
+                    self._json(202, {"draining": True,
+                                     "handoff_path": handoff})
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
             def _stream_response(self, req):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonlines")
@@ -167,6 +263,27 @@ class ServingFrontend:
         self.httpd.daemon_threads = True
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    def _drain_and_retire(self, handoff_path: Optional[str],
+                          quantize: Optional[str]) -> None:
+        """Admin-initiated retirement: drain + stop the serve loop, export
+        the warm prefix chains for the successor, fire ``on_retired``."""
+        try:
+            self.serving.stop(drain_timeout=self.drain_timeout_s)
+            if handoff_path:
+                # write-then-rename: the file's existence is the router's
+                # "handoff complete" signal, so it must appear atomically
+                part = handoff_path + ".part"
+                self.serving.export_prefix_handoff(part, quantize=quantize)
+                os.replace(part, handoff_path)
+        except Exception:
+            logger.exception("frontend: drain/retire failed")
+        cb = self.on_retired
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("frontend: on_retired callback failed")
 
     @property
     def url(self) -> str:

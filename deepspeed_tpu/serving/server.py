@@ -40,6 +40,7 @@ Serving under siege (this file + ``degradation.py`` + ``kv_tier.py``):
 
 import dataclasses
 import itertools
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -47,7 +48,7 @@ from typing import Dict, List, Optional, Sequence
 
 from deepspeed_tpu.comm.guard import CommOutcome, classify_exception
 from deepspeed_tpu.config import constants as C
-from deepspeed_tpu.resilience.chaos import monkey_from_env
+from deepspeed_tpu.resilience.chaos import REPLICA_ID_ENV, monkey_from_env
 from deepspeed_tpu.serving.degradation import (DegradationLadder,
                                                LadderConfig, ServeLevel)
 from deepspeed_tpu.serving.kv_tier import (effective_usable_blocks,
@@ -230,6 +231,20 @@ class InferenceServer:
         # serve/tick_stage_share counter track (/metrics + dstrace)
         self._tick_stage_cum = {s: 0.0 for s in _TICK_STAGES}
         self._tick_cum_s = 0.0
+        # fleet identity: set by the fleet launcher on replica workers
+        # (-1 standalone); reported on /healthz so the router can key
+        # affinity/retirement by replica, and matched by the chaos
+        # replica-kill knob
+        try:
+            self.replica_id = int(os.environ.get(REPLICA_ID_ENV, "-1")
+                                  or "-1")
+        except ValueError:
+            self.replica_id = -1
+        # predecessor prefix-handoff files queued for adoption; imported
+        # by the serve loop between ticks (the thread that owns the engine)
+        self._handoff_paths: List[str] = []
+        self.handoff_stats = {"imported_chains": 0, "imported_blocks": 0,
+                              "skipped_chains": 0}
         # fault-isolation state (serve-loop-private except the flag)
         self._tick = 0
         self._consecutive_faults = 0
@@ -291,6 +306,62 @@ class InferenceServer:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
 
+    # ------------------------------------------------------------------
+    # fleet prefix handoff (retirement export / successor adoption)
+    # ------------------------------------------------------------------
+    def adopt_prefix_handoff(self, path: str) -> None:
+        """Queue a predecessor's prefix-handoff file for adoption. The
+        serve loop — the only thread that owns the engine — imports it
+        between ticks, so this is safe to call from the frontend's admin
+        route while requests are in flight. With no serve loop running
+        (worker startup), the import runs inline."""
+        if not hasattr(self.engine, "import_prefix_handoff"):
+            raise ValueError("engine has no prefix-handoff support")
+        if not self.running:
+            self._import_handoff(path)
+            return
+        with self._lock:
+            self._handoff_paths.append(path)
+        self._wake.set()
+
+    def _adopt_handoffs(self) -> None:
+        with self._lock:
+            paths, self._handoff_paths = self._handoff_paths, []
+        for p in paths:
+            self._import_handoff(p)
+
+    def _import_handoff(self, path: str) -> None:
+        try:
+            got = self.engine.import_prefix_handoff(path)
+        except Exception:
+            logger.exception(f"serve: prefix handoff import failed ({path})")
+            return
+        self.handoff_stats["imported_chains"] += got.get("chains", 0)
+        self.handoff_stats["imported_blocks"] += got.get("blocks", 0)
+        self.handoff_stats["skipped_chains"] += got.get("skipped", 0)
+        get_tracer().instant("serve/prefix_handoff_adopt", cat="serve",
+                             **{k: int(v) for k, v in got.items()})
+        logger.info(f"serve: adopted prefix handoff {path}: {got}")
+
+    def export_prefix_handoff(self, path: str,
+                              quantize: Optional[str] = None) -> dict:
+        """Drain-time export of the warm prefix cache for a successor
+        (retirement: drain -> stop -> export -> successor adopts). Must
+        run with the serve loop stopped — the export gathers device pages
+        and may not race the tick."""
+        if self.running:
+            raise RuntimeError(
+                "export_prefix_handoff requires a stopped server "
+                "(drain + stop first)")
+        if not hasattr(self.engine, "export_prefix_handoff"):
+            return {"chains": 0, "blocks": 0}
+        q = quantize if quantize is not None else self.config.host_kv_quantize
+        got = self.engine.export_prefix_handoff(path, quantize=q)
+        get_tracer().instant("serve/prefix_handoff_export", cat="serve",
+                             **{k: int(v) for k, v in got.items()})
+        logger.info(f"serve: exported prefix handoff {path}: {got}")
+        return got
+
     @property
     def running(self) -> bool:
         return (self._thread is not None and self._thread.is_alive()
@@ -324,7 +395,14 @@ class InferenceServer:
                "demoted": demoted,
                "fault_episode": fault_episode,
                "step_faults": self.metrics.engine_step_faults,
-               "kv_occupancy": self.engine.kv_occupancy()}
+               "kv_occupancy": self.engine.kv_occupancy(),
+               # the fleet router's affinity + retirement signals
+               "replica_id": self.replica_id,
+               "draining": self._draining,
+               "prefix_cache_blocks": (
+                   self.engine.prefix_cache.cached_blocks()
+                   if getattr(self.engine, "prefix_cache", None) is not None
+                   else 0)}
         if degraded:
             out["degraded_reason"] = degraded
         if self._tier_capable:
@@ -499,6 +577,13 @@ class InferenceServer:
         marks: List[tuple] = []     # the tick's stage timeline (see _mark)
         if self.chaos is not None:
             self.chaos.serve_slow_tick(self._tick)
+            # fleet drill: SIGKILL this replica mid-decode when it is the
+            # configured victim (has_work == live streams to fail over)
+            self.chaos.maybe_kill_replica(self._tick, self.engine.has_work())
+        if self._handoff_paths:
+            # rare (successor adoption at retirement); one attr check per
+            # tick otherwise
+            self._adopt_handoffs()
         if self.membership is not None and self._degraded is None:
             if not self._check_membership():
                 return False

@@ -134,6 +134,21 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
                        "plan_prefix_evictions", "plan_promotions",
                        "tier_pressure"),
     ),
+    # the fleet router's per-request decision helpers: pure stdlib
+    # int/dict work over healthz snapshots, run on EVERY routed request
+    # and EVERY poll tick — registering them proves routing never grows a
+    # numpy materialization or host sync (the router host may not even
+    # have an accelerator runtime)
+    HotPathSpec(
+        path="deepspeed_tpu/serving/fleet.py",
+        cls=None,
+        hot_functions=("affinity_key", "pick_replica", "plan_scale"),
+    ),
+    HotPathSpec(
+        path="deepspeed_tpu/serving/fleet.py",
+        cls="ReplicaHandle",
+        hot_functions=("in_rotation", "snapshot"),
+    ),
     # the radix prefix cache: the serve tick walks/pins/plans against the
     # trie on EVERY admission and rebalance — registering the whole
     # bookkeeping surface PROVES the trie never host-syncs the tick (the

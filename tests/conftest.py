@@ -31,6 +31,42 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
+# Persistent XLA compilation cache, OPT-IN per module. The heavy training
+# modules compile near-identical tiny graphs over and over (XLA's in-process
+# cache is per-jit-instance, so the same HLO recompiles test after test);
+# the content-addressed disk cache roughly halves their wall clock even when
+# cold. It is NOT safe globally: executables that embed host callbacks
+# (pallas interpret mode, io_callback — e.g. the comm/compress error-feedback
+# graphs) segfault when reloaded from the cache on this jaxlib, so only
+# pure-XLA modules that have been verified green with the cache are listed.
+_XLA_CACHE_MODULES = {
+    "test_param_offload", "test_offload", "test_t5", "test_pipeline",
+    "test_llama", "test_gpt_neox", "test_gpt2", "test_gemma2",
+    "test_aux_runtime", "test_onebit", "test_fast_convergence",
+}
+
+
+@pytest.fixture(autouse=True)
+def _scoped_xla_cache(request):
+    mod = request.node.module.__name__.rpartition(".")[2] \
+        if request.node.module else ""
+    if mod not in _XLA_CACHE_MODULES:
+        yield
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("DSTPU_TEST_XLA_CACHE",
+                                         "/tmp/dstpu-test-xla-cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # older jax: no cache knobs — run uncached
+        yield
+        return
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
 
 @pytest.fixture
 def mesh8():
