@@ -38,6 +38,7 @@ from deepspeed_tpu.inference.v2.scheduler import (
     snap_bucket,
 )
 from deepspeed_tpu.models.llama import LlamaConfig
+from deepspeed_tpu.runtime.sched import TickLedger
 from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -154,6 +155,12 @@ class InferenceEngineV2:
         self._prefill_computed = 0
         # last step's host-timed prefill/decode split (serve-tick clocks)
         self.last_step_timing = {"prefill_s": 0.0, "decode_s": 0.0}
+        # deterministic per-tick scheduler counters (runtime/sched.py) — the
+        # decode-first chunked-prefill proof set; fed every non-empty step
+        # in BOTH modes so an uncapped run yields the A/B baseline counters
+        self.sched_ledger = TickLedger()
+        self.last_step_counters = {"prefill_tokens": 0, "chunks": 0,
+                                   "decode_tokens": 0}
         # speculative-decoding counters (speculative_stats)
         self._spec_steps = 0
         self._spec_proposed = 0
@@ -166,6 +173,33 @@ class InferenceEngineV2:
         if self.prefix_cache is None:
             self.prefix_cache = PrefixCache(self.config.kv_block_size,
                                             max_cached_blocks)
+
+    def configure_chunked_prefill(self, prefill_chunk_tokens: int) -> None:
+        """Set the decode-first prefill cap (the serving layer's wiring
+        point for ``serving.scheduler.prefill_chunk_tokens``). The cap
+        must cover at least one KV block: capped mid-prompt boundaries
+        snap DOWN to block granularity, so a smaller cap could never
+        make progress."""
+        cap = int(prefill_chunk_tokens)
+        if cap > 0 and cap < self.kv.cfg.block_size:
+            raise ValueError(
+                f"prefill_chunk_tokens={cap} is smaller than the KV block "
+                f"size ({self.kv.cfg.block_size}): block-aligned chunking "
+                f"could never make progress")
+        self.config = dataclasses.replace(
+            self.config, scheduler=dataclasses.replace(
+                self.config.scheduler, prefill_chunk_tokens=cap))
+
+    def sched_mark(self) -> None:
+        """Start the measured counter window (bench: at the compile mark,
+        so warm-wave ticks never leak into the measured maxima)."""
+        self.sched_ledger.reset_window()
+
+    def sched_stats(self, gap_unit_tokens: int = 0) -> Dict[str, object]:
+        """The scheduler proof set (see TickLedger.snapshot)."""
+        return self.sched_ledger.snapshot(
+            cap=self.config.scheduler.prefill_chunk_tokens,
+            gap_unit_tokens=gap_unit_tokens)
 
     # ------------------------------------------------------------------
     # admission control (reference: engine_v2.py:158 query, :184 can_schedule)
@@ -278,8 +312,10 @@ class InferenceEngineV2:
         return matched
 
     def step(self) -> Dict[int, int]:
+        cap = self.config.scheduler.prefill_chunk_tokens
         plan = plan_step(self.state.decoding(), self.state.prefilling(),
-                         self.config.scheduler)
+                         self.config.scheduler,
+                         block_tokens=self.kv.cfg.block_size)
         out: Dict[int, int] = {}
         # scaled fp8 pages carry their per-(head, page) scales through the
         # jitted steps as a (pages, scales) tuple
@@ -299,12 +335,21 @@ class InferenceEngineV2:
             tokens[:chunk.length] = seq.prompt_tokens[chunk.start:end]
             mb = self._ctx_bucket_blocks(end)
             table = self._block_table(seq, mb)
+            t_chunk = time.monotonic()
             logits, cache = prefill_chunk_g(
                 self.params, cache, jnp.asarray(tokens), chunk.start,
                 jnp.asarray(table), chunk.length,
                 policy=self.policy, cfg=self.model_config,
                 block_size=self.kv.cfg.block_size,
                 attn_impl=self.config.attn_impl)
+            if cap > 0:
+                # per-chunk sub-span (nested inside serve/step_prefill, same
+                # exclusive stage) — only with chunking on, so cap-off trace
+                # streams stay bit-identical to pre-cap serving
+                tracer.complete("serve/prefill_chunk",
+                                time.monotonic() - t_chunk, cat="serve",
+                                uid=seq.uid, tokens=chunk.length,
+                                bucket=chunk.bucket)
             seq.seen_tokens = end
             self._prefill_computed += chunk.length
             if self.prefix_cache is not None:
@@ -379,6 +424,15 @@ class InferenceEngineV2:
         # gauges + `dstpu plan --serve` prefill/decode attribution)
         self.last_step_timing = {"prefill_s": t_prefill,
                                  "decode_s": t_decode}
+        prefill_tokens = sum(c.length for c in plan.prefill_chunks)
+        decode_tokens = len(plan.decode_seqs)
+        self.last_step_counters = {"prefill_tokens": prefill_tokens,
+                                   "chunks": len(plan.prefill_chunks),
+                                   "decode_tokens": decode_tokens}
+        if not plan.empty:
+            self.sched_ledger.observe_tick(prefill_tokens,
+                                           len(plan.prefill_chunks),
+                                           decode_tokens, cap=cap)
         return out
 
     def _sample_batch(self, logits) -> np.ndarray:
@@ -505,6 +559,37 @@ class InferenceEngineV2:
         self.host_kv.pop(uid, promoted=True)
         self._table_sig = None
         return entry.nbytes
+
+    def adopt_kv_handoff(self, uid: int, prompt_tokens: Sequence[int],
+                         generated: Sequence[int],
+                         entry: HostKVEntry) -> bool:
+        """In-process disaggregation adoption (serving/disagg.py): continue
+        a sequence whose KV a prefill-role engine demoted into a
+        ``HostKVEntry`` — create it here with its history, reserve device
+        blocks, scatter the dequantized pages, and let the planner pick it
+        up as a running decode. Prefix admission is bypassed: the prefill
+        work was done (and conservation-counted) on the donor engine.
+        Returns False with NOTHING mutated when this engine can't cover
+        the entry right now (capacity / slots / uid collision) — the
+        caller retries next tick. The PR 17 handoff-file path generalized
+        to in-process adoption: same codec round-trip, no filesystem."""
+        if uid in self.state or \
+                len(self.state) >= self.state.max_tracked_sequences or \
+                entry.blocks > self.kv.free_blocks + self._evictable_blocks():
+            return False
+        seq = self.state.create(uid, prompt_tokens)
+        seq.generated = list(generated)
+        blocks = self._reserve(entry.blocks)
+        if entry.blocks:
+            data = dequantize_pages(entry.data, entry.qscales, entry.codec,
+                                    np.dtype(np.float32)
+                                    if entry.codec != "none"
+                                    else entry.data.dtype)
+            self.kv.scatter_blocks(blocks, data, entry.scales)
+        seq.blocks = list(blocks)
+        seq.seen_tokens = int(entry.seen_tokens)
+        self._table_sig = None
+        return True
 
     # ------------------------------------------------------------------
     # fleet prefix handoff (drain-time export / adopt-time import)

@@ -20,6 +20,10 @@ class SchedulerConfig:
     max_tokens_per_step: int = 2048      # SplitFuse token budget
     max_decode_batch: int = 64
     prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048)
+    # decode-first chunk cap: at most this many prefill tokens per step, so
+    # chunked prefill interleaves with decode and TPOT never spikes behind a
+    # long prompt. 0 (default) = uncapped, bit-identical pre-cap planning.
+    prefill_chunk_tokens: int = 0
 
 
 @dataclasses.dataclass
@@ -49,17 +53,34 @@ def snap_bucket(n: int, buckets: Sequence[int]) -> int:
 
 def plan_step(decoding: List[SequenceDescriptor],
               prefilling: List[SequenceDescriptor],
-              cfg: SchedulerConfig) -> StepPlan:
+              cfg: SchedulerConfig,
+              block_tokens: int = 0) -> StepPlan:
     """Build one step's work: decodes first (latency), then prefill chunks up to
-    the token budget (reference: SplitFuse composition in engine_v2.put)."""
+    the token budget (reference: SplitFuse composition in engine_v2.put).
+
+    With ``cfg.prefill_chunk_tokens > 0`` the decode-first cap applies: total
+    prefill tokens this step never exceed the cap, and mid-prompt chunk
+    boundaries are rounded DOWN to ``block_tokens`` multiples (KV-block /
+    PrefixCache granularity — a chunk ending mid-block would strand a
+    partial page no later hit or handoff could adopt). Buckets are unchanged,
+    so capped chunks reuse the warm compile ladder. Cap off (0, default) is
+    bit-identical to pre-cap planning."""
+    cap = int(cfg.prefill_chunk_tokens)
     decodes = decoding[:cfg.max_decode_batch]
     budget = cfg.max_tokens_per_step - len(decodes)
+    if cap > 0:
+        budget = min(budget, cap)
     chunks: List[PrefillChunk] = []
     for seq in prefilling:
         if budget < cfg.prefill_buckets[0] // 2 and chunks:
             break
         remaining = len(seq.prompt_tokens) - seq.seen_tokens
         take = min(remaining, budget, cfg.prefill_buckets[-1])
+        if cap > 0 and block_tokens > 0 and take < remaining:
+            # a capped mid-prompt boundary snaps to KV-block granularity;
+            # when the leftover budget can't cover one block, the prompt
+            # waits a tick (decodes keep the step — that's the point)
+            take -= take % block_tokens
         if take <= 0:
             break
         bucket = snap_bucket(take, cfg.prefill_buckets)

@@ -47,6 +47,7 @@ from deepspeed_tpu.telemetry.compiles import watch_jit
 from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.runtime.dataloader import PrefetchLoader, StagedBatch
+from deepspeed_tpu.runtime.sched import DispatchRing, StagedPrefetcher
 from deepspeed_tpu.utils.timer import (
     BACKWARD_GLOBAL_TIMER,
     FORWARD_GLOBAL_TIMER,
@@ -590,6 +591,13 @@ class DeepSpeedTPUEngine:
         # the configured cadence survives enable/disable toggles; the live
         # _sync_every collapses to 1 whenever the pipeline is off
         self._sync_every_cfg = int(acfg.sync_every)
+        # the shared host-orchestration core (runtime/sched.py): DispatchRing
+        # owns the device-side pending ring, the bounded drained-entry queue
+        # and the window anchor; StagedPrefetcher owns the identity-keyed
+        # loader lifecycle. The serve loop consumes the same classes —
+        # engine-specific host fan-out stays in _drain_metric_ring.
+        self._sched = DispatchRing(capacity=4096)
+        self._staged = StagedPrefetcher()
         self._sync_every = self._sync_every_cfg if self._async_enabled else 1
         self._prefetch_enabled = self._async_enabled and bool(acfg.prefetch)
         if self._prefetch_enabled and (config.flops_profiler.enabled
@@ -601,13 +609,6 @@ class DeepSpeedTPUEngine:
                      "eigenvalue need host-materialized batches", ranks=[0])
             self._prefetch_enabled = False
         self._prefetch_depth = int(acfg.prefetch_depth)
-        self._metric_ring: List[Dict[str, Any]] = []   # device-side pending
-        self._drained_metrics: collections.deque = collections.deque(
-            maxlen=4096)                               # host entries, unconsumed
-        self._last_drain_time: Optional[float] = None
-        self._prefetcher: Optional[PrefetchLoader] = None
-        self._prefetcher_src = None
-        self._prefetch_switches = 0
         if self._async_enabled and config.wall_clock_breakdown:
             log_dist("async_pipeline: wall_clock_breakdown forces a device "
                      "sync per timer start/stop — the breakdown timers will "
@@ -1563,13 +1564,13 @@ class DeepSpeedTPUEngine:
             # be captured here: the state is donated to the next compiled
             # step, which deletes those buffers while they'd still sit in
             # the ring. The live scale is fetched at drain time instead.
-            self._metric_ring.append({
+            due = self._sched.push({
                 "step": self.global_steps,
                 "samples": self.global_samples,
                 "loss": out.loss, "grad_norm": out.grad_norm, "lr": out.lr,
                 "overflow": out.overflow,
             })
-            if len(self._metric_ring) >= self._sync_every:
+            if due:
                 self._drain_metric_ring()
             return
         self._last_metrics = {"lr": out.lr, "grad_norm": out.grad_norm,
@@ -1610,36 +1611,80 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------
     # async step pipeline: the designated drain + its consumers
     # ------------------------------------------------------------------
+    # The ring/prefetcher mechanics live on the shared sched core
+    # (runtime/sched.py, also consumed by the serve loop); these views keep
+    # the names the PR 3 pipeline exposed — consumers and the hot-sync
+    # lint fixtures poke them directly.
+    @property
+    def _metric_ring(self) -> List[Dict[str, Any]]:
+        return self._sched.pending
+
+    @property
+    def _drained_metrics(self) -> collections.deque:
+        return self._sched.drained
+
+    @property
+    def _last_drain_time(self) -> Optional[float]:
+        return self._sched.anchor
+
+    @_last_drain_time.setter
+    def _last_drain_time(self, t: Optional[float]) -> None:
+        self._sched.anchor = t
+
+    @property
+    def _sync_every(self) -> int:
+        return self._sched.sync_every
+
+    @_sync_every.setter
+    def _sync_every(self, v: int) -> None:
+        self._sched.sync_every = int(v)
+
+    @property
+    def _prefetch_depth(self) -> int:
+        return self._staged.depth
+
+    @_prefetch_depth.setter
+    def _prefetch_depth(self, v: int) -> None:
+        self._staged.depth = int(v)
+
+    @property
+    def _prefetcher(self) -> Optional[PrefetchLoader]:
+        return self._staged.loader
+
+    @property
+    def _prefetcher_src(self):
+        return self._staged.source
+
+    @property
+    def _prefetch_switches(self) -> int:
+        return self._staged.switches
+
     def _drain_metric_ring(self) -> List[Dict[str, Any]]:
         """THE designated readback point of the async pipeline: one batched
-        ``device_get`` moves every pending step's outputs to host (and, by
-        data dependency, proves those steps' device work completed — the
-        anchor that keeps the reconciled timers honest). Host fan-out:
-        ``_last_metrics``, monitor events for ``steps_per_print``-boundary
-        steps, TRAIN_BATCH_TIMER/throughput reconciliation, and the ordered
-        entry queue the resilience runner replays through its StepGuard."""
-        if not self._metric_ring:
-            return []
-        ring, self._metric_ring = self._metric_ring, []
+        ``device_get`` (DispatchRing.drain) moves every pending step's
+        outputs to host (and, by data dependency, proves those steps'
+        device work completed — the anchor that keeps the reconciled timers
+        honest). Host fan-out: ``_last_metrics``, monitor events for
+        ``steps_per_print``-boundary steps, TRAIN_BATCH_TIMER/throughput
+        reconciliation, and the ordered entry queue the resilience runner
+        replays through its StepGuard."""
         # the LIVE loss scale rides the same transfer (exact at sync_every=1;
-        # for lagged fp16 entries the monitor shows the drain-time scale)
+        # for lagged fp16 entries the monitor shows the drain-time scale);
+        # execution-time OOM of an async step surfaces at the designated
+        # readback — same classify-and-stash contract
         try:
-            with self.tracer.span("engine/drain", cat="train",
-                                  steps=len(ring)):
-                host, scale = jax.device_get((ring,
-                                              self.state.loss_scale.scale))
+            res = self._sched.drain(extra=self.state.loss_scale.scale)
         except Exception as e:
-            # execution-time OOM of an async step surfaces HERE, at the
-            # designated readback — same classify-and-stash contract
             self._note_oom(e)
             raise
-        now = time.time()
-        scale = float(scale)
+        if res is None:
+            return []
+        scale = float(res.extra)
         entries = [{"step": int(e["step"]), "samples": int(e["samples"]),
                     "loss": float(e["loss"]),
                     "grad_norm": float(e["grad_norm"]),
                     "lr": float(e["lr"]), "overflow": bool(e["overflow"]),
-                    "loss_scale": scale} for e in host]
+                    "loss_scale": scale} for e in res.payloads]
         last = entries[-1]
         self._last_metrics = {"lr": last["lr"], "grad_norm": last["grad_norm"],
                               "loss": last["loss"],
@@ -1648,8 +1693,8 @@ class DeepSpeedTPUEngine:
         # re-anchors whenever the ring is empty), so checkpoint I/O or idle
         # gaps between windows never inflate the reconciled step time
         window = 0.0
-        if self._last_drain_time is not None:
-            window = max(now - self._last_drain_time, 0.0)
+        if res.anchored:
+            window = res.window_s
             self.timers(TRAIN_BATCH_TIMER).record_external(
                 window, count=len(entries))
             # retro span covering the reconciled window: the TRUE step time
@@ -1677,16 +1722,7 @@ class DeepSpeedTPUEngine:
             # the drain already paid a host sync; the dsmem sample here adds
             # allocator-stat dict reads only (DS002-registered hook)
             self._mem_sampler.on_drain(step=last["step"])
-        dropped = (len(self._drained_metrics) + len(entries)
-                   - self._drained_metrics.maxlen)
-        if dropped > 0:
-            # deque eviction must never be silent: with no consumer attached
-            # the bounded-lag guard guarantee degrades past this point
-            logger.warning(
-                "async_pipeline: drained-metrics queue overflow — %d oldest "
-                "un-consumed entries dropped (no take_drained_metrics "
-                "consumer attached?)", dropped)
-        self._drained_metrics.extend(entries)
+        self._sched.store(entries)
         return entries
 
     def flush_metrics(self) -> List[Dict[str, Any]]:
@@ -1703,25 +1739,13 @@ class DeepSpeedTPUEngine:
         resilience runner's per-step hook — with ``sync_every=N`` its guard
         observes steps with up to N steps of detection lag, replayed in
         order here."""
-        out = list(self._drained_metrics)
-        self._drained_metrics.clear()
-        return out
+        return self._sched.take()
 
     def requeue_drained_metrics(self, entries: List[Dict[str, Any]]) -> None:
         """Put taken-but-unprocessed entries back at the FRONT of the queue
         (original order preserved) — the runner uses this when its guard
         raises mid-replay, so the tail still gets judged by a later flush."""
-        free = self._drained_metrics.maxlen - len(self._drained_metrics)
-        if len(entries) > free:
-            # appendleft on a full deque would evict the NEWEST entries from
-            # the right — refuse to lose them silently
-            logger.warning(
-                "async_pipeline: requeue overflow — %d newest entries "
-                "dropped from the drained-metrics queue",
-                len(entries) - free)
-            entries = entries[:free]
-        for e in reversed(entries):
-            self._drained_metrics.appendleft(e)
+        self._sched.requeue(entries)
 
     def configure_async_pipeline(self, enabled: Optional[bool] = None,
                                  sync_every: Optional[int] = None,
@@ -1733,10 +1757,7 @@ class DeepSpeedTPUEngine:
         staged batches (the source iterator has already advanced past them)
         — reconfigure at iterator boundaries when exact batch order matters."""
         self.flush_metrics()
-        if self._prefetcher is not None:
-            self._prefetcher.close()
-            self._prefetcher = None
-            self._prefetcher_src = None
+        self._staged.close()
         if enabled is not None:
             if enabled and (self._param_offload is not None
                             or self._offload is not None):
@@ -1766,25 +1787,9 @@ class DeepSpeedTPUEngine:
         return self
 
     def _ensure_prefetcher(self, data_iter) -> PrefetchLoader:
-        """One staged-batch prefetcher per source iterator (identity-keyed;
-        a new source closes the old prefetcher, dropping its staged
-        batches — swap iterators at epoch boundaries)."""
-        if self._prefetcher is not None and self._prefetcher_src is data_iter:
-            return self._prefetcher
-        if self._prefetcher is not None:
-            self._prefetch_switches += 1
-            if self._prefetch_switches <= 3 or \
-                    self._prefetch_switches % 100 == 0:
-                # a fresh iterator object per call defeats prefetch (thread
-                # churn + staged batches already pulled from the source are
-                # dropped) — loud the first few times, throttled after
-                logger.warning(
-                    "async_pipeline: data_iter identity changed (switch "
-                    "#%d) — discarding the previous prefetcher and up to "
-                    "%d staged batches; pass a STABLE iterator across "
-                    "train_batch calls", self._prefetch_switches,
-                    self._prefetch_depth)
-            self._prefetcher.close()
+        """One staged-batch prefetcher per source iterator (identity-keyed
+        by StagedPrefetcher; a new source closes the old prefetcher,
+        dropping its staged batches — swap iterators at epoch boundaries)."""
         gas = self.gradient_accumulation_steps
 
         def stacked_batches():
@@ -1794,12 +1799,14 @@ class DeepSpeedTPUEngine:
                 except StopIteration:   # PEP 479: surface as a clean end
                     return
 
-        self._prefetcher = PrefetchLoader(
-            stacked_batches(),
-            stage_fn=lambda b: StagedBatch(self._shard_batch(b, stacked=True)),
-            depth=self._prefetch_depth)
-        self._prefetcher_src = data_iter
-        return self._prefetcher
+        def build():
+            return PrefetchLoader(
+                stacked_batches(),
+                stage_fn=lambda b: StagedBatch(
+                    self._shard_batch(b, stacked=True)),
+                depth=self._prefetch_depth)
+
+        return self._staged.ensure(data_iter, build)
 
     # ------------------------------------------------------------------
     # forward/backward/step compatibility protocol

@@ -97,6 +97,17 @@ SCENARIOS: Dict[str, ServeScenario] = {
                               max_new_tokens=(4, 10),
                               low_priority_every=3,
                               prompt_len=(24, 48), shared_prefix_frac=0.5),
+    # decode-first scheduling proof workload: a first burst starts
+    # decoding, then seeded LONG prompts (several KV blocks each, larger
+    # than the tiny engine's 64-token step budget) keep landing mid-decode
+    # — unchunked, each arrival serializes every decode behind a full
+    # prefill tick; with `serving.scheduler.prefill_chunk_tokens` set, the
+    # tick ledger proves prefill never exceeds the cap
+    "long_prompt": ServeScenario(name="long_prompt", mode="open",
+                                 num_requests=16, burst=4,
+                                 arrival_interval_s=0.01,
+                                 max_new_tokens=(8, 16),
+                                 prompt_len=(48, 96)),
 }
 
 
@@ -333,6 +344,11 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
     # the measured proof set (its uids are likewise dropped from the
     # span-derived latency percentiles below)
     compile_mark = compiles_total()
+    if hasattr(server.engine, "sched_mark"):
+        # reset the tick-ledger window maxima (max prefill tokens/tick,
+        # max decode stall) so the scheduler proof set below covers the
+        # measured window only, like every other counter here
+        server.engine.sched_mark()
     pre_snap = server.metrics.snapshot() if warmup else {}
     pre_prefix = (server.engine.prefix_stats()
                   if warmup and hasattr(server.engine, "prefix_stats")
@@ -428,6 +444,30 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
             snap["bytes_per_resident_token"]
         prefix["host_compression_ratio"] = \
             snap["host_kv_compression_ratio"]
+    # scheduler proof set: the engine tick ledger (per-tick prefill-token
+    # maxima, cap utilization, decode-gap in ticks). Window maxima cover
+    # the measured window (sched_mark above); totals are cumulative, and
+    # the conservation check ties them to the engine-truth prefill
+    # counter — chunking must neither lose nor duplicate a prompt token.
+    sched: dict = {}
+    if hasattr(server.engine, "sched_stats"):
+        sched_cfg = dict(getattr(server.config, "scheduler", None) or {})
+        cap = int(sched_cfg.get("prefill_chunk_tokens", 0) or 0)
+        plan_cfg = getattr(getattr(server.engine, "config", None),
+                           "scheduler", None)
+        # unchunked runs report the decode gap in units of the smallest
+        # prefill bucket so a chunked A/B can re-state its gap in the
+        # same units (sched_stats(gap_unit_tokens=...))
+        unit = cap or (int(plan_cfg.prefill_buckets[0])
+                       if plan_cfg is not None and plan_cfg.prefill_buckets
+                       else 0)
+        sched = server.engine.sched_stats(gap_unit_tokens=unit)
+        if hasattr(server.engine, "prefix_stats"):
+            computed = int(server.engine.prefix_stats()
+                           .get("prefill_tokens_computed", 0))
+            sched["prefill_tokens_engine"] = computed
+            sched["chunk_conservation_ok"] = \
+                sched["chunk_tokens_total"] == computed
     # the atexit dump lands relative to THIS process's cwd — record it
     # absolute, or `dstpu plan --serve` would resolve a relative
     # DSTPU_TRACE against the report's directory instead
@@ -479,6 +519,10 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
             "prefill_tokens_saved": prefix.get("prefill_tokens_saved", 0),
             "prefill_tokens_computed":
                 prefix.get("prefill_tokens_computed", 0),
+            # worst tick's prefill tokens in the measured window — the
+            # counter the `prefill_chunk_tokens` plan rule predicts on
+            "max_prefill_tokens_per_tick":
+                sched.get("max_prefill_tokens_per_tick", 0),
             # the compile-ledger proof: XLA compiles that landed INSIDE
             # the measured window (warmed runs must report 0 — a compile
             # here stalled ticks and skewed every latency number above)
@@ -487,6 +531,7 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
         # latency_from_trace + counters are measured-window only; the raw
         # "metrics" mirror (and its percentile sketches) stays cumulative
         "warmed": {"enabled": warmup, "requests": warm_requests},
+        "scheduler": sched,
         "prefix": prefix,
         "kv_ledger": ledger,
         "ladder": {"level": server.ladder.level.name.lower(),
@@ -744,10 +789,11 @@ def build_tiny_server(kv_num_blocks: int = 64, kv_block_size: int = 16,
     model = LlamaForCausalLM(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         {"input_ids": np.zeros((1, 8), np.int32)})["params"]
-    engine = InferenceEngineV2(params, cfg, V2EngineConfig(
+    v2cfg = V2EngineConfig(
         kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
         scheduler=SchedulerConfig(max_tokens_per_step=64,
-                                  prefill_buckets=(16, 32, 64))))
+                                  prefill_buckets=(16, 32, 64)))
+    engine = InferenceEngineV2(params, cfg, v2cfg)
     overrides = {"max_queue_depth": 32, "kv_offload_enabled": kv_offload,
                  "kv_demote_watermark": 0.5,
                  "kv_demote_watermark_brownout": 0.3,
@@ -756,6 +802,15 @@ def build_tiny_server(kv_num_blocks: int = 64, kv_block_size: int = 16,
                                       else "none"),
                  "idle_poll_s": 0.001}
     overrides.update(serving_overrides or {})
+    sched_group = dict((serving_overrides or {}).get("scheduler") or {})
+    if sched_group.get("role_split"):
+        # prefill-role/decode-role pair sharing the tiny params; each role
+        # gets its own KV pool at the configured geometry, and the server
+        # drives the pair through the single-engine surface
+        from deepspeed_tpu.serving.disagg import DisaggregatedEngine
+        engine = DisaggregatedEngine(
+            engine, InferenceEngineV2(params, cfg, v2cfg),
+            handoff_quantize=sched_group.get("handoff_quantize", "none"))
     return InferenceServer(engine, ServingConfig(**overrides))
 
 

@@ -92,6 +92,25 @@ class ServerClosedError(RuntimeError):
     """Submission refused: the server is draining, stopped, or degraded."""
 
 
+#: the ``serving.scheduler`` sub-group (a nested dict so partial user
+#: configs merge over these and ``from_ds_config`` passes the group
+#: through verbatim): decode-first chunked prefill + the prefill/decode
+#: role split. Every default = today's semantics (cap off, one engine).
+SCHEDULER_DEFAULTS = {
+    # per-tick prefill-token cap: chunked prefill interleaves with decode
+    # so TPOT never spikes behind a long prompt. 0 = uncapped (pre-cap
+    # planning, bit-identical). Must cover >= 1 KV block when set.
+    "prefill_chunk_tokens": 0,
+    # prefill-role/decode-role engine pair in one process with
+    # block-granular KV handoff (serving/disagg.py); consumed by the
+    # server builder, not the tick
+    "role_split": False,
+    # page codec for the in-process KV handoff ("none" | "int8" | "fp8");
+    # "none" = full-width, bit-identical adoption
+    "handoff_quantize": "none",
+}
+
+
 class _EngineStepError(RuntimeError):
     """Internal: ``engine.step`` raised. Carries the original exception as
     ``__cause__`` so the fault handler can classify it (fatal -> sticky
@@ -145,6 +164,31 @@ class ServingConfig:
     # fault episode over (serve/recovered instant + counter)
     max_consecutive_step_faults: int = 8  # latch degraded past this many
     # engine-step faults with no clean step in between
+
+    # --- async serve scheduler (SCHEDULER_DEFAULTS above): decode-first
+    # chunked prefill + the prefill/decode role split; a partial dict
+    # merges over the defaults in __post_init__ ---
+    scheduler: dict = dataclasses.field(
+        default_factory=lambda: dict(SCHEDULER_DEFAULTS))
+
+    def __post_init__(self):
+        merged = dict(SCHEDULER_DEFAULTS)
+        unknown = sorted(set(self.scheduler or {}) - set(merged))
+        if unknown:
+            raise ValueError(
+                f"unknown 'serving.scheduler' keys: {unknown}; "
+                f"known: {sorted(merged)}")
+        merged.update(self.scheduler or {})
+        self.scheduler = merged
+        if int(merged["prefill_chunk_tokens"]) < 0:
+            raise ValueError(
+                f"serving.scheduler.prefill_chunk_tokens must be >= 0, "
+                f"got {merged['prefill_chunk_tokens']}")
+        from deepspeed_tpu.inference.v2.kv_offload import KV_CODECS
+        if merged["handoff_quantize"] not in KV_CODECS:
+            raise ValueError(
+                f"serving.scheduler.handoff_quantize must be one of "
+                f"{KV_CODECS}, got {merged['handoff_quantize']!r}")
 
     @classmethod
     def from_ds_config(cls, ds_config: dict) -> "ServingConfig":
@@ -225,6 +269,13 @@ class InferenceServer:
         self._prefix_capable = (self.config.prefix_cache_enabled
                                 and getattr(engine, "prefix_cache", None)
                                 is not None)
+        # decode-first chunked prefill: wire the scheduler sub-group's cap
+        # into the engine's SplitFuse planner (minimal test doubles without
+        # the hook simply run uncapped); cap 0 touches nothing, so the
+        # default config leaves planning bit-identical
+        cap = int(self.config.scheduler.get("prefill_chunk_tokens", 0) or 0)
+        if cap > 0 and hasattr(engine, "configure_chunked_prefill"):
+            engine.configure_chunked_prefill(cap)
         self._block_bytes_cache: Optional[int] = None
         # serving-tick stage clocks (serve-loop-private): cumulative busy
         # seconds per stage + cumulative tick seconds, feeding the

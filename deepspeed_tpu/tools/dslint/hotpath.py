@@ -93,6 +93,57 @@ HOT_PATHS: Tuple[HotPathSpec, ...] = (
         },
         forbidden=ENGINE_HOT_FORBIDDEN,
     ),
+    # the extracted host-orchestration core (runtime/sched.py) BOTH loops
+    # now consume: the dispatch ring's producer/consumer surface runs on
+    # every train step AND every serve tick, and ``drain`` is THE
+    # designated batched readback — the file-wide confine proves nothing
+    # else in the shared core ever grows a ``device_get``
+    HotPathSpec(
+        path="deepspeed_tpu/runtime/sched.py",
+        cls="DispatchRing",
+        hot_functions=("push", "rearm_if_idle", "store", "take",
+                       "requeue", "__len__"),
+        confine={".device_get": ("drain",)},
+        forbidden=ENGINE_HOT_FORBIDDEN,
+    ),
+    HotPathSpec(
+        path="deepspeed_tpu/runtime/sched.py",
+        cls="StagedPrefetcher",
+        hot_functions=("ensure",),
+    ),
+    # the serve scheduler's tick ledger: ``observe_tick`` runs once per
+    # engine step — pure host int arithmetic (``snapshot`` is report-time
+    # and deliberately NOT hot)
+    HotPathSpec(
+        path="deepspeed_tpu/runtime/sched.py",
+        cls="TickLedger",
+        hot_functions=("observe_tick", "reset_window"),
+    ),
+    # the serve tick planner + chunk splitter: decode-first batch
+    # composition and cap/bucket/block-snapped prefill chunking, run on
+    # EVERY engine step — pure int planning over the sequence tables
+    HotPathSpec(
+        path="deepspeed_tpu/inference/v2/scheduler.py",
+        cls=None,
+        hot_functions=("snap_bucket", "plan_step"),
+    ),
+    # disaggregation: the role-pair step + the block-granular KV handoff
+    # run every tick of a role-split server; the only device touches are
+    # the engine demote/adopt calls the handoff *decides* to issue
+    HotPathSpec(
+        path="deepspeed_tpu/serving/disagg.py",
+        cls="DisaggregatedEngine",
+        hot_functions=("step", "_handoff", "can_schedule", "has_work"),
+    ),
+    # the adoption half of the handoff: host-side table/codec work plus
+    # the deliberate scatter of already-dequantized pages (numpy over
+    # HOST arrays — device syncs stay forbidden)
+    HotPathSpec(
+        path="deepspeed_tpu/inference/v2/engine_v2.py",
+        cls="InferenceEngineV2",
+        hot_functions=("adopt_kv_handoff",),
+        forbidden=ENGINE_FORBIDDEN,
+    ),
     # the serving tick: one thread drives admit/step/fan-out for every live
     # request — a sync here stalls every stream at once. The PR 10 siege
     # helpers (KV tier rebalance, ladder observation, drift reconcile,

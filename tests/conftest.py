@@ -43,6 +43,7 @@ _XLA_CACHE_MODULES = {
     "test_param_offload", "test_offload", "test_t5", "test_pipeline",
     "test_llama", "test_gpt_neox", "test_gpt2", "test_gemma2",
     "test_aux_runtime", "test_onebit", "test_fast_convergence",
+    "test_sched",
 }
 
 
