@@ -462,6 +462,38 @@ def dslint_report():
             rows.append(("dslint baseline",
                          f"{n} grandfathered finding{'s' if n != 1 else ''} "
                          f"({bl})"))
+        try:
+            from deepspeed_tpu.tools.dslint.callgraph import \
+                build_graph_from_sources
+            from deepspeed_tpu.tools.dslint.engine import iter_python_files
+            from deepspeed_tpu.tools.dslint.hotpath import (ESCAPE_HATCHES,
+                                                            HOT_ROOTS)
+            pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            files = []
+            for p in iter_python_files(
+                    [os.path.join(pkg, "deepspeed_tpu")]):
+                rel = os.path.relpath(p, pkg).replace(os.sep, "/")
+                with open(p, encoding="utf-8") as fh:
+                    files.append((rel, fh.read()))
+            g = build_graph_from_sources(files)
+            st = g.stats()
+            roots = sorted(k for k in (g.resolve(r.path, r.qualname)
+                                       for r in HOT_ROOTS) if k)
+            prune = {k for k in (g.resolve(h.path, h.qualname)
+                                 for h in ESCAPE_HATCHES
+                                 if h.mode == "prune") if k}
+            reached = g.reachable_from(roots, prune=prune)
+            rows.append(("dslint callgraph",
+                         f"{st['functions']} functions, {st['edges']} "
+                         f"edges, {st['unresolved_calls']} dynamic calls "
+                         f"degraded to stats"))
+            rows.append(("dslint hot taint",
+                         f"{len(roots)}/{len(HOT_ROOTS)} roots resolved -> "
+                         f"{len(reached)} functions "
+                         f"({100 * len(reached) // max(st['functions'], 1)}"
+                         f"% of package) under DS002"))
+        except Exception as e:    # graph stats are best-effort decoration
+            rows.append(("dslint callgraph", f"unavailable ({e})"))
         return rows
     except Exception as e:   # the report must never die on tooling drift
         return [("dslint", f"unavailable ({e})")]

@@ -57,6 +57,9 @@ class QuantizedTensor:
 
     @property
     def nbytes(self) -> int:
+        # the asarray branch only runs when codes is a host container
+        # (lists/bytes from a deserialized payload) — arrays short-circuit
+        # dslint: disable=DS002 -- hasattr-guarded host fallback, arrays take the nbytes branch
         return (np.asarray(self.codes).nbytes if not hasattr(self.codes, "nbytes")
                 else self.codes.nbytes) + self.scale.nbytes
 

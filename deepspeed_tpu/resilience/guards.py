@@ -240,6 +240,7 @@ class StepGuard:
                 "step guard: lr backoff is not supported with offload_param "
                 "(fused host optimizer owns the schedule); lr unchanged")
             return
+        # dslint: disable=DS002 -- scale is a python float from the backoff schedule, not an array
         self.lr_scale = float(scale)
         get_tracer().instant("resilience/lr_backoff", cat="resilience",
                              step=self.engine.global_steps,

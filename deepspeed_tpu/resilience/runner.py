@@ -478,6 +478,7 @@ class FaultTolerantRunner:
     def _record_history(self, step, host, duration):
         def f(v):
             try:
+                # dslint: disable=DS002 -- host dict values: step() device_gets (sync) or drains (async) first
                 return float(v) if v is not None else None
             except (TypeError, ValueError):
                 return None

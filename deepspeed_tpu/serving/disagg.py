@@ -21,9 +21,11 @@ greedy sampling the handed-off sequence continues to the same tokens as a
 single-engine run (pinned by tests/test_sched.py).
 """
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from deepspeed_tpu.runtime.sched import TickLedger
+from deepspeed_tpu.telemetry.tracer import get_tracer
 
 
 class _PairStateView:
@@ -121,6 +123,7 @@ class DisaggregatedEngine:
 
     # -- the step: prefill role, handoff, decode role ------------------
     def step(self) -> Dict[int, int]:
+        t0 = time.perf_counter()
         out = self.prefill.step()
         out.update(self.decode.step())
         # handoff AFTER both role steps: a uid is resident on exactly one
@@ -147,6 +150,13 @@ class DisaggregatedEngine:
                 counters["prefill_tokens"], counters["chunks"],
                 counters["decode_tokens"],
                 cap=self.prefill.config.scheduler.prefill_chunk_tokens)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.complete("disagg/tick", time.perf_counter() - t0,
+                            cat="serve",
+                            prefill_tokens=counters["prefill_tokens"],
+                            decode_tokens=counters["decode_tokens"],
+                            chunks=counters["chunks"])
         return out
 
     def _handoff(self) -> None:
@@ -177,6 +187,10 @@ class DisaggregatedEngine:
                 self.handoff_stats["handoff_blocks"] += entry.blocks
                 self.handoff_stats["handoff_bytes"] += entry.nbytes
                 self.handoff_stats["handoff_raw_bytes"] += entry.raw_nbytes
+                get_tracer().instant("disagg/handoff", cat="serve",
+                                     uid=uid, blocks=entry.blocks,
+                                     bytes=entry.nbytes,
+                                     quantize=self.handoff_quantize)
             else:
                 self.handoff_stats["handoff_deferred"] += 1
 

@@ -496,13 +496,17 @@ class FleetRouter:
         with self._lock:
             counters = dict(self.counters)
             snaps = [h.snapshot() for h in self._handles.values()]
-        for k in COUNTER_KEYS:
-            name = f"dstpu_fleet_{k}"
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {counters[k]}")
-        lines.append("# TYPE dstpu_fleet_replicas_in_rotation gauge")
-        lines.append("dstpu_fleet_replicas_in_rotation "
-                     f"{sum(1 for s in snaps if s['in_rotation'])}")
+        # ONE emission site for every dstpu_fleet_* family: the row list
+        # can't claim a family twice (the gauge used to be a second
+        # hand-emitted TYPE block inside the counter loop's namespace —
+        # one COUNTER_KEYS collision away from duplicate metadata, which
+        # the Prometheus text parser rejects wholesale; DS008 pins this)
+        rows = [(k, "counter", counters[k]) for k in COUNTER_KEYS]
+        rows.append(("replicas_in_rotation", "gauge",
+                     sum(1 for s in snaps if s["in_rotation"])))
+        for key, kind, val in rows:
+            lines.append(f"# TYPE dstpu_fleet_{key} {kind}")
+            lines.append(f"dstpu_fleet_{key} {val}")
         lines.extend(get_tracer().prometheus_lines(prefix=("fleet/",)))
         return "\n".join(lines) + "\n"
 

@@ -348,7 +348,9 @@ class ServingMetrics:
             for name, help_text, stat in summaries:
                 full = f"dstpu_serving_{name}"
                 lines.append(f"# HELP {full} {help_text}")
-                lines.append(f"# TYPE {full} summary")
+                # namespace inlined so the TYPE claim is statically scoped
+                # to dstpu_serving_* (DS008)
+                lines.append(f"# TYPE dstpu_serving_{name} summary")
                 for q in (0.5, 0.9, 0.99):
                     lines.append(f'{full}{{quantile="{q}"}} '
                                  f"{stat.quantile(q):.9g}")
@@ -363,7 +365,7 @@ class ServingMetrics:
                 continue
             full = f"dstpu_serving_{key}"
             kind = "counter" if key in counters else "gauge"
-            lines.append(f"# TYPE {full} {kind}")
+            lines.append(f"# TYPE dstpu_serving_{key} {kind}")
             lines.append(f"{full} {val:.9g}")
         # tracer-backed span summaries (request phase latencies straight
         # from the dstrace ring: serve/queued, serve/prefill, serve/decode)

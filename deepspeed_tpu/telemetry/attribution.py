@@ -44,6 +44,26 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
+def _load_trace_names():
+    """File-load ``telemetry/names.py`` from the sibling path — never a
+    package import: this module loads standalone on jax-less hosts. The
+    registry is the ONE declaration of the span names this sweep
+    attributes; dslint DS007 keeps the emitters in agreement with it."""
+    import importlib.util
+    mod = sys.modules.get("dstpu_trace_names")
+    if mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "names.py")
+        spec = importlib.util.spec_from_file_location(
+            "dstpu_trace_names", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules["dstpu_trace_names"] = mod
+    return mod
+
+
+_NAMES = _load_trace_names()
+
 EXIT_OK = 0
 EXIT_REGRESSION = 1
 EXIT_UNREADABLE = 2
@@ -72,7 +92,16 @@ _PRIORITY = {"drain": 6, "h2d": 5, "comm": 4, "ckpt": 3, "prefetch": 2,
 #: — sub-ms skew, never 5%).
 TIE_OUT_TOLERANCE = 0.05
 
-_DISPATCH_NAMES = ("engine/dispatch", "engine/train_step")
+#: canonical names/prefixes from the registry (the emit side is pinned to
+#: the same file by DS007, so a rename can't silently empty a stage)
+_DISPATCH_NAMES = tuple(_NAMES.TRAIN_DISPATCH_NAMES)
+_RECONCILE_NAME = _NAMES.TRAIN_RECONCILE_NAME
+_DRAIN_NAME = _NAMES.TRAIN_DRAIN_NAME
+_H2D_NAME = _NAMES.COMM_H2D_NAME
+_OVERLAP_NAME = _NAMES.COMM_OVERLAP_NAME
+_COMM_PREFIX = _NAMES.COMM_PREFIX
+_CKPT_PREFIX = _NAMES.CKPT_PREFIX
+_PREFETCH_PREFIX = _NAMES.PREFETCH_PREFIX
 
 #: sync-mode window synthesis splits at inter-dispatch gaps larger than
 #: ``median gap x FACTOR`` (with an absolute floor so a uniform sub-ms
@@ -166,15 +195,15 @@ def quantile(sorted_vals: List[float], q: float) -> float:
 # stage classification + step windows
 # ---------------------------------------------------------------------------
 def stage_of(name: str, cat: str) -> Optional[str]:
-    if name == "engine/drain":
+    if name == _DRAIN_NAME:
         return "drain"
-    if name == "comm/h2d":
+    if name == _H2D_NAME:
         return "h2d"
-    if name.startswith("ckpt/"):
+    if name.startswith(_CKPT_PREFIX):
         return "ckpt"
-    if name.startswith("prefetch/"):
+    if name.startswith(_PREFETCH_PREFIX):
         return "prefetch"
-    if cat == "comm" or name.startswith("comm/"):
+    if cat == "comm" or name.startswith(_COMM_PREFIX):
         return "comm"
     if name in _DISPATCH_NAMES:
         return "dispatch"
@@ -202,7 +231,7 @@ def step_windows(events: List[Ev]) -> Tuple[List[Dict[str, Any]], str]:
     start -> last dispatch end), so inter-step host work still attributes.
     """
     rec = sorted((e for e in events if e.ph == "X"
-                  and e.name == "engine/steps_reconciled"),
+                  and e.name == _RECONCILE_NAME),
                  key=lambda e: e.ts)
     if rec:
         wins = []
@@ -298,7 +327,7 @@ def attribute(events: List[Ev], source: str = "<events>",
         w0, w1 = w["start_us"], w["end_us"]
         on_track, off_track = [], []
         for e in spans:
-            if e.name == "engine/steps_reconciled":
+            if e.name == _RECONCILE_NAME:
                 continue
             st = stage_of(e.name, e.cat)
             if st is None or e.end <= w0 or e.ts >= w1:
@@ -441,13 +470,14 @@ def comm_rollup(events: List[Ev]) -> Dict[str, Dict[str, Any]]:
     exactly the wire saving the comm_compression group bought."""
     out: Dict[str, Dict[str, Any]] = {}
     for e in events:
-        if not (e.cat == "comm" or e.name.startswith("comm/")):
+        if not (e.cat == "comm" or e.name.startswith(_COMM_PREFIX)):
             continue
         # h2d is staging (its own stage), comm/overlap the analytic
         # schedule track — neither is collective volume
-        if e.name in ("comm/h2d", "comm/overlap") or "bytes" not in e.args:
+        if e.name in (_H2D_NAME, _OVERLAP_NAME) or "bytes" not in e.args:
             continue
-        op = e.name[len("comm/"):] if e.name.startswith("comm/") else e.name
+        op = e.name[len(_COMM_PREFIX):] \
+        if e.name.startswith(_COMM_PREFIX) else e.name
         world = e.args.get("world", 1)
         key = f"{op}@{world}"
         rec = out.setdefault(key, {"op": op, "world": world,
@@ -505,9 +535,9 @@ def comm_overlap_rollup(ledger: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 #: dsmem counter names (must match telemetry/memory.py — a literal, not an
 #: import: this module loads standalone by contract)
-_MEM_IN_USE = "mem/hbm_bytes_in_use"
-_MEM_PEAK = "mem/hbm_peak_bytes"
-_MEM_LIMIT = "mem/hbm_bytes_limit"
+_MEM_IN_USE = _NAMES.HBM_IN_USE_COUNTER
+_MEM_PEAK = _NAMES.HBM_PEAK_COUNTER
+_MEM_LIMIT = _NAMES.HBM_LIMIT_COUNTER
 
 
 def memory_observed(events: List[Ev]) -> Optional[Dict[str, Any]]:
@@ -556,14 +586,14 @@ def observed_config(events: List[Ev], windows: List[Dict[str, Any]],
     """The async-pipeline config the trace itself reveals — what `plan`
     proposes *against* (never trusts a config file that may have drifted
     from the run)."""
-    drains = [e for e in events if e.ph == "X" and e.name == "engine/drain"]
+    drains = [e for e in events if e.ph == "X" and e.name == _DRAIN_NAME]
     sync_every = None
     if mode == "async" and drains:
         per = [int(e.args.get("steps", 0) or 0) for e in drains]
         per = [p for p in per if p > 0]
         if per:
             sync_every = max(per)   # flushes shorten windows; cadence = max
-    prefetch = any(e.name.startswith("prefetch/") for e in events)
+    prefetch = any(e.name.startswith(_PREFETCH_PREFIX) for e in events)
     return {"mode": mode, "sync_every": sync_every, "prefetch": prefetch,
             "transfers_observed": len(drains) if mode == "async" else
             sum(w["steps"] for w in windows)}

@@ -62,6 +62,27 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
+
+def _load_trace_names():
+    """File-load ``telemetry/names.py`` from the sibling path — never a
+    package import: this module loads standalone on jax-less hosts (the
+    DS007 registry is the one declaration of the comm-span namespace the
+    skew ledger joins on)."""
+    import importlib.util
+    mod = sys.modules.get("dstpu_trace_names")
+    if mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "names.py")
+        spec = importlib.util.spec_from_file_location(
+            "dstpu_trace_names", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules["dstpu_trace_names"] = mod
+    return mod
+
+
+_COMM_PREFIX = _load_trace_names().COMM_PREFIX
+
 EXIT_OK = 0
 EXIT_REGRESSION = 1
 EXIT_UNREADABLE = 2
@@ -152,7 +173,8 @@ def _wall_base_us(ident: Dict[str, Any]) -> Optional[float]:
 
 
 def _is_comm(e: dict) -> bool:
-    return e.get("cat") == "comm" or str(e.get("name", "")).startswith("comm/")
+    return e.get("cat") == "comm" \
+        or str(e.get("name", "")).startswith(_COMM_PREFIX)
 
 
 def _comm_span_arrivals(events: List[dict]) -> Dict[int, float]:

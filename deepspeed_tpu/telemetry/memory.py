@@ -368,6 +368,7 @@ class MemoryLedger:
             grad_accum_dtype=(raw.get("data_types", {}) or {}
                               ).get("grad_accum_dtype"),
             offload_optimizer=opt_off.get("device", "none") or "none",
+            # dslint: disable=DS002 -- config-dict scalar, not an array
             offload_optimizer_ratio=float(opt_off.get("ratio", 1.0) or 1.0),
             offload_param=par_off.get("device", "none") or "none",
             layers_per_group=int(par_off.get("layers_per_group", 1) or 1),

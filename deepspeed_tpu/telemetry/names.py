@@ -1,0 +1,176 @@
+"""Canonical trace-name registry — THE one place a span/instant/counter
+name is declared.
+
+Until dslint v2 the emitters (engine, server, fleet, chaos, comm guard)
+and the offline consumers (``attribution.py`` / ``serve_attribution.py``
+/ ``crossrank.py`` stage tables, the plan rules, the bench gates) agreed
+on names one hand-written test at a time — renaming an emitted span
+silently dropped it out of the exclusive-stage ledgers and every
+downstream share went to ``residual``. Now:
+
+* every name a ``Tracer.span/instant/counter/complete`` call emits as a
+  literal MUST appear in :data:`TRACE_NAMES` (rule **DS007**; dynamic
+  f-string names must start with a :data:`DYNAMIC_PREFIXES` entry), and
+* the offline stage tables derive their name constants FROM this module,
+
+so a rename that touches only one side is a lint finding, not a silent
+attribution hole.
+
+Contract: this module is **stdlib-only pure data** and must stay loadable
+standalone (``importlib`` file-load, no package import) — the offline
+consumers run on jax-less hosts and load it from the sibling path under
+``sys.modules["dstpu_trace_names"]``.
+
+Adding a name: add the ``name -> (kinds,)`` entry here (kinds from
+``span``/``instant``/``counter``/``complete``), emit it, and — if an
+offline sweep should attribute it — extend the relevant stage constant
+below. ``python bin/dslint deepspeed_tpu`` confirms both sides agree.
+"""
+
+from typing import Dict, Tuple
+
+#: every literal trace name the package emits, mapped to the event kinds
+#: it may be emitted as. DS007 flags an emitted literal that is missing
+#: here, and a registered name emitted as an unregistered kind.
+TRACE_NAMES: Dict[str, Tuple[str, ...]] = {
+    # -- training engine ---------------------------------------------------
+    "engine/train_step": ("span",),
+    "engine/dispatch": ("span",),
+    "engine/drain": ("span",),              # DispatchRing's drain span
+    "engine/steps_reconciled": ("complete",),
+    "engine/overflow_step": ("instant",),
+    "comm/h2d": ("span",),
+    "comm/overlap": ("complete",),
+    "ckpt/save": ("span",),
+    "ckpt/load": ("span",),
+    "prefetch/next": ("span",),
+    "prefetch/stage": ("span",),
+    "xla/compile": ("instant",),
+    # -- memory telemetry --------------------------------------------------
+    "mem/oom": ("instant",),
+    "mem/see_memory_usage": ("instant",),
+    "mem/hbm_bytes_in_use": ("counter",),
+    "mem/hbm_peak_bytes": ("counter",),
+    "mem/hbm_bytes_limit": ("counter",),
+    "mem/host_rss_bytes": ("counter",),
+    # -- collective guard / membership ------------------------------------
+    "comm/init_retry": ("instant",),
+    "comm/init_wedge": ("instant",),
+    "comm/op_failed": ("instant",),
+    "comm/wedge": ("instant",),
+    "comm/straggler": ("instant",),
+    # -- resilience --------------------------------------------------------
+    "resilience/bad_step": ("instant",),
+    "resilience/lr_backoff": ("instant",),
+    "resilience/quarantine": ("instant",),
+    "resilience/comm_fault": ("instant",),
+    "resilience/preempt_signal": ("instant",),
+    "resilience/watchdog_flag": ("instant",),
+    # -- chaos drills ------------------------------------------------------
+    "chaos/stall": ("complete",),
+    "chaos/serve_slow_tick": ("complete",),
+    "chaos/ckpt_io_fail": ("instant",),
+    "chaos/comm_delay": ("instant",),
+    "chaos/comm_wedge": ("instant",),
+    "chaos/die": ("instant",),
+    "chaos/nan": ("instant",),
+    "chaos/oom": ("instant",),
+    "chaos/replica_kill": ("instant",),
+    "chaos/serve_kv_pressure": ("instant",),
+    "chaos/serve_poison": ("instant",),
+    # -- elasticity --------------------------------------------------------
+    "elastic/peer_lost": ("instant",),
+    "elastic/regrow": ("instant",),
+    "elastic/shrink_refused": ("instant",),
+    "elastic/shrink_planned": ("instant",),
+    "elastic/reshard": ("instant",),
+    # -- serving tick ------------------------------------------------------
+    "serve/tick": ("complete",),
+    "serve/engine_step": ("span",),
+    "serve/admit": ("span",),
+    "serve/demote": ("span",),
+    "serve/promote": ("span",),
+    "serve/drain": ("span",),
+    "serve/step_prefill": ("complete",),
+    "serve/step_decode": ("complete",),
+    "serve/prefill_chunk": ("complete",),
+    "serve/queued": ("complete",),
+    "serve/prefill": ("complete",),
+    "serve/decode": ("complete",),
+    "serve/kv_bytes": ("counter",),
+    "serve/tick_stage_share": ("counter",),
+    "serve/kv_tier": ("counter",),
+    "serve/prefix_cache": ("counter",),
+    "serve/backpressure": ("instant",),
+    "serve/degraded": ("instant",),
+    "serve/evicted": ("instant",),
+    "serve/kv_demote": ("instant",),
+    "serve/kv_promote": ("instant",),
+    "serve/kv_recalibrate": ("instant",),
+    "serve/kv_drift": ("instant",),
+    "serve/ladder": ("instant",),
+    "serve/prefix_evict": ("instant",),
+    "serve/prefix_handoff_adopt": ("instant",),
+    "serve/prefix_handoff_export": ("instant",),
+    "serve/quarantine": ("instant",),
+    "serve/recovered": ("instant",),
+    "serve/step_fault": ("instant",),
+    # -- disaggregated prefill/decode -------------------------------------
+    "disagg/tick": ("complete",),
+    "disagg/handoff": ("instant",),
+    # -- fleet router ------------------------------------------------------
+    "fleet/poll_tick": ("span",),
+    "fleet/rotation": ("counter",),
+    "fleet/load": ("counter",),
+    "fleet/handoff": ("instant",),
+    "fleet/out_of_rotation": ("instant",),
+    "fleet/replica_lost": ("instant",),
+    "fleet/replica_relaunched": ("instant",),
+    "fleet/request_lost": ("instant",),
+    "fleet/reroute": ("instant",),
+    "fleet/retire": ("instant",),
+    "fleet/scale_out": ("instant",),
+    "fleet/spill": ("instant",),
+}
+
+#: f-string names are allowed when their literal head starts with one of
+#: these (per-op comm records, per-state request transitions); everything
+#: else dynamic is a DS007 finding. Literal names never get prefix
+#: leniency — they must be registered above.
+DYNAMIC_PREFIXES: Tuple[str, ...] = ("comm/", "serve/")
+
+# ---------------------------------------------------------------------------
+# canonical constants the offline stage tables consume (attribution.py /
+# serve_attribution.py / crossrank.py file-load this module standalone)
+# ---------------------------------------------------------------------------
+TRAIN_DISPATCH_NAMES: Tuple[str, ...] = ("engine/dispatch",
+                                         "engine/train_step")
+TRAIN_RECONCILE_NAME = "engine/steps_reconciled"
+TRAIN_DRAIN_NAME = "engine/drain"
+COMM_H2D_NAME = "comm/h2d"
+COMM_OVERLAP_NAME = "comm/overlap"
+COMM_PREFIX = "comm/"
+CKPT_PREFIX = "ckpt/"
+PREFETCH_PREFIX = "prefetch/"
+
+HBM_IN_USE_COUNTER = "mem/hbm_bytes_in_use"
+HBM_PEAK_COUNTER = "mem/hbm_peak_bytes"
+HBM_LIMIT_COUNTER = "mem/hbm_bytes_limit"
+
+SERVE_TICK_NAME = "serve/tick"
+
+#: serving stage table: span name -> exclusive-sweep stage key. The
+#: ``serve_attribution`` priorities live next to the sweep; the NAMES
+#: live here so renaming an emitter trips DS007 instead of silently
+#: reattributing the stage to residual.
+SERVE_STAGE_OF: Dict[str, str] = {
+    "serve/admit": "admission",
+    "serve/step_prefill": "prefill",
+    # per-chunk sub-spans nest inside step_prefill when chunked prefill
+    # is on — same stage, so the exclusive sweep still ties out
+    "serve/prefill_chunk": "prefill",
+    "serve/step_decode": "decode",
+    "serve/demote": "demote",
+    "serve/promote": "promote",
+    "serve/drain": "drain",
+}

@@ -50,6 +50,27 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
+
+def _load_trace_names():
+    """File-load ``telemetry/names.py`` from the sibling path — never a
+    package import: this module loads standalone on jax-less hosts. The
+    stage table's NAMES live in the registry, so renaming a serve emitter
+    is a DS007 finding instead of silently reattributing to residual."""
+    import importlib.util
+    mod = sys.modules.get("dstpu_trace_names")
+    if mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "names.py")
+        spec = importlib.util.spec_from_file_location(
+            "dstpu_trace_names", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules["dstpu_trace_names"] = mod
+    return mod
+
+
+_NAMES = _load_trace_names()
+
 EXIT_OK = 0
 EXIT_REGRESSION = 1
 EXIT_UNREADABLE = 2
@@ -79,17 +100,12 @@ _PRIORITY = {"demote": 6, "promote": 5, "prefill": 4, "decode": 3,
 #: skew between the retro tick window and the stage spans inside it).
 TIE_OUT_TOLERANCE = 0.05
 
-_STAGE_OF = {
-    "serve/admit": "admission",
-    "serve/step_prefill": "prefill",
-    # per-chunk sub-spans nest inside step_prefill when chunked prefill is
-    # on — same stage, so the exclusive sweep still ties out
-    "serve/prefill_chunk": "prefill",
-    "serve/step_decode": "decode",
-    "serve/demote": "demote",
-    "serve/promote": "promote",
-    "serve/drain": "drain",
-}
+_TICK_NAME = _NAMES.SERVE_TICK_NAME
+
+#: span name -> exclusive stage key: the names come from the
+#: registry (one declaration, DS007-enforced); the sweep
+#: priorities stay here next to the sweep
+_STAGE_OF = dict(_NAMES.SERVE_STAGE_OF)
 
 #: ServingConfig defaults the proposal rules fall back to when the input
 #: is a bare trace with no bench_serve provenance (a literal, NOT an
@@ -225,7 +241,7 @@ def tick_windows(events: List[Ev]) -> Tuple[List[Dict[str, Any]], str]:
     ledger then misses admission/drain work outside the step — noted via
     the returned mode)."""
     ticks = sorted((e for e in events
-                    if e.ph == "X" and e.name == "serve/tick"),
+                    if e.ph == "X" and e.name == _TICK_NAME),
                    key=lambda e: e.ts)
     if ticks:
         return [{"start_us": e.ts, "end_us": e.end,
@@ -245,7 +261,7 @@ def main_track(events: List[Ev]) -> Optional[Any]:
     """The tid that emits the tick spans — the serve loop's track."""
     counts: Dict[Any, int] = {}
     for e in events:
-        if e.ph == "X" and e.name in ("serve/tick", "serve/engine_step"):
+        if e.ph == "X" and e.name in (_TICK_NAME, "serve/engine_step"):
             counts[e.tid] = counts.get(e.tid, 0) + 1
     if not counts:
         return None

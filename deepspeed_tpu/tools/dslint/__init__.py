@@ -9,6 +9,9 @@ Rule catalog (see ``docs/static_analysis.md``):
   DS004 thread-shared-state    unlocked writes across a thread boundary
   DS005 signal-handler-safety  non-reentrant work inside a signal handler
   DS006 config-key-drift       raw keys vs config/constants.py, dead constants
+  DS007 trace-name-drift       emitted trace names vs telemetry/names.py registry
+  DS008 prom-family-uniqueness at most one '# TYPE' emission site per metric family
+  DS009 offline-purity         OFFLINE_ONLY modules never (transitively) import jax
 
 Programmatic entry points::
 

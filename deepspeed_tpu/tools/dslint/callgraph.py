@@ -1,0 +1,1018 @@
+"""Project-wide call graph over stdlib ``ast`` — the interprocedural
+substrate DS002 (host-sync taint), DS009 (offline purity) and
+``dslint --changed`` share.
+
+Same discipline as the rest of dslint: stdlib-only, no imports of the
+code under analysis, built once per run from the already-parsed
+``FileContext`` trees and memoized per source snapshot (the lint suite
+re-lints the whole package several times per session; the graph is paid
+for once).
+
+Resolution strategy (deliberately conservative — precision where the
+codebase's idioms make it cheap, and *no* finding is ever produced from
+a guess):
+
+  * module functions & imports    bare names resolve through the file's
+                                  own defs, then ``import``/``from``
+                                  aliases into project modules
+  * self/cls method calls         ``self.m()`` resolves within the
+                                  enclosing class (bases included when
+                                  they resolve in-project)
+  * class-attr-bound callables    ``self.x = ClassName(...)`` (any
+                                  method), ``self.x = some_func``,
+                                  annotated params assigned to attrs
+                                  (``def __init__(self, e: "T")`` +
+                                  ``self.e = e``), and class-level
+                                  ``x: T`` annotations type the receiver
+  * local variables               ``x = ClassName(...)``, annotated
+                                  locals/params
+  * return types                  functions returning ``ClassName(...)``,
+                                  a typed name, or carrying a return
+                                  annotation propagate the receiver type
+                                  through call chains (``get_tracer().
+                                  instant(...)``)
+  * protocols                     ``with`` resolves ``__enter__``/
+                                  ``__exit__`` of the context's type;
+                                  ``len``/``next``/``iter``/``bool`` on a
+                                  typed value resolve the dunder;
+                                  property *reads* on a typed receiver
+                                  resolve the getter
+  * references                    a bare function/method used as a value
+                                  (``Thread(target=self._worker)``,
+                                  callbacks, ``getattr(x, "name")`` with
+                                  a literal name) adds an edge — thread
+                                  entry points stay inside the taint
+  * nested defs                   an enclosing function gets an edge to
+                                  every def nested in it (closures built
+                                  on a hot path run on it)
+  * fallback                     a method call on an *untyped* receiver
+                                  resolves by unique method name across
+                                  all project classes (up to
+                                  ``_FALLBACK_CAP`` candidates — linking
+                                  all of them over-approximates, which is
+                                  safe for taint); beyond the cap the
+                                  call is recorded as *unresolved* and
+                                  degrades to a statistic, never a
+                                  finding
+"""
+
+import ast
+import builtins
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["CallGraph", "FuncInfo", "ClassInfo", "build_graph",
+           "get_callgraph", "own_body_nodes"]
+
+_BUILTINS = frozenset(dir(builtins))
+
+#: attribute calls on an untyped receiver resolve by method name when at
+#: most this many project classes define the method
+_FALLBACK_CAP = 3
+
+#: builtin -> dunder protocol resolution on a typed argument
+_PROTOCOL_BUILTINS = {"len": "__len__", "next": "__next__",
+                      "iter": "__iter__", "bool": "__bool__",
+                      "repr": "__repr__", "str": "__str__"}
+
+#: method names on an *untyped* receiver that are overwhelmingly
+#: dict/list/set/str/file traffic — treating them as project calls would
+#: need a typed receiver anyway, so they resolve-external instead of
+#: polluting the unresolved statistics
+_STDLIB_METHODS = frozenset((
+    "get", "items", "keys", "values", "append", "extend", "pop",
+    "popitem", "setdefault", "update", "add", "discard", "remove",
+    "clear", "copy", "sort", "reverse", "insert", "count", "index",
+    "split", "rsplit", "join", "strip", "lstrip", "rstrip",
+    "startswith", "endswith", "format", "encode", "decode", "lower",
+    "upper", "replace", "lstat", "read", "readline", "write", "close",
+    "flush", "seek", "item", "tolist", "astype", "reshape", "get_nowait",
+    "put_nowait", "put", "acquire", "release", "wait", "notify",
+    "notify_all", "set", "is_set", "total_seconds", "isoformat",
+    "hexdigest", "digest", "groups", "group", "match", "search",
+    "findall", "sub", "most_common", "popleft", "appendleft",
+))
+
+#: container accessor calls whose result carries the receiver's
+#: (element-flattened) types through — ``self._handles.values()`` yields
+#: whatever ``Dict[int, ReplicaHandle]`` flattened to
+_CONTAINER_PASSTHROUGH = frozenset(
+    ("values", "get", "pop", "copy", "setdefault"))
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def own_body_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body EXCLUDING nested function/class subtrees
+    (each nested def is its own graph node; scanning it under the parent
+    would double-report its sinks)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNC_NODES + (ast.ClassDef,)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class FuncInfo:
+    __slots__ = ("key", "relpath", "qualname", "node", "cls")
+
+    def __init__(self, key, relpath, qualname, node, cls):
+        self.key = key                  # "relpath::qualname"
+        self.relpath = relpath
+        self.qualname = qualname        # "Class.method" / "func" / "f.inner"
+        self.node = node
+        self.cls = cls                  # enclosing ClassInfo key or None
+
+    def __repr__(self):
+        return f"<fn {self.key}>"
+
+
+class ClassInfo:
+    __slots__ = ("key", "relpath", "qualname", "node", "bases",
+                 "methods", "attr_types", "attr_funcs", "properties")
+
+    def __init__(self, key, relpath, qualname, node):
+        self.key = key
+        self.relpath = relpath
+        self.qualname = qualname
+        self.node = node
+        self.bases: List[ast.expr] = list(node.bases)
+        self.methods: Dict[str, str] = {}       # name -> func key
+        self.attr_types: Dict[str, Set[str]] = {}   # self.x -> class keys
+        self.attr_funcs: Dict[str, Set[str]] = {}   # self.x -> func keys
+        self.properties: Set[str] = set()
+
+
+class _Module:
+    __slots__ = ("relpath", "modname", "tree", "imports", "functions",
+                 "classes", "global_types", "global_funcs",
+                 "internal_imports", "external_imports", "import_lines")
+
+    def __init__(self, relpath, modname, tree):
+        self.relpath = relpath
+        self.modname = modname          # "deepspeed_tpu.runtime.engine"
+        self.tree = tree
+        # alias -> ("module", modname) | ("symbol", modname, name)
+        self.imports: Dict[str, tuple] = {}
+        self.functions: Dict[str, str] = {}     # top-level name -> func key
+        self.classes: Dict[str, str] = {}       # top-level name -> class key
+        self.global_types: Dict[str, Set[str]] = {}
+        self.global_funcs: Dict[str, str] = {}
+        self.internal_imports: Set[str] = set()     # module-level, project
+        self.external_imports: Set[str] = set()     # top-level ext names
+        self.import_lines: Dict[str, int] = {}      # target relpath -> line
+
+
+class CallGraph:
+    """Functions, call/reference edges, and the module import graph."""
+
+    def __init__(self):
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.modules: Dict[str, _Module] = {}       # relpath -> _Module
+        self.edges: Dict[str, Set[str]] = {}
+        self.edge_lines: Dict[Tuple[str, str], int] = {}
+        # caller key -> [(line, text)] — dynamic calls that degrade to
+        # statistics (NEVER findings)
+        self.unresolved: Dict[str, List[Tuple[int, str]]] = {}
+        self._reverse: Optional[Dict[str, Set[str]]] = None
+
+    # -- structure ------------------------------------------------------
+    def add_edge(self, caller: str, callee: str, line: int):
+        if callee == caller:
+            pass                        # self-recursion is still an edge
+        self.edges.setdefault(caller, set()).add(callee)
+        self.edge_lines.setdefault((caller, callee), line)
+        self._reverse = None
+
+    def callees(self, key: str) -> Set[str]:
+        return self.edges.get(key, set())
+
+    def reverse(self) -> Dict[str, Set[str]]:
+        if self._reverse is None:
+            rev: Dict[str, Set[str]] = {}
+            for caller, outs in self.edges.items():
+                for callee in outs:
+                    rev.setdefault(callee, set()).add(caller)
+            self._reverse = rev
+        return self._reverse
+
+    def resolve(self, path_suffix: str, qualname: str) -> Optional[str]:
+        """Function key for (repo-path-suffix, qualname), or None."""
+        for key, info in self.functions.items():
+            if info.qualname == qualname and _path_matches(
+                    info.relpath, path_suffix):
+                return key
+        return None
+
+    def reachable_from(self, roots: Iterable[str],
+                       prune: Iterable[str] = ()) -> Dict[str, Optional[str]]:
+        """BFS closure over call edges: reached key -> predecessor key
+        (None for roots). ``prune`` keys are reached but not expanded."""
+        prune = set(prune)
+        pred: Dict[str, Optional[str]] = {}
+        queue = []
+        for r in roots:
+            if r not in pred:
+                pred[r] = None
+                queue.append(r)
+        while queue:
+            cur = queue.pop(0)
+            if cur in prune:
+                continue
+            for nxt in sorted(self.edges.get(cur, ())):
+                if nxt not in pred:
+                    pred[nxt] = cur
+                    queue.append(nxt)
+        return pred
+
+    def path_to(self, pred: Dict[str, Optional[str]], key: str) -> List[str]:
+        out = [key]
+        seen = {key}
+        while pred.get(out[-1]) is not None:
+            nxt = pred[out[-1]]
+            if nxt in seen:
+                break
+            out.append(nxt)
+            seen.add(nxt)
+        return list(reversed(out))
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "functions": len(self.functions),
+            "classes": len(self.classes),
+            "modules": len(self.modules),
+            "edges": sum(len(v) for v in self.edges.values()),
+            "unresolved_calls": sum(len(v)
+                                    for v in self.unresolved.values()),
+        }
+
+
+def _path_matches(relpath: str, suffix: str) -> bool:
+    relpath = relpath.replace(os.sep, "/")
+    return relpath == suffix or relpath.endswith("/" + suffix)
+
+
+# ----------------------------------------------------------------------
+# builder
+# ----------------------------------------------------------------------
+def _module_name(relpath: str) -> str:
+    p = relpath.replace(os.sep, "/")
+    if p.endswith("/__init__.py"):
+        p = p[: -len("/__init__.py")]
+    elif p.endswith(".py"):
+        p = p[:-3]
+    return p.replace("/", ".")
+
+
+class _Builder:
+    def __init__(self, files):
+        # files: iterable of (relpath, tree)
+        self.g = CallGraph()
+        self.by_modname: Dict[str, str] = {}        # modname -> relpath
+        self.files = list(files)
+        # method name -> class keys defining it (fallback resolution)
+        self.method_index: Dict[str, List[str]] = {}
+        self.class_by_name: Dict[str, List[str]] = {}
+        self._return_types: Dict[str, Set[str]] = {}
+        self._in_progress: Set[str] = set()
+
+    # -- phase 1: index -------------------------------------------------
+    def index(self):
+        for relpath, tree in self.files:
+            mod = _Module(relpath, _module_name(relpath), tree)
+            self.g.modules[relpath] = mod
+            self.by_modname[mod.modname] = relpath
+            self._index_scope(mod, tree, prefix="", cls=None)
+        for mod in self.g.modules.values():
+            self._index_imports(mod)
+        for cls in self.g.classes.values():
+            for name in cls.methods:
+                self.method_index.setdefault(name, []).append(cls.key)
+            self.class_by_name.setdefault(
+                cls.qualname.rsplit(".", 1)[-1], []).append(cls.key)
+
+    def _index_scope(self, mod: _Module, node: ast.AST, prefix: str,
+                     cls: Optional[ClassInfo]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                key = f"{mod.relpath}::{qn}"
+                info = FuncInfo(key, mod.relpath, qn, child,
+                                cls.key if cls is not None else None)
+                self.g.functions[key] = info
+                if cls is not None:
+                    cls.methods.setdefault(child.name, key)
+                    if any(isinstance(d, ast.Name) and d.id == "property"
+                           or isinstance(d, ast.Attribute)
+                           and d.attr in ("getter", "setter", "deleter")
+                           for d in child.decorator_list):
+                        cls.properties.add(child.name)
+                elif not prefix:
+                    mod.functions.setdefault(child.name, key)
+                self._index_scope(mod, child, qn, cls=None)
+            elif isinstance(child, ast.ClassDef):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                key = f"{mod.relpath}::{qn}"
+                cinfo = ClassInfo(key, mod.relpath, qn, child)
+                self.g.classes[key] = cinfo
+                if not prefix:
+                    mod.classes.setdefault(child.name, key)
+                self._index_scope(mod, child, qn, cls=cinfo)
+            else:
+                self._index_scope(mod, child, prefix, cls)
+
+    # -- phase 2: imports ----------------------------------------------
+    def _index_imports(self, mod: _Module):
+        pkg_parts = mod.modname.split(".")
+
+        def note_internal(modname: str, lineno: int):
+            rel = self.by_modname.get(modname)
+            if rel is None:
+                # "from a.b import name" where a.b is a package dir
+                rel = self.by_modname.get(modname + ".__init__")
+            if rel is not None:
+                mod.internal_imports.add(rel)
+                mod.import_lines.setdefault(rel, lineno)
+                return True
+            return False
+
+        for node in self._module_level_stmts(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = \
+                        ("module", a.name)
+                    if not note_internal(a.name, node.lineno):
+                        mod.external_imports.add(a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:          # relative import
+                    anchor = pkg_parts[: len(pkg_parts) - node.level + (
+                        1 if mod.relpath.endswith("__init__.py") else 0)]
+                    base = ".".join(anchor + ([base] if base else []))
+                for a in node.names:
+                    dotted = f"{base}.{a.name}" if base else a.name
+                    if dotted in self.by_modname:
+                        mod.imports[a.asname or a.name] = ("module", dotted)
+                        note_internal(dotted, node.lineno)
+                    else:
+                        mod.imports[a.asname or a.name] = \
+                            ("symbol", base, a.name)
+                        if not note_internal(base, node.lineno):
+                            if base:
+                                mod.external_imports.add(base.split(".")[0])
+        self._index_lazy_imports(mod, pkg_parts)
+
+    def _index_lazy_imports(self, mod: _Module, pkg_parts):
+        """Imports inside function bodies register ALIASES only (so calls
+        through closures resolve — ``make_sync_fn`` imports the comm
+        facade lazily) — the import *graph* used by DS009 stays strictly
+        module-level: a lazy import is exactly the idiom that keeps a
+        module offline-pure."""
+        top = {id(n) for n in self._module_level_stmts(mod.tree)}
+        for node in ast.walk(mod.tree):
+            if id(node) in top:
+                continue
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports.setdefault(
+                        a.asname or a.name.split(".")[0], ("module", a.name))
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    anchor = pkg_parts[: len(pkg_parts) - node.level + (
+                        1 if mod.relpath.endswith("__init__.py") else 0)]
+                    base = ".".join(anchor + ([base] if base else []))
+                for a in node.names:
+                    dotted = f"{base}.{a.name}" if base else a.name
+                    if dotted in self.by_modname:
+                        mod.imports.setdefault(
+                            a.asname or a.name, ("module", dotted))
+                    else:
+                        mod.imports.setdefault(
+                            a.asname or a.name, ("symbol", base, a.name))
+
+    def _module_level_stmts(self, tree: ast.Module):
+        """Module-level statements, descending into top-level ``try``/
+        ``if`` (ImportError guards) but skipping ``TYPE_CHECKING`` blocks
+        and all function/class bodies — import-graph purity is about what
+        executes at import time."""
+        stack: List[ast.stmt] = list(tree.body)
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            if isinstance(node, ast.If):
+                if "TYPE_CHECKING" in ast.dump(node.test):
+                    continue
+                stack = node.body + node.orelse + stack
+                continue
+            if isinstance(node, ast.Try):
+                stack = (node.body + [s for h in node.handlers
+                                      for s in h.body]
+                         + node.orelse + node.finalbody + stack)
+                continue
+            yield node
+
+    # -- phase 3: types -------------------------------------------------
+    def infer_types(self):
+        for mod in self.g.modules.values():
+            for node in self._module_level_stmts(mod.tree):
+                self._note_global_assign(mod, node)
+        for cls in self.g.classes.values():
+            self._infer_class_attrs(cls)
+
+    def _note_global_assign(self, mod: _Module, node: ast.stmt):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            types = self._constructed_types(mod, node.value)
+            if types:
+                mod.global_types.setdefault(name, set()).update(types)
+            fn = self._value_function(mod, node.value)
+            if fn:
+                mod.global_funcs[name] = fn
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            types = self._annotation_types(mod, node.annotation)
+            if types:
+                mod.global_types.setdefault(
+                    node.target.id, set()).update(types)
+
+    def _infer_class_attrs(self, cls: ClassInfo):
+        mod = self.g.modules[cls.relpath]
+        for stmt in cls.node.body:          # class-level annotations
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                types = self._annotation_types(mod, stmt.annotation)
+                if types:
+                    cls.attr_types.setdefault(
+                        stmt.target.id, set()).update(types)
+        for mkey in cls.methods.values():
+            fn = self.g.functions[mkey].node
+            params = self._param_annotations(mod, fn)
+            for node in own_body_nodes(fn):
+                if isinstance(node, ast.AnnAssign):
+                    attr = _self_attr(node.target)
+                    if attr is not None:
+                        types = self._annotation_types(mod, node.annotation)
+                        if types:
+                            cls.attr_types.setdefault(
+                                attr, set()).update(types)
+                    continue
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    types = self._constructed_types(mod, node.value)
+                    if not types and isinstance(node.value, ast.Name):
+                        types = params.get(node.value.id, set())
+                    if types:
+                        cls.attr_types.setdefault(attr, set()).update(types)
+                    f = self._value_function(mod, node.value,
+                                             cls_for_self=cls)
+                    if f:
+                        cls.attr_funcs.setdefault(attr, set()).add(f)
+
+    def _param_annotations(self, mod: _Module, fn) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {}
+        args = list(fn.args.posonlyargs) + list(fn.args.args) \
+            + list(fn.args.kwonlyargs)
+        for a in args:
+            if a.annotation is not None:
+                types = self._annotation_types(mod, a.annotation)
+                if types:
+                    out[a.arg] = types
+        return out
+
+    def _annotation_types(self, mod: _Module, ann: ast.expr) -> Set[str]:
+        """Class keys named by an annotation: Name/Attribute, string
+        forward refs, ``Optional[T]``/``Union[...]``/``T | U``."""
+        if ann is None:
+            return set()
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return set()
+        if isinstance(ann, ast.Subscript):      # Optional[T], Union[...]
+            inner = ann.slice
+            parts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+            out: Set[str] = set()
+            for p in parts:
+                out |= self._annotation_types(mod, p)
+            return out
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._annotation_types(mod, ann.left)
+                    | self._annotation_types(mod, ann.right))
+        name = _dotted(ann)
+        if not name or name in ("None", "Optional", "Any"):
+            return set()
+        ck = self._resolve_class_name(mod, name)
+        return {ck} if ck else set()
+
+    def _resolve_class_name(self, mod: _Module, dotted: str
+                            ) -> Optional[str]:
+        head, _, rest = dotted.partition(".")
+        if not rest and head in mod.classes:
+            return mod.classes[head]
+        imp = mod.imports.get(head)
+        if imp is not None:
+            if imp[0] == "module" and rest:
+                target = self.g.modules.get(self.by_modname.get(imp[1], ""))
+                if target is not None:
+                    return target.classes.get(rest.split(".")[0])
+            elif imp[0] == "symbol" and not rest:
+                target = self.g.modules.get(self.by_modname.get(imp[1], ""))
+                if target is not None:
+                    return target.classes.get(imp[2])
+        if not rest:                    # unique class name project-wide
+            cands = self.class_by_name.get(head, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _constructed_types(self, mod: _Module, value: ast.expr) -> Set[str]:
+        """Class keys constructed by ``value`` (``ClassName(...)`` /
+        ``module.ClassName(...)``), or the return types of a resolvable
+        project call (``watch_jit(...)`` -> CompileWatched)."""
+        if not isinstance(value, ast.Call):
+            if isinstance(value, ast.Name):
+                return set(mod.global_types.get(value.id, set()))
+            return set()
+        name = _dotted(value.func)
+        if name:
+            ck = self._resolve_class_name(mod, name)
+            if ck:
+                return {ck}
+        targets, _ = self._call_targets(mod, value, scope=None)
+        out: Set[str] = set()
+        for t in targets or ():
+            out |= self.return_types(t)
+        return out
+
+    def _value_function(self, mod: _Module, value: ast.expr,
+                        cls_for_self: Optional[ClassInfo] = None
+                        ) -> Optional[str]:
+        if isinstance(value, ast.Name):
+            return mod.functions.get(value.id) or self._imported_function(
+                mod, value.id)
+        attr = _self_attr(value)
+        if attr and cls_for_self is not None:
+            mk = cls_for_self.methods.get(attr)
+            if mk:
+                return mk
+        return None
+
+    def _imported_function(self, mod: _Module, name: str) -> Optional[str]:
+        imp = mod.imports.get(name)
+        if imp is None or imp[0] != "symbol":
+            return None
+        target = self.g.modules.get(self.by_modname.get(imp[1], ""))
+        if target is None:
+            return None
+        return target.functions.get(imp[2])
+
+    # -- return types ---------------------------------------------------
+    def return_types(self, fkey: str) -> Set[str]:
+        if fkey in self._return_types:
+            return self._return_types[fkey]
+        if fkey in self._in_progress:       # cycle: give up quietly
+            return set()
+        self._in_progress.add(fkey)
+        try:
+            info = self.g.functions.get(fkey)
+            if info is None:
+                return set()
+            mod = self.g.modules[info.relpath]
+            out: Set[str] = set()
+            if info.node.returns is not None:
+                out |= self._annotation_types(mod, info.node.returns)
+            for node in own_body_nodes(info.node):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    out |= self._constructed_types(mod, node.value)
+                    if isinstance(node.value, ast.Name) \
+                            and node.value.id == "self" and info.cls:
+                        out.add(info.cls)
+            self._return_types[fkey] = out
+            return out
+        finally:
+            self._in_progress.discard(fkey)
+
+    # -- phase 4: edges -------------------------------------------------
+    def build_edges(self):
+        for fkey, info in list(self.g.functions.items()):
+            self._edges_of(info)
+
+    class _Scope:
+        __slots__ = ("func", "cls", "locals", "enclosing")
+
+        def __init__(self, func, cls, locals_, enclosing):
+            self.func = func
+            self.cls = cls
+            self.locals = locals_           # name -> class keys
+            self.enclosing = enclosing      # name -> func key (nested defs)
+
+    def _edges_of(self, info: FuncInfo):
+        mod = self.g.modules[info.relpath]
+        cls = self.g.classes.get(info.cls) if info.cls else None
+        locals_: Dict[str, Set[str]] = dict(
+            self._param_annotations(mod, info.node))
+        enclosing: Dict[str, str] = {}
+        for child in ast.iter_child_nodes(info.node):
+            if isinstance(child, _FUNC_NODES):
+                nested = f"{info.key}.{child.name}"
+                if nested in self.g.functions:
+                    enclosing[child.name] = nested
+                    # a closure built on a hot path runs on it
+                    self.g.add_edge(info.key, nested, child.lineno)
+        scope = self._Scope(info, cls, locals_, enclosing)
+        # forward pass: assignments type locals as they appear
+        for node in _own_body_preorder(info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = self._constructed_types_scoped(mod, scope, node.value)
+                if t:
+                    locals_.setdefault(node.targets[0].id, set()).update(t)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                t = self._annotation_types(mod, node.annotation)
+                if t:
+                    locals_.setdefault(node.target.id, set()).update(t)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._type_loop_target(mod, scope, node.target, node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                # preorder yields the comp before its elt, so generator
+                # targets are typed before the element expression is seen
+                for gen in node.generators:
+                    self._type_loop_target(mod, scope, gen.target, gen.iter)
+            elif isinstance(node, ast.Call):
+                self._note_call(mod, scope, node)
+                self._note_reference_args(mod, scope, node)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                self._note_with(mod, scope, node)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                self._note_property_read(mod, scope, node)
+
+    def _type_loop_target(self, mod, scope, target, iter_expr):
+        """``for h in self._handles.values()`` types ``h`` from the
+        (element-flattened) container; ``for k, v in d.items()`` types the
+        value slot."""
+        if isinstance(target, ast.Name):
+            t = self._expr_types(mod, scope, iter_expr)
+            if t:
+                scope.locals.setdefault(target.id, set()).update(t)
+        elif isinstance(target, ast.Tuple) and len(target.elts) == 2 \
+                and isinstance(target.elts[1], ast.Name) \
+                and isinstance(iter_expr, ast.Call) \
+                and isinstance(iter_expr.func, ast.Attribute) \
+                and iter_expr.func.attr == "items":
+            t = self._expr_types(mod, scope, iter_expr.func.value)
+            if t:
+                scope.locals.setdefault(target.elts[1].id, set()).update(t)
+
+    def _note_call(self, mod, scope, call: ast.Call):
+        targets, resolved = self._call_targets(mod, call, scope)
+        if targets:
+            for t in targets:
+                self.g.add_edge(scope.func.key, t, call.lineno)
+        elif not resolved:
+            self.g.unresolved.setdefault(scope.func.key, []).append(
+                (call.lineno, _dotted(call.func) or "<dynamic>"))
+
+    def _note_reference_args(self, mod, scope, call: ast.Call):
+        """Function/method references passed as values: Thread targets,
+        callbacks, ``getattr(x, "literal")``."""
+        name = _dotted(call.func)
+        if name == "getattr" and len(call.args) >= 2 \
+                and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, str):
+            self._reference_by_name(mod, scope, call.args[0],
+                                    call.args[1].value, call.lineno)
+        values = list(call.args) + [kw.value for kw in call.keywords]
+        for v in values:
+            fk = self._reference_target(mod, scope, v)
+            if fk:
+                self.g.add_edge(scope.func.key, fk, call.lineno)
+
+    def _reference_target(self, mod, scope, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in scope.enclosing:
+                return scope.enclosing[expr.id]
+            return mod.functions.get(expr.id) \
+                or self._imported_function(mod, expr.id)
+        attr = _self_attr(expr)
+        if attr and scope.cls is not None:
+            mk = scope.cls.methods.get(attr)
+            if mk and attr not in scope.cls.properties:
+                return mk
+        return None
+
+    def _reference_by_name(self, mod, scope, receiver, name, lineno):
+        for ck in self._expr_types(mod, scope, receiver) \
+                or self._fallback_classes(name):
+            cinfo = self.g.classes.get(ck)
+            if cinfo is not None:
+                mk = self._lookup_method(cinfo, name)
+                if mk:
+                    self.g.add_edge(scope.func.key, mk, lineno)
+
+    def _note_with(self, mod, scope, node):
+        for item in node.items:
+            cexpr = item.context_expr
+            types: Set[str] = set()
+            if isinstance(cexpr, ast.Call):
+                targets, _ = self._call_targets(mod, cexpr, scope)
+                for t in targets or ():
+                    types |= self.return_types(t)
+            types |= self._expr_types(mod, scope, cexpr)
+            for ck in types:
+                cinfo = self.g.classes.get(ck)
+                if cinfo is None:
+                    continue
+                for dunder in ("__enter__", "__exit__"):
+                    mk = self._lookup_method(cinfo, dunder)
+                    if mk:
+                        self.g.add_edge(scope.func.key, mk, node.lineno)
+
+    def _note_property_read(self, mod, scope, node: ast.Attribute):
+        for ck in self._expr_types(mod, scope, node.value):
+            cinfo = self.g.classes.get(ck)
+            if cinfo is not None and node.attr in cinfo.properties:
+                mk = cinfo.methods.get(node.attr)
+                if mk:
+                    self.g.add_edge(scope.func.key, mk, node.lineno)
+
+    # -- call resolution ------------------------------------------------
+    def _call_targets(self, mod, call: ast.Call, scope
+                      ) -> Tuple[Optional[Set[str]], bool]:
+        """(targets, resolved): resolved=True when we understood the call
+        even if it leads outside the project (stdlib/jax/builtin)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            if scope is not None and name in scope.enclosing:
+                return {scope.enclosing[name]}, True
+            if name in mod.functions:
+                return {mod.functions[name]}, True
+            if name in mod.global_funcs:
+                return {mod.global_funcs[name]}, True
+            if name in mod.classes:
+                return self._ctor_targets(mod.classes[name]), True
+            imp_fn = self._imported_function(mod, name)
+            if imp_fn:
+                return {imp_fn}, True
+            ck = self._resolve_class_name(mod, name)
+            if ck:
+                return self._ctor_targets(ck), True
+            if name in _PROTOCOL_BUILTINS and call.args and scope is not None:
+                types = self._expr_types(mod, scope, call.args[0])
+                out = set()
+                for t in types:
+                    cinfo = self.g.classes.get(t)
+                    mk = cinfo and self._lookup_method(
+                        cinfo, _PROTOCOL_BUILTINS[name])
+                    if mk:
+                        out.add(mk)
+                return (out or None), True
+            if name in _BUILTINS:
+                return None, True
+            if name in mod.imports:         # imported external symbol
+                return None, True
+            return None, False              # injected callable: dynamic
+        if isinstance(func, ast.Attribute):
+            return self._attr_call_targets(mod, call, func, scope)
+        if isinstance(func, ast.Call):      # curried: f(...)(...) — the
+            return None, True               # inner call got its own edge
+        return None, True                   # subscripts, lambdas, ...
+
+    def _attr_call_targets(self, mod, call, func: ast.Attribute, scope
+                           ) -> Tuple[Optional[Set[str]], bool]:
+        attr = func.attr
+        recv = func.value
+        # module-qualified: guard.note_comm_op(...), np.asarray(...)
+        dotted = _dotted(recv)
+        if dotted:
+            head = dotted.split(".")[0]
+            imp = mod.imports.get(head)
+            if imp is not None and imp[0] == "module":
+                modname = imp[1] if dotted == head \
+                    else ".".join([imp[1]] + dotted.split(".")[1:])
+                target_rel = self.by_modname.get(modname)
+                if target_rel is not None:
+                    tmod = self.g.modules[target_rel]
+                    if attr in tmod.functions:
+                        return {tmod.functions[attr]}, True
+                    if attr in tmod.classes:
+                        return self._ctor_targets(tmod.classes[attr]), True
+                    return None, True       # project module, unknown attr
+                project_tops = {m.split(".")[0] for m in self.by_modname}
+                if imp[1].split(".")[0] not in project_tops:
+                    return None, True       # external module call
+        # typed receiver
+        types = self._expr_types(mod, scope, recv) if scope is not None \
+            else set()
+        if types:
+            out = set()
+            for ck in types:
+                cinfo = self.g.classes.get(ck)
+                if cinfo is None:
+                    continue
+                mk = self._lookup_method(cinfo, attr)
+                if mk:
+                    out.add(mk)
+                    continue
+                # callable-object attribute: ``self.fn = watch_jit(...)``
+                # calls CompileWatched.__call__; ``self.cb = func`` calls
+                # the bound function
+                out |= cinfo.attr_funcs.get(attr, set())
+                for tk in cinfo.attr_types.get(attr, set()):
+                    tinfo = self.g.classes.get(tk)
+                    mk2 = tinfo and self._lookup_method(tinfo, "__call__")
+                    if mk2:
+                        out.add(mk2)
+            if out:
+                return out, True
+            return None, True           # typed, but method not in project
+        # untyped receiver: stdlib container/str traffic is not a project
+        # call — resolve-external rather than degrade to a warning
+        if attr in _STDLIB_METHODS:
+            return None, True
+        # unique-ish method name across project classes
+        cands = self.method_index.get(attr, [])
+        if 1 <= len(cands) <= _FALLBACK_CAP:
+            out = set()
+            for ck in cands:
+                mk = self.g.classes[ck].methods.get(attr)
+                if mk:
+                    out.add(mk)
+            return out, True
+        if not cands:
+            return None, True           # clearly not a project method
+        return None, False              # ambiguous: degrade to a warning
+
+    def _ctor_targets(self, class_key: str) -> Optional[Set[str]]:
+        cinfo = self.g.classes.get(class_key)
+        if cinfo is None:
+            return None
+        mk = self._lookup_method(cinfo, "__init__")
+        return {mk} if mk else None
+
+    def _lookup_method(self, cinfo: ClassInfo, name: str,
+                       depth: int = 0) -> Optional[str]:
+        mk = cinfo.methods.get(name)
+        if mk or depth > 4:
+            return mk
+        mod = self.g.modules[cinfo.relpath]
+        for base in cinfo.bases:
+            bname = _dotted(base)
+            if not bname:
+                continue
+            bk = self._resolve_class_name(mod, bname)
+            if bk and bk != cinfo.key:
+                mk = self._lookup_method(self.g.classes[bk], name,
+                                         depth + 1)
+                if mk:
+                    return mk
+        return None
+
+    def _expr_types(self, mod, scope, expr) -> Set[str]:
+        if scope is None:
+            return set()
+        if isinstance(expr, ast.Name):
+            if expr.id in ("self", "cls") and scope.cls is not None:
+                return {scope.cls.key}
+            return set(scope.locals.get(expr.id, set())) \
+                or set(mod.global_types.get(expr.id, set()))
+        if isinstance(expr, ast.Attribute):
+            attr = _self_attr(expr)
+            if attr and scope.cls is not None:
+                return set(scope.cls.attr_types.get(attr, set()))
+            # x.y where x is typed: y's annotation/attr types
+            recv_types = self._expr_types(mod, scope, expr.value)
+            out: Set[str] = set()
+            for ck in recv_types:
+                cinfo = self.g.classes.get(ck)
+                if cinfo is not None:
+                    out |= cinfo.attr_types.get(expr.attr, set())
+            return out
+        if isinstance(expr, ast.Call):
+            # container accessors pass the receiver's (element-flattened)
+            # types through: ``self._handles.values()`` yields whatever
+            # ``Dict[int, ReplicaHandle]`` flattened to
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr in _CONTAINER_PASSTHROUGH:
+                inner = self._expr_types(mod, scope, expr.func.value)
+                if inner:
+                    return inner
+            targets, _ = self._call_targets(mod, expr, scope)
+            out = set()
+            for t in targets or ():
+                out |= self.return_types(t)
+            # direct construction: T() has type T
+            name = _dotted(expr.func)
+            if name:
+                ck = self._resolve_class_name(mod, name)
+                if ck:
+                    out.add(ck)
+            return out
+        if isinstance(expr, ast.Subscript):     # d[k] on a typed container
+            return self._expr_types(mod, scope, expr.value)
+        return set()
+
+    def _constructed_types_scoped(self, mod, scope, value) -> Set[str]:
+        t = self._expr_types(mod, scope, value) if isinstance(
+            value, (ast.Call, ast.Name, ast.Attribute)) else set()
+        return t
+
+    def _fallback_classes(self, method_name: str) -> List[str]:
+        cands = self.method_index.get(method_name, [])
+        return cands if 1 <= len(cands) <= _FALLBACK_CAP else []
+
+
+def _dotted(node) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+def _own_body_preorder(fn):
+    """Pre-order walk of a function's own body (nested defs/classes
+    skipped) so assignment-based local typing sees defs before uses in
+    straight-line code."""
+    stack = list(reversed(list(ast.iter_child_nodes(fn))))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNC_NODES + (ast.ClassDef,)):
+            continue
+        yield node
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+# ----------------------------------------------------------------------
+# entry points + per-session memo
+# ----------------------------------------------------------------------
+def build_graph(files: Iterable[Tuple[str, ast.AST]]) -> CallGraph:
+    """Build from (relpath, parsed-tree) pairs."""
+    b = _Builder(files)
+    b.index()
+    b.infer_types()
+    b.build_edges()
+    return b.g
+
+
+_CACHE: Dict[tuple, CallGraph] = {}
+_CACHE_MAX = 4
+
+
+def get_callgraph(project) -> CallGraph:
+    """The call graph for a ``ProjectContext`` — built once per source
+    snapshot and shared by every rule in the run (and across runs in one
+    test session: the lint suite re-lints the package several times)."""
+    cached = getattr(project, "_dslint_callgraph", None)
+    if cached is not None:
+        return cached
+    key = tuple(sorted((f.relpath, len(f.source), hash(f.source))
+                       for f in project.files))
+    graph = _CACHE.get(key)
+    if graph is None:
+        graph = build_graph((f.relpath, f.tree) for f in project.files)
+        if len(_CACHE) >= _CACHE_MAX:
+            _CACHE.pop(next(iter(_CACHE)))
+        _CACHE[key] = graph
+    project._dslint_callgraph = graph
+    return graph
+
+
+def build_graph_from_sources(entries: Iterable[Tuple[str, str]]) -> CallGraph:
+    """Build from (relpath, source-text) pairs, through the same snapshot
+    cache ``get_callgraph`` uses — env_report, the test-session fixture,
+    and the rules all pay for ONE build per source snapshot as long as
+    their relpaths agree (repo-relative, forward slashes)."""
+    entries = list(entries)
+    key = tuple(sorted((rel, len(src), hash(src)) for rel, src in entries))
+    graph = _CACHE.get(key)
+    if graph is None:
+        graph = build_graph((rel, ast.parse(src)) for rel, src in entries)
+        if len(_CACHE) >= _CACHE_MAX:
+            _CACHE.pop(next(iter(_CACHE)))
+        _CACHE[key] = graph
+    return graph

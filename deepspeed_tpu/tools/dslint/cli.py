@@ -4,6 +4,7 @@
     dslint --format json deepspeed_tpu/      # machine-readable
     dslint --write-baseline deepspeed_tpu/   # grandfather current findings
     dslint --select DS002 path/to/file.py    # one rule only
+    dslint --changed origin/main             # changed files + reverse deps
     dslint --list-rules
 
 Exit codes: 0 clean (vs baseline); 1 findings — including DS000 parse
@@ -11,20 +12,22 @@ errors — or stale baseline entries; 2 usage / baseline-load problems.
 """
 
 import argparse
+import ast
 import collections
 import json
 import os
+import subprocess
 import sys
 
 from deepspeed_tpu.tools.dslint import baseline as baseline_mod
-from deepspeed_tpu.tools.dslint.engine import LintEngine
+from deepspeed_tpu.tools.dslint.engine import LintEngine, iter_python_files
 from deepspeed_tpu.tools.dslint.rules import get_rules
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dslint",
-        description="JAX/TPU-aware static analysis (rules DS001-DS006)")
+        description="JAX/TPU-aware static analysis (rules DS001-DS009)")
     p.add_argument("paths", nargs="*", default=["."],
                    help="files/directories to lint (default: .)")
     p.add_argument("--format", choices=("text", "json"), default="text")
@@ -42,10 +45,82 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--root", default=None,
                    help="directory findings paths are relative to "
                         "(default: the baseline file's directory, else cwd)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="lint only python files changed vs BASE (default "
+                        "HEAD; staged, unstaged and untracked all count) "
+                        "PLUS their reverse dependencies — files whose "
+                        "call or import edges reach a changed file, so "
+                        "taint/purity findings that depend on the change "
+                        "are still seen. Fast pre-push subset of the "
+                        "full run.")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="findings only, no summary")
     return p
+
+
+def _git_lines(cwd, *args):
+    try:
+        proc = subprocess.run(["git", *args], cwd=cwd, capture_output=True,
+                              text=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return [ln.strip() for ln in proc.stdout.splitlines() if ln.strip()]
+
+
+def changed_python_files(top, base="HEAD"):
+    """Repo-relative .py files that differ from ``base``: committed-but-
+    diverged, staged, unstaged, and untracked all count (the lint should
+    see exactly what a push would)."""
+    diffed = _git_lines(top, "diff", "--name-only", base, "--")
+    untracked = _git_lines(top, "ls-files", "--others",
+                           "--exclude-standard")
+    if diffed is None and untracked is None:
+        return None
+    out = []
+    for rel in (diffed or []) + (untracked or []):
+        if rel.endswith(".py") and os.path.exists(os.path.join(top, rel)):
+            out.append(rel.replace(os.sep, "/"))
+    return sorted(set(out))
+
+
+def expand_with_reverse_deps(top, changed):
+    """The changed files plus every file that can REACH one of them
+    through a call or module-level import edge (transitively). Those
+    dependents' findings can flip without their own text changing — a
+    hot root two files away may now taint a new sink, an offline module
+    may newly reach jax — so a subset run must re-lint them too."""
+    from deepspeed_tpu.tools.dslint.callgraph import build_graph
+    files = []
+    for p in iter_python_files([top]):
+        rel = os.path.relpath(p, top).replace(os.sep, "/")
+        try:
+            with open(p, encoding="utf-8") as f:
+                files.append((rel, ast.parse(f.read())))
+        except (OSError, SyntaxError):
+            continue        # unparseable: the engine reports DS000 if it
+                            # is in the changed set itself
+    g = build_graph(files)
+    rev = {}                # file -> files that call/import into it
+    for caller, callees in g.edges.items():
+        cf = g.functions[caller].relpath
+        for callee in callees:
+            tf = g.functions[callee].relpath
+            if tf != cf:
+                rev.setdefault(tf, set()).add(cf)
+    for rel, mod in g.modules.items():
+        for tgt in mod.internal_imports:
+            if tgt != rel:
+                rev.setdefault(tgt, set()).add(rel)
+    out, queue = set(), list(changed)
+    while queue:
+        cur = queue.pop()
+        if cur in out:
+            continue
+        out.add(cur)
+        queue.extend(rev.get(cur, ()))
+    return sorted(out)
 
 
 def _resolve_baseline(args) -> str:
@@ -65,6 +140,47 @@ def main(argv=None) -> int:
         for r in rules:
             print(f"{r.id}  {r.name:<24} {r.description}")
         return 0
+
+    if args.changed is not None:
+        lines = _git_lines(os.getcwd(), "rev-parse", "--show-toplevel")
+        if not lines:
+            print("dslint: --changed requires a git checkout",
+                  file=sys.stderr)
+            return 2
+        top = lines[0]
+        changed = changed_python_files(top, args.changed)
+        if changed is None:
+            print(f"dslint: cannot diff against {args.changed!r}",
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            if not args.quiet:
+                print(f"dslint: no python files changed vs {args.changed}")
+            return 0
+        # scope: the positional paths (relative to the repo top), else the
+        # package the checked-in baseline governs — a changed test file is
+        # not part of the self-lint surface, matching the full-run recipe
+        # `dslint deepspeed_tpu/`
+        scopes = [os.path.relpath(os.path.abspath(p), top).replace(
+            os.sep, "/") for p in args.paths if p != "."]
+        if not scopes:
+            scopes = ["deepspeed_tpu"] if os.path.isdir(
+                os.path.join(top, "deepspeed_tpu")) else ["."]
+        in_scope = lambda rel: any(
+            s == "." or rel == s or rel.startswith(s + "/") for s in scopes)
+        changed = [rel for rel in changed if in_scope(rel)]
+        if not changed:
+            if not args.quiet:
+                print(f"dslint: no in-scope python files changed vs "
+                      f"{args.changed}")
+            return 0
+        subset = [rel for rel in expand_with_reverse_deps(top, changed)
+                  if in_scope(rel)]
+        if not args.quiet:
+            print(f"dslint: --changed vs {args.changed}: {len(changed)} "
+                  f"changed file(s) + {len(subset) - len(changed)} "
+                  f"reverse dep(s)")
+        args.paths = [os.path.join(top, rel) for rel in subset]
 
     split = lambda s: [x.strip() for x in s.split(",") if x.strip()] \
         if s else None

@@ -102,6 +102,7 @@ class FileContext:
         self.suppressions = parse_suppressions(source)
         self._scope_spans: Optional[List[Tuple[int, int, str]]] = None
         self._stmt_spans: Optional[List[Tuple[int, int]]] = None
+        self._decorator_spans: Optional[List[Tuple[int, int]]] = None
 
     # ------------------------------------------------------------------
     def qualname(self, node: ast.AST) -> str:
@@ -136,8 +137,13 @@ class FileContext:
         """A finding is suppressed by a disable comment on its own line OR
         on the first line of any statement enclosing it — so the documented
         standalone form works for findings anchored on a continuation line
-        of a multi-line statement."""
-        for cand in (line, *self._stmt_starts_covering(line)):
+        of a multi-line statement. A decorator stack counts as one such
+        region (first decorator line through the ``def``/``async def``
+        line): a standalone comment above the stack lexically binds to the
+        FIRST decorator line, and must still reach findings anchored on a
+        later decorator or the def line itself."""
+        for cand in (line, *self._stmt_starts_covering(line),
+                     *self._decorator_starts_covering(line)):
             disabled = self.suppressions.get(cand, set())
             if rule in disabled or "ALL" in disabled:
                 return True
@@ -159,6 +165,16 @@ class FileContext:
                              for n in ast.walk(node))
                     self._stmt_spans.append((node.lineno, hi))
         return [lo for lo, hi in self._stmt_spans if lo <= line <= hi]
+
+    def _decorator_starts_covering(self, line: int):
+        if getattr(self, "_decorator_spans", None) is None:
+            self._decorator_spans = []
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)) and node.decorator_list:
+                    self._decorator_spans.append(
+                        (node.decorator_list[0].lineno, node.lineno))
+        return [lo for lo, hi in self._decorator_spans if lo <= line <= hi]
 
     def finding(self, rule: str, node: ast.AST, message: str,
                 token: str) -> Finding:
@@ -250,6 +266,33 @@ def iter_python_files(paths: Iterable[str]) -> List[str]:
     return list(dict.fromkeys(out))
 
 
+#: (abspath, mtime_ns, size) -> (source, tree). The test suite lints the
+#: whole package several times in one process (self-lint, the hot-sync
+#: proof, offline purity); the trees are immutable to rules, so re-parsing
+#: ~200 unchanged files each run is pure waste. Keyed on stat so edited
+#: fixtures (tmp-path copies, --changed scratch repos) never hit stale.
+_PARSE_CACHE: dict = {}
+_PARSE_CACHE_MAX = 1024
+
+
+def _load_parsed(abspath):
+    try:
+        st = os.stat(abspath)
+        key = (abspath, st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None and key in _PARSE_CACHE:
+        return _PARSE_CACHE[key]
+    with open(abspath, "r", encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=abspath)
+    if key is not None:
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[key] = (source, tree)
+    return source, tree
+
+
 class LintEngine:
     def __init__(self, rules: List[Rule], root: Optional[str] = None,
                  select: Optional[Iterable[str]] = None,
@@ -277,9 +320,7 @@ class LintEngine:
         for abspath in iter_python_files(paths):
             relpath = self._relpath(abspath)
             try:
-                with open(abspath, "r", encoding="utf-8") as f:
-                    source = f.read()
-                tree = ast.parse(source, filename=abspath)
+                source, tree = _load_parsed(abspath)
             except (SyntaxError, UnicodeDecodeError) as e:
                 parse_errors.append(Finding(
                     rule="DS000", path=relpath,

@@ -1,4 +1,4 @@
-"""dslint rule registry: one module per rule, IDs DS001..DS006.
+"""dslint rule registry: one module per rule, IDs DS001..DS009.
 
 Adding a rule: subclass ``Rule`` in a new ``ds0XX_*.py``, give it ``id``/
 ``name``/``description``, implement ``check`` (per-file) and/or
@@ -15,6 +15,11 @@ from deepspeed_tpu.tools.dslint.rules.ds003_truthiness import (
 from deepspeed_tpu.tools.dslint.rules.ds004_threads import ThreadSharedStateRule
 from deepspeed_tpu.tools.dslint.rules.ds005_signals import SignalHandlerRule
 from deepspeed_tpu.tools.dslint.rules.ds006_config_keys import ConfigKeyDriftRule
+from deepspeed_tpu.tools.dslint.rules.ds007_trace_names import TraceNameRule
+from deepspeed_tpu.tools.dslint.rules.ds008_prom_families import (
+    PromFamilyRule)
+from deepspeed_tpu.tools.dslint.rules.ds009_offline_purity import (
+    OfflinePurityRule)
 
 ALL_RULES = (
     DonationSafetyRule,
@@ -23,6 +28,9 @@ ALL_RULES = (
     ThreadSharedStateRule,
     SignalHandlerRule,
     ConfigKeyDriftRule,
+    TraceNameRule,
+    PromFamilyRule,
+    OfflinePurityRule,
 )
 
 

@@ -1,31 +1,43 @@
-"""DS002 — host sync in a registered hot path.
+"""DS002 — host sync reachable from a registered hot root.
 
-Generalizes the original ``tests/test_no_hot_sync.py`` AST tripwire to
-every function in the hot-path registry (``hotpath.HOT_PATHS``): the
-per-step/per-tick fast paths must never regrow ``float()``, ``.item()``,
-``jax.device_get``, ``block_until_ready`` or friends — one sync silently
-re-serializes the whole pipeline while every timing test keeps passing.
+dslint v2 rewrote this rule from registry membership to **taint
+propagation**: instead of enumerating every hot function by hand
+(the retired 300-line ``hotpath.HOT_PATHS`` registry), the rule builds
+the project call graph (``callgraph.py``), computes the closure of the
+declared ``HOT_ROOTS``, and scans the own body of every reached function
+for host-sync sinks — ``float()``, ``.item()``, ``jax.device_get``,
+``block_until_ready``, ``np.asarray`` and friends. A helper extracted
+out of a hot function, or a new callee a hot path grows, is covered the
+moment the edge exists; nothing has to be registered.
 
-Three enforcement shapes per registry spec:
+The designed synchronous points are declared as ``ESCAPE_HATCHES``:
 
-  hot_functions   any forbidden call anywhere in the function is a finding
-  guard_branches  only ``if ...<guard_attr>`` branches are checked (async
-                  fan-in points whose synchronous fallback may sync)
-  confine         a call (e.g. ``.device_get``) is allowed ONLY in the
-                  listed functions of that file; anywhere else it fires
+  sync_ok   own-body sinks exempt, callees still traversed (THE drain)
+  prune     subtree exempt and not traversed (the host offload step)
+  guarded   only lines that provably execute when ``guard_attr`` is
+            false are exempt (async fan-in with a sync fallback branch)
 
-A registered function that no longer exists is ALSO a finding (registry
-drift) — renaming a hot function without updating the registry must not
-silently retire the tripwire.
+Drift is still a finding: a root or hatch whose function no longer
+resolves (renamed without updating ``hotpath.py``) fires on the file it
+pointed at — the tripwire cannot silently rot. Calls the graph cannot
+resolve degrade to statistics (``CallGraph.unresolved``), never to
+findings.
 """
 
 import ast
-import os
-from typing import Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from deepspeed_tpu.tools.dslint import astutil
-from deepspeed_tpu.tools.dslint.engine import FileContext, Rule
-from deepspeed_tpu.tools.dslint.hotpath import HOT_PATHS, HotPathSpec
+from deepspeed_tpu.tools.dslint.callgraph import (CallGraph, get_callgraph,
+                                                  own_body_nodes)
+from deepspeed_tpu.tools.dslint.engine import (FileContext, Finding,
+                                               ProjectContext, Rule)
+from deepspeed_tpu.tools.dslint.hotpath import (DEFAULT_FORBIDDEN,
+                                                ESCAPE_HATCHES, HOST_NUMPY_FILES,
+                                                HOT_ROOTS, EscapeHatch,
+                                                HotRoot)
+
+_NP_MATCHERS = ("np.asarray", "np.array")
 
 
 def _matches(call: ast.Call, matcher: str) -> bool:
@@ -39,8 +51,8 @@ def _matches(call: ast.Call, matcher: str) -> bool:
     return isinstance(call.func, ast.Name) and call.func.id == matcher
 
 
-def _forbidden_calls(node: ast.AST, forbidden: Tuple[str, ...]):
-    for n in ast.walk(node):
+def _forbidden_calls(nodes: Iterable[ast.AST], forbidden: Tuple[str, ...]):
+    for n in nodes:
         if isinstance(n, ast.Call):
             for m in forbidden:
                 if _matches(n, m):
@@ -62,11 +74,25 @@ def _terminates(stmts) -> bool:
         stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
 
 
+def _mentions_guard(node: ast.AST, guard_attr: str) -> bool:
+    """True if the expression reads ``guard_attr`` — either as a plain
+    attribute access or through ``getattr(obj, "guard_attr", default)``
+    (the duck-typed form call sites use against foreign objects)."""
+    for x in ast.walk(node):
+        if isinstance(x, ast.Attribute) and x.attr == guard_attr:
+            return True
+        if (isinstance(x, ast.Call) and isinstance(x.func, ast.Name)
+                and x.func.id == "getattr" and len(x.args) >= 2
+                and isinstance(x.args[1], ast.Constant)
+                and x.args[1].value == guard_attr):
+            return True
+    return False
+
+
 def _guard_negated(test: ast.expr, guard_attr: str) -> bool:
     return any(
         isinstance(x, ast.UnaryOp) and isinstance(x.op, ast.Not)
-        and any(isinstance(y, ast.Attribute) and y.attr == guard_attr
-                for y in ast.walk(x.operand))
+        and _mentions_guard(x.operand, guard_attr)
         for x in ast.walk(test))
 
 
@@ -102,115 +128,130 @@ class HotPathSyncRule(Rule):
     id = "DS002"
     name = "host-sync-in-hot-path"
     description = ("host synchronization (float()/.item()/device_get/"
-                   "block_until_ready) inside a registered hot path")
+                   "block_until_ready) in a function reachable from a "
+                   "registered hot root")
 
-    def __init__(self, specs: Tuple[HotPathSpec, ...] = HOT_PATHS):
-        self.specs = specs
+    def __init__(self, roots: Tuple[HotRoot, ...] = HOT_ROOTS,
+                 hatches: Tuple[EscapeHatch, ...] = ESCAPE_HATCHES,
+                 host_numpy_files: Tuple[str, ...] = HOST_NUMPY_FILES):
+        self.roots = roots
+        self.hatches = hatches
+        self.host_numpy_files = host_numpy_files
 
     # ------------------------------------------------------------------
-    def check(self, ctx: FileContext):
-        findings = []
-        # match on the ABSOLUTE path (full-component suffix), not the
-        # run-relative one: `cd deepspeed_tpu && dslint .` or an unusual
-        # --root must not silently un-register the tripwire
-        abspath = os.path.abspath(ctx.abspath).replace(os.sep, "/")
-        for spec in self.specs:
-            if not (abspath.endswith("/" + spec.path)
-                    or abspath == spec.path or ctx.relpath == spec.path):
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        graph = get_callgraph(project)
+        by_path: Dict[str, FileContext] = {f.relpath: f
+                                           for f in project.files}
+        findings: List[Finding] = []
+
+        # roots: resolve; a root whose file is in this run but whose
+        # function is gone is DRIFT — the declaration must move with the
+        # refactor, silently retiring coverage is the failure mode the
+        # old registry had
+        root_of: Dict[str, HotRoot] = {}
+        for root in self.roots:
+            ctx = self._ctx_for(by_path, root.path)
+            key = graph.resolve(root.path, root.qualname)
+            if key is not None:
+                root_of.setdefault(key, root)
+            elif ctx is not None:
+                findings.append(ctx.finding(
+                    self.id, ctx.tree,
+                    f"hot-root drift: `{root.qualname}` not found in "
+                    f"{root.path} — update hotpath.py HOT_ROOTS alongside "
+                    f"the rename/removal", token=f"hot-root:{root.qualname}"))
+
+        hatch_of: Dict[str, EscapeHatch] = {}
+        for hatch in self.hatches:
+            ctx = self._ctx_for(by_path, hatch.path)
+            key = graph.resolve(hatch.path, hatch.qualname)
+            if key is not None:
+                hatch_of[key] = hatch
+            elif ctx is not None:
+                findings.append(ctx.finding(
+                    self.id, ctx.tree,
+                    f"escape-hatch drift: `{hatch.qualname}` not found in "
+                    f"{hatch.path} — update hotpath.py ESCAPE_HATCHES "
+                    f"alongside the rename/removal",
+                    token=f"hatch:{hatch.qualname}"))
+
+        prune = {k for k, h in hatch_of.items() if h.mode == "prune"}
+        pred = graph.reachable_from(sorted(root_of), prune=prune)
+
+        for key in sorted(pred):
+            if key in prune:
                 continue
-            findings.extend(self._check_spec(ctx, spec))
+            hatch = hatch_of.get(key)
+            if hatch is not None and hatch.mode == "sync_ok":
+                continue
+            info = graph.functions.get(key)
+            ctx = info and by_path.get(info.relpath)
+            if ctx is None:
+                continue            # reached a file outside this run
+            findings.extend(self._scan(graph, pred, root_of, key, info,
+                                       ctx, hatch))
         return findings
 
-    def _scope(self, ctx: FileContext, spec: HotPathSpec
-               ) -> Optional[ast.AST]:
-        if spec.cls is None:
-            return ctx.tree
-        for cls in astutil.classes_of(ctx.tree):
-            if cls.name == spec.cls:
-                return cls
+    # ------------------------------------------------------------------
+    def _ctx_for(self, by_path: Dict[str, FileContext], path: str
+                 ) -> Optional[FileContext]:
+        ctx = by_path.get(path)
+        if ctx is not None:
+            return ctx
+        for rel, c in by_path.items():
+            if rel.endswith("/" + path) or path.endswith("/" + rel):
+                return c
         return None
 
-    def _check_spec(self, ctx: FileContext, spec: HotPathSpec):
-        findings = []
-        scope = self._scope(ctx, spec)
-        if scope is None:
-            findings.append(ctx.finding(
-                self.id, ctx.tree,
-                f"hot-path registry drift: class `{spec.cls}` not found in "
-                f"{spec.path} — update deepspeed_tpu/tools/dslint/hotpath.py "
-                f"alongside the refactor", token=f"registry:{spec.cls}"))
-            return findings
-        methods = {n.name: n for n in astutil.functions_of(scope)}
+    def _forbidden_for(self, root: HotRoot, relpath: str
+                       ) -> Tuple[str, ...]:
+        forb = root.forbidden
+        if any(relpath == p or relpath.endswith("/" + p)
+               for p in self.host_numpy_files):
+            forb = tuple(m for m in forb if m not in _NP_MATCHERS)
+        return forb
 
-        for name in spec.hot_functions:
-            fn = methods.get(name)
-            if fn is None:
-                findings.append(ctx.finding(
-                    self.id, scope,
-                    f"hot-path registry drift: `{name}` not found — update "
-                    f"hotpath.py alongside the rename/removal",
-                    token=f"registry:{name}"))
-                continue
-            for call, m in _forbidden_calls(fn, spec.forbidden):
-                findings.append(ctx.finding(
-                    self.id, call,
-                    f"`{m}` in hot path `{name}`: a host sync here "
-                    f"serializes every step — route readback through the "
-                    f"designated drain", token=f"{name}:{m}"))
+    def _root_chain(self, graph: CallGraph, pred, root_of, key
+                    ) -> Tuple[HotRoot, str]:
+        chain = graph.path_to(pred, key)
+        root = root_of.get(chain[0]) if chain else None
+        if root is None:            # should not happen; defensive
+            root = next(iter(root_of.values()))
+            return root, root.qualname
+        names = [graph.functions[k].qualname for k in chain
+                 if k in graph.functions]
+        if len(names) > 4:
+            names = names[:2] + ["..."] + names[-2:]
+        return root, " -> ".join(names)
 
-        for name, guard_attr in spec.guard_branches:
-            fn = methods.get(name)
-            if fn is None:
-                findings.append(ctx.finding(
-                    self.id, scope,
-                    f"hot-path registry drift: guarded function `{name}` "
-                    f"not found — update hotpath.py",
-                    token=f"registry:{name}"))
-                continue
+    def _scan(self, graph: CallGraph, pred, root_of, key, info, ctx,
+              hatch: Optional[EscapeHatch]):
+        root, chain = self._root_chain(graph, pred, root_of, key)
+        forbidden = self._forbidden_for(root, info.relpath)
+        sync_lines: set = set()
+        if hatch is not None and hatch.mode == "guarded":
             branches = [
-                n for n in ast.walk(fn)
+                n for n in ast.walk(info.node)
                 if isinstance(n, ast.If)
-                and any(isinstance(x, ast.Attribute) and x.attr == guard_attr
-                        for x in ast.walk(n.test))]
+                and _mentions_guard(n.test, hatch.guard_attr)]
             if not branches:
-                findings.append(ctx.finding(
-                    self.id, fn,
-                    f"hot-path registry drift: `{name}` lost its "
-                    f"`{guard_attr}` branch — update hotpath.py",
-                    token=f"registry:{name}:{guard_attr}"))
-                continue
-            # scan everything that can execute in async mode: the whole
-            # function MINUS the statements provably on the sync-only side
-            # (the negated-guard body, the positive guard's else branch,
-            # and — when a guard branch early-returns — the tail after it).
-            # Early-return refactors therefore cannot retire the tripwire.
-            sync_lines = _sync_only_lines(fn, branches, guard_attr)
-            for call, m in _forbidden_calls(fn, spec.forbidden):
-                if call.lineno in sync_lines:
-                    continue         # the designed synchronous fallback
-                findings.append(ctx.finding(
-                    self.id, call,
-                    f"`{m}` on the `{guard_attr}` (async) side of "
-                    f"`{name}`: this push path queues device arrays "
-                    f"verbatim — a transfer here re-serializes every step",
-                    token=f"{name}:{guard_attr}:{m}"))
-
-        for matcher, allowed in (spec.confine or {}).items():
-            # confinement is FILE-wide: module functions plus every class's
-            # methods (a helper class added later must not dodge the net)
-            fns = list(astutil.functions_of(ctx.tree))
-            for cls in astutil.classes_of(ctx.tree):
-                fns += list(astutil.functions_of(cls))
-            for fn in fns:
-                if fn.name in allowed:
-                    continue
-                for call, m in _forbidden_calls(fn, (matcher,)):
-                    findings.append(ctx.finding(
-                        self.id, call,
-                        f"`{m}` outside its designated functions "
-                        f"(allowed: {', '.join(sorted(allowed))}) in "
-                        f"`{fn.name}` — route readback through the drain or "
-                        f"add a deliberate exemption to hotpath.py with a "
-                        f"comment explaining why it cannot lag",
-                        token=f"confine:{fn.name}:{m}"))
-        return findings
+                yield ctx.finding(
+                    self.id, info.node,
+                    f"escape-hatch drift: `{info.qualname}` lost its "
+                    f"`{hatch.guard_attr}` branch — update hotpath.py",
+                    token=f"hatch:{info.qualname}:{hatch.guard_attr}")
+                return
+            sync_lines = _sync_only_lines(info.node, branches,
+                                          hatch.guard_attr)
+        for call, m in _forbidden_calls(own_body_nodes(info.node),
+                                        forbidden):
+            if call.lineno in sync_lines:
+                continue            # the designed synchronous fallback
+            yield ctx.finding(
+                self.id, call,
+                f"`{m}` in `{info.qualname}`, reachable from hot root "
+                f"`{root.qualname}` ({chain}): a host sync here "
+                f"serializes every step/tick — route readback through "
+                f"the designated drain or declare an escape hatch in "
+                f"hotpath.py", token=f"hot:{m}")

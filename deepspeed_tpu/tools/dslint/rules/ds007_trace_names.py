@@ -1,0 +1,208 @@
+"""DS007 — trace-name drift between emitters and the registry.
+
+Every literal name handed to ``Tracer.span/instant/counter/complete``
+must appear in ``deepspeed_tpu/telemetry/names.py`` ``TRACE_NAMES``
+(with a matching kind); dynamic f-string names must start with a
+registered ``DYNAMIC_PREFIXES`` entry. The offline stage tables
+(attribution / serve_attribution / crossrank) derive their constants
+from the same registry, so a renamed span is a lint finding instead of a
+silent attribution hole (the renamed stage's time quietly becoming
+``residual`` was the pre-v2 failure mode).
+
+Resolution is deliberately shallow and sound-by-silence: a first
+argument that is a string constant or a same-file module-level string
+constant is checked; anything the rule cannot resolve statically
+(parameters, dict lookups, attributes) is skipped, never guessed — the
+taint rule's discipline of degrading to silence rather than false
+positives.
+"""
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from deepspeed_tpu.tools.dslint.engine import (FileContext, Finding,
+                                               ProjectContext, Rule)
+
+_KINDS = ("span", "instant", "counter", "complete")
+_REGISTRY_SUFFIX = "telemetry/names.py"
+
+
+def _emitter_kind(call: ast.Call) -> Optional[str]:
+    """The event kind if this looks like a Tracer emit call: receiver is
+    a name/attribute/call whose leaf mentions ``tracer`` (``tracer``,
+    ``self.tracer``, ``get_tracer()``, ``self._tracer()``) or is the
+    conventional short alias ``tr``."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _KINDS:
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Name):
+        leaf = recv.id
+    elif isinstance(recv, ast.Attribute):
+        leaf = recv.attr
+    elif isinstance(recv, ast.Call):
+        cf = recv.func
+        leaf = (cf.id if isinstance(cf, ast.Name)
+                else cf.attr if isinstance(cf, ast.Attribute) else "")
+    else:
+        return None
+    if "tracer" in leaf.lower() or leaf == "tr":
+        return f.attr
+    return None
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            targets = [node.target]
+        if targets and isinstance(getattr(node, "value", None),
+                                  ast.Constant) \
+                and isinstance(node.value.value, str):
+            for t in targets:
+                out[t.id] = node.value.value
+    return out
+
+
+def _fstring_head(js: ast.JoinedStr) -> str:
+    head = ""
+    for part in js.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            head += part.value
+        else:
+            break
+    return head
+
+
+def parse_registry(tree: ast.Module
+                   ) -> Tuple[Dict[str, Tuple[str, ...]], Tuple[str, ...]]:
+    """Extract ``TRACE_NAMES`` / ``DYNAMIC_PREFIXES`` from the registry
+    module's AST — dslint never imports the project it lints."""
+    names: Dict[str, Tuple[str, ...]] = {}
+    prefixes: Tuple[str, ...] = ()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target, value = node.target.id, node.value
+        else:
+            continue
+        if target == "TRACE_NAMES" and isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                kinds = tuple(
+                    e.value for e in getattr(v, "elts", [])
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str))
+                names[k.value] = kinds
+        elif target == "DYNAMIC_PREFIXES" and isinstance(value, ast.Tuple):
+            prefixes = tuple(e.value for e in value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+    return names, prefixes
+
+
+def _find_registry(project: ProjectContext
+                   ) -> Optional[Tuple[Dict[str, Tuple[str, ...]],
+                                       Tuple[str, ...]]]:
+    for ctx in project.files:
+        if ctx.relpath.endswith(_REGISTRY_SUFFIX) \
+                or ctx.relpath == "names.py":
+            return parse_registry(ctx.tree)
+    # subset run (--changed): locate the registry on disk from any linted
+    # file's absolute path
+    for ctx in project.files:
+        d = os.path.dirname(ctx.abspath)
+        while True:
+            for cand in (
+                    os.path.join(d, "deepspeed_tpu", "telemetry", "names.py"),
+                    os.path.join(d, "telemetry", "names.py")):
+                if os.path.isfile(cand):
+                    try:
+                        return parse_registry(ast.parse(
+                            open(cand, encoding="utf-8").read()))
+                    except (OSError, SyntaxError):
+                        return None
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+class TraceNameRule(Rule):
+    id = "DS007"
+    name = "trace-name-drift"
+    description = ("trace name emitted via Tracer.span/instant/counter/"
+                   "complete is not declared in telemetry/names.py "
+                   "TRACE_NAMES (or its kind is not registered)")
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        reg = _find_registry(project)
+        if reg is None:
+            return []          # nothing to check against (scratch subset)
+        names, prefixes = reg
+        findings: List[Finding] = []
+        for ctx in project.files:
+            if ctx.relpath.endswith(_REGISTRY_SUFFIX) \
+                    or ctx.relpath == "names.py" \
+                    or ctx.relpath.startswith("tests/") \
+                    or "/tests/" in ctx.relpath \
+                    or "tools/dslint" in ctx.relpath:
+                continue
+            consts = _module_str_constants(ctx.tree)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _emitter_kind(node)
+                if kind is None or not node.args:
+                    continue
+                findings.extend(self._check_name(ctx, node, kind,
+                                                 node.args[0], consts,
+                                                 names, prefixes))
+        return findings
+
+    def _check_name(self, ctx: FileContext, call: ast.Call, kind: str,
+                    arg: ast.expr, consts: Dict[str, str],
+                    names: Dict[str, Tuple[str, ...]],
+                    prefixes: Tuple[str, ...]):
+        name: Optional[str] = None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+        elif isinstance(arg, ast.Name):
+            name = consts.get(arg.id)
+        elif isinstance(arg, ast.JoinedStr):
+            head = _fstring_head(arg)
+            if not any(head.startswith(p) and p for p in prefixes):
+                yield ctx.finding(
+                    self.id, call,
+                    f"dynamic trace name with unregistered head "
+                    f"{head!r} — literal-prefix f-strings must start "
+                    f"with a telemetry/names.py DYNAMIC_PREFIXES entry",
+                    token=f"prefix:{head}")
+            return
+        if name is None:
+            return                      # unresolvable: skip, never guess
+        if name not in names:
+            yield ctx.finding(
+                self.id, call,
+                f"trace name {name!r} is not registered in telemetry/"
+                f"names.py TRACE_NAMES — register it (and extend the "
+                f"stage tables if an offline sweep should attribute it)",
+                token=f"name:{name}")
+        elif kind not in names[name]:
+            yield ctx.finding(
+                self.id, call,
+                f"trace name {name!r} emitted as `{kind}` but registered "
+                f"kinds are {names[name]!r} — update TRACE_NAMES or the "
+                f"emitter", token=f"kind:{name}:{kind}")

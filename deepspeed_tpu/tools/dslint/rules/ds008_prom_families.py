@@ -1,0 +1,155 @@
+"""DS008 — Prometheus family uniqueness (one TYPE emission site each).
+
+The Prometheus text parser rejects an exposition wholesale when a metric
+family's metadata (``# TYPE``) appears twice — a real outage fixed in
+PR 8 and re-pinned by hand in PRs 11/13. This rule mechanizes it
+project-wide: every ``"# TYPE ..."`` string the package can emit is a
+*claim*, and claims must not be able to collide.
+
+A string constant ``"# TYPE dstpu_x summary"`` claims the concrete
+family ``dstpu_x``. An f-string ``f"# TYPE dstpu_serving_{key} {kind}"``
+claims the *static prefix* ``dstpu_serving_`` — the one emission site
+owns that whole namespace. Findings:
+
+* the same concrete family claimed at more than one site,
+* a concrete family that falls inside a prefix claimed elsewhere (the
+  fleet ``/metrics`` hazard: a hand-emitted gauge inside the counter
+  loop's namespace — adding the gauge's key to the counter table would
+  duplicate the family silently),
+* two *different functions* claiming overlapping prefixes (inside one
+  function the code can, and visibly does, keep the key sets disjoint),
+* a TYPE f-string with no static family prefix at all (``f"# TYPE
+  {name} ..."`` claims everything and can collide with anything).
+
+The fix shape is the metrics.py discipline: route every family of a
+namespace through ONE emission site whose f-string carries the namespace
+inline.
+"""
+
+import ast
+from typing import Iterable, List, NamedTuple, Optional, Tuple
+
+from deepspeed_tpu.tools.dslint.engine import (Finding, ProjectContext,
+                                               Rule)
+
+_MARK = "# TYPE "
+
+
+class _Claim(NamedTuple):
+    relpath: str
+    qualname: str               # enclosing function ("" at module level)
+    node: ast.AST
+    ctx: object
+    family: Optional[str]       # concrete family, or None for a prefix
+    prefix: Optional[str]       # static prefix, or None for concrete
+
+
+def _classify(head: str, complete: bool) -> Tuple[Optional[str],
+                                                  Optional[str]]:
+    """``head`` is the literal text after ``"# TYPE "``. If it already
+    contains the full family (a space follows it, or the string ends
+    there as a plain constant), the claim is concrete; otherwise the
+    head is a static family prefix."""
+    if " " in head:
+        return head.split(" ", 1)[0], None
+    if complete:
+        return (head, None) if head else (None, "")
+    return None, head
+
+
+def _iter_claims(ctx) -> Iterable[_Claim]:
+    in_fstring = {id(v) for n in ast.walk(ctx.tree)
+                  if isinstance(n, ast.JoinedStr) for v in n.values}
+    for node in ast.walk(ctx.tree):
+        if id(node) in in_fstring:
+            continue                    # heads count via their JoinedStr
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.startswith(_MARK):
+            fam, pref = _classify(node.value[len(_MARK):], complete=True)
+            yield _Claim(ctx.relpath, ctx.qualname(node), node, ctx,
+                         fam, pref)
+        elif isinstance(node, ast.JoinedStr) and node.values \
+                and isinstance(node.values[0], ast.Constant) \
+                and isinstance(node.values[0].value, str) \
+                and node.values[0].value.startswith(_MARK):
+            fam, pref = _classify(node.values[0].value[len(_MARK):],
+                                  complete=False)
+            yield _Claim(ctx.relpath, ctx.qualname(node), node, ctx,
+                         fam, pref)
+
+
+class PromFamilyRule(Rule):
+    id = "DS008"
+    name = "prometheus-family-uniqueness"
+    description = ("a Prometheus metric family's `# TYPE` metadata is "
+                   "emitted (or can be emitted) from more than one site "
+                   "— duplicate metadata makes the text parser reject "
+                   "the whole exposition")
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        claims: List[_Claim] = []
+        for ctx in project.files:
+            if ctx.relpath.startswith("tests/") or "/tests/" in ctx.relpath \
+                    or "tools/dslint" in ctx.relpath:
+                continue                # the lint tool names the pattern
+            claims.extend(_iter_claims(ctx))
+
+        findings: List[Finding] = []
+        concretes = [c for c in claims if c.family is not None]
+        prefixes = [c for c in claims if c.prefix is not None]
+
+        for c in prefixes:
+            if c.prefix == "":
+                findings.append(c.ctx.finding(
+                    self.id, c.node,
+                    "TYPE emission with no static family prefix — "
+                    "`f\"# TYPE {name} ...\"` claims every family and "
+                    "can collide with any other emission site; inline "
+                    "the namespace (`f\"# TYPE dstpu_xxx_{key} ...\"`)",
+                    token="prefix:"))
+
+        seen = {}
+        for c in concretes:
+            prior = seen.get(c.family)
+            if prior is not None and (prior.relpath, prior.node.lineno) \
+                    != (c.relpath, c.node.lineno):
+                findings.append(c.ctx.finding(
+                    self.id, c.node,
+                    f"family `{c.family}` TYPE metadata also emitted at "
+                    f"{prior.relpath}:{prior.node.lineno} — exactly one "
+                    f"emission site per family",
+                    token=f"dup:{c.family}"))
+            else:
+                seen[c.family] = c
+
+        for c in concretes:
+            for p in prefixes:
+                if p.prefix and c.family.startswith(p.prefix) \
+                        and (p.relpath, p.node.lineno) \
+                        != (c.relpath, c.node.lineno):
+                    findings.append(c.ctx.finding(
+                        self.id, c.node,
+                        f"family `{c.family}` lies inside the namespace "
+                        f"`{p.prefix}*` claimed by the dynamic TYPE "
+                        f"emission at {p.relpath}:{p.node.lineno} — one "
+                        f"key collision away from duplicate metadata; "
+                        f"route this family through that site (or move "
+                        f"it out of the namespace)",
+                        token=f"shadow:{c.family}"))
+
+        for i, a in enumerate(prefixes):
+            for b in prefixes[i + 1:]:
+                if not a.prefix or not b.prefix:
+                    continue        # empty prefixes already reported
+                if (a.relpath, a.qualname) == (b.relpath, b.qualname):
+                    continue        # same function keeps its keys disjoint
+                if a.prefix.startswith(b.prefix) \
+                        or b.prefix.startswith(a.prefix):
+                    findings.append(b.ctx.finding(
+                        self.id, b.node,
+                        f"dynamic TYPE namespaces overlap: `{b.prefix}*` "
+                        f"here vs `{a.prefix}*` at {a.relpath}:"
+                        f"{a.node.lineno} — two functions can emit the "
+                        f"same family's metadata",
+                        token=f"overlap:{b.prefix}"))
+        return findings

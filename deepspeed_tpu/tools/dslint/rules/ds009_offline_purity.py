@@ -1,0 +1,148 @@
+"""DS009 — offline purity, both directions, as lint.
+
+``hotpath.OFFLINE_ONLY_MODULES`` (the dstpu plan/trace analyzers) are
+stdlib-only by contract: they file-load standalone on jax-less hosts and
+replay whole dumps. Two invariants used to be pinned by scattered
+``-X importtime`` subprocess tests; this rule derives both from the
+module-level import graph the call-graph builder already indexes:
+
+* an OFFLINE_ONLY module must not reach ``jax``/``jaxlib`` through any
+  chain of module-level project imports (lazy function-level imports are
+  exactly the idiom that keeps a module pure, and are not in the graph);
+* no file containing hot-path code (a ``HOT_ROOTS`` file, or any file
+  with a function reachable from a root) may import an OFFLINE_ONLY
+  module at module level — the replay analyzers do unbounded host work
+  and must never ride a per-step import.
+
+A declared OFFLINE_ONLY path that no longer matches a module is drift
+and fires on ``hotpath.py`` itself, same as a rotted DS002 root.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from deepspeed_tpu.tools.dslint.callgraph import get_callgraph
+from deepspeed_tpu.tools.dslint.engine import (FileContext, Finding,
+                                               ProjectContext, Rule)
+from deepspeed_tpu.tools.dslint.hotpath import (ESCAPE_HATCHES, HOT_ROOTS,
+                                                OFFLINE_ONLY_MODULES)
+
+_DEVICE_RUNTIMES = ("jax", "jaxlib")
+_DECLARATION_FILE = "tools/dslint/hotpath.py"
+
+
+def _match_module(modules: Dict[str, object], path: str) -> Optional[str]:
+    if path in modules:
+        return path
+    for rel in modules:
+        if rel.endswith("/" + path) or path.endswith("/" + rel):
+            return rel
+    return None
+
+
+class OfflinePurityRule(Rule):
+    id = "DS009"
+    name = "offline-purity"
+    description = ("an OFFLINE_ONLY module reaches jax through its "
+                   "module-level import graph, or a hot-path file "
+                   "imports an OFFLINE_ONLY module")
+
+    def __init__(self, offline=OFFLINE_ONLY_MODULES, roots=HOT_ROOTS,
+                 hatches=ESCAPE_HATCHES):
+        self.offline = offline
+        self.roots = roots
+        self.hatches = hatches
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        graph = get_callgraph(project)
+        by_path: Dict[str, FileContext] = {f.relpath: f
+                                           for f in project.files}
+        findings: List[Finding] = []
+
+        offline_rels = []
+        for path in self.offline:
+            rel = _match_module(graph.modules, path)
+            if rel is not None:
+                offline_rels.append(rel)
+                continue
+            decl = next((c for r, c in by_path.items()
+                         if r.endswith(_DECLARATION_FILE)), None)
+            if decl is not None:
+                findings.append(decl.finding(
+                    self.id, decl.tree,
+                    f"offline-module drift: `{path}` in "
+                    f"OFFLINE_ONLY_MODULES matches no module — update "
+                    f"hotpath.py alongside the rename/removal",
+                    token=f"offline:{path}"))
+
+        # direction 1: offline modules must not reach a device runtime
+        for rel in offline_rels:
+            chain = self._runtime_chain(graph, rel)
+            ctx = by_path.get(rel)
+            if chain is None or ctx is None:
+                continue
+            via, runtime = chain
+            hop = via[1] if len(via) > 1 else rel
+            line = graph.modules[rel].import_lines.get(hop, 1)
+            route = " -> ".join(via + [runtime])
+            findings.append(ctx.finding(
+                self.id, ast.Pass(lineno=line, col_offset=0),
+                f"offline-only module imports `{runtime}` "
+                f"{'transitively ' if len(via) > 1 else ''}({route}) — "
+                f"the replay analyzers must stay loadable on jax-less "
+                f"hosts; make the import lazy or break the chain",
+                token=f"runtime:{runtime}"))
+
+        # direction 2: hot files must not import offline modules
+        hot_files = {r.path for r in self.roots}
+        root_keys = [k for k in (graph.resolve(r.path, r.qualname)
+                                 for r in self.roots) if k]
+        prune = {k for k in (graph.resolve(h.path, h.qualname)
+                             for h in self.hatches
+                             if h.mode == "prune") if k}
+        for key in graph.reachable_from(root_keys, prune=prune):
+            info = graph.functions.get(key)
+            if info is not None:
+                hot_files.add(info.relpath)
+        for hot in sorted(hot_files):
+            rel = _match_module(graph.modules, hot)
+            ctx = rel and by_path.get(rel)
+            if not ctx:
+                continue
+            mod = graph.modules[rel]
+            for off in offline_rels:
+                if off in mod.internal_imports:
+                    line = mod.import_lines.get(off, 1)
+                    findings.append(ctx.finding(
+                        self.id, ast.Pass(lineno=line, col_offset=0),
+                        f"hot-path file imports offline-only module "
+                        f"`{off}` at module level — the replay analyzer "
+                        f"must never ride a per-step path; use the lazy "
+                        f"package re-export or a function-level import",
+                        token=f"import:{off}"))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _runtime_chain(self, graph, start: str
+                       ) -> Optional[Tuple[List[str], str]]:
+        """BFS over module-level project imports; returns the module
+        chain from ``start`` to the first module that imports a device
+        runtime, plus the runtime name."""
+        pred: Dict[str, Optional[str]] = {start: None}
+        queue = [start]
+        while queue:
+            rel = queue.pop(0)
+            mod = graph.modules.get(rel)
+            if mod is None:
+                continue
+            for rt in _DEVICE_RUNTIMES:
+                if rt in mod.external_imports:
+                    chain = [rel]
+                    while pred[chain[-1]] is not None:
+                        chain.append(pred[chain[-1]])
+                    return list(reversed(chain)), rt
+            for nxt in sorted(mod.internal_imports):
+                if nxt not in pred:
+                    pred[nxt] = rel
+                    queue.append(nxt)
+        return None

@@ -82,3 +82,37 @@ def mesh_dp8():
     from deepspeed_tpu.comm.mesh import create_mesh
     from deepspeed_tpu.config.config import MeshConfig
     return create_mesh(MeshConfig(data=8))
+
+
+@pytest.fixture(scope="session")
+def package_callgraph():
+    """The dslint call graph over ``deepspeed_tpu/``, built ONCE per test
+    session — the lint-layer tests (hot-path coverage proofs, offline
+    purity, reachability assertions) all read from this instead of
+    re-parsing ~200 files each."""
+    import pathlib as _pathlib
+
+    from deepspeed_tpu.tools.dslint.callgraph import build_graph_from_sources
+    from deepspeed_tpu.tools.dslint.engine import iter_python_files
+
+    repo = _pathlib.Path(__file__).resolve().parent.parent
+    files = []
+    for p in iter_python_files([str(repo / "deepspeed_tpu")]):
+        rel = str(_pathlib.Path(p).relative_to(repo)).replace(os.sep, "/")
+        files.append((rel, _pathlib.Path(p).read_text(encoding="utf-8")))
+    # routes through the dslint snapshot cache: whichever of the engine
+    # rules / env_report / this fixture runs first pays for the one build
+    return build_graph_from_sources(files)
+
+
+@pytest.fixture(scope="session")
+def hot_reached(package_callgraph):
+    """Keys reachable from the declared DS002 hot roots (prune hatches
+    applied) — the taint closure the layer tests assert membership in."""
+    from deepspeed_tpu.tools.dslint.hotpath import ESCAPE_HATCHES, HOT_ROOTS
+    g = package_callgraph
+    roots = sorted(filter(None, (g.resolve(r.path, r.qualname)
+                                 for r in HOT_ROOTS)))
+    prune = {k for k in (g.resolve(h.path, h.qualname)
+                         for h in ESCAPE_HATCHES if h.mode == "prune") if k}
+    return set(g.reachable_from(roots, prune=prune))

@@ -1,4 +1,6 @@
-"""DS002 clean twin: same hot-path shape, readback only in the drain."""
+"""DS002 clean twin: same root/callee shape, no sync anywhere the taint
+reaches — queued device arrays, the guarded hatch syncs only on its
+fallback side, readback only in the sync_ok drain."""
 
 import jax
 
@@ -6,15 +8,18 @@ import jax
 class FakeEngine:
     def train_batch(self, batch):
         loss = self._fn(batch)
-        self.ring.append(loss)                   # device array, no transfer
+        self.record(loss)
+        self.note(loss)
         return loss
 
-    def record(self, out):
+    def record(self, out):                       # guarded hatch
         if self._async_enabled:
             self.ring.append(out)                # queued verbatim
+        else:
+            self.last = float(out)               # sync fallback: allowed
 
-    def helper(self, x):
-        return x
+    def note(self, x):
+        self.history.append(x)                   # device array, no transfer
 
     def drain(self):
         return jax.device_get(self.ring)         # THE designated drain

@@ -1,6 +1,7 @@
-"""DS002 fixture (linted with a spec naming FakeEngine's hot path):
-float() in the hot function, a transfer in the async-guarded branch, and
-device_get outside its confined functions — must fire for each."""
+"""DS002 fixture (linted with a HotRoot naming FakeEngine.train_batch):
+float() in the root itself, a transfer in the guarded hatch's async
+branch, and a .item() two call hops from the root — must fire for each.
+The designated drain (a sync_ok hatch) stays quiet."""
 
 import jax
 
@@ -8,14 +9,18 @@ import jax
 class FakeEngine:
     def train_batch(self, batch):
         loss = self._fn(batch)
-        return float(loss)                       # sync in hot path -> DS002
+        self.record(loss)
+        self.note(loss)
+        return float(loss)                       # sync in hot root -> DS002
 
-    def record(self, out):
+    def record(self, out):                       # guarded hatch
         if self._async_enabled:
             self.ring.append(jax.device_get(out))  # sync in async branch
+        else:
+            self.last = float(out)               # sync fallback: allowed
 
-    def helper(self, x):
-        return jax.device_get(x)                 # outside confine allowlist
+    def note(self, x):
+        self.history.append(x.item())            # two hops from the root
 
     def drain(self):
-        return jax.device_get(self.ring)         # the designated drain: ok
+        return jax.device_get(self.ring)         # sync_ok hatch: quiet

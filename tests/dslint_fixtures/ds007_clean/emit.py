@@ -1,0 +1,17 @@
+"""DS007 clean twin: every literal registered with a matching kind, the
+f-string head is a registered dynamic prefix, and a name the rule cannot
+resolve statically (a parameter) is skipped, never guessed."""
+
+_DRAIN = "engine/drain"
+
+
+class Engine:
+    def step(self, tracer):
+        with tracer.span("engine/train_step"):
+            pass
+        tracer.complete("engine/train_step", 0.1)
+        tracer.span(_DRAIN)
+
+    def op(self, tracer, op_name):
+        tracer.span(f"comm/{op_name}")           # registered dynamic head
+        tracer.span(op_name)                     # unresolvable: skipped

@@ -1,0 +1,7 @@
+"""Fixture-local trace-name registry for the clean twin."""
+
+TRACE_NAMES = {
+    "engine/train_step": ("span", "complete"),
+    "engine/drain": ("span",),
+}
+DYNAMIC_PREFIXES = ("comm/",)

@@ -1,0 +1,16 @@
+"""DS007 fixture: an unregistered literal, a registered name emitted as
+the wrong kind, a typo'd module-level constant, and an f-string whose
+head is not a registered dynamic prefix — must fire for each."""
+
+_DRAIN = "engine/dran"                           # typo: unregistered
+
+
+class Engine:
+    def step(self, tracer):
+        with tracer.span("engine/step"):         # unregistered -> DS007
+            pass
+        tracer.complete("engine/train_step", 0.1)  # wrong kind -> DS007
+        tracer.span(_DRAIN)                      # typo'd constant -> DS007
+
+    def gauge(self, tracer, kind):
+        tracer.counter(f"mem/{kind}_bytes", v=1)  # bad dynamic head -> DS007

@@ -1,0 +1,8 @@
+"""Fixture-local trace-name registry (found before the real one because
+it ends in ``telemetry/names.py`` inside the linted subtree)."""
+
+TRACE_NAMES = {
+    "engine/train_step": ("span",),
+    "engine/drain": ("span",),
+}
+DYNAMIC_PREFIXES = ("comm/",)

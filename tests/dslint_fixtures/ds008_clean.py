@@ -1,0 +1,17 @@
+"""DS008 clean twin: one emission site per family, every f-string claim
+scoped by an inlined namespace, and each prefix owned by exactly one
+function (its keys keep the families disjoint)."""
+
+
+class Metrics:
+    def render(self):
+        lines = ["# TYPE dstpu_fleet_requests counter"]
+        for key in self._gauges:
+            lines.append(f"# TYPE dstpu_fleet_gauge_{key} gauge")
+        return lines
+
+    def render_other(self):
+        out = []
+        for key in self._counters:
+            out.append(f"# TYPE dstpu_serving_{key} counter")
+        return out

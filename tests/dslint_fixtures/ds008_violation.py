@@ -1,0 +1,26 @@
+"""DS008 fixture: an unscoped TYPE f-string, the same concrete family
+claimed at two sites, a concrete family shadowed by a loop-generated
+prefix on a different line, and the same prefix claimed from two
+functions — must fire for each."""
+
+
+class Metrics:
+    def render(self):
+        lines = ["# TYPE dstpu_fleet_requests counter"]
+        for key in self._gauges:
+            # prefix claim dstpu_fleet_* shadows the concrete family above
+            lines.append(f"# TYPE dstpu_fleet_{key} gauge")
+        return lines
+
+    def render_dup(self, name):
+        return [
+            f"# TYPE {name} counter",            # unscoped claim -> DS008
+            "# TYPE dstpu_fleet_requests counter",   # duplicate family
+        ]
+
+    def render_other(self):
+        out = []
+        for key in self._counters:
+            # same dstpu_fleet_* prefix from a second function -> overlap
+            out.append(f"# TYPE dstpu_fleet_{key} counter")
+        return out

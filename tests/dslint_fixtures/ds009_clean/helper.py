@@ -1,0 +1,7 @@
+"""Clean twin helper: still imports the runtime (it is a device module)."""
+
+import jax
+
+
+def shape_of(x):
+    return jax.numpy.shape(x)

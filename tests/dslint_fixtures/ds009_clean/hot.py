@@ -1,0 +1,6 @@
+"""Clean twin hot root: no module-level edge to the offline module."""
+
+
+class Hot:
+    def step(self, batch):
+        return [t + 1 for t in batch]

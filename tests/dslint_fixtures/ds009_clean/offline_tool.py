@@ -1,0 +1,7 @@
+"""DS009 clean twin: the offline module defers the device-adjacent
+helper to a lazy in-function import — the offline-purity idiom."""
+
+
+def analyze(trace):
+    from ds009_clean import helper               # lazy: not in the graph
+    return helper.shape_of(trace)

@@ -1,0 +1,9 @@
+"""DS009 fixture, direction 2: a hot-root file imports the offline-only
+module at module level, paying its import cost on the hot path."""
+
+from ds009_violation import offline_tool
+
+
+class Hot:
+    def step(self, batch):
+        return offline_tool.analyze(batch)

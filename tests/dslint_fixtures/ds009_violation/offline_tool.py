@@ -1,0 +1,8 @@
+"""DS009 fixture: declared OFFLINE_ONLY, but a module-level import chain
+(offline_tool -> helper -> jax) reaches the device runtime."""
+
+from ds009_violation import helper
+
+
+def analyze(trace):
+    return helper.shape_of(trace)

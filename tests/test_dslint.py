@@ -12,7 +12,6 @@ Three layers:
     anywhere in the package fails tier-1
 """
 
-import dataclasses
 import json
 import pathlib
 import shutil
@@ -24,9 +23,11 @@ import pytest
 from deepspeed_tpu.tools.dslint import (get_rules, lint_paths, load_baseline,
                                         write_baseline)
 from deepspeed_tpu.tools.dslint.engine import LintEngine, parse_suppressions
-from deepspeed_tpu.tools.dslint.hotpath import HotPathSpec
+from deepspeed_tpu.tools.dslint.hotpath import EscapeHatch, HotRoot
 from deepspeed_tpu.tools.dslint.rules import ALL_RULES
 from deepspeed_tpu.tools.dslint.rules.ds002_hot_sync import HotPathSyncRule
+from deepspeed_tpu.tools.dslint.rules.ds009_offline_purity import \
+    OfflinePurityRule
 
 pytestmark = pytest.mark.lint
 
@@ -45,33 +46,51 @@ def _rules_of(result):
 # ----------------------------------------------------------------------
 # per-rule fixture pairs
 # ----------------------------------------------------------------------
-_DS002_SPEC = HotPathSpec(
-    path="{name}.py", cls="FakeEngine",
-    hot_functions=("train_batch",),
-    guard_branches=(("record", "_async_enabled"),),
-    confine={".device_get": ("drain",)})
-
-
 def _ds002_rules(name):
-    spec = dataclasses.replace(_DS002_SPEC, path=f"{name}.py")
-    return [HotPathSyncRule(specs=(spec,))]
+    """Taint-model DS002 pointed at the fixture: one hot root, a guarded
+    hatch on ``record``, the designated drain as ``sync_ok``."""
+    path = f"{name}.py"
+    return [HotPathSyncRule(
+        roots=(HotRoot(path=path, qualname="FakeEngine.train_batch",
+                       reason="fixture root"),),
+        hatches=(
+            EscapeHatch(path=path, qualname="FakeEngine.record",
+                        mode="guarded", guard_attr="_async_enabled",
+                        reason="fixture guarded hatch"),
+            EscapeHatch(path=path, qualname="FakeEngine.drain",
+                        mode="sync_ok", reason="fixture drain"),
+        ))]
+
+
+def _ds009_rules(name):
+    """Fixture-scoped offline/hot declarations for the purity rule."""
+    return [OfflinePurityRule(
+        offline=(f"{name}/offline_tool.py",),
+        roots=(HotRoot(path=f"{name}/hot.py", qualname="Hot.step",
+                       reason="fixture root"),),
+        hatches=())]
 
 
 @pytest.mark.parametrize("rule_id,min_findings", [
     ("DS001", 2), ("DS002", 3), ("DS003", 3), ("DS004", 2), ("DS005", 4),
-    ("DS006", 2),
+    ("DS006", 2), ("DS007", 4), ("DS008", 3), ("DS009", 2),
 ])
 def test_rule_fires_on_violation_and_not_on_clean(rule_id, min_findings):
     low = rule_id.lower()
-    if rule_id == "DS006":          # project-shaped fixture (dir with
-        bad = [FIXTURES / f"{low}_violation"]        # config/constants.py)
-        good = [FIXTURES / f"{low}_clean"]
-        kw_bad = kw_good = {}
-    elif rule_id == "DS002":        # registry-driven: point a spec at the
+    if rule_id in ("DS006", "DS007"):   # project-shaped fixtures (dirs:
+        bad = [FIXTURES / f"{low}_violation"]    # config/constants.py or
+        good = [FIXTURES / f"{low}_clean"]       # telemetry/names.py)
+        kw_bad = kw_good = {"select": [rule_id]}
+    elif rule_id == "DS002":        # registry-driven: point a root at the
         bad = [FIXTURES / f"{low}_violation.py"]     # fixture file
         good = [FIXTURES / f"{low}_clean.py"]
         kw_bad = {"rules": _ds002_rules(f"{low}_violation")}
         kw_good = {"rules": _ds002_rules(f"{low}_clean")}
+    elif rule_id == "DS009":        # declaration-driven like DS002
+        bad = [FIXTURES / f"{low}_violation"]
+        good = [FIXTURES / f"{low}_clean"]
+        kw_bad = {"rules": _ds009_rules(f"{low}_violation")}
+        kw_good = {"rules": _ds009_rules(f"{low}_clean")}
     else:
         bad = [FIXTURES / f"{low}_violation.py"]
         good = [FIXTURES / f"{low}_clean.py"]
@@ -89,6 +108,20 @@ def test_rule_fires_on_violation_and_not_on_clean(rule_id, min_findings):
         f"{[f.render() for f in quiet.findings]}")
 
 
+def test_renaming_an_emitted_span_trips_ds007(tmp_path):
+    """The exact drift DS007 exists for: rename the name at the emitter
+    only (registry untouched) and the clean fixture starts firing."""
+    work = tmp_path / "proj"
+    shutil.copytree(FIXTURES / "ds007_clean", work)
+    emit = work / "emit.py"
+    emit.write_text(emit.read_text().replace(
+        '"engine/train_step"', '"engine/training_step"'))
+    res = lint_paths([str(work)], root=str(tmp_path), select=["DS007"])
+    assert any(f.rule == "DS007" and "engine/training_step" in f.message
+               for f in res.findings), \
+        [f.render() for f in res.findings]
+
+
 def test_every_rule_has_fixture_pair():
     """A new rule cannot land without a fires/doesn't-fire pair."""
     for cls in ALL_RULES:
@@ -101,16 +134,34 @@ def test_every_rule_has_fixture_pair():
             f"rule {cls.id} has no fixture pair under tests/dslint_fixtures/")
 
 
-def test_ds002_registry_drift_is_a_finding(tmp_path):
-    """Renaming a registered hot function without updating the registry
-    must fire, not silently retire the tripwire."""
+def test_ds002_root_drift_is_a_finding(tmp_path):
+    """Renaming a registered hot root without updating hotpath.py must
+    fire, not silently retire the taint coverage."""
     f = tmp_path / "engine_like.py"
     f.write_text("class FakeEngine:\n    def renamed(self):\n        pass\n")
-    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
-                       hot_functions=("train_batch",))
+    root = HotRoot(path="engine_like.py",
+                   qualname="FakeEngine.train_batch", reason="t")
     res = lint_paths([str(f)], root=str(tmp_path),
-                     rules=[HotPathSyncRule(specs=(spec,))])
-    assert any("registry drift" in f.message for f in res.findings)
+                     rules=[HotPathSyncRule(roots=(root,), hatches=())])
+    assert any("hot-root drift" in f.message for f in res.findings)
+
+
+def test_ds002_hatch_drift_is_a_finding(tmp_path):
+    """An escape hatch pointing at a function that no longer exists is
+    drift too — a stale hatch must not silently widen or narrow."""
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "class FakeEngine:\n"
+        "    def train_batch(self, b):\n        return b\n")
+    root = HotRoot(path="engine_like.py",
+                   qualname="FakeEngine.train_batch", reason="t")
+    hatch = EscapeHatch(path="engine_like.py",
+                        qualname="FakeEngine.gone_drain",
+                        mode="sync_ok", reason="t")
+    res = lint_paths([str(f)], root=str(tmp_path),
+                     rules=[HotPathSyncRule(roots=(root,),
+                                            hatches=(hatch,))])
+    assert any("escape-hatch drift" in f.message for f in res.findings)
 
 
 # ----------------------------------------------------------------------
@@ -224,22 +275,29 @@ def test_parse_error_is_a_finding_and_never_grandfathered(tmp_path):
     assert load_baseline(str(bl))["entries"] == []
 
 
-def test_ds002_confine_covers_helper_classes(tmp_path):
-    """A second class in the same file cannot dodge the confinement net."""
+def test_ds002_taint_follows_calls_not_file_membership(tmp_path):
+    """The taint reaches a helper in ANOTHER class through a call edge,
+    and does NOT flag an identical sync in a function nothing hot calls
+    — coverage is the call graph, not file or class membership."""
     f = tmp_path / "engine_like.py"
     f.write_text(
         "import jax\n\n"
         "class FakeEngine:\n"
-        "    def drain(self):\n"
-        "        return jax.device_get(self.ring)\n\n"
+        "    def __init__(self):\n"
+        "        self.h = Helper()\n"
+        "    def train_batch(self, b):\n"
+        "        return self.h.peek()\n\n"
         "class Helper:\n"
         "    def peek(self):\n"
-        "        return jax.device_get(self.x)\n")
-    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
-                       confine={".device_get": ("drain",)})
+        "        return jax.device_get(self.x)   # reached: fires\n"
+        "    def cold_report(self):\n"
+        "        return jax.device_get(self.x)   # unreached: quiet\n")
+    root = HotRoot(path="engine_like.py",
+                   qualname="FakeEngine.train_batch", reason="t")
     res = lint_paths([str(f)], root=str(tmp_path),
-                     rules=[HotPathSyncRule(specs=(spec,))])
-    assert len(res.findings) == 1 and "peek" in res.findings[0].message
+                     rules=[HotPathSyncRule(roots=(root,), hatches=())])
+    assert len(res.findings) == 1, [x.render() for x in res.findings]
+    assert "peek" in res.findings[0].anchor
 
 
 def test_suppression_reaches_multiline_statement_continuation(tmp_path):
@@ -260,6 +318,18 @@ def test_suppression_reaches_multiline_statement_continuation(tmp_path):
     assert not res.findings and len(res.suppressed) == 1
 
 
+def _guarded_record_rule():
+    """A root that is ALSO its own guarded hatch (the FaultTolerantRunner
+    shape): the async side of the guard stays sync-free, the fallback
+    side is the designed sync path."""
+    return HotPathSyncRule(
+        roots=(HotRoot(path="engine_like.py",
+                       qualname="FakeEngine.record", reason="t"),),
+        hatches=(EscapeHatch(path="engine_like.py",
+                             qualname="FakeEngine.record", mode="guarded",
+                             guard_attr="_async_enabled", reason="t"),))
+
+
 def test_ds002_early_return_guard_still_scans_the_async_tail(tmp_path):
     """Refactoring the guard to early-return form must not retire the
     tripwire: the tail after `if not <guard>: ...; return` IS the async
@@ -273,10 +343,8 @@ def test_ds002_early_return_guard_still_scans_the_async_tail(tmp_path):
         "            self.last = float(out)    # sync fallback: allowed\n"
         "            return\n"
         "        self.ring.append(jax.device_get(out))  # async tail: fires\n")
-    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
-                       guard_branches=(("record", "_async_enabled"),))
     res = lint_paths([str(f)], root=str(tmp_path),
-                     rules=[HotPathSyncRule(specs=(spec,))])
+                     rules=[_guarded_record_rule()])
     assert len(res.findings) == 1
     assert ".device_get" in res.findings[0].message
 
@@ -313,12 +381,43 @@ def test_ds002_inverted_guard_checks_the_async_side(tmp_path):
         "            return float(out)      # sync fallback: allowed\n"
         "        else:\n"
         "            self.ring.append(jax.device_get(out))  # async: fires\n")
-    spec = HotPathSpec(path="engine_like.py", cls="FakeEngine",
-                       guard_branches=(("record", "_async_enabled"),))
     res = lint_paths([str(f)], root=str(tmp_path),
-                     rules=[HotPathSyncRule(specs=(spec,))])
+                     rules=[_guarded_record_rule()])
     assert len(res.findings) == 1
     assert ".device_get" in res.findings[0].message
+
+
+def test_cli_changed_mode_lints_changed_files_plus_reverse_deps(
+        tmp_path, capsys, monkeypatch):
+    """--changed lints exactly the git-diff subset plus files whose call/
+    import edges reach it — a seeded violation in the edited file fires,
+    and the caller file rides along as a reverse dep."""
+    from deepspeed_tpu.tools.dslint import cli
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                        *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    (tmp_path / "lib.py").write_text("def ok(x):\n    return x\n")
+    (tmp_path / "app.py").write_text(
+        "import lib\n\ndef run(x):\n    return lib.ok(x)\n")
+    (tmp_path / "lone.py").write_text("def solo():\n    return 1\n")
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+    # edit lib.py: the DS003 shape (array truthiness in an assert)
+    (tmp_path / "lib.py").write_text(
+        "import numpy as np\n\n"
+        "def ok(x):\n"
+        "    assert np.isfinite(x)\n"
+        "    return x\n")
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(["--changed", "HEAD", "--baseline", "none"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "1 changed file(s) + 1 reverse dep(s)" in out   # app, not lone
+    assert "lib.py" in out and "DS003" in out
 
 
 def test_cli_exit_codes_and_json(tmp_path):
@@ -357,6 +456,39 @@ def test_self_lint_package_clean_vs_baseline():
 
 
 def test_rule_count_matches_catalog():
-    assert len(get_rules()) >= 6
+    assert len(get_rules()) >= 9
     engine = LintEngine(get_rules())
     assert len(engine.rules) == len(ALL_RULES)
+
+
+def test_suppression_binds_through_decorator_stacks(tmp_path):
+    """A standalone disable above a decorator stack lexically binds to the
+    FIRST decorator line — it must still reach a finding anchored on a
+    LATER decorator of the same (async) def, which previously slipped
+    through because decorators are not simple statements."""
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "import jax\n\n"
+        "def deco(fn=None, **kw):\n"
+        "    return fn if fn is not None else deco\n\n"
+        "class FakeEngine:\n"
+        "    # dslint: disable=DS002 -- fixture: host scale, not an array\n"
+        "    @deco\n"
+        "    @deco(scale=float(3))\n"
+        "    async def train_batch(self, b):\n"
+        "        return b\n")
+    root = HotRoot(path="engine_like.py",
+                   qualname="FakeEngine.train_batch", reason="t")
+    res = lint_paths([str(f)], root=str(tmp_path),
+                     rules=[HotPathSyncRule(roots=(root,), hatches=())])
+    assert not res.findings, [x.render() for x in res.findings]
+    assert res.suppressed
+
+    # without the comment the decorator-line sink IS a finding (the
+    # suppression path above is exercised, not vacuous)
+    f.write_text(f.read_text().replace(
+        "    # dslint: disable=DS002 -- fixture: host scale, not an "
+        "array\n", ""))
+    res2 = lint_paths([str(f)], root=str(tmp_path),
+                      rules=[HotPathSyncRule(roots=(root,), hatches=())])
+    assert len(res2.findings) == 1

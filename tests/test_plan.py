@@ -581,20 +581,20 @@ def test_telemetry_package_lazy_attribution_reexport():
 
 
 def test_attribution_is_offline_only():
-    from deepspeed_tpu.tools.dslint.hotpath import (HOT_PATHS,
-                                                    OFFLINE_ONLY_MODULES)
+    """Both directions of the purity contract are now the DS009 lint
+    rule (transitive module-level import graph, not just direct imports)
+    — this test pins the declaration AND runs the real rule over the
+    package. One subprocess keep-alive remains above
+    (``test_plan_subcommand_never_imports_the_package``); the other
+    scattered ``-X importtime`` checks collapsed into this rule."""
+    from deepspeed_tpu.tools.dslint import lint_paths
+    from deepspeed_tpu.tools.dslint.hotpath import OFFLINE_ONLY_MODULES
+    from deepspeed_tpu.tools.dslint.rules.ds009_offline_purity import \
+        OfflinePurityRule
     assert "deepspeed_tpu/telemetry/attribution.py" in OFFLINE_ONLY_MODULES
-    for mod in OFFLINE_ONLY_MODULES:
-        # direction 1: the offline module never touches the device runtime
-        mods = _imports_of(os.path.join(REPO, mod))
-        assert not any(m == "jax" or m.startswith("jax.") for m in mods), \
-            f"{mod} imports jax — offline analyzers must not"
-        # direction 2: no registered hot-path file can reach it
-        needle = mod[:-3].replace("/", ".")
-        for spec in HOT_PATHS:
-            hot_mods = _imports_of(os.path.join(REPO, spec.path))
-            assert needle not in hot_mods, \
-                f"hot path {spec.path} imports offline-only {needle}"
+    res = lint_paths([os.path.join(REPO, "deepspeed_tpu")], root=REPO,
+                     rules=[OfflinePurityRule()])
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
 
 
 # ---------------------------------------------------------------------------
