@@ -71,6 +71,7 @@ def debug_report():
     rows.extend(plan_report())
     rows.extend(serve_plan_report())
     rows.extend(crossrank_report())
+    rows.extend(reqtrace_report())
     rows.extend(memory_report())
     rows.extend(serving_report())
     rows.extend(fleet_report())
@@ -352,6 +353,56 @@ def crossrank_report():
         return rows
     except Exception as e:   # the report must never die on tooling drift
         return [("cross-rank", f"unavailable ({e})")]
+
+
+def reqtrace_report():
+    """Per-request fleet-timeline status: the last ``dstpu reqtrace``
+    artifact ($DSTPU_REQTRACE_ARTIFACT or ./reqtrace.json — requests
+    stitched, orphan spans, flight dumps folded, worst tie-out error)
+    plus the dstpu_req_* SLO histogram family inventory — the
+    request-scoped counterpart of the cross-rank rows."""
+    import json
+    import os
+    rows = []
+    try:
+        from deepspeed_tpu.telemetry.reqtrace import (
+            DEFAULT_REQTRACE_ARTIFACT, REQTRACE_ARTIFACT_ENV,
+            TIE_OUT_TOLERANCE)
+        artifact = os.environ.get(REQTRACE_ARTIFACT_ENV) or (
+            DEFAULT_REQTRACE_ARTIFACT
+            if os.path.exists(DEFAULT_REQTRACE_ARTIFACT) else None)
+        if artifact and os.path.exists(artifact):
+            with open(artifact) as f:
+                rep = json.load(f)
+            err = rep.get("max_tie_out_error", 0.0)
+            verdict = (f"{err * 100:.2f}% max tie-out"
+                       + ("" if err <= TIE_OUT_TOLERANCE
+                          else f" (OVER {TIE_OUT_TOLERANCE * 100:.0f}%)"))
+            rows.append(("reqtrace",
+                         f"{artifact} ({rep.get('requests_stitched', 0)} "
+                         f"requests stitched from "
+                         f"{len(rep.get('sources', []))} dumps, "
+                         f"{rep.get('orphan_spans', 0)} orphan spans, "
+                         f"{rep.get('flight_dumps', 0)} flight dumps / "
+                         f"{rep.get('recovered_requests', 0)} requests "
+                         f"recovered, {verdict})"))
+        else:
+            rows.append(("reqtrace",
+                         "no artifact (bin/dstpu reqtrace router.json "
+                         "replica*.json flight_replica*.json --out "
+                         f"{DEFAULT_REQTRACE_ARTIFACT}, or set "
+                         f"${REQTRACE_ARTIFACT_ENV})"))
+        # the SLO histogram families /metrics exports (and bench_serve
+        # proves conservation over) — inventory, not live values
+        from deepspeed_tpu.serving.metrics import REQ_HIST_FAMILIES
+        rows.append(("slo histograms",
+                     f"{len(REQ_HIST_FAMILIES)} dstpu_req_* families ("
+                     + ", ".join(f.split("dstpu_req_")[1].rsplit(
+                         "_seconds", 1)[0]
+                         for f, _attr, _h in REQ_HIST_FAMILIES) + ")"))
+        return rows
+    except Exception as e:   # the report must never die on tooling drift
+        return [("reqtrace", f"unavailable ({e})")]
 
 
 def serving_report():

@@ -259,6 +259,12 @@ class ChaosMonkey:
                          "serve_slow_tick": 0, "serve_kv_pressure": 0,
                          "serve_poison": 0, "replica_kill": 0}
         self._serve_kv_pressure_on = False   # edge detector for the instant
+        # pre-SIGKILL hook (serving flight recorder): SIGKILL is
+        # uncatchable, so a replica's last chance to dump its black box is
+        # a synchronous callback BEFORE os.kill — registered by the
+        # serving layer, called with the due tick; its failure must never
+        # save the victim (the drill's contract is that the process dies)
+        self.on_replica_kill: Optional[callable] = None
 
     # ------------------------------------------------------------------
     def _roll(self, kind: str, step: int, salt: int = 0) -> float:
@@ -500,6 +506,12 @@ class ChaosMonkey:
         # the death from its broken streams + healthz, which is the drill
         get_tracer().instant("chaos/replica_kill", cat="resilience",
                              tick=tick, replica=rid)
+        if self.on_replica_kill is not None:
+            try:
+                self.on_replica_kill(tick)
+            except Exception:
+                logger.exception("chaos: pre-kill flight hook failed "
+                                 "(the kill proceeds regardless)")
         os.kill(os.getpid(), signal.SIGKILL)
 
     # ------------------------------------------------------------------

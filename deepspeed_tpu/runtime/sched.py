@@ -206,6 +206,10 @@ class TickLedger:
     measured maxima; cumulative totals keep running (every proof
     identity over them is conservation-shaped)."""
 
+    #: bounded per-request attribution table (serving runs indefinitely;
+    #: finished requests are popped, abandoned ones age out FIFO)
+    REQUEST_CAP = 4096
+
     def __init__(self):
         self.ticks = 0                    # observed (working) ticks
         self.prefill_ticks = 0            # ticks that ran >= 1 chunk
@@ -214,7 +218,22 @@ class TickLedger:
         self.chunks_total = 0
         self.decode_tokens_total = 0
         self.capped_chunk_ticks = 0       # prefill ticks bound by the cap
+        # uid -> {"ticks", "prefill_tokens", "chunks", "decode_tokens"}:
+        # which slice of the tick stream each request consumed — the
+        # wall-clock-free denominator the SLO layer states latencies in
+        # (ceil-div cap units via ``units()``)
+        self.request_ticks: Dict[int, Dict[str, int]] = {}
         self.reset_window()
+
+    @staticmethod
+    def units(tokens: int, unit_tokens: int) -> int:
+        """Ceil-div of a token count into ``unit_tokens``-sized scheduling
+        quanta — the ``max_decode_gap_ticks`` normalizer, exposed so the
+        SLO histograms can be fed in cap units instead of wall seconds
+        (deterministic across hosts; 0 when either operand is)."""
+        if unit_tokens <= 0 or tokens <= 0:
+            return 0
+        return -(-int(tokens) // int(unit_tokens))    # ceil div
 
     def reset_window(self) -> None:
         """Start the measured window: maxima reset, totals keep running."""
@@ -244,6 +263,30 @@ class TickLedger:
         if decode_tokens and prefill_tokens > self.max_decode_stall_tokens:
             self.max_decode_stall_tokens = prefill_tokens
 
+    def attribute_request(self, uid: int, prefill_tokens: int = 0,
+                          chunks: int = 0, decode_tokens: int = 0) -> None:
+        """Book one tick's work against the request that consumed it.
+        Called alongside ``observe_tick`` by callers that know the
+        per-request split (the serve loop's fan-out does); pure host int
+        arithmetic like everything else here."""
+        entry = self.request_ticks.get(uid)
+        if entry is None:
+            while len(self.request_ticks) >= self.REQUEST_CAP:
+                # FIFO age-out: dict preserves insertion order
+                self.request_ticks.pop(next(iter(self.request_ticks)))
+            entry = {"ticks": 0, "prefill_tokens": 0, "chunks": 0,
+                     "decode_tokens": 0}
+            self.request_ticks[uid] = entry
+        entry["ticks"] += 1
+        entry["prefill_tokens"] += int(prefill_tokens)
+        entry["chunks"] += int(chunks)
+        entry["decode_tokens"] += int(decode_tokens)
+
+    def pop_request(self, uid: int) -> Optional[Dict[str, int]]:
+        """Remove and return a finished request's attribution entry (None
+        when the request was never attributed or already aged out)."""
+        return self.request_ticks.pop(uid, None)
+
     def merge_from(self, other: "TickLedger") -> None:
         """Fold another ledger in (the disaggregated pair sums its role
         engines' ledgers into one proof set)."""
@@ -272,9 +315,7 @@ class TickLedger:
         overrides the normalizer so an uncapped baseline run can be
         stated in the SAME units as the capped run it is compared to."""
         unit = int(gap_unit_tokens or cap or 0)
-        gap = 0
-        if self.max_decode_stall_tokens > 0 and unit > 0:
-            gap = -(-self.max_decode_stall_tokens // unit)   # ceil div
+        gap = self.units(self.max_decode_stall_tokens, unit)
         util = 0.0
         if cap > 0 and self.window_prefill_ticks > 0:
             util = self.window_chunk_tokens / float(

@@ -47,8 +47,34 @@ import numpy as np
 from deepspeed_tpu.serving.request import RequestState
 from deepspeed_tpu.serving.server import (BackpressureError, InferenceServer,
                                           ServerClosedError)
+from deepspeed_tpu.telemetry import hist as dshist
 from deepspeed_tpu.telemetry.compiles import compiles_total
 from deepspeed_tpu.telemetry.tracer import _quantile, get_tracer
+
+
+def _slo_section(snapshots: List[Dict[str, dict]],
+                 pre_snapshots: List[Dict[str, dict]]) -> Dict[str, dict]:
+    """The SLO proof set: the deterministic ``dstpu_req_*`` log-bucket
+    histograms (``telemetry/hist.py``), folded across replicas and stated
+    as measured-window deltas (the warmed-run discipline every counter in
+    the report follows). Quantiles are bucket upper edges — exact and
+    platform-independent, unlike the wall-clock percentile sketches."""
+    merged: Dict[str, dshist.LogHistogram] = {}
+    for i, snap in enumerate(snapshots):
+        pre = pre_snapshots[i] if i < len(pre_snapshots) else {}
+        for family, h_snap in snap.items():
+            h = dshist.LogHistogram.from_snapshot(h_snap)
+            if family in pre:
+                h = h.delta_from(dshist.LogHistogram.from_snapshot(
+                    pre[family]))
+            if family in merged:
+                merged[family].merge(h)
+            else:
+                merged[family] = h
+    return {family: {"count": h.count, "sum_s": round(h.sum, 6),
+                     "p50_le_s": h.quantile(0.5),
+                     "p99_le_s": h.quantile(0.99)}
+            for family, h in merged.items()}
 
 
 @dataclasses.dataclass
@@ -350,6 +376,7 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
         # measured window only, like every other counter here
         server.engine.sched_mark()
     pre_snap = server.metrics.snapshot() if warmup else {}
+    pre_slo = server.metrics.slo_snapshot() if warmup else {}
     pre_prefix = (server.engine.prefix_stats()
                   if warmup and hasattr(server.engine, "prefix_stats")
                   else {})
@@ -468,6 +495,20 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
             sched["prefill_tokens_engine"] = computed
             sched["chunk_conservation_ok"] = \
                 sched["chunk_tokens_total"] == computed
+    # the SLO proof set + its conservation gate: every measured request
+    # that produced a first token lands in the TTFT histogram exactly
+    # once (on_finish observes iff first_token_ts is set, and the client
+    # record holds tokens iff one fanned out) — a mismatch means a
+    # request's latency escaped the SLO accounting
+    slo = _slo_section([server.metrics.slo_snapshot()], [pre_slo])
+    ttft_n = slo.get("dstpu_req_ttft_seconds", {}).get("count", 0)
+    first_token_requests = sum(
+        1 for rec in results.values() if rec.get("tokens"))
+    slo["conservation"] = {
+        "ttft_observations": ttft_n,
+        "first_token_requests": first_token_requests,
+        "ok": ttft_n == first_token_requests,
+    }
     # the atexit dump lands relative to THIS process's cwd — record it
     # absolute, or `dstpu plan --serve` would resolve a relative
     # DSTPU_TRACE against the report's directory instead
@@ -531,6 +572,7 @@ def run_scenario(server: InferenceServer, scenario: ServeScenario,
         # latency_from_trace + counters are measured-window only; the raw
         # "metrics" mirror (and its percentile sketches) stays cumulative
         "warmed": {"enabled": warmup, "requests": warm_requests},
+        "slo": slo,
         "scheduler": sched,
         "prefix": prefix,
         "kv_ledger": ledger,
@@ -664,6 +706,10 @@ def run_fleet_scenario(router, scenario: ServeScenario,
         for server, _fe in members:
             warm_scenario(server, scenario)
     c0 = router.counters_snapshot()
+    # always a delta (like the router counters above): a previous
+    # scenario on the same fleet must not leak into this proof set
+    pre_slo: List[Dict[str, dict]] = [
+        server.metrics.slo_snapshot() for server, _fe in members]
     pre_prefix: List[dict] = [
         server.engine.prefix_stats() if hasattr(server.engine,
                                                 "prefix_stats") else {}
@@ -726,6 +772,22 @@ def run_fleet_scenario(router, scenario: ServeScenario,
             prefix.get("prefill_tokens_saved", 0)
             + prefix.get("prefill_tokens_computed", 0)
             == prefix.get("prefill_tokens_total", 0))
+    # fleet SLO proof set: per-replica histograms folded counterwise
+    # (LogHistogram.merge — same fixed bounds everywhere). Conservation
+    # is a band, not a point: every router-completed request observed
+    # TTFT at exactly one replica, and each reroute may have added one
+    # extra observation at the abandoned replica before the failover
+    slo = _slo_section([server.metrics.slo_snapshot()
+                        for server, _fe in members], pre_slo)
+    ttft_n = slo.get("dstpu_req_ttft_seconds", {}).get("count", 0)
+    completed = counters.get("completed", 0)
+    slo["conservation"] = {
+        "ttft_observations": ttft_n,
+        "completed": completed,
+        "reroutes": counters.get("reroutes", 0),
+        "ok": (completed <= ttft_n
+               <= completed + counters.get("reroutes", 0)),
+    }
     health = router.health()
     prov = {
         "preset": scenario.name,
@@ -758,6 +820,7 @@ def run_fleet_scenario(router, scenario: ServeScenario,
             + counters.get("requests_lost", 0)
             + counters.get("client_errors", 0)
             == counters.get("submitted", 0)),
+        "slo": slo,
         "prefix": prefix,
         "replicas": health["replicas"],
     }
@@ -926,6 +989,14 @@ def main(argv=None) -> int:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    slo_cons = (report.get("slo") or {}).get("conservation") or {}
+    if slo_cons and not slo_cons.get("ok"):
+        # same explicit-check discipline as the --warm gate below: the
+        # SLO histograms must account for every completed request
+        print("dstpu_bench_serve: SLO conservation identity failed — "
+              f"{slo_cons} (a request's latency escaped the dstpu_req_* "
+              "histograms, or was double-counted)", file=sys.stderr)
+        return 1
     if args.warm:
         compiles = report["counters"].get("compiles_during_measurement", 0)
         if compiles != 0:

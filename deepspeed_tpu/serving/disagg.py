@@ -85,6 +85,10 @@ class DisaggregatedEngine:
         self.handoff_stats = {"handoffs": 0, "handoff_blocks": 0,
                               "handoff_bytes": 0, "handoff_raw_bytes": 0,
                               "handoff_deferred": 0}
+        # (uid, seconds) per completed handoff since the last drain — the
+        # serve loop pops these each tick and folds them into the SLO
+        # histograms (and the traced request's req/handoff span)
+        self._handoff_latencies: List[Tuple[int, float]] = []
 
     # -- pass-through config surfaces ----------------------------------
     @property
@@ -171,6 +175,11 @@ class DisaggregatedEngine:
             if seq.done or seq.in_prefill:
                 continue
             uid = seq.uid
+            # per-handoff latency window: demote -> adopt for this tick's
+            # attempt. A deferred handoff accrues only its successful
+            # retry tick's work — the wait between ticks is queue time,
+            # already visible as the gap before the handoff span.
+            t_h0 = time.perf_counter()
             if not seq.paused:
                 # freshly completed prefill (first token already sampled):
                 # gather+release its pages into the prefill engine's host
@@ -183,16 +192,27 @@ class DisaggregatedEngine:
                                             seq.generated, entry):
                 self.prefill.host_kv.pop(uid)
                 self.prefill.state.pop(uid)
+                lat_s = time.perf_counter() - t_h0
                 self.handoff_stats["handoffs"] += 1
                 self.handoff_stats["handoff_blocks"] += entry.blocks
                 self.handoff_stats["handoff_bytes"] += entry.nbytes
                 self.handoff_stats["handoff_raw_bytes"] += entry.raw_nbytes
+                self._handoff_latencies.append((uid, lat_s))
                 get_tracer().instant("disagg/handoff", cat="serve",
                                      uid=uid, blocks=entry.blocks,
                                      bytes=entry.nbytes,
                                      quantize=self.handoff_quantize)
             else:
                 self.handoff_stats["handoff_deferred"] += 1
+
+    def pop_handoff_latencies(self) -> List[Tuple[int, float]]:
+        """Drain the completed-handoff latencies accumulated since the
+        last call: ``[(uid, seconds), ...]``. The serve loop calls this
+        each tick to feed the handoff SLO histogram and, for traced
+        requests, the ``req/handoff`` span."""
+        out = self._handoff_latencies
+        self._handoff_latencies = []
+        return out
 
     # -- lifecycle -----------------------------------------------------
     def finish(self, uid: int) -> None:

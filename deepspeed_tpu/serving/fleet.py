@@ -56,12 +56,17 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.resilience.chaos import REPLICA_ID_ENV
 from deepspeed_tpu.serving import http_util
-from deepspeed_tpu.telemetry.tracer import get_tracer
+from deepspeed_tpu.telemetry.tracer import TRACE_ENV, get_tracer
 from deepspeed_tpu.utils.logging import logger
 
 #: status-artifact env var (elasticity.agent STATUS_ENV idiom): when set,
 #: the router keeps a JSON fleet summary at this path for env_report
 FLEET_STATUS_ENV = "DSTPU_FLEET_STATUS"
+
+#: flight-recorder directory (mirrors ``serving.server.FLIGHT_DIR_ENV``;
+#: the string is duplicated here because the router must never import
+#: the engine-owning module — a router host needs no accelerator runtime)
+FLIGHT_DIR_ENV = "DSTPU_FLIGHT_DIR"
 
 
 @dataclasses.dataclass
@@ -98,6 +103,7 @@ class FleetConfig:
     handoff_quantize: str = "int8"       # prefix-handoff page codec
     # --- observability ---
     status_path: str = ""                # "" -> $DSTPU_FLEET_STATUS if set
+    flight_dir: str = ""                 # "" -> $DSTPU_FLIGHT_DIR if set
     seed: int = 0                        # retry-jitter stream
 
     @classmethod
@@ -315,6 +321,9 @@ class FleetRouter:
         self._idle_polls = 0
         self._retiring = False
         self._handoff_dir = self.config.handoff_dir or None
+        # flight-recorder dumps already folded into the stitched timeline
+        # (each discovery announces itself exactly once)
+        self._flight_seen: set = set()
         self._retry_policy = http_util.RetryPolicy(
             max_attempts=max(self.config.retry_budget, 1),
             backoff_s=self.config.retry_backoff_s,
@@ -375,6 +384,12 @@ class FleetRouter:
                 except (ValueError, TypeError) as e:
                     self._json(400, {"error": f"bad request: {e!r}"})
                     return
+                # the propagation channel: a client-sent X-Dstpu-Trace
+                # header becomes the request's fleet-wide trace id (body
+                # field wins if both — it's the more deliberate one)
+                hdr_trace = self.headers.get("X-Dstpu-Trace")
+                if hdr_trace and not body.get("trace_id"):
+                    body["trace_id"] = hdr_trace
                 if body.get("stream"):
                     sink = _ChunkSink(self)
                     status, payload, headers = router.route_generate(
@@ -493,9 +508,16 @@ class FleetRouter:
         """Router counters + the fleet/ tracer tracks, one TYPE block per
         family (the metrics.py discipline)."""
         lines: List[str] = []
+        now = time.monotonic()
         with self._lock:
             counters = dict(self.counters)
             snaps = [h.snapshot() for h in self._handles.values()]
+            # healthz staleness: seconds since the OLDEST fresh poll over
+            # live replicas — the router's worst-case blind window. A
+            # climbing gauge means the poll loop is wedged or a replica
+            # stopped answering before being marked lost.
+            ages = [now - h.last_ok for h in self._handles.values()
+                    if h.alive and not h.lost and not h.retired]
         # ONE emission site for every dstpu_fleet_* family: the row list
         # can't claim a family twice (the gauge used to be a second
         # hand-emitted TYPE block inside the counter loop's namespace —
@@ -504,13 +526,43 @@ class FleetRouter:
         rows = [(k, "counter", counters[k]) for k in COUNTER_KEYS]
         rows.append(("replicas_in_rotation", "gauge",
                      sum(1 for s in snaps if s["in_rotation"])))
+        rows.append(("healthz_staleness", "gauge",
+                     round(max(ages), 6) if ages else 0.0))
         for key, kind, val in rows:
             lines.append(f"# TYPE dstpu_fleet_{key} {kind}")
             lines.append(f"dstpu_fleet_{key} {val}")
-        lines.extend(get_tracer().prometheus_lines(prefix=("fleet/",)))
+        lines.extend(get_tracer().prometheus_lines(prefix=("fleet/",
+                                                           "req/")))
         return "\n".join(lines) + "\n"
 
+    def discover_flight_dumps(self) -> List[str]:
+        """Scan the flight-recorder directory for dumps left behind by
+        dying/shedding replicas (``serving.server.flight_dump`` writes
+        ``flight_replica{rid}_{pid}.json`` atomically, so a file that
+        exists is complete). Each newly seen dump is announced once with
+        a ``fleet/flight_recovered`` instant — the router-side marker the
+        offline stitcher uses to fold the dump's ring into the per-request
+        timeline. Returns every dump currently on disk (sorted)."""
+        dirpath = self.config.flight_dir or os.environ.get(FLIGHT_DIR_ENV)
+        if not dirpath or not os.path.isdir(dirpath):
+            return []
+        try:
+            names = sorted(n for n in os.listdir(dirpath)
+                           if n.startswith("flight_replica")
+                           and n.endswith(".json"))
+        except OSError:
+            return []
+        paths = [os.path.join(dirpath, n) for n in names]
+        for p in paths:
+            if p not in self._flight_seen:
+                self._flight_seen.add(p)
+                get_tracer().instant("fleet/flight_recovered", cat="serve",
+                                     path=p)
+                logger.warning(f"fleet: recovered flight dump {p}")
+        return paths
+
     def _write_status(self) -> None:
+        flight_dumps = self.discover_flight_dumps()
         path = self.config.status_path or os.environ.get(FLEET_STATUS_ENV)
         if not path:
             return
@@ -518,6 +570,7 @@ class FleetRouter:
             doc = {"replicas": [h.snapshot()
                                 for h in self._handles.values()],
                    "counters": dict(self.counters),
+                   "flight_dumps": flight_dumps,
                    "updated": time.time()}
         tmp = f"{path}.tmp"
         try:
@@ -764,10 +817,16 @@ class FleetRouter:
                 self.counters["client_errors"] += 1
             return 400, {"error": "bad max_new_tokens"}, []
         uid = next(self._fleet_uid)
+        # fleet-wide trace id: accepted from the client (X-Dstpu-Trace /
+        # body), minted here otherwise. Propagated to every replica the
+        # request touches; the router's req/wall span below is the
+        # envelope the offline stitcher ties the replica phases against.
+        trace_id = str(body.get("trace_id") or f"r{os.getpid()}-{uid}")
+        wall_t0 = time.monotonic()
         key = (affinity_key(prompt, cfg.affinity_block_tokens)
                if cfg.affinity_enabled else None)
         entry = {"rerouted": 0, "recomputed_tokens": 0, "tokens": 0,
-                 "replicas": [], "state": "routing"}
+                 "replicas": [], "state": "routing", "trace_id": trace_id}
         with self._lock:
             self.counters["submitted"] += 1
             self.ledger[uid] = entry
@@ -788,6 +847,16 @@ class FleetRouter:
                 first_shed_counted = True
                 with self._lock:
                     self.counters["first_choice_sheds"] += 1
+
+        def finish_wall(outcome: str) -> None:
+            # the router-observed wall time for this request, start to
+            # terminal — the tie-out denominator: replica span sums plus
+            # router-attributed gaps (reroute backoffs) must account for
+            # this envelope within reqtrace's tolerance
+            get_tracer().complete("req/wall", time.monotonic() - wall_t0,
+                                  cat="serve", trace_id=trace_id, uid=uid,
+                                  outcome=outcome, tokens=len(sent),
+                                  replicas=list(entry["replicas"]))
 
         while True:
             with self._lock:
@@ -816,9 +885,11 @@ class FleetRouter:
                     with self._lock:
                         self.counters["client_sheds"] += 1
                     entry["state"] = "shed"
+                    finish_wall("shed")
                     return (429, {"uid": uid, "error": "fleet shedding",
                                   "retry_after_s": 1.0},
                             [("Retry-After", "1")])
+                finish_wall("lost")
                 return self._lose(uid, entry, sent,
                                   "no replicas in rotation")
             handle = self._handles.get(rid)
@@ -838,6 +909,7 @@ class FleetRouter:
                 entry["tokens"] = len(sent)
                 with self._lock:
                     self.counters["completed"] += 1
+                finish_wall("finished")
                 return 200, self._final(uid, entry, sent, rid,
                                         {"finish_reason": "length",
                                          "state": "finished"}), []
@@ -846,7 +918,8 @@ class FleetRouter:
             try:
                 kind, info = self._proxy_once(handle, prompt + sent,
                                               remaining, body, uid, sent,
-                                              started, emit, deadline)
+                                              started, emit, deadline,
+                                              trace_id)
             finally:
                 with self._lock:
                     handle.pending = max(0, handle.pending - 1)
@@ -861,11 +934,13 @@ class FleetRouter:
                 entry["tokens"] = len(sent)
                 with self._lock:
                     self.counters["completed"] += 1
+                finish_wall("finished")
                 return 200, self._final(uid, entry, sent, rid, info), []
             if kind == "client_error":
                 with self._lock:
                     self.counters["client_errors"] += 1
                 entry["state"] = "client_error"
+                finish_wall("client_error")
                 return 400, dict(info, uid=uid), []
             if kind == "shed":
                 # the replica's door 429'd a request the poll snapshot
@@ -876,6 +951,7 @@ class FleetRouter:
                     with self._lock:
                         self.counters["client_sheds"] += 1
                     entry["state"] = "shed"
+                    finish_wall("shed")
                     ra = info if isinstance(info, (int, float)) else 1.0
                     return (429, {"uid": uid, "error": "replica shedding",
                                   "retry_after_s": ra},
@@ -893,6 +969,7 @@ class FleetRouter:
             # kind == "died": transport death / mid-stream abort — the
             # zero-loss failover path
             if reroutes_left <= 0 or time.monotonic() >= deadline:
+                finish_wall("lost")
                 return self._lose(uid, entry, sent,
                                   f"retry budget exhausted after {info!r}")
             attempt = cfg.retry_budget - reroutes_left + 1
@@ -910,8 +987,16 @@ class FleetRouter:
                            f"{rid} with {len(sent)} tokens already "
                            f"streamed ({info!r})")
             tried.add(rid)
-            time.sleep(http_util.backoff_delay(self._retry_policy, attempt,
-                                               salt=uid))
+            delay = http_util.backoff_delay(self._retry_policy, attempt,
+                                            salt=uid)
+            time.sleep(delay)
+            # the reroute backoff is router-attributed time: it links the
+            # dying replica's spans to the survivor's in the stitched
+            # timeline AND accounts for the gap between them (tie-out)
+            get_tracer().complete("req/reroute", delay, cat="serve",
+                                  trace_id=trace_id, uid=uid,
+                                  from_replica=rid, sent=len(sent),
+                                  recompute=recompute)
             first_attempt = False
 
     def _lose(self, uid: int, entry: dict, sent: List[int],
@@ -930,6 +1015,7 @@ class FleetRouter:
                info: dict) -> dict:
         return {"uid": uid, "state": entry["state"],
                 "finish_reason": info.get("finish_reason"),
+                "trace_id": entry.get("trace_id"),
                 "replica_id": rid, "replicas": list(entry["replicas"]),
                 "rerouted": entry["rerouted"],
                 "recomputed_tokens": entry["recomputed_tokens"],
@@ -939,7 +1025,7 @@ class FleetRouter:
                     max_new: int, body: dict, uid: int, sent: List[int],
                     started: Callable[[], None],
                     emit: Callable[[int], None],
-                    deadline: float) -> Tuple[str, object]:
+                    deadline: float, trace_id: str) -> Tuple[str, object]:
         """One streamed attempt against one replica. The router ALWAYS
         streams internally — even for non-streaming clients — because the
         exact sent-token count is what makes failover lossless. Tokens
@@ -955,14 +1041,18 @@ class FleetRouter:
                    "stream": True, "priority": body.get("priority", 0),
                    # the dedupe uid: the submit may be retried because THIS
                    # id makes the retry safe to attribute
-                   "client_uid": uid}
+                   "client_uid": uid,
+                   # trace propagation rides the body too, for transports
+                   # that strip custom headers
+                   "trace_id": trace_id}
         if body.get("timeout_s") is not None:
             payload["timeout_s"] = body["timeout_s"]
         io_timeout = min(self.config.stream_read_timeout_s,
                          max(deadline - time.monotonic(), 0.05))
         try:
             reply = http_util.open_stream(handle.url + "/generate", payload,
-                                          timeout_s=io_timeout)
+                                          timeout_s=io_timeout,
+                                          headers={"X-Dstpu-Trace": trace_id})
         except Exception as e:
             return "died", repr(e)
         if reply.status == 429:
@@ -1058,6 +1148,19 @@ def subprocess_launcher(workdir: str, worker_args: Sequence[str] = (),
                *worker_args]
         env = dict(os.environ)
         env[REPLICA_ID_ENV] = str(rid)
+        # flight recorder: workers dump their ring + in-flight ledgers
+        # here on death/shed (an explicit $DSTPU_FLIGHT_DIR wins so
+        # drills can point the whole fleet at one directory)
+        env.setdefault(FLIGHT_DIR_ENV, workdir)
+        # $DSTPU_TRACE on the router would be inherited verbatim: every
+        # worker's atexit ring dump would clobber the same file (and the
+        # router's own dump). Derive a per-replica path instead — the
+        # survivor rings it produces are exactly what `dstpu reqtrace`
+        # stitches next to the router ring and the flight dumps.
+        trace_path = env.get(TRACE_ENV)
+        if trace_path:
+            base, ext = os.path.splitext(trace_path)
+            env[TRACE_ENV] = f"{base}_replica{rid}{ext or '.json'}"
         if resume:
             env["DSTPU_RESUME"] = "fleet-relaunch"
         else:
@@ -1123,6 +1226,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       scale_out_enabled=args.scale_out,
                       max_replicas=args.max_replicas,
                       handoff_dir=workdir,
+                      # workers flight-dump into the workdir by default
+                      # (subprocess_launcher's $DSTPU_FLIGHT_DIR
+                      # setdefault) — look for recoveries there unless
+                      # the operator pointed the fleet elsewhere
+                      flight_dir=os.environ.get(FLIGHT_DIR_ENV, workdir),
                       status_path=args.status_path)
     if args.replica_url:
         handles = [ReplicaHandle(i, u)

@@ -43,6 +43,7 @@ import json
 import os
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
@@ -69,6 +70,10 @@ class ServingFrontend:
         # fleet hook: called after an admin-initiated drain+retire
         # completes (the fleet worker exits its process there)
         self.on_retired: Optional[Callable[[], None]] = None
+        # monotonic stamp of the last /healthz poll: /healthz reports the
+        # gap since the PREVIOUS poll (last_poll_age_s) so the router's
+        # blind window between polls is measured, not assumed
+        self._last_healthz_mono: Optional[float] = None
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -94,7 +99,14 @@ class ServingFrontend:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    now = time.monotonic()
+                    prev = frontend._last_healthz_mono
+                    frontend._last_healthz_mono = now
                     h = frontend.serving.health()
+                    # seconds since the PREVIOUS poll (None on the first):
+                    # the router's own blind window, measured replica-side
+                    h["last_poll_age_s"] = (round(now - prev, 6)
+                                            if prev is not None else None)
                     self._json(200 if h["ok"] else 503, h)
                 elif self.path == "/metrics":
                     body = frontend.serving.metrics.prometheus_text().encode()
@@ -153,12 +165,18 @@ class ServingFrontend:
                     # TypeError: valid JSON that isn't an object
                     self._json(400, {"error": f"bad request: {e!r}"})
                     return
+                # trace-ID contract: the X-Dstpu-Trace header wins (the
+                # router's propagation channel); a body field is the
+                # fallback for clients that cannot set headers
+                trace_id = (self.headers.get("X-Dstpu-Trace")
+                            or body.get("trace_id"))
                 try:
                     req = frontend.serving.submit(
                         prompt,
                         max_new_tokens=body.get("max_new_tokens"),
                         timeout_s=body.get("timeout_s"),
-                        priority=body.get("priority", 0))
+                        priority=body.get("priority", 0),
+                        trace_id=trace_id)
                 except (TypeError, ValueError) as e:
                     # type-malformed payloads (non-list prompt, string
                     # max_new_tokens, ...) are client errors, not 500s

@@ -108,12 +108,15 @@ def _split(url: str) -> Tuple[str, int, str]:
 
 
 def _one_request(method: str, url: str, body: Optional[bytes],
-                 timeout_s: float) -> HttpReply:
+                 timeout_s: float,
+                 headers: Optional[Dict[str, str]] = None) -> HttpReply:
     host, port, path = _split(url)
     conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
     try:
-        headers = {"Content-Type": "application/json"} if body else {}
-        conn.request(method, path, body, headers)
+        hdrs = {"Content-Type": "application/json"} if body else {}
+        if headers:
+            hdrs.update(headers)
+        conn.request(method, path, body, hdrs)
         resp = conn.getresponse()
         data = resp.read()
         return HttpReply(resp.status,
@@ -126,7 +129,8 @@ def request_json(method: str, url: str, payload: Optional[dict] = None,
                  timeout_s: float = 5.0,
                  retry: Optional[RetryPolicy] = None,
                  retry_status: Tuple[int, ...] = (),
-                 idempotency_key: Optional[object] = None) -> HttpReply:
+                 idempotency_key: Optional[object] = None,
+                 headers: Optional[Dict[str, str]] = None) -> HttpReply:
     """One JSON request with bounded, classified retries.
 
     Transport failures retry only when ``classify_exception`` says
@@ -147,7 +151,8 @@ def request_json(method: str, url: str, payload: Optional[dict] = None,
     while True:
         attempt += 1
         try:
-            reply = _one_request(method, url, body, timeout_s)
+            reply = _one_request(method, url, body, timeout_s,
+                                 headers=headers)
         except Exception as e:
             outcome = classify_exception(e)
             if outcome is not CommOutcome.TRANSIENT or attempt >= attempts:
@@ -205,8 +210,8 @@ class StreamReply:
                 self._resp = None
 
 
-def open_stream(url: str, payload: dict,
-                timeout_s: float = 30.0) -> StreamReply:
+def open_stream(url: str, payload: dict, timeout_s: float = 30.0,
+                headers: Optional[Dict[str, str]] = None) -> StreamReply:
     """POST ``payload`` and return the streamed reply. ``timeout_s`` is
     the per-socket-read deadline (bounds both connect and every token
     wait). Raises on transport failure BEFORE a status line; after that,
@@ -214,9 +219,11 @@ def open_stream(url: str, payload: dict,
     host, port, path = _split(url)
     conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
     body = json.dumps(payload).encode()
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
     try:
-        conn.request("POST", path, body,
-                     {"Content-Type": "application/json"})
+        conn.request("POST", path, body, hdrs)
         resp = conn.getresponse()
     except Exception:
         conn.close()

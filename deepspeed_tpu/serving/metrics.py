@@ -9,14 +9,31 @@ front-end's ``/metrics`` endpoint.
 
 import collections
 import threading
-from typing import List
+from typing import Dict, List
 
 from deepspeed_tpu.monitor import Event
+from deepspeed_tpu.telemetry import hist as dshist
 from deepspeed_tpu.telemetry.tracer import get_tracer
 from deepspeed_tpu.utils.timer import RateTracker
 
 # bounded sample reservoirs: serving runs indefinitely, metric memory must not
 _SAMPLE_WINDOW = 1024
+
+#: the SLO histogram families this module exports on /metrics, as
+#: ``(family, attr, help)`` — one fixed-log-bucket histogram each
+#: (``telemetry.hist``), fed from monotonic-stamp differences only.
+#: bench_serve's proof set and env_report's inventory both derive from
+#: THIS tuple, so a new family can never reach /metrics unlisted.
+REQ_HIST_FAMILIES = (
+    ("dstpu_req_ttft_seconds", "hist_ttft",
+     "time to first token (from arrival, includes queue wait)"),
+    ("dstpu_req_tpot_seconds", "hist_tpot",
+     "time per output token (decode phase)"),
+    ("dstpu_req_queue_wait_seconds", "hist_queue_wait",
+     "admission queue wait"),
+    ("dstpu_req_handoff_seconds", "hist_handoff",
+     "prefill->decode KV handoff latency (role-split engines)"),
+)
 
 
 class _LatencyStat:
@@ -101,6 +118,12 @@ class ServingMetrics:
         self.ttft = _LatencyStat()
         self.tpot = _LatencyStat()
         self.queue_wait = _LatencyStat()
+        # SLO histograms (deterministic fixed log buckets; lifetime, not
+        # windowed — delta_from two slo_snapshot()s for a measured run)
+        self.hist_ttft = dshist.LogHistogram()
+        self.hist_tpot = dshist.LogHistogram()
+        self.hist_queue_wait = dshist.LogHistogram()
+        self.hist_handoff = dshist.LogHistogram()
         # gauges (set each serve-loop tick)
         self.queue_depth = 0
         self.inflight = 0
@@ -149,11 +172,20 @@ class ServingMetrics:
                 self.requests_failed += 1
             if req.queue_wait_s is not None:
                 self.queue_wait.add(req.queue_wait_s)
+                self.hist_queue_wait.observe(req.queue_wait_s)
             if req.ttft_s is not None:
                 self.ttft.add(req.ttft_s)
+                self.hist_ttft.observe(req.ttft_s)
             if req.tpot_s is not None:
                 self.tpot.add(req.tpot_s)
+                self.hist_tpot.observe(req.tpot_s)
         self.request_rate.add(1)
+
+    def on_handoff_latency(self, lat_s: float):
+        """Fold one completed prefill->decode KV handoff's latency in
+        (role-split engines; the serve loop drains these each tick)."""
+        with self._lock:
+            self.hist_handoff.observe(lat_s)
 
     def set_gauges(self, queue_depth: int, inflight: int, kv_occupancy: float):
         with self._lock:
@@ -309,6 +341,15 @@ class ServingMetrics:
                 "requests_per_sec": self.request_rate.rate(),
             }
 
+    def slo_snapshot(self) -> Dict[str, dict]:
+        """One consistent snapshot of every SLO histogram, keyed by its
+        /metrics family name — the bench_serve proof set. Diff two of
+        these (``LogHistogram.from_snapshot`` + ``delta_from``) for the
+        warmed-run window."""
+        with self._lock:
+            return {family: getattr(self, attr).snapshot()
+                    for family, attr, _help in REQ_HIST_FAMILIES}
+
     def events(self, step: int) -> List[Event]:
         """(tag, value, step) tuples for ``MonitorMaster.write_events``."""
         return [(f"serving/{k}", float(v), step)
@@ -356,6 +397,12 @@ class ServingMetrics:
                                  f"{stat.quantile(q):.9g}")
                 lines.append(f"{full}_sum {stat.sum:.9g}")
                 lines.append(f"{full}_count {stat.count}")
+            # SLO histograms: the dstpu_req_* namespace, one DS008-clean
+            # block per family (fixed log buckets -> per-replica pages
+            # merge counterwise into fleet-wide distributions)
+            for family, attr, help_text in REQ_HIST_FAMILIES:
+                lines.extend(dshist.prometheus_histogram_lines(
+                    family, getattr(self, attr), help_text=help_text))
         # every snapshot key renders except the latency aggregates (the
         # *_s keys), which are exposed as proper summaries above — derived
         # from the snapshot itself so a new counter/gauge can never be in

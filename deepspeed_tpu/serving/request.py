@@ -62,6 +62,16 @@ class Request:
         # (stamped by submit(); rides on the lifecycle retro-spans so the
         # serve plan reports latency tails per ladder level)
         self.ladder_level = "healthy"
+        # fleet-wide trace id (stamped by submit() from the router's
+        # X-Dstpu-Trace header). When set, the lifecycle retro-spans get
+        # req/* twins carrying it — the join key reqtrace.py stitches the
+        # router's and replicas' rings on. None (local callers) emits no
+        # req/ spans at all, so single-process rings are unchanged.
+        self.trace_id: Optional[str] = None
+        # TickLedger request attribution, settled at reap: which slice of
+        # the tick stream this request consumed (wall-clock-free; rides
+        # describe() into responses and flight-recorder ledgers)
+        self.sched_attribution: Optional[dict] = None
 
         # lifecycle timestamps (monotonic clock; durations only)
         self.arrival_ts = time.monotonic()
@@ -163,6 +173,33 @@ class Request:
                             tokens=len(self.tokens))
         tracer.instant(f"serve/{self.state.value}", cat="serve", tid=tid,
                        uid=self.uid, reason=self.finish_reason)
+        if self.trace_id is not None:
+            self._trace_req_spans(tracer, tid)
+
+    def _trace_req_spans(self, tracer, tid: int):
+        """The trace_id-scoped twins of the lifecycle spans: same clock,
+        same track, but named under ``req/`` and carrying the fleet-wide
+        trace id so the offline stitcher can join this replica's phases
+        with the router's ``req/wall`` envelope. Emitted ONLY for traced
+        (fleet-routed) requests — local submits leave the ring exactly as
+        it was before request tracing existed."""
+        trace_id = self.trace_id
+        if self.admit_ts is not None:
+            tracer.complete("req/queue", self.admit_ts - self.arrival_ts,
+                            cat="serve", end_ts=self.admit_ts, tid=tid,
+                            trace_id=trace_id, uid=self.uid)
+            if self.first_token_ts is not None:
+                tracer.complete("req/prefill",
+                                self.first_token_ts - self.admit_ts,
+                                cat="serve", end_ts=self.first_token_ts,
+                                tid=tid, trace_id=trace_id, uid=self.uid,
+                                prompt_tokens=len(self.prompt_tokens))
+        if self.first_token_ts is not None and self.finish_ts is not None:
+            tracer.complete("req/decode",
+                            self.finish_ts - self.first_token_ts,
+                            cat="serve", end_ts=self.finish_ts, tid=tid,
+                            trace_id=trace_id, uid=self.uid,
+                            tokens=len(self.tokens), state=self.state.value)
 
     # ---- derived metrics -------------------------------------------------
     @property
@@ -203,6 +240,10 @@ class Request:
         }
         if self.priority:
             out["priority"] = self.priority
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        if self.sched_attribution is not None:
+            out["sched_attribution"] = dict(self.sched_attribution)
         if self.fault_count:
             out["fault_count"] = self.fault_count
         if self.error is not None:

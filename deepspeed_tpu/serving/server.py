@@ -40,6 +40,7 @@ Serving under siege (this file + ``degradation.py`` + ``kv_tier.py``):
 
 import dataclasses
 import itertools
+import json
 import os
 import threading
 import time
@@ -57,13 +58,23 @@ from deepspeed_tpu.serving.kv_tier import (effective_usable_blocks,
                                            plan_promotions, tier_pressure)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState
-from deepspeed_tpu.telemetry.tracer import get_tracer
+from deepspeed_tpu.telemetry.tracer import get_tracer, request_tid
 from deepspeed_tpu.utils.logging import logger
 
 
 #: an un-trippable demote line for cache trims outside the offload tier
 #: (module-level so the hot tick never calls float() itself)
 _NO_DEMOTE_LINE = float("inf")
+
+#: flight-recorder directory (set by the fleet launcher on every replica
+#: worker): when present, a dying/shedding replica atomically dumps its
+#: trace ring + live per-request ledgers here (write-then-rename), so the
+#: router can fold the dump into the stitched request timeline post-mortem
+FLIGHT_DIR_ENV = "DSTPU_FLIGHT_DIR"
+
+#: throttle between shed-triggered flight dumps: a shedding replica 429s
+#: many requests per second and one black box per episode is the point
+FLIGHT_SHED_INTERVAL_S = 5.0
 
 #: the serving-tick stage clocks `dstpu plan --serve` attributes: the
 #: server times admission/demote/promote/drain segments itself, the engine
@@ -302,6 +313,13 @@ class InferenceServer:
         self._clean_steps = 0
         self._fault_episode = False            # read by health() under lock
         self._admitted_since_clean: List[int] = []
+        # flight recorder: last dump's monotonic stamp (shed throttle)
+        self._last_flight_dump: Optional[float] = None
+        if self.chaos is not None:
+            # SIGKILL is uncatchable, so the black box cannot be a signal
+            # handler: the chaos monkey exposes a pre-kill hook and the
+            # flight dump runs SYNCHRONOUSLY before os.kill fires
+            self.chaos.on_replica_kill = self._flight_on_kill
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -491,13 +509,16 @@ class InferenceServer:
     def submit(self, prompt_tokens: Sequence[int],
                max_new_tokens: Optional[int] = None,
                timeout_s: Optional[float] = None,
-               priority: int = 0) -> Request:
+               priority: int = 0,
+               trace_id: Optional[str] = None) -> Request:
         """Accept a request (thread-safe) or reject synchronously.
         Raises ``ServerClosedError`` when draining/stopped/degraded and
         ``BackpressureError`` when the ladder sheds, the queue is full, or
         the projected KV occupancy (both tiers) is over its limit.
         ``priority < 0`` marks low-priority work whose engine admission is
-        paused during brownout."""
+        paused during brownout. ``trace_id`` (the router's X-Dstpu-Trace
+        value) makes the request's lifecycle spans stitchable fleet-wide
+        (``req/`` twins carrying the id)."""
         cfg = self.config
         if max_new_tokens is None:
             max_new_tokens = cfg.default_max_new_tokens
@@ -523,6 +544,8 @@ class InferenceServer:
         # lifecycle retro-spans, so `dstpu plan --serve` can report
         # TTFT/TPOT per ladder level (healthy vs brownout tails)
         req.ladder_level = level.name.lower()
+        if trace_id is not None:
+            req.trace_id = str(trace_id)
         if not req.prompt_tokens:
             raise ValueError("empty prompt")
         max_ctx = self.engine.state.max_context_length
@@ -549,6 +572,12 @@ class InferenceServer:
                 self.metrics.on_shed()
                 get_tracer().instant("serve/backpressure", cat="serve",
                                      kind="shed")
+                # shed-to-429 is a flight-recorder trigger: the black box
+                # explains WHY clients got 429s (throttled — one dump per
+                # episode, not one per refused request)
+                self.flight_dump("shed",
+                                 min_interval_s=FLIGHT_SHED_INTERVAL_S,
+                                 _locked=True)
                 raise BackpressureError(
                     f"shedding load (pressure "
                     f"{self.ladder.last_pressure:.2f}); retry after "
@@ -665,6 +694,22 @@ class InferenceServer:
             except Exception as e:
                 raise _EngineStepError(str(e)) from e
             self.metrics.on_step()
+            # role-split engines time each prefill->decode KV handoff;
+            # drain those stamps into the SLO histogram every tick (plain
+            # float handover — no host sync, nothing when absent). Traced
+            # requests also get a req/handoff span here: the engine knows
+            # the uid, only the server knows the trace id.
+            pop_handoff = getattr(self.engine, "pop_handoff_latencies", None)
+            if pop_handoff is not None:
+                for uid, lat_s in pop_handoff():
+                    self.metrics.on_handoff_latency(lat_s)
+                    with self._lock:
+                        req = self._inflight.get(uid)
+                    if req is not None and req.trace_id is not None:
+                        get_tracer().complete(
+                            "req/handoff", lat_s, cat="serve",
+                            tid=request_tid(uid), trace_id=req.trace_id,
+                            uid=uid)
             self._note_clean_step()
             worked = True
             t0 = time.monotonic()
@@ -999,6 +1044,68 @@ class InferenceServer:
             # metrics.ladder_transitions ties out against ladder.transitions
             self.metrics.on_ladder_transition(*edge)
         self.metrics.on_degraded_latch()
+        # a latched replica leaves rotation for good: dump the black box
+        # BEFORE _fail_all clears the ledgers it records
+        self.flight_dump(f"degraded: {reason}")
+
+    # ------------------------------------------------------------------
+    # flight recorder (the serving black box)
+    # ------------------------------------------------------------------
+    def _flight_on_kill(self, tick: int) -> None:
+        """Pre-SIGKILL hook the chaos monkey calls synchronously — the
+        only moment this process can still explain itself."""
+        self.flight_dump(f"chaos_replica_kill@tick{tick}")
+
+    def flight_dump(self, reason: str, min_interval_s: float = 0.0,
+                    _locked: bool = False) -> Optional[str]:
+        """Atomically dump this replica's black box: the trace ring (a
+        Chrome dump, so reqtrace/crossrank load it like any other ring)
+        plus every live request's ledger under ``otherData.flight``.
+        Write-then-rename into ``$DSTPU_FLIGHT_DIR`` (the PR 17
+        status-artifact idiom) so the router only ever reads complete
+        dumps. No-op without the env var; ``min_interval_s`` throttles
+        repeat triggers (shed storms); ``_locked`` means the caller
+        already holds ``self._lock`` (the shed branch). Returns the dump
+        path, or None when disabled/throttled/failed."""
+        dirpath = os.environ.get(FLIGHT_DIR_ENV)
+        if not dirpath:
+            return None
+        now = time.monotonic()
+        if (min_interval_s > 0.0 and self._last_flight_dump is not None
+                and now - self._last_flight_dump < min_interval_s):
+            return None
+        self._last_flight_dump = now
+        tracer = get_tracer()
+        tracer.instant("serve/flight_dump", cat="serve", reason=reason,
+                       replica=self.replica_id, tick=self._tick)
+        if _locked:
+            inflight = [r.describe() for r in self._inflight.values()]
+            queued = [r.describe() for r in self._queue]
+        else:
+            with self._lock:
+                inflight = [r.describe() for r in self._inflight.values()]
+                queued = [r.describe() for r in self._queue]
+        doc = tracer.to_chrome()
+        doc.setdefault("otherData", {})["flight"] = {
+            "reason": reason,
+            "replica_id": self.replica_id,
+            "pid": os.getpid(),
+            "tick": self._tick,
+            "inflight": inflight,
+            "queued": queued,
+        }
+        path = os.path.join(
+            dirpath, f"flight_replica{self.replica_id}_{os.getpid()}.json")
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception(f"serve: flight dump to {path} failed")
+            return None
+        logger.warning(f"serve: flight recorder dumped ({reason}) -> {path}")
+        return path
 
     # ------------------------------------------------------------------
     # request-level fault isolation
@@ -1309,12 +1416,18 @@ class InferenceServer:
     def _fan_out(self, step_out: Dict[int, int]):
         now = time.monotonic()
         n = 0
+        ledger = getattr(self.engine, "sched_ledger", None)
         for uid, tok in step_out.items():
             req = self._inflight.get(uid)
             if req is None or req.state.terminal:
                 continue
             req.state = RequestState.DECODE
             req.push_token(int(tok), now=now)
+            if ledger is not None:
+                # book this tick's decode work against the request — the
+                # wall-clock-free per-request denominator (TickLedger
+                # request attribution; settled into describe() at reap)
+                ledger.attribute_request(uid, decode_tokens=1)
             n += 1
             seq = self.engine.state.get(uid)
             if seq is not None and seq.done:
@@ -1360,6 +1473,7 @@ class InferenceServer:
         reap AND the fault-eviction path (whose reap_finished() may flush
         OTHER done sequences too; dropping those uids would leak their
         requests in ``_inflight`` forever)."""
+        ledger = getattr(self.engine, "sched_ledger", None)
         for uid in reaped:
             with self._lock:
                 req = self._inflight.pop(uid, None)
@@ -1368,7 +1482,13 @@ class InferenceServer:
                 if uid in self._admitted_since_clean:
                     self._admitted_since_clean.remove(uid)
             if req is None:
+                if ledger is not None:
+                    ledger.pop_request(uid)
                 continue
+            if ledger is not None:
+                # settle the request's tick attribution (also bounds the
+                # ledger table: finished uids never linger there)
+                req.sched_attribution = ledger.pop_request(uid)
             if not req.state.terminal:
                 # engine marked it done (eos) but no token crossed this step
                 req.finalize(RequestState.FINISHED, "eos")

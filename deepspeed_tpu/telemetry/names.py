@@ -115,6 +115,14 @@ TRACE_NAMES: Dict[str, Tuple[str, ...]] = {
     "serve/quarantine": ("instant",),
     "serve/recovered": ("instant",),
     "serve/step_fault": ("instant",),
+    "serve/flight_dump": ("instant",),
+    # -- per-request tracing (trace_id-scoped; reqtrace.py stitches) -------
+    "req/queue": ("complete",),
+    "req/prefill": ("complete",),
+    "req/decode": ("complete",),
+    "req/handoff": ("complete",),
+    "req/reroute": ("complete",),
+    "req/wall": ("complete",),
     # -- disaggregated prefill/decode -------------------------------------
     "disagg/tick": ("complete",),
     "disagg/handoff": ("instant",),
@@ -131,6 +139,7 @@ TRACE_NAMES: Dict[str, Tuple[str, ...]] = {
     "fleet/retire": ("instant",),
     "fleet/scale_out": ("instant",),
     "fleet/spill": ("instant",),
+    "fleet/flight_recovered": ("instant",),
 }
 
 #: f-string names are allowed when their literal head starts with one of
@@ -173,4 +182,23 @@ SERVE_STAGE_OF: Dict[str, str] = {
     "serve/demote": "demote",
     "serve/promote": "promote",
     "serve/drain": "drain",
+}
+
+#: per-request tracing namespace (reqtrace.py file-loads this module
+#: standalone, same contract as the tables above). Spans carrying a
+#: ``trace_id`` arg under REQ_PREFIX are the stitch join; REQ_STAGE_OF
+#: maps each lifecycle span to its timeline stage; REQ_WALL_NAME is the
+#: router-side envelope every replica-side span must fit inside (the
+#: tie-out denominator); REQ_TRACE_ARG is the one arg key the join uses.
+REQ_PREFIX = "req/"
+REQ_TRACE_ARG = "trace_id"
+REQ_WALL_NAME = "req/wall"
+REQ_REROUTE_NAME = "req/reroute"
+REQ_HANDOFF_NAME = "req/handoff"
+REQ_STAGE_OF: Dict[str, str] = {
+    "req/queue": "queue",
+    "req/prefill": "prefill",
+    "req/decode": "decode",
+    "req/handoff": "handoff",
+    "req/reroute": "reroute",
 }

@@ -316,6 +316,20 @@ ESCAPE_HATCHES: Tuple[EscapeHatch, ...] = (
         mode="sync_ok",
         reason="flattens the host counter/gauge snapshot for export"),
     EscapeHatch(
+        path="deepspeed_tpu/telemetry/hist.py",
+        qualname="LogHistogram.observe",
+        mode="sync_ok",
+        reason="float() normalizes a host monotonic-stamp difference "
+               "into a bucket counter — the SLO histograms are fed "
+               "stdlib floats only, never device arrays"),
+    EscapeHatch(
+        path="deepspeed_tpu/telemetry/hist.py",
+        qualname="LogHistogram.bucket_index",
+        mode="sync_ok",
+        reason="the le-inclusive bucket scan over the same host float "
+               "(observe's callee; covered separately because sync_ok "
+               "does not exempt callees)"),
+    EscapeHatch(
         path="deepspeed_tpu/telemetry/tracer.py",
         qualname="Tracer.tail",
         mode="sync_ok",
@@ -351,4 +365,10 @@ OFFLINE_ONLY_MODULES: Tuple[str, ...] = (
     # plan --cross-rank`) — replays N whole dumps at once; strictly
     # offline, stdlib-only, jax-less-host loadable
     "deepspeed_tpu/telemetry/crossrank.py",
+    # the per-request fleet-timeline stitcher (`dstpu reqtrace`): joins
+    # router + replica + flight-recorder dumps on the trace id — whole-
+    # dump replay, interval sweeps, strictly offline. (telemetry/hist.py
+    # is deliberately NOT here: serving/metrics.py feeds its histograms
+    # on the serve path, so it lives under DS002 taint instead.)
+    "deepspeed_tpu/telemetry/reqtrace.py",
 )

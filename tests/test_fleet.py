@@ -777,7 +777,18 @@ def test_fleet_chaos_replica_kill_drill(tmp_path, monkeypatch):
     ZERO requests lost (every client holds its full token count),
     replica 1 lost exactly once, rerouted streams recomputed on the
     survivor, and the DSTPU_RESUME relaunch rejoins rotation (die-once
-    spares it)."""
+    spares it).
+
+    Doubles as the reqtrace acceptance: every client sends an
+    X-Dstpu-Trace header, the SIGKILLed replica leaves a flight-recorder
+    dump behind, and the router ring + flight dumps stitch into
+    per-request timelines whose tie-out holds."""
+    from deepspeed_tpu.telemetry import reqtrace
+    from deepspeed_tpu.telemetry.tracer import get_tracer
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.clear()          # stitch THIS drill's spans, not the module's
+    tracer.configure(enabled=True)
     monkeypatch.setenv("DSTPU_CHAOS_REPLICA_KILL", "1:4")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     workdir = str(tmp_path)
@@ -791,7 +802,7 @@ def test_fleet_chaos_replica_kill_drill(tmp_path, monkeypatch):
                       lost_after_s=0.5, retry_budget=3,
                       retry_backoff_s=0.01, retry_backoff_max_s=0.1,
                       relaunch_budget=1, affinity_enabled=False,
-                      request_timeout_s=240.0)
+                      request_timeout_s=240.0, flight_dir=workdir)
     router = FleetRouter(cfg, launcher=launcher).start()
     N, MAX_NEW = 12, 6
     results = {}
@@ -805,7 +816,8 @@ def test_fleet_chaos_replica_kill_drill(tmp_path, monkeypatch):
                 {"prompt_tokens": [(i * 7 + j) % 96 + 1
                                    for j in range(8 + i % 4)],
                  "max_new_tokens": MAX_NEW, "stream": True},
-                timeout_s=240.0)
+                timeout_s=240.0,
+                headers={"X-Dstpu-Trace": f"drill-{i}"})
             if reply.status != 200:
                 with lock:
                     results[i] = {"status": reply.status,
@@ -862,5 +874,43 @@ def test_fleet_chaos_replica_kill_drill(tmp_path, monkeypatch):
                 break
             time.sleep(0.25)
         assert rejoined, f"replica 1 never rejoined: {router.health()}"
+        # --- reqtrace acceptance: flight recorder + stitched timelines ---
+        # the client-sent trace id survives router -> replica -> final
+        for i, rec in sorted(results.items()):
+            assert rec["final"].get("trace_id") == f"drill-{i}", rec
+        # the SIGKILLed replica dumped its ring + in-flight ledger before
+        # dying (write-then-rename, so an existing file is complete)
+        flight_dumps = router.discover_flight_dumps()
+        assert any(os.path.basename(p).startswith("flight_replica1_")
+                   for p in flight_dumps), flight_dumps
+        # stitch the router's own ring with the recovered flight dumps
+        router_dump = os.path.join(workdir, "router_ring.json")
+        tracer.export_chrome(router_dump)
+        report = reqtrace.stitch_requests([router_dump] + flight_dumps)
+        assert report["alignment"] == "wall_anchor"
+        assert report["flight_dumps"] >= 1
+        # every drill request has a router wall envelope that closed
+        # "finished" — requests_lost == 0, seen end to end
+        for i in range(N):
+            t = report["traces"].get(f"drill-{i}")
+            assert t is not None, f"drill-{i} missing: {report['traces'].keys()}"
+            assert t["wall"]["outcome"] == "finished", (i, t["wall"])
+        # the tie-out invariant holds on a REAL two-process stitch
+        assert report["tie_out_violations"] == [], report
+        assert report["max_tie_out_error"] <= reqtrace.TIE_OUT_TOLERANCE
+        # the killed attempt is visible: flight ledger entries carry the
+        # drill trace ids, and the rerouted stream's timeline links the
+        # dead attempt to the survivor via req/reroute
+        recovered_ids = {e["trace_id"]
+                         for t in report["traces"].values()
+                         for e in t.get("recovered", [])}
+        assert any(tid.startswith("drill-") for tid in recovered_ids), \
+            report["recovered_requests"]
+        rerouted_ids = {r["final"]["trace_id"] for r in rerouted}
+        traced_reroutes = {tid for tid, t in report["traces"].items()
+                           if t["reroutes"] >= 1}
+        assert rerouted_ids <= traced_reroutes, (rerouted_ids,
+                                                 traced_reroutes)
     finally:
         router.stop()
+        tracer.configure(enabled=was_enabled)
